@@ -1,0 +1,63 @@
+"""orleans_tpu — a TPU-native distributed virtual-actor framework.
+
+A ground-up rebuild of the capabilities of Microsoft Orleans (reference:
+randa1/orleans) designed for TPU hardware: location-transparent grains with
+automatic activation and single-threaded turn semantics, typed RPC via grain
+references, a ring-partitioned grain directory, table-based membership with
+elastic recovery, pluggable persistence, durable reminders, and streams.
+
+Unlike the reference — which dispatches each message through sockets and a
+two-level thread scheduler (reference: src/OrleansRuntime/Core/Dispatcher.cs,
+src/OrleansRuntime/Scheduler/OrleansTaskScheduler.cs) — the hot data plane
+here is a *batched tick machine*: each tick's grain-to-grain messages are
+accumulated into sparse (src, dst, method, payload) tensors and all grain
+state transitions execute as JAX/XLA scatter-gather kernels over a
+`jax.sharding.Mesh` (directory placement == the mesh sharding map).
+
+Public API (mirrors the reference's `Orleans` namespace surface):
+
+    from orleans_tpu import Grain, grain_interface, Silo, GrainClient
+"""
+
+from orleans_tpu.ids import (
+    GrainId,
+    ActivationId,
+    SiloAddress,
+    ActivationAddress,
+    GrainType,
+)
+from orleans_tpu.core.grain import (
+    Grain,
+    StatefulGrain,
+    grain_interface,
+    grain_method,
+    read_only,
+    always_interleave,
+    reentrant,
+    stateless_worker,
+    one_way,
+)
+from orleans_tpu.core.context import RequestContext
+from orleans_tpu.codec import SerializationManager, Immutable
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "GrainId",
+    "ActivationId",
+    "SiloAddress",
+    "ActivationAddress",
+    "GrainType",
+    "Grain",
+    "StatefulGrain",
+    "grain_interface",
+    "grain_method",
+    "read_only",
+    "always_interleave",
+    "reentrant",
+    "stateless_worker",
+    "one_way",
+    "RequestContext",
+    "SerializationManager",
+    "Immutable",
+]

@@ -1,0 +1,610 @@
+"""Wire serialization: token-stream binary codec + deep copy.
+
+Parity with the reference's serialization subsystem (reference:
+src/Orleans/Serialization/SerializationManager.cs:47 — three-delegate model
+DeepCopier/Serializer/Deserializer per type, runtime registration :328,
+DeepCopy :850, Serialize :1052, Deserialize :1356;
+BinaryTokenStreamWriter.cs:41 / Reader.cs:42; SerializationTokenType.cs:26;
+IExternalSerializer.cs:36; fallback serializer = .NET BinaryFormatter).
+
+Design mapping to this build:
+
+* token-stream binary format with typed tokens, including first-class tokens
+  for GrainId / ActivationId / SiloAddress / ActivationAddress (the reference
+  assigns them token ids 40-43) and numpy arrays (the TPU-native addition —
+  payload tensors round-trip without boxing).
+* object-graph reference tracking: shared references and cycles serialize as
+  back-references (reference: SerializationContext record/check of offsets).
+* per-type registration of (serializer, deserializer, deep_copier); external
+  serializers may claim arbitrary types; the fallback is pickle (analog of
+  the reference's BinaryFormatter fallback).
+* ``deep_copy`` is the message-passing copy barrier: arguments crossing a
+  grain boundary in-process are deep-copied unless wrapped in ``Immutable``
+  (reference: Immutable.cs, SerializationManager.DeepCopy).
+
+Host-side only: this codec runs in the control plane and the client gateway.
+The device data plane never sees it — on-TPU payloads are fixed-layout
+arrays managed by the tensor engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import pickle
+import struct
+import uuid
+from enum import IntEnum
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+import numpy as np
+
+from orleans_tpu.ids import (
+    ActivationAddress,
+    ActivationId,
+    GrainCategory,
+    GrainId,
+    SiloAddress,
+)
+
+
+class Token(IntEnum):
+    """Wire tokens (reference: SerializationTokenType.cs:26)."""
+
+    NONE = 0
+    TRUE = 1
+    FALSE = 2
+    INT = 3            # varint zigzag
+    FLOAT = 4          # f64
+    STR = 5
+    BYTES = 6
+    LIST = 7
+    TUPLE = 8
+    DICT = 9
+    SET = 10
+    UUID = 11
+    FROZENSET = 13
+    COMPLEX = 12
+    BACKREF = 20       # reference to earlier object in this stream
+    REGISTERED = 30    # type registered with SerializationManager
+    EXTERNAL = 31      # claimed by an IExternalSerializer analog
+    FALLBACK = 32      # pickle fallback
+    # identity tokens — same ids as the reference (GrainId=40 ... =43)
+    GRAIN_ID = 40
+    ACTIVATION_ID = 41
+    SILO_ADDRESS = 42
+    ACTIVATION_ADDRESS = 43
+    NDARRAY = 50       # TPU-native: numpy array payloads
+    IMMUTABLE = 51
+
+
+class Immutable:
+    """Marks a value as safe to pass by reference across grain calls
+    (reference: Immutable.cs — skips the deep-copy barrier)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any):
+        self.value = value
+
+    def __repr__(self) -> str:
+        return f"Immutable({self.value!r})"
+
+
+class SerializationError(Exception):
+    pass
+
+
+class Writer:
+    """Binary token-stream writer (reference: BinaryTokenStreamWriter.cs:41)."""
+
+    def __init__(self) -> None:
+        self._buf = io.BytesIO()
+
+    def token(self, t: Token) -> None:
+        self._buf.write(bytes((int(t),)))
+
+    def varint(self, v: int) -> None:
+        # zigzag + LEB128 — arbitrary-precision ints supported.
+        z = ((-v) << 1) - 1 if v < 0 else (v << 1)
+        while True:
+            b = z & 0x7F
+            z >>= 7
+            if z:
+                self._buf.write(bytes((b | 0x80,)))
+            else:
+                self._buf.write(bytes((b,)))
+                break
+
+    def f64(self, v: float) -> None:
+        self._buf.write(struct.pack("<d", v))
+
+    def u64(self, v: int) -> None:
+        self._buf.write(struct.pack("<Q", v & 0xFFFFFFFFFFFFFFFF))
+
+    def raw(self, b: bytes) -> None:
+        self.varint(len(b))
+        self._buf.write(b)
+
+    def string(self, s: str) -> None:
+        self.raw(s.encode("utf-8"))
+
+    def getvalue(self) -> bytes:
+        return self._buf.getvalue()
+
+    def tell(self) -> int:
+        return self._buf.tell()
+
+
+class Reader:
+    """Binary token-stream reader (reference: BinaryTokenStreamReader.cs:42)."""
+
+    def __init__(self, data: bytes) -> None:
+        self._data = data
+        self._pos = 0
+
+    def token(self) -> Token:
+        t = Token(self._data[self._pos])
+        self._pos += 1
+        return t
+
+    def varint(self) -> int:
+        z = 0
+        shift = 0
+        while True:
+            b = self._data[self._pos]
+            self._pos += 1
+            z |= (b & 0x7F) << shift
+            shift += 7
+            if not (b & 0x80):
+                break
+        if z & 1:
+            return -((z + 1) >> 1)
+        return z >> 1
+
+    def f64(self) -> float:
+        v = struct.unpack_from("<d", self._data, self._pos)[0]
+        self._pos += 8
+        return v
+
+    def u64(self) -> int:
+        v = struct.unpack_from("<Q", self._data, self._pos)[0]
+        self._pos += 8
+        return v
+
+    def raw(self) -> bytes:
+        n = self.varint()
+        v = self._data[self._pos:self._pos + n]
+        self._pos += n
+        return v
+
+    def string(self) -> str:
+        return self.raw().decode("utf-8")
+
+    @property
+    def pos(self) -> int:
+        return self._pos
+
+
+class ExternalSerializer:
+    """Pluggable serializer claiming whole types
+    (reference: IExternalSerializer.cs:36; BondSerializer.cs:42)."""
+
+    def is_supported(self, t: Type) -> bool:
+        raise NotImplementedError
+
+    def serialize(self, obj: Any) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes) -> Any:
+        raise NotImplementedError
+
+    def deep_copy(self, obj: Any) -> Any:
+        return self.deserialize(self.serialize(obj))
+
+
+_Serializer = Callable[["SerializationManager", Any, Writer, dict], None]
+_Deserializer = Callable[["SerializationManager", Reader, dict], Any]
+_Copier = Callable[[Any], Any]
+
+
+class SerializationManager:
+    """Type registry + entry points (reference: SerializationManager.cs:47).
+
+    A process-wide singleton instance (``default_manager``) serves the
+    runtime; tests may instantiate isolated managers.
+    """
+
+    def __init__(self) -> None:
+        self._registered: Dict[str, Tuple[Type, _Serializer, _Deserializer, Optional[_Copier]]] = {}
+        self._by_type: Dict[Type, str] = {}
+        self._externals: list[ExternalSerializer] = []
+        self._allow_fallback = True
+
+    # -- registration (reference: SerializationManager.Register :328) -------
+
+    def register(self, cls: Type, name: Optional[str] = None,
+                 serializer: Optional[_Serializer] = None,
+                 deserializer: Optional[_Deserializer] = None,
+                 deep_copier: Optional[_Copier] = None) -> None:
+        name = name or f"{cls.__module__}.{cls.__qualname__}"
+        if serializer is None or deserializer is None:
+            if dataclasses.is_dataclass(cls):
+                serializer, deserializer = _dataclass_codec(cls)
+            else:
+                raise SerializationError(
+                    f"register({cls}): non-dataclass types need explicit "
+                    f"serializer/deserializer delegates")
+        self._registered[name] = (cls, serializer, deserializer, deep_copier)
+        self._by_type[cls] = name
+
+    def register_external(self, ext: ExternalSerializer) -> None:
+        self._externals.append(ext)
+
+    # -- serialize ----------------------------------------------------------
+
+    def serialize(self, obj: Any) -> bytes:
+        w = Writer()
+        self._write(obj, w, {"refs": {}})
+        return w.getvalue()
+
+    def deserialize(self, data: bytes) -> Any:
+        r = Reader(data)
+        return self._read(r, {"refs": {}})
+
+    def _write(self, obj: Any, w: Writer, ctx: dict) -> None:
+        # reference tracking for mutable containers / registered objects
+        if obj is None:
+            w.token(Token.NONE)
+            return
+        if obj is True:
+            w.token(Token.TRUE)
+            return
+        if obj is False:
+            w.token(Token.FALSE)
+            return
+        t = type(obj)
+        if t is int:
+            w.token(Token.INT)
+            w.varint(obj)
+            return
+        if t is float:
+            w.token(Token.FLOAT)
+            w.f64(obj)
+            return
+        if t is str:
+            w.token(Token.STR)
+            w.string(obj)
+            return
+        if t is bytes or t is bytearray:
+            w.token(Token.BYTES)
+            w.raw(bytes(obj))
+            return
+        if t is complex:
+            w.token(Token.COMPLEX)
+            w.f64(obj.real)
+            w.f64(obj.imag)
+            return
+        if t is uuid.UUID:
+            w.token(Token.UUID)
+            w.u64((obj.int >> 64) & 0xFFFFFFFFFFFFFFFF)
+            w.u64(obj.int & 0xFFFFFFFFFFFFFFFF)
+            return
+        if t is GrainId:
+            w.token(Token.GRAIN_ID)
+            w.varint(obj.type_code)
+            w.u64(obj.n0)
+            w.u64(obj.n1)
+            w.varint(int(obj.category))
+            if obj.key_ext is not None:
+                w.token(Token.TRUE)
+                w.string(obj.key_ext)
+            else:
+                w.token(Token.FALSE)
+            return
+        if t is ActivationId:
+            w.token(Token.ACTIVATION_ID)
+            w.u64(obj.n0)
+            w.u64(obj.n1)
+            return
+        if t is SiloAddress:
+            w.token(Token.SILO_ADDRESS)
+            w.string(obj.host)
+            w.varint(obj.port)
+            w.varint(obj.generation)
+            return
+        if t is ActivationAddress:
+            w.token(Token.ACTIVATION_ADDRESS)
+            self._write(obj.silo, w, ctx)
+            self._write(obj.grain, w, ctx)
+            self._write(obj.activation, w, ctx)
+            return
+        if t is Immutable:
+            w.token(Token.IMMUTABLE)
+            self._write(obj.value, w, ctx)
+            return
+        if isinstance(obj, np.ndarray):
+            w.token(Token.NDARRAY)
+            w.string(str(obj.dtype))
+            w.varint(obj.ndim)
+            for d in obj.shape:
+                w.varint(d)
+            w.raw(np.ascontiguousarray(obj).tobytes())
+            return
+
+        # -- mutable containers & objects: back-reference tracking ----------
+        oid = id(obj)
+        refs = ctx["refs"]
+        if oid in refs:
+            w.token(Token.BACKREF)
+            w.varint(refs[oid])
+            return
+
+        if t is list:
+            refs[oid] = len(refs)
+            w.token(Token.LIST)
+            w.varint(len(obj))
+            for item in obj:
+                self._write(item, w, ctx)
+            return
+        if t is tuple:
+            w.token(Token.TUPLE)
+            w.varint(len(obj))
+            for item in obj:
+                self._write(item, w, ctx)
+            return
+        if t is dict:
+            refs[oid] = len(refs)
+            w.token(Token.DICT)
+            w.varint(len(obj))
+            for k, v in obj.items():
+                self._write(k, w, ctx)
+                self._write(v, w, ctx)
+            return
+        if t is set:
+            refs[oid] = len(refs)
+            w.token(Token.SET)
+            w.varint(len(obj))
+            for item in obj:
+                self._write(item, w, ctx)
+            return
+        if t is frozenset:
+            w.token(Token.FROZENSET)
+            w.varint(len(obj))
+            for item in obj:
+                self._write(item, w, ctx)
+            return
+
+        name = self._by_type.get(t)
+        if name is not None:
+            refs[oid] = len(refs)
+            cls, ser, _, _ = self._registered[name]
+            w.token(Token.REGISTERED)
+            w.string(name)
+            ser(self, obj, w, ctx)
+            return
+
+        for i, ext in enumerate(self._externals):
+            if ext.is_supported(t):
+                refs[oid] = len(refs)
+                w.token(Token.EXTERNAL)
+                w.varint(i)
+                w.raw(ext.serialize(obj))
+                return
+
+        if not self._allow_fallback:
+            raise SerializationError(f"no serializer for {t}")
+        # pickle fallback (reference: BinaryFormatter fallback path)
+        refs[oid] = len(refs)
+        w.token(Token.FALLBACK)
+        w.raw(pickle.dumps(obj))
+
+    def _read(self, r: Reader, ctx: dict) -> Any:
+        refs = ctx["refs"]
+        t = r.token()
+        if t == Token.NONE:
+            return None
+        if t == Token.TRUE:
+            return True
+        if t == Token.FALSE:
+            return False
+        if t == Token.INT:
+            return r.varint()
+        if t == Token.FLOAT:
+            return r.f64()
+        if t == Token.STR:
+            return r.string()
+        if t == Token.BYTES:
+            return r.raw()
+        if t == Token.COMPLEX:
+            return complex(r.f64(), r.f64())
+        if t == Token.UUID:
+            hi = r.u64()
+            lo = r.u64()
+            return uuid.UUID(int=(hi << 64) | lo)
+        if t == Token.GRAIN_ID:
+            type_code = r.varint()
+            n0 = r.u64()
+            n1 = r.u64()
+            cat = GrainCategory(r.varint())
+            has_ext = r.token() == Token.TRUE
+            ext = r.string() if has_ext else None
+            return GrainId._intern(GrainId(type_code, n0, n1, cat, ext))
+        if t == Token.ACTIVATION_ID:
+            return ActivationId(r.u64(), r.u64())
+        if t == Token.SILO_ADDRESS:
+            return SiloAddress(r.string(), r.varint(), r.varint())
+        if t == Token.ACTIVATION_ADDRESS:
+            silo = self._read(r, ctx)
+            grain = self._read(r, ctx)
+            act = self._read(r, ctx)
+            return ActivationAddress(silo, grain, act)
+        if t == Token.IMMUTABLE:
+            return Immutable(self._read(r, ctx))
+        if t == Token.NDARRAY:
+            dtype = np.dtype(r.string())
+            ndim = r.varint()
+            shape = tuple(r.varint() for _ in range(ndim))
+            data = r.raw()
+            return np.frombuffer(bytes(data), dtype=dtype).reshape(shape).copy()
+        if t == Token.BACKREF:
+            return refs[r.varint()]
+        if t == Token.LIST:
+            out: list = []
+            refs[len(refs)] = out
+            n = r.varint()
+            for _ in range(n):
+                out.append(self._read(r, ctx))
+            return out
+        if t == Token.TUPLE:
+            n = r.varint()
+            return tuple(self._read(r, ctx) for _ in range(n))
+        if t == Token.DICT:
+            d: dict = {}
+            refs[len(refs)] = d
+            n = r.varint()
+            for _ in range(n):
+                k = self._read(r, ctx)
+                d[k] = self._read(r, ctx)
+            return d
+        if t == Token.SET:
+            slot = len(refs)
+            refs[slot] = None  # sets can't contain themselves; placeholder
+            n = r.varint()
+            s = {self._read(r, ctx) for _ in range(n)}
+            refs[slot] = s
+            return s
+        if t == Token.FROZENSET:
+            n = r.varint()
+            return frozenset(self._read(r, ctx) for _ in range(n))
+        if t == Token.REGISTERED:
+            name = r.string()
+            entry = self._registered.get(name)
+            if entry is None:
+                raise SerializationError(f"unknown registered type {name!r}")
+            _, _, deser, _ = entry
+            slot = len(refs)
+            refs[slot] = None
+            # Two-phase deserializers (the dataclass codec) call this to
+            # register the shell object before reading fields, so cyclic
+            # object graphs resolve back-references to the real object.
+            ctx["register_ref"] = lambda obj: refs.__setitem__(slot, obj)
+            obj = deser(self, r, ctx)
+            ctx.pop("register_ref", None)
+            refs[slot] = obj
+            return obj
+        if t == Token.EXTERNAL:
+            i = r.varint()
+            slot = len(refs)
+            refs[slot] = None
+            obj = self._externals[i].deserialize(bytes(r.raw()))
+            refs[slot] = obj
+            return obj
+        if t == Token.FALLBACK:
+            slot = len(refs)
+            refs[slot] = None
+            obj = pickle.loads(bytes(r.raw()))
+            refs[slot] = obj
+            return obj
+        raise SerializationError(f"unexpected token {t}")
+
+    # -- deep copy (reference: SerializationManager.DeepCopy :850) ----------
+
+    _SHALLOW_SAFE = (int, float, str, bytes, bool, type(None), complex,
+                     uuid.UUID, GrainId, ActivationId, SiloAddress,
+                     ActivationAddress, frozenset)
+
+    def deep_copy(self, obj: Any, _memo: Optional[dict] = None) -> Any:
+        """Copy barrier for in-process message passing.
+
+        ``Immutable``-wrapped values pass through by reference
+        (reference: Immutable.cs / SerializationManager.DeepCopyInner).
+        """
+        if isinstance(obj, self._SHALLOW_SAFE):
+            return obj
+        if isinstance(obj, Immutable):
+            return obj  # by-reference pass-through
+        memo = _memo if _memo is not None else {}
+        oid = id(obj)
+        if oid in memo:
+            return memo[oid]
+        t = type(obj)
+        if isinstance(obj, np.ndarray):
+            c = obj.copy()
+            memo[oid] = c
+            return c
+        if t is list:
+            c = []
+            memo[oid] = c
+            c.extend(self.deep_copy(x, memo) for x in obj)
+            return c
+        if t is tuple:
+            return tuple(self.deep_copy(x, memo) for x in obj)
+        if t is dict:
+            c = {}
+            memo[oid] = c
+            for k, v in obj.items():
+                c[self.deep_copy(k, memo)] = self.deep_copy(v, memo)
+            return c
+        if t is set:
+            c = {self.deep_copy(x, memo) for x in obj}
+            memo[oid] = c
+            return c
+        name = self._by_type.get(t)
+        if name is not None:
+            _, _, _, copier = self._registered[name]
+            if copier is not None:
+                c = copier(obj)
+                memo[oid] = c
+                return c
+        for ext in self._externals:
+            if ext.is_supported(t):
+                c = ext.deep_copy(obj)
+                memo[oid] = c
+                return c
+        # jax arrays are immutable — pass through without device round-trip
+        if t.__module__.startswith("jax") or "ArrayImpl" in t.__name__:
+            return obj
+        # round-trip through the codec (correct for cycles via stream refs)
+        c = self.deserialize(self.serialize(obj))
+        memo[oid] = c
+        return c
+
+
+def _dataclass_codec(cls: Type) -> Tuple[_Serializer, _Deserializer]:
+    fields = [f.name for f in dataclasses.fields(cls)]
+
+    def ser(mgr: SerializationManager, obj: Any, w: Writer, ctx: dict) -> None:
+        for fname in fields:
+            mgr._write(getattr(obj, fname), w, ctx)
+
+    def deser(mgr: SerializationManager, r: Reader, ctx: dict) -> Any:
+        # two-phase: register the shell before reading fields so cyclic
+        # graphs (obj.field → obj) resolve back-references correctly
+        obj = object.__new__(cls)
+        register = ctx.pop("register_ref", None)
+        if register is not None:
+            register(obj)
+        for fname in fields:
+            object.__setattr__(obj, fname, mgr._read(r, ctx))
+        post = getattr(obj, "__post_init__", None)
+        if post is not None:
+            import inspect
+            if not any(p.default is inspect.Parameter.empty
+                       for p in inspect.signature(post).parameters.values()):
+                post()  # InitVar-taking __post_init__ can't be replayed
+        return obj
+
+    return ser, deser
+
+
+# Process-wide default (reference: SerializationManager static surface).
+default_manager = SerializationManager()
+
+
+def serializable(cls: Type) -> Type:
+    """Class decorator: register a dataclass with the default manager
+    (replaces the reference's Roslyn-generated per-type serializers,
+    reference: SerializerGenerator.cs:49)."""
+    default_manager.register(cls)
+    return cls

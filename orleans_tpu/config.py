@@ -1,0 +1,99 @@
+"""Cluster / silo / client configuration.
+
+Parity: reference configuration system (reference: src/Orleans/Configuration/
+ClusterConfiguration.cs, GlobalConfiguration.cs — liveness :149-194,
+directory cache :247-275, placement defaults :353-357; NodeConfiguration.cs;
+ClientConfiguration.cs; LimitManager.cs:34).  XML loading is replaced by
+plain dataclasses + ``from_dict`` (programmatic construction was equally
+supported in the reference and is what its test host used).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class LivenessConfig:
+    """(reference: GlobalConfiguration liveness section :149-194)"""
+
+    probe_timeout: float = 0.5            # ProbeTimeout
+    table_refresh_timeout: float = 5.0    # TableRefreshTimeout
+    death_vote_expiration: float = 120.0  # DeathVoteExpirationTimeout
+    iam_alive_table_publish: float = 5.0  # IAmAliveTablePublishTimeout
+    num_missed_probes_limit: int = 3      # NumMissedProbesLimit
+    num_probed_silos: int = 3             # NumProbedSilos
+    num_votes_for_death: int = 2          # NumVotesForDeathDeclaration
+    probe_period: float = 1.0
+
+
+@dataclass
+class DirectoryConfig:
+    """(reference: GlobalConfiguration directory cache section :247-275)"""
+
+    cache_size: int = 100_000
+    buckets_per_silo: int = 30            # virtual-bucket ring
+
+
+@dataclass
+class CollectionConfig:
+    collection_quantum: float = 60.0      # ActivationCollector quantum
+    default_age_limit: float = 7200.0     # DefaultCollectionAgeLimit (2h)
+
+
+@dataclass
+class MessagingConfig:
+    response_timeout: float = 30.0        # ResponseTimeout
+    max_forward_count: int = 2            # MaxForwardCount
+    max_resend_count: int = 3             # MaxResendCount
+    deadlock_detection: bool = True       # PerformDeadlockDetection
+    max_enqueued_requests: int = 5000     # LimitManager MaxEnqueuedRequests
+
+
+@dataclass
+class TensorEngineConfig:
+    """TPU data-plane knobs (no reference analog — this is the rebuild's
+    batched dispatch engine)."""
+
+    enabled: bool = True
+    tick_interval: float = 0.001          # min seconds between ticks
+    max_rounds_per_tick: int = 4          # intra-tick call-chain rounds
+    bucket_sizes: tuple = (256, 4096, 65536, 1 << 20)  # padded batch buckets
+    mesh_axis: str = "grains"
+
+
+@dataclass
+class SiloConfig:
+    name: str = "silo"
+    liveness: LivenessConfig = field(default_factory=LivenessConfig)
+    directory: DirectoryConfig = field(default_factory=DirectoryConfig)
+    collection: CollectionConfig = field(default_factory=CollectionConfig)
+    messaging: MessagingConfig = field(default_factory=MessagingConfig)
+    tensor: TensorEngineConfig = field(default_factory=TensorEngineConfig)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SiloConfig":
+        import typing
+        hints = typing.get_type_hints(cls)  # resolve string annotations
+        kwargs: Dict[str, Any] = {}
+        for f in dataclasses.fields(cls):
+            if f.name not in d:
+                continue
+            v = d[f.name]
+            ftype = hints.get(f.name, f.type)
+            if dataclasses.is_dataclass(ftype) and isinstance(v, dict):
+                kwargs[f.name] = ftype(**v)
+            else:
+                kwargs[f.name] = v
+        return cls(**kwargs)
+
+
+@dataclass
+class ClientConfig:
+    """(reference: ClientConfiguration.cs)"""
+
+    response_timeout: float = 30.0
+    gateway_list: list = field(default_factory=list)

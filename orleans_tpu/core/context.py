@@ -1,0 +1,94 @@
+"""Ambient request context + call-chain capture.
+
+Parity: the reference flows an implicit key-value dictionary with every
+request (reference: src/Orleans/RequestContext.cs:53 — Export :150 /
+Import :125) plus the invocation history used for deadlock detection
+(reference: RequestInvocationHistory.cs; InsideGrainClient.cs:452-467).
+
+Here the ambient store is a ``contextvars.ContextVar`` — asyncio tasks
+inherit it automatically, which is exactly the "flows with the logical call"
+semantic the reference implements by hand over its custom scheduler.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from orleans_tpu.ids import ActivationId, GrainId
+
+_request_context: contextvars.ContextVar[Optional[Dict[str, Any]]] = \
+    contextvars.ContextVar("orleans_request_context", default=None)
+
+# The call chain of the currently-executing request: list of grain ids from
+# the original caller down to the current activation.  Used by the
+# dispatcher's deadlock detector (reference: Dispatcher.CheckDeadlock :345).
+_call_chain: contextvars.ContextVar[Tuple[GrainId, ...]] = \
+    contextvars.ContextVar("orleans_call_chain", default=())
+
+
+class RequestContext:
+    """Static-style API matching the reference's RequestContext."""
+
+    @staticmethod
+    def get(key: str, default: Any = None) -> Any:
+        ctx = _request_context.get()
+        return default if ctx is None else ctx.get(key, default)
+
+    @staticmethod
+    def set(key: str, value: Any) -> None:
+        ctx = _request_context.get()
+        ctx = dict(ctx) if ctx else {}
+        ctx[key] = value
+        _request_context.set(ctx)
+
+    @staticmethod
+    def remove(key: str) -> None:
+        ctx = _request_context.get()
+        if ctx and key in ctx:
+            ctx = dict(ctx)
+            del ctx[key]
+            _request_context.set(ctx or None)
+
+    @staticmethod
+    def clear() -> None:
+        _request_context.set(None)
+
+    # -- wire import/export (reference: Export :150 / Import :125) ----------
+
+    @staticmethod
+    def export() -> Optional[Dict[str, Any]]:
+        ctx = _request_context.get()
+        return dict(ctx) if ctx else None
+
+    @staticmethod
+    def import_(data: Optional[Dict[str, Any]]) -> None:
+        _request_context.set(dict(data) if data else None)
+
+
+def current_call_chain() -> Tuple[GrainId, ...]:
+    return _call_chain.get()
+
+
+def set_call_chain(chain: Tuple[GrainId, ...]) -> None:
+    _call_chain.set(chain)
+
+
+# -- current activation (reference: RuntimeContext.Current) -----------------
+
+_current_activation: contextvars.ContextVar[Any] = \
+    contextvars.ContextVar("orleans_current_activation", default=None)
+
+
+def current_activation() -> Any:
+    """The ActivationData whose turn is currently executing, if any."""
+    return _current_activation.get()
+
+
+def set_current_activation(act: Any) -> contextvars.Token:
+    return _current_activation.set(act)
+
+
+def reset_current_activation(token: contextvars.Token) -> None:
+    _current_activation.reset(token)

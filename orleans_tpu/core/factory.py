@@ -1,0 +1,38 @@
+"""GrainFactory: typed references from (interface, key).
+
+Parity: reference GrainFactory (reference: src/Orleans/GrainFactory.cs:40 —
+GetGrain overloads :92-167, Cast :273).  The Cast operation is the
+``as_interface`` method (re-typing a reference to another interface the
+grain class implements).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Union
+
+from orleans_tpu.core.grain import get_interface, grain_id_for
+from orleans_tpu.core.reference import GrainReference
+from orleans_tpu.ids import GrainId
+
+
+class GrainFactory:
+
+    def get_grain(self, interface, key: Union[int, str, uuid.UUID]
+                  ) -> GrainReference:
+        """(reference: GrainFactory.GetGrain<T>(key) :92-167)"""
+        iface = get_interface(interface)
+        grain_id = grain_id_for(interface, key)
+        return GrainReference(grain_id, iface.interface_id)
+
+    def get_grain_by_id(self, interface, grain_id: GrainId) -> GrainReference:
+        iface = get_interface(interface)
+        return GrainReference(grain_id, iface.interface_id)
+
+    def as_interface(self, ref: GrainReference, interface) -> GrainReference:
+        """Re-type a reference (reference: GrainFactory.Cast :273)."""
+        iface = get_interface(interface)
+        return GrainReference(ref.grain_id, iface.interface_id)
+
+
+factory = GrainFactory()

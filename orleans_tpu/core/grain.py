@@ -1,0 +1,423 @@
+"""The grain programming model: interfaces, base classes, attributes.
+
+Parity with the reference's L5 public API:
+
+* ``grain_interface`` replaces marker interfaces + Roslyn codegen
+  (reference: src/Orleans/Core/IGrain.cs; CodeGeneration/
+  GrainInterfaceData — interface ids, method ids).  Python introspection
+  builds the typed method table at class-definition time; the "invoker"
+  (reference: IGrainMethodInvoker, GrainMethodInvokerGenerator.cs:48) is a
+  dict lookup from method id to the bound coroutine.
+* ``Grain`` / ``StatefulGrain`` mirror Grain / Grain<TState>
+  (reference: src/Orleans/Core/Grain.cs:40,284 — OnActivateAsync :240,
+  RegisterTimer :142, DeactivateOnIdle :218, State accessors :314-327).
+* method/class decorators mirror the attributes in
+  reference: src/Orleans/Core/GrainAttributes.cs — [ReadOnly], [Reentrant],
+  [AlwaysInterleave], [StatelessWorker], [OneWay], plus placement
+  attributes.
+
+TPU-native addition: a grain class may additionally provide a *vectorized
+turn* — ``@batched_method`` handlers operating on stacked state rows — which
+lets the tensor engine execute every activation of the type in one XLA
+kernel per tick instead of one Python turn per message (see
+``orleans_tpu.tensor``).  Host-path and tensor-path grains share identity,
+directory, persistence and RPC surfaces.
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Type
+
+from orleans_tpu.hashing import jenkins_hash
+from orleans_tpu.ids import GrainId, GrainCategory, type_code_of
+from orleans_tpu.placement import (
+    DEFAULT_PLACEMENT,
+    PlacementStrategy,
+    StatelessWorkerPlacement,
+)
+
+
+# ---------------------------------------------------------------------------
+# method / interface metadata
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MethodInfo:
+    """One entry of the typed method table (replaces codegen'd invokers)."""
+
+    name: str
+    method_id: int
+    read_only: bool = False
+    one_way: bool = False
+    always_interleave: bool = False
+    batched: bool = False  # tensor-path handler (TPU data plane)
+
+
+@dataclass
+class InterfaceInfo:
+    name: str
+    interface_id: int
+    methods_by_id: Dict[int, MethodInfo] = field(default_factory=dict)
+    methods_by_name: Dict[str, MethodInfo] = field(default_factory=dict)
+    cls: Optional[type] = None
+
+    def add(self, m: MethodInfo) -> None:
+        self.methods_by_id[m.method_id] = m
+        self.methods_by_name[m.name] = m
+
+
+def method_id_of(name: str) -> int:
+    """Stable method id (reference: codegen'd per-method integer ids)."""
+    return jenkins_hash(("m:" + name).encode("utf-8")) & 0x7FFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# method decorators (reference: GrainAttributes.cs)
+# ---------------------------------------------------------------------------
+
+def read_only(fn: Callable) -> Callable:
+    """[ReadOnly] — may interleave with other read-only turns."""
+    fn.__grain_read_only__ = True
+    return fn
+
+
+def always_interleave(fn: Callable) -> Callable:
+    """[AlwaysInterleave] — may interleave with any turn."""
+    fn.__grain_always_interleave__ = True
+    return fn
+
+
+def one_way(fn: Callable) -> Callable:
+    """[OneWay] — fire-and-forget; no response message is sent."""
+    fn.__grain_one_way__ = True
+    return fn
+
+
+def grain_method(fn: Callable) -> Callable:
+    """Optional explicit marker; any public async def is a grain method."""
+    fn.__grain_method__ = True
+    return fn
+
+
+def batched_method(fn: Callable) -> Callable:
+    """Tensor-path handler: ``fn(state_rows, args_rows, ctx) ->
+    (state_rows, result_rows)`` over stacked activations (see
+    orleans_tpu.tensor.engine)."""
+    fn.__grain_batched__ = True
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# class decorators
+# ---------------------------------------------------------------------------
+
+def _sync_registration(cls: type) -> None:
+    """Class decorators may appear above or below @grain_class — if the
+    class is already registered, refresh the captured attributes."""
+    info = registry.by_class.get(cls)
+    if info is not None:
+        info.reentrant = getattr(cls, "__grain_reentrant__", False)
+        info.placement = getattr(cls, "__grain_placement__", DEFAULT_PLACEMENT)
+        info.stateless_worker = getattr(cls, "__grain_stateless_worker__", False)
+
+
+def reentrant(cls: type) -> type:
+    """[Reentrant] — requests to this grain may interleave freely."""
+    cls.__grain_reentrant__ = True
+    _sync_registration(cls)
+    return cls
+
+
+def stateless_worker(max_local: int = -1) -> Callable[[type], type]:
+    """[StatelessWorker] — auto-scaled local replicas, no identity
+    (reference: GrainAttributes.cs StatelessWorkerAttribute +
+    StatelessWorkerPlacement)."""
+
+    def apply(cls: type) -> type:
+        cls.__grain_placement__ = StatelessWorkerPlacement(max_local)
+        cls.__grain_stateless_worker__ = True
+        _sync_registration(cls)
+        return cls
+
+    return apply
+
+
+def placement(strategy: PlacementStrategy) -> Callable[[type], type]:
+    """Per-class placement strategy attribute
+    (reference: PlacementAttribute subclasses in GrainAttributes)."""
+
+    def apply(cls: type) -> type:
+        cls.__grain_placement__ = strategy
+        _sync_registration(cls)
+        return cls
+
+    return apply
+
+
+# ---------------------------------------------------------------------------
+# interface declaration
+# ---------------------------------------------------------------------------
+
+_INTERFACES: Dict[int, InterfaceInfo] = {}
+_INTERFACES_BY_NAME: Dict[str, InterfaceInfo] = {}
+
+
+def grain_interface(cls: type) -> type:
+    """Declare a grain interface: every public ``async def`` (or
+    ``@batched_method``) becomes an RPC method with a stable method id.
+
+    Replaces the reference's IGrain marker interfaces + build-time codegen
+    (reference: ClientGenerator.cs:41; GrainInterfaceData)."""
+    name = cls.__name__
+    info = InterfaceInfo(name=name, interface_id=type_code_of(name), cls=cls)
+    for attr_name, attr in inspect.getmembers(cls):
+        if attr_name.startswith("_"):
+            continue
+        if not callable(attr):
+            continue
+        is_batched = getattr(attr, "__grain_batched__", False)
+        if not (inspect.iscoroutinefunction(attr) or is_batched
+                or getattr(attr, "__grain_method__", False)):
+            continue
+        info.add(MethodInfo(
+            name=attr_name,
+            method_id=method_id_of(attr_name),
+            read_only=getattr(attr, "__grain_read_only__", False),
+            one_way=getattr(attr, "__grain_one_way__", False),
+            always_interleave=getattr(attr, "__grain_always_interleave__", False),
+            batched=is_batched,
+        ))
+    cls.__grain_interface_info__ = info
+    _INTERFACES[info.interface_id] = info
+    _INTERFACES_BY_NAME[name] = info
+    return cls
+
+
+def get_interface(id_or_name) -> InterfaceInfo:
+    if isinstance(id_or_name, int):
+        return _INTERFACES[id_or_name]
+    if isinstance(id_or_name, str):
+        return _INTERFACES_BY_NAME[id_or_name]
+    # a decorated class
+    return id_or_name.__grain_interface_info__
+
+
+# ---------------------------------------------------------------------------
+# grain base classes
+# ---------------------------------------------------------------------------
+
+class Grain:
+    """Base class for grain implementations (reference: Grain.cs:40).
+
+    Runtime wiring (``_activation``) is injected by the catalog when the
+    activation is created (reference: Catalog.CreateGrainInstance :622).
+    """
+
+    # injected by the catalog
+    _activation: Any = None
+
+    # -- identity -----------------------------------------------------------
+
+    @property
+    def grain_id(self) -> GrainId:
+        return self._activation.grain_id
+
+    @property
+    def primary_key(self) -> int:
+        return self._activation.grain_id.primary_key_int
+
+    @property
+    def primary_key_str(self) -> Optional[str]:
+        return self._activation.grain_id.primary_key_str
+
+    @property
+    def runtime(self):
+        """The silo's inside-runtime-client (reference: Grain.Runtime)."""
+        return self._activation.runtime
+
+    # -- lifecycle (reference: Grain.cs OnActivateAsync :240) ---------------
+
+    async def on_activate(self) -> None:
+        """Called after state load, before the first message is delivered."""
+
+    async def on_deactivate(self) -> None:
+        """Called before the activation is destroyed."""
+
+    # -- services -----------------------------------------------------------
+
+    def get_grain(self, interface, key):
+        """Typed reference to another grain (reference: GrainFactory via
+        Grain.GrainFactory)."""
+        return self.runtime.factory.get_grain(interface, key)
+
+    def register_timer(self, callback: Callable[..., Awaitable[None]],
+                       due: float, period: Optional[float] = None,
+                       state: Any = None):
+        """Volatile per-activation timer; ticks run as turns on this
+        activation (reference: Grain.RegisterTimer :142, GrainTimer.cs:31)."""
+        return self._activation.register_timer(callback, due, period, state)
+
+    def deactivate_on_idle(self) -> None:
+        """Deactivate as soon as the current turn completes
+        (reference: Grain.DeactivateOnIdle :218)."""
+        self._activation.deactivate_on_idle()
+
+    def delay_deactivation(self, seconds: float) -> None:
+        """Keep this activation alive at least ``seconds`` longer
+        (reference: Grain.DelayDeactivation)."""
+        self._activation.delay_deactivation(seconds)
+
+    def get_reminder(self, name: str):
+        return self.runtime.reminder_registry.get_reminder(self.grain_id, name)
+
+    async def register_reminder(self, name: str, due: float, period: float):
+        """Durable timer (reference: Grain.RegisterOrUpdateReminder)."""
+        return await self.runtime.reminder_registry.register_or_update(
+            self.grain_id, name, due, period)
+
+    async def unregister_reminder(self, name: str) -> None:
+        await self.runtime.reminder_registry.unregister(self.grain_id, name)
+
+    def get_stream(self, provider_name: str, namespace: str, stream_id):
+        """Stream handle (reference: Grain.GetStreamProvider)."""
+        provider = self.runtime.stream_provider(provider_name)
+        return provider.get_stream(namespace, stream_id)
+
+    @property
+    def logger(self):
+        return self._activation.logger
+
+
+class StatefulGrain(Grain):
+    """Grain with managed persistent state (reference: Grain<TState>,
+    Grain.cs:284; state accessors :314-327).
+
+    ``state`` is loaded from the configured storage provider during
+    activation stage 2 (reference: Catalog.SetupActivationState :731) and
+    written only on explicit ``write_state()``.
+    """
+
+    # injected by the catalog: GrainStateStorageBridge
+    _storage: Any = None
+
+    @property
+    def state(self) -> Any:
+        return self._storage.state
+
+    @state.setter
+    def state(self, value: Any) -> None:
+        self._storage.state = value
+
+    async def read_state(self) -> None:
+        """Re-read from storage (reference: ReadStateAsync :314)."""
+        await self._storage.read_state()
+
+    async def write_state(self) -> None:
+        """Persist current state (reference: WriteStateAsync :324)."""
+        await self._storage.write_state()
+
+    async def clear_state(self) -> None:
+        """Delete persisted state (reference: ClearStateAsync :327)."""
+        await self._storage.clear_state()
+
+
+# ---------------------------------------------------------------------------
+# implementation registry (reference #14: GrainTypeManager.cs:35)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class GrainClassInfo:
+    cls: Type[Grain]
+    type_code: int
+    interfaces: List[InterfaceInfo]
+    placement: PlacementStrategy
+    reentrant: bool
+    stateless_worker: bool
+    storage_provider: Optional[str] = None
+    initial_state: Optional[Callable[[], Any]] = None
+
+
+class GrainTypeRegistry:
+    """Maps interfaces to implementation classes
+    (reference: GrainTypeManager.cs:35; GrainInterfaceMap.cs).
+
+    The reference scans assemblies at silo start
+    (SiloAssemblyLoader.cs:39); here registration happens at class
+    decoration time, and the registry is process-global so every in-process
+    silo shares the same type map (the reference ships the map between
+    silos via the TypeManager system target)."""
+
+    def __init__(self) -> None:
+        self.by_class: Dict[type, GrainClassInfo] = {}
+        self.by_type_code: Dict[int, GrainClassInfo] = {}
+        self.impl_by_interface: Dict[int, GrainClassInfo] = {}
+
+    def register(self, cls: Type[Grain],
+                 storage_provider: Optional[str] = None,
+                 initial_state: Optional[Callable[[], Any]] = None) -> GrainClassInfo:
+        interfaces = [base.__grain_interface_info__
+                      for base in cls.__mro__
+                      if "__grain_interface_info__" in vars(base)]
+        info = GrainClassInfo(
+            cls=cls,
+            type_code=type_code_of(cls.__name__),
+            interfaces=interfaces,
+            placement=getattr(cls, "__grain_placement__", DEFAULT_PLACEMENT),
+            reentrant=getattr(cls, "__grain_reentrant__", False),
+            stateless_worker=getattr(cls, "__grain_stateless_worker__", False),
+            storage_provider=storage_provider,
+            initial_state=initial_state,
+        )
+        self.by_class[cls] = info
+        self.by_type_code[info.type_code] = info
+        for iface in interfaces:
+            # Last registration wins, matching the reference's behavior for
+            # ambiguous interface→class maps resolved by explicit class name.
+            self.impl_by_interface[iface.interface_id] = info
+        return info
+
+    def implementation_of(self, interface_id: int) -> GrainClassInfo:
+        info = self.impl_by_interface.get(interface_id)
+        if info is None:
+            raise KeyError(f"no grain class implements interface {interface_id:x}")
+        return info
+
+
+registry = GrainTypeRegistry()
+
+
+def grain_class(cls: Optional[type] = None, *,
+                storage_provider: Optional[str] = None,
+                initial_state: Optional[Callable[[], Any]] = None):
+    """Class decorator registering a grain implementation.
+
+    ``storage_provider`` names the provider for StatefulGrain state
+    (reference: [StorageProvider(ProviderName=...)] attribute,
+    GrainAttributes.cs)."""
+
+    def apply(c: type) -> type:
+        registry.register(c, storage_provider=storage_provider,
+                          initial_state=initial_state)
+        return c
+
+    if cls is not None:
+        return apply(cls)
+    return apply
+
+
+def grain_id_for(interface, key) -> GrainId:
+    """Resolve (interface, key) → GrainId using the implementing class's
+    type code, so references and activations agree on identity
+    (reference: TypeCodeMapper.ComposeGrainId)."""
+    iface = get_interface(interface)
+    impl = registry.implementation_of(iface.interface_id)
+    import uuid as _uuid
+    if isinstance(key, int):
+        return GrainId.from_int(impl.type_code, key)
+    if isinstance(key, str):
+        return GrainId.from_string(impl.type_code, key)
+    if isinstance(key, _uuid.UUID):
+        return GrainId.from_guid(impl.type_code, key)
+    raise TypeError(f"unsupported grain key type {type(key)}")

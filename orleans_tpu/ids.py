@@ -1,0 +1,262 @@
+"""Identity primitives: grain / activation / silo addressing.
+
+Parity with the reference's L0 identity layer (reference: src/Orleans/IDs/
+UniqueKey.cs:34, GrainId.cs:33, ActivationId.cs, SiloAddress.cs,
+ActivationAddress.cs, Interner.cs):
+
+* A grain identity is a 128-bit key (two 64-bit words) + a type code +
+  an optional string extension, tagged with a category (application grain,
+  system target, client, ...).
+* ``SiloAddress`` is endpoint + generation (epoch) so a restarted silo on
+  the same port is a *different* silo.
+* ``ActivationAddress`` is the full routing triple (silo, grain, activation).
+
+TPU-first addition: every ``GrainId`` exposes ``packed()`` — a stable 64-bit
+integer used as the grain's key inside device-side id tensors, and
+``ring_hash()`` — the 32-bit uniform hash used for consistent-ring placement
+(reference: GrainId.GetUniformHashCode / JenkinsHash.cs).  The host directory
+and the device bucketing kernel both derive placement from the same hash, so
+"where does this grain live" has one answer on both sides of the PCIe bus.
+"""
+
+from __future__ import annotations
+
+import itertools
+import struct
+import threading
+import uuid
+import weakref
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Optional, Union
+
+from orleans_tpu.hashing import jenkins_hash, stable_hash_u64, combine_hashes
+
+
+class GrainCategory(IntEnum):
+    """Key category (reference: UniqueKey.cs Category enum)."""
+
+    GRAIN = 1
+    CLIENT = 2
+    SYSTEM_TARGET = 3
+    SYSTEM_GRAIN = 4
+    KEY_EXT_GRAIN = 5
+
+
+# GrainType is the string name of the grain *class* (implementation type).
+# The reference uses integer type codes assigned by codegen
+# (TypeCodeMapper.cs); we derive a stable 31-bit code from the class name.
+GrainType = str
+
+
+def type_code_of(type_name: str) -> int:
+    """Stable 31-bit type code for a grain interface/class name.
+
+    Reference analog: GrainInterfaceData.GetGrainInterfaceId — codegen'd
+    integer ids; here derived by stable hash of the name (no codegen step).
+    """
+    return jenkins_hash(type_name.encode("utf-8")) & 0x7FFFFFFF
+
+
+_intern_lock = threading.Lock()
+_grain_id_intern: "weakref.WeakValueDictionary[tuple, GrainId]" = weakref.WeakValueDictionary()
+
+
+@dataclass(frozen=True, eq=False)
+class GrainId:
+    """Logical grain identity (reference: GrainId.cs:33 over UniqueKey.cs:34).
+
+    ``n0``/``n1`` are the two 64-bit words of the 128-bit primary key;
+    string-keyed grains carry the string in ``key_ext`` (KEY_EXT_GRAIN
+    category), matching the reference's UniqueKey layout.
+    """
+
+    type_code: int
+    n0: int
+    n1: int
+    category: GrainCategory = GrainCategory.GRAIN
+    key_ext: Optional[str] = None
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def _intern(gid: "GrainId") -> "GrainId":
+        key = (gid.type_code, gid.n0, gid.n1, int(gid.category), gid.key_ext)
+        with _intern_lock:
+            existing = _grain_id_intern.get(key)
+            if existing is not None:
+                return existing
+            _grain_id_intern[key] = gid
+            return gid
+
+    @classmethod
+    def from_int(cls, type_code: int, key: int,
+                 category: GrainCategory = GrainCategory.GRAIN) -> "GrainId":
+        """Integer-keyed grain (reference: GrainFactory.GetGrain<T>(long))."""
+        return cls._intern(cls(type_code, 0, key & 0xFFFFFFFFFFFFFFFF, category))
+
+    @classmethod
+    def from_guid(cls, type_code: int, key: uuid.UUID,
+                  category: GrainCategory = GrainCategory.GRAIN) -> "GrainId":
+        n = key.int
+        return cls._intern(cls(type_code, (n >> 64) & 0xFFFFFFFFFFFFFFFF,
+                               n & 0xFFFFFFFFFFFFFFFF, category))
+
+    @classmethod
+    def from_string(cls, type_code: int, key: str) -> "GrainId":
+        """String-keyed grain → KEY_EXT category (reference: UniqueKey key_ext)."""
+        return cls._intern(cls(type_code, 0, 0, GrainCategory.KEY_EXT_GRAIN, key))
+
+    @classmethod
+    def system_target(cls, type_code: int) -> "GrainId":
+        """Well-known runtime actor id (reference: Constants.cs:52-61)."""
+        return cls._intern(cls(type_code, 0, 0, GrainCategory.SYSTEM_TARGET))
+
+    @classmethod
+    def client(cls, client_uuid: uuid.UUID) -> "GrainId":
+        return cls.from_guid(0, client_uuid, GrainCategory.CLIENT)
+
+    # -- key accessors ------------------------------------------------------
+
+    @property
+    def primary_key_int(self) -> int:
+        return self.n1
+
+    @property
+    def primary_key_guid(self) -> uuid.UUID:
+        return uuid.UUID(int=((self.n0 << 64) | self.n1))
+
+    @property
+    def primary_key_str(self) -> Optional[str]:
+        return self.key_ext
+
+    @property
+    def is_client(self) -> bool:
+        return self.category == GrainCategory.CLIENT
+
+    @property
+    def is_system_target(self) -> bool:
+        return self.category == GrainCategory.SYSTEM_TARGET
+
+    # -- hashing / packing --------------------------------------------------
+
+    def packed(self) -> int:
+        """Stable 64-bit scalar identity for device-side id tensors.
+
+        For int-keyed grains of one type this is injective over the low 64-bit
+        key mixed with type code; for guid/string keys it is a stable hash
+        (the directory maps hash→row, so rare collisions only cost a host
+        fallback lookup, never a correctness error).
+        """
+        base = combine_hashes(self.type_code | (int(self.category) << 32),
+                              self.n0, self.n1)
+        if self.key_ext is not None:
+            base = combine_hashes(base, jenkins_hash(self.key_ext.encode("utf-8")))
+        return base
+
+    def ring_hash(self) -> int:
+        """32-bit uniform hash for consistent-ring placement
+        (reference: GrainId.GetUniformHashCode → JenkinsHash over key bytes)."""
+        buf = struct.pack("<QQI", self.n0, self.n1,
+                          (self.type_code & 0xFFFFFFFF) | (int(self.category) << 29) & 0xFFFFFFFF)
+        if self.key_ext is not None:
+            buf += self.key_ext.encode("utf-8")
+        return jenkins_hash(buf)
+
+    def __hash__(self) -> int:
+        return hash((self.type_code, self.n0, self.n1, int(self.category), self.key_ext))
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, GrainId):
+            return NotImplemented
+        return (self.type_code == other.type_code and self.n0 == other.n0
+                and self.n1 == other.n1 and self.category == other.category
+                and self.key_ext == other.key_ext)
+
+    def __repr__(self) -> str:
+        if self.key_ext is not None:
+            key = repr(self.key_ext)
+        elif self.n0 == 0:
+            key = str(self.n1)
+        else:
+            key = str(self.primary_key_guid)
+        return f"GrainId({self.category.name.lower()}:{self.type_code:x}/{key})"
+
+
+@dataclass(frozen=True)
+class SiloAddress:
+    """Silo endpoint + generation (reference: SiloAddress.cs).
+
+    ``generation`` is the silo's start timestamp-ish epoch: a restarted silo
+    at the same endpoint is a distinct identity, which is what lets the
+    membership protocol declare the *old* incarnation dead.
+    """
+
+    host: str
+    port: int
+    generation: int
+
+    _counter = itertools.count(1)
+
+    @classmethod
+    def new_local(cls, host: str = "local", port: int = 0) -> "SiloAddress":
+        return cls(host, port, next(cls._counter))
+
+    def ring_hash(self) -> int:
+        """Uniform hash for the silo's point on the consistent ring
+        (reference: SiloAddress.GetConsistentHashCode)."""
+        return jenkins_hash(f"{self.host}:{self.port}@{self.generation}".encode("utf-8"))
+
+    def matches(self, other: "SiloAddress") -> bool:
+        """Same endpoint, ignoring generation (reference: SiloAddress.Matches)."""
+        return self.host == other.host and self.port == other.port
+
+    def __str__(self) -> str:
+        return f"S{self.host}:{self.port}:{self.generation}"
+
+
+@dataclass(frozen=True)
+class ActivationId:
+    """Physical activation instance id (reference: ActivationId.cs).
+
+    Random 128-bit, unique per activation; a grain re-activated after
+    deactivation gets a *new* ActivationId.
+    """
+
+    n0: int
+    n1: int
+
+    @classmethod
+    def new(cls) -> "ActivationId":
+        u = uuid.uuid4().int
+        return cls((u >> 64) & 0xFFFFFFFFFFFFFFFF, u & 0xFFFFFFFFFFFFFFFF)
+
+    def __str__(self) -> str:
+        return f"@{self.n0:016x}{self.n1:016x}"
+
+
+@dataclass(frozen=True)
+class ActivationAddress:
+    """Full routing address: (silo, grain, activation)
+    (reference: ActivationAddress.cs)."""
+
+    silo: SiloAddress
+    grain: GrainId
+    activation: ActivationId
+
+    def __str__(self) -> str:
+        return f"[{self.grain} {self.activation} @ {self.silo}]"
+
+
+# Well-known system-target type codes (reference: Constants.cs:52-61).
+class SystemTargetCodes(IntEnum):
+    DIRECTORY_SERVICE = 10
+    SILO_CONTROL = 12
+    CLIENT_OBSERVER_REGISTRAR = 13
+    CATALOG = 14
+    MEMBERSHIP_ORACLE = 15
+    REMINDER_SERVICE = 16
+    TYPE_MANAGER = 17
+    PROVIDER_MANAGER = 19
+    DEPLOYMENT_LOAD_PUBLISHER = 22
+    STREAM_PULLING_MANAGER = 23

@@ -1,0 +1,66 @@
+"""Placement strategies (data half).
+
+Parity: the reference splits placement into per-grain-class *strategies*
+(reference: src/Orleans/Placement/PlacementStrategy.cs, RandomPlacement,
+PreferLocalPlacement, ActivationCountBasedPlacement, StatelessWorkerPlacement,
+SystemPlacement) and silo-side *directors* that execute them
+(reference: src/OrleansRuntime/Placement/PlacementDirectorsManager.cs:32).
+This module holds the strategies; directors live in
+``orleans_tpu.runtime.placement_directors``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PlacementStrategy:
+    pass
+
+
+@dataclass(frozen=True)
+class RandomPlacement(PlacementStrategy):
+    """Uniform random silo choice (reference: RandomPlacement.cs)."""
+
+
+@dataclass(frozen=True)
+class PreferLocalPlacement(PlacementStrategy):
+    """Place on the calling silo unless it is overloaded
+    (reference: PreferLocalPlacement.cs)."""
+
+
+@dataclass(frozen=True)
+class HashBasedPlacement(PlacementStrategy):
+    """Place on the grain's ring-owner silo — the TPU-native default:
+    placement == the sharding map, so the directory lookup is a pure
+    function of (grain id, membership view) with no remote hop.
+
+    The reference's closest analog is directory-owner placement implied by
+    its north star; Orleans' default is RandomPlacement."""
+
+
+@dataclass(frozen=True)
+class ActivationCountBasedPlacement(PlacementStrategy):
+    """Power-of-k-choices by activation count
+    (reference: ActivationCountBasedPlacement.cs;
+    ActivationCountPlacementDirector.cs:35, choose-out-of-k :117)."""
+
+    choose_out_of: int = 2
+
+
+@dataclass(frozen=True)
+class StatelessWorkerPlacement(PlacementStrategy):
+    """Local replicated activations, up to ``max_local`` per silo
+    (reference: StatelessWorkerPlacement.cs; [StatelessWorker] attribute)."""
+
+    max_local: int = -1  # -1 → default from config (cpu count in reference)
+
+
+@dataclass(frozen=True)
+class SystemPlacement(PlacementStrategy):
+    """System targets: fixed, well-known placement per silo
+    (reference: SystemPlacement.cs)."""
+
+
+DEFAULT_PLACEMENT = HashBasedPlacement()

@@ -1,0 +1,124 @@
+"""In-memory storage providers.
+
+Parity: reference MemoryStorage (reference: src/OrleansProviders/
+PersistenceProviders/MemoryStorage.cs:57 + MemoryStorageGrain.cs) and the
+latency-injecting variant MemoryStorageWithLatency
+(reference: MemoryStorageWithLatency.cs:54).
+
+The reference stores through MemoryStorageGrain actors so data survives
+in-process "cluster" topology changes; here the same effect comes from an
+optional shared ``backing`` dict that multiple silos' providers can point at
+(the test cluster passes one store to every silo — reference:
+TestingSiloHost's shared ILocalDataStore, Silo.cs:217-221).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Any, Dict, Optional, Tuple
+
+from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.ids import GrainId
+from orleans_tpu.runtime.storage import (
+    GrainState,
+    InconsistentStateError,
+    StorageProvider,
+)
+
+_etag_counter = itertools.count(1)
+
+
+class MemoryStorage(StorageProvider):
+    """(reference: MemoryStorage.cs:57)"""
+
+    def __init__(self, backing: Optional[Dict] = None,
+                 deep_copy: bool = True) -> None:
+        # key → (serialized-or-copied data, etag)
+        self._store: Dict[Tuple[str, GrainId], Tuple[Any, str]] = \
+            backing if backing is not None else {}
+        self._deep_copy = deep_copy
+
+    @staticmethod
+    def shared_backing() -> Dict:
+        """A store that survives silo restarts in one process."""
+        return {}
+
+    async def read_state(self, grain_type: str, grain_id: GrainId,
+                         state: GrainState) -> None:
+        entry = self._store.get((grain_type, grain_id))
+        if entry is None:
+            state.record_exists = False
+            state.etag = None
+            return
+        data, etag = entry
+        state.data = codec.deep_copy(data) if self._deep_copy else data
+        state.etag = etag
+        state.record_exists = True
+
+    async def write_state(self, grain_type: str, grain_id: GrainId,
+                          state: GrainState) -> None:
+        key = (grain_type, grain_id)
+        entry = self._store.get(key)
+        stored_etag = entry[1] if entry is not None else None
+        if stored_etag != state.etag:
+            raise InconsistentStateError(stored_etag, state.etag)
+        new_etag = str(next(_etag_counter))
+        data = codec.deep_copy(state.data) if self._deep_copy else state.data
+        self._store[key] = (data, new_etag)
+        state.etag = new_etag
+        state.record_exists = True
+
+    async def clear_state(self, grain_type: str, grain_id: GrainId,
+                          state: GrainState) -> None:
+        key = (grain_type, grain_id)
+        entry = self._store.get(key)
+        stored_etag = entry[1] if entry is not None else None
+        if stored_etag != state.etag:
+            raise InconsistentStateError(stored_etag, state.etag)
+        self._store.pop(key, None)
+        state.etag = None
+        state.record_exists = False
+        state.data = None
+
+
+class MemoryStorageWithLatency(MemoryStorage):
+    """Latency-injecting wrapper for tests
+    (reference: MemoryStorageWithLatency.cs:54)."""
+
+    def __init__(self, latency: float = 0.05,
+                 backing: Optional[Dict] = None) -> None:
+        super().__init__(backing)
+        self.latency = latency
+
+    async def read_state(self, grain_type, grain_id, state) -> None:
+        await asyncio.sleep(self.latency)
+        await super().read_state(grain_type, grain_id, state)
+
+    async def write_state(self, grain_type, grain_id, state) -> None:
+        await asyncio.sleep(self.latency)
+        await super().write_state(grain_type, grain_id, state)
+
+    async def clear_state(self, grain_type, grain_id, state) -> None:
+        await asyncio.sleep(self.latency)
+        await super().clear_state(grain_type, grain_id, state)
+
+
+class ErrorInjectionStorage(MemoryStorage):
+    """Fails reads/writes on demand (reference: TestInternalGrains
+    ErrorInjectionStorageProvider)."""
+
+    def __init__(self, backing: Optional[Dict] = None) -> None:
+        super().__init__(backing)
+        self.fail_reads = False
+        self.fail_writes = False
+
+    async def read_state(self, grain_type, grain_id, state) -> None:
+        if self.fail_reads:
+            raise IOError("injected read failure")
+        await super().read_state(grain_type, grain_id, state)
+
+    async def write_state(self, grain_type, grain_id, state) -> None:
+        if self.fail_writes:
+            raise IOError("injected write failure")
+        await super().write_state(grain_type, grain_id, state)

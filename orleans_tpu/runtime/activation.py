@@ -1,0 +1,260 @@
+"""Per-activation state machine, mailbox, and turn gate.
+
+Parity: reference ActivationData (reference: src/OrleansRuntime/Catalog/
+ActivationData.cs:42 — waiting-message list :473, EnqueueMessage :487,
+overload check :522, Running record :411) plus the single-threaded turn
+guarantee the reference enforces with its two-level scheduler
+(reference: src/OrleansRuntime/Scheduler/WorkItemGroup.cs:36).
+
+Execution-model mapping: the reference pins each activation to a
+WorkItemGroup drained by a worker-pool thread; here each silo runs one
+asyncio event loop, each *turn* is an asyncio task, and this class is the
+admission gate that decides whether an arriving request starts a turn now
+or waits — which is precisely the reference's reentrancy logic
+(reference: Dispatcher.ActivationMayAcceptRequest/CanInterleave :316,:329).
+Single-threadedness is structural (one event loop), so the gate only has to
+enforce *logical* turn exclusivity: one non-interleaving request in flight
+per activation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from enum import Enum
+from typing import Any, Awaitable, Callable, Deque, Dict, List, Optional
+
+from orleans_tpu.core.grain import GrainClassInfo, MethodInfo
+from orleans_tpu.ids import ActivationAddress, ActivationId, GrainId, SiloAddress
+from orleans_tpu.runtime.messaging import Message, RejectionType
+
+
+class ActivationState(Enum):
+    """(reference: ActivationState.cs)"""
+
+    CREATE = "create"
+    ACTIVATING = "activating"
+    VALID = "valid"
+    DEACTIVATING = "deactivating"
+    INVALID = "invalid"
+
+
+class GrainTimer:
+    """Volatile per-activation timer (reference: GrainTimer.cs:31).
+
+    Ticks are delivered as turns through the activation's admission gate, so
+    a timer callback never runs concurrently with a request turn — matching
+    the reference, which schedules ticks on the activation's task scheduler.
+    """
+
+    def __init__(self, activation: "ActivationData",
+                 callback: Callable[..., Awaitable[None]],
+                 due: float, period: Optional[float], state: Any) -> None:
+        import inspect
+        self._activation = activation
+        takes_state = len(inspect.signature(callback).parameters) >= 1
+        self._fire = (lambda: callback(state)) if takes_state else (lambda: callback())
+        self._due = due
+        self._period = period
+        self._task: Optional[asyncio.Task] = None
+        self._disposed = False
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        try:
+            await asyncio.sleep(self._due)
+            while not self._disposed:
+                # ACTIVATING is fine (timers registered in on_activate);
+                # only a dying/dead activation stops the timer
+                if self._activation.state in (ActivationState.DEACTIVATING,
+                                              ActivationState.INVALID):
+                    break
+                await self._activation.run_closure_turn(self._fire)
+                if self._period is None:
+                    break
+                await asyncio.sleep(self._period)
+        except asyncio.CancelledError:
+            pass
+
+    def dispose(self) -> None:
+        self._disposed = True
+        if self._task is not None:
+            self._task.cancel()
+
+
+class ActivationData:
+    """One activation: grain instance + mailbox + gate + collector metadata."""
+
+    # Overload limit (reference: ActivationData.CheckOverloaded :522 driven
+    # by LimitManager 'MaxEnqueuedRequests').
+    DEFAULT_MAX_ENQUEUED = 5000
+
+    def __init__(self, grain_id: GrainId, activation_id: ActivationId,
+                 silo: SiloAddress, class_info: GrainClassInfo,
+                 runtime: Any) -> None:
+        self.grain_id = grain_id
+        self.activation_id = activation_id
+        self.address = ActivationAddress(silo, grain_id, activation_id)
+        self.class_info = class_info
+        self.runtime = runtime  # InsideRuntimeClient
+        self.grain_instance: Any = None
+        self.state = ActivationState.CREATE
+
+        # mailbox + gate
+        self.waiting: Deque[tuple[Message, Callable[[Message], Awaitable[None]]]] = deque()
+        self.running: Dict[int, Message] = {}
+        self._closure_waiters: Deque[tuple[asyncio.Future, Callable]] = deque()
+        self.max_enqueued = self.DEFAULT_MAX_ENQUEUED
+
+        # collector metadata (reference: ActivationData.CollectionTicket)
+        self.last_use = time.monotonic()
+        self.keep_alive_until = 0.0
+        self._deactivate_on_idle = False
+        self.deactivation_task: Optional[asyncio.Task] = None
+
+        self.timers: List[GrainTimer] = []
+        self.logger = runtime.logger.child(str(grain_id)) if runtime else None
+        self.on_destroyed: List[Callable[[], None]] = []
+
+    # -- admission gate (reference: Dispatcher.cs:316,:329) -----------------
+
+    def may_interleave(self, msg: Message) -> bool:
+        if self.class_info.reentrant:
+            return True
+        if msg.is_always_interleave:
+            return True
+        if msg.is_read_only and all(m.is_read_only for m in self.running.values()):
+            return True
+        return False
+
+    def can_start_turn(self, msg: Message) -> bool:
+        if not self.running:
+            return True
+        return self.may_interleave(msg)
+
+    def check_overloaded(self) -> Optional[str]:
+        """(reference: ActivationData.CheckOverloaded :522)"""
+        n = len(self.waiting)
+        if n > self.max_enqueued:
+            return (f"activation {self.address} overloaded: {n} enqueued "
+                    f"(limit {self.max_enqueued})")
+        return None
+
+    def enqueue_or_start(self, msg: Message,
+                         invoke: Callable[[Message], Awaitable[None]]) -> Optional[str]:
+        """Either start a turn for ``msg`` now or queue it.
+
+        Returns an overload description if the message must be rejected
+        (reference: Dispatcher.HandleIncomingRequest :375 + EnqueueMessage
+        :487)."""
+        self.last_use = time.monotonic()
+        if self.state == ActivationState.VALID and self.can_start_turn(msg):
+            self._start_turn(msg, invoke)
+            return None
+        overload = self.check_overloaded()
+        if overload is not None:
+            return overload
+        self.waiting.append((msg, invoke))
+        return None
+
+    def _start_turn(self, msg: Message,
+                    invoke: Callable[[Message], Awaitable[None]]) -> None:
+        self.running[msg.id] = msg
+        loop = asyncio.get_running_loop()
+        task = loop.create_task(self._run_turn(msg, invoke))
+        task.add_done_callback(lambda t: t.exception())  # observed via response
+
+    async def _run_turn(self, msg: Message,
+                        invoke: Callable[[Message], Awaitable[None]]) -> None:
+        try:
+            await invoke(msg)
+        finally:
+            self.running.pop(msg.id, None)
+            self.last_use = time.monotonic()
+            self._pump()
+
+    def _pump(self) -> None:
+        """After a turn ends: admit queued closures then queued messages
+        (reference: ActivationData 'RunOnInactive'/waiting pump)."""
+        while self._closure_waiters and not self.running:
+            fut, token = self._closure_waiters.popleft()
+            if not fut.done():
+                # Reserve the gate for the closure *before* waking it so no
+                # message sneaks in between set_result and its resumption.
+                self.running[id(token)] = token  # type: ignore[index]
+                fut.set_result(None)
+                return
+        while self.waiting:
+            msg, invoke = self.waiting[0]
+            if self.state == ActivationState.VALID and self.can_start_turn(msg):
+                self.waiting.popleft()
+                self._start_turn(msg, invoke)
+                if not self.may_interleave(msg):
+                    break
+            else:
+                break
+        if (self._deactivate_on_idle and not self.running and not self.waiting
+                and self.state == ActivationState.VALID):
+            self.runtime.catalog.schedule_deactivation(self)
+
+    # -- closure turns (timers, system work on the activation's context) ----
+
+    async def run_closure_turn(self, fn: Callable[[], Awaitable[None]]) -> None:
+        """Run ``fn`` as a turn respecting the gate (used by timers).
+
+        Reference analog: ClosureWorkItem queued to the activation's
+        WorkItemGroup (reference: ClosureWorkItem.cs)."""
+        if self.state not in (ActivationState.VALID, ActivationState.ACTIVATING):
+            return
+        token = object()
+        if self.running:
+            fut = asyncio.get_running_loop().create_future()
+            self._closure_waiters.append((fut, token))
+            await fut  # _pump reserves the gate under id(token) before waking us
+        else:
+            self.running[id(token)] = token  # type: ignore[index]
+        try:
+            await fn()
+        finally:
+            self.running.pop(id(token), None)
+            self.last_use = time.monotonic()
+            self._pump()
+
+    # -- timers -------------------------------------------------------------
+
+    def register_timer(self, callback, due: float, period: Optional[float],
+                       state: Any) -> GrainTimer:
+        timer = GrainTimer(self, callback, due, period, state)
+        self.timers.append(timer)
+        timer.start()
+        return timer
+
+    def stop_timers(self) -> None:
+        for t in self.timers:
+            t.dispose()
+        self.timers.clear()
+
+    # -- collection (reference: Grain.DeactivateOnIdle :218) ----------------
+
+    def deactivate_on_idle(self) -> None:
+        self._deactivate_on_idle = True
+        if not self.running and not self.waiting:
+            self.runtime.catalog.schedule_deactivation(self)
+
+    def delay_deactivation(self, seconds: float) -> None:
+        self.keep_alive_until = max(self.keep_alive_until,
+                                    time.monotonic() + seconds)
+
+    def is_collectible(self, age_limit: float, now: float) -> bool:
+        return (self.state == ActivationState.VALID
+                and not self.running and not self.waiting
+                and now >= self.keep_alive_until
+                and now - self.last_use >= age_limit)
+
+    def __repr__(self) -> str:
+        return (f"Activation({self.grain_id} {self.activation_id} "
+                f"{self.state.value} run={len(self.running)} "
+                f"wait={len(self.waiting)})")

@@ -1,0 +1,306 @@
+"""Catalog: activation lifecycle + local activation directory + collector.
+
+Parity: reference Catalog (reference: src/OrleansRuntime/Catalog/
+Catalog.cs:43 — GetOrCreateActivation :411, InitActivation :487 with its
+three stages directory-register → load-state → OnActivateAsync, failure
+unwind :512-611, DeactivateActivations :836, destroy :945-1053),
+ActivationDirectory (ActivationDirectory.cs:33) and the age-based
+ActivationCollector (ActivationCollector.cs:37).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional
+
+from orleans_tpu.core.grain import GrainClassInfo, registry as type_registry
+from orleans_tpu.ids import ActivationAddress, ActivationId, GrainId
+from orleans_tpu.runtime.activation import ActivationData, ActivationState
+
+
+class DuplicateActivationError(Exception):
+    """Lost the single-activation registration race; the winner's address
+    is attached (reference: Catalog.cs:533-563 DuplicateActivationException
+    handling — queued messages forward to the winner)."""
+
+    def __init__(self, winner: ActivationAddress):
+        super().__init__(f"duplicate activation; winner at {winner}")
+        self.winner = winner
+
+
+class ActivationDirectory:
+    """Local ActivationId→ActivationData map + per-grain index
+    (reference: ActivationDirectory.cs:33)."""
+
+    def __init__(self) -> None:
+        self.by_activation: Dict[ActivationId, ActivationData] = {}
+        self.by_grain: Dict[GrainId, List[ActivationData]] = {}
+
+    def record(self, act: ActivationData) -> None:
+        self.by_activation[act.activation_id] = act
+        self.by_grain.setdefault(act.grain_id, []).append(act)
+
+    def remove(self, act: ActivationData) -> None:
+        self.by_activation.pop(act.activation_id, None)
+        lst = self.by_grain.get(act.grain_id)
+        if lst is not None:
+            try:
+                lst.remove(act)
+            except ValueError:
+                pass
+            if not lst:
+                del self.by_grain[act.grain_id]
+
+    def find_target(self, grain_id: GrainId,
+                    activation_id: Optional[ActivationId]) -> Optional[ActivationData]:
+        if activation_id is not None:
+            act = self.by_activation.get(activation_id)
+            if act is not None:
+                return act
+        lst = self.by_grain.get(grain_id)
+        return lst[0] if lst else None
+
+    def activations_of(self, grain_id: GrainId) -> List[ActivationData]:
+        return list(self.by_grain.get(grain_id, ()))
+
+    def __len__(self) -> int:
+        return len(self.by_activation)
+
+    def all(self) -> List[ActivationData]:
+        return list(self.by_activation.values())
+
+
+class Catalog:
+    """Creates, initializes, collects, and destroys activations."""
+
+    # Default age-out (reference: GlobalConfiguration
+    # DefaultCollectionAgeLimit = 2h; shortened defaults live in config).
+    DEFAULT_AGE_LIMIT = 2 * 3600.0
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+        self.directory = ActivationDirectory()
+        self.age_limit = self.DEFAULT_AGE_LIMIT
+        self._pending_inits: Dict[ActivationId, asyncio.Future] = {}
+        self._collector_task: Optional[asyncio.Task] = None
+        self.deactivations_count = 0
+        self.activations_count = 0
+
+    @property
+    def runtime(self):
+        return self.silo.runtime_client
+
+    # -- creation (reference: Catalog.GetOrCreateActivation :411) -----------
+
+    def get_activation(self, grain_id: GrainId,
+                       activation_id: Optional[ActivationId] = None
+                       ) -> Optional[ActivationData]:
+        act = self.directory.find_target(grain_id, activation_id)
+        if act is not None and act.state in (ActivationState.VALID,
+                                             ActivationState.ACTIVATING):
+            return act
+        return None
+
+    async def get_or_create_activation(self, grain_id: GrainId
+                                       ) -> ActivationData:
+        act = self.get_activation(grain_id)
+        if act is not None:
+            if act.state == ActivationState.ACTIVATING:
+                await self.wait_for_init(act)
+            return act
+        # if a previous activation is mid-deactivation, let it finish so the
+        # directory registration is released before we re-register
+        # (reference: Catalog serializes destroy → re-create on one grain)
+        for old in self.directory.activations_of(grain_id):
+            if (old.state == ActivationState.DEACTIVATING
+                    and old.deactivation_task is not None):
+                await asyncio.shield(old.deactivation_task)
+        return await self.create_activation(grain_id)
+
+    async def create_activation(self, grain_id: GrainId) -> ActivationData:
+        class_info = type_registry.by_type_code.get(grain_id.type_code)
+        if class_info is None:
+            raise KeyError(f"no grain class registered for {grain_id}")
+        act = ActivationData(grain_id, ActivationId.new(),
+                             self.silo.address, class_info, self.runtime)
+        act.max_enqueued = self.silo.config.messaging.max_enqueued_requests
+        self.directory.record(act)
+        init_done: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending_inits[act.activation_id] = init_done
+        try:
+            await self._init_activation(act)
+            if not init_done.done():
+                init_done.set_result(None)
+            self.activations_count += 1
+            return act
+        except BaseException as exc:
+            # failure unwind (reference: Catalog.cs:512-611): mark invalid,
+            # unregister, let queued messages reroute.
+            act.state = ActivationState.INVALID
+            self.directory.remove(act)
+            if not init_done.done():
+                init_done.set_exception(exc)
+                init_done.exception()  # mark retrieved
+            raise
+        finally:
+            self._pending_inits.pop(act.activation_id, None)
+
+    async def get_or_create_stateless_worker(self, grain_id: GrainId,
+                                             class_info: GrainClassInfo
+                                             ) -> ActivationData:
+        """Pick an idle local replica or spin up a new one, up to the
+        class's max_local (reference: StatelessWorkerDirector.cs local
+        replica selection; [StatelessWorker] semantics)."""
+        import os
+        acts = [a for a in self.directory.activations_of(grain_id)
+                if a.state in (ActivationState.VALID, ActivationState.ACTIVATING)]
+        for a in acts:
+            if not a.running and not a.waiting:
+                return a
+        max_local = class_info.placement.max_local
+        if max_local <= 0:
+            max_local = os.cpu_count() or 1
+        if len(acts) < max_local:
+            return await self.create_activation(grain_id)
+        return min(acts, key=lambda a: len(a.waiting))
+
+    async def wait_for_init(self, act: ActivationData) -> None:
+        fut = self._pending_inits.get(act.activation_id)
+        if fut is not None:
+            await asyncio.shield(fut)
+
+    async def _init_activation(self, act: ActivationData) -> None:
+        """Three-stage init (reference: Catalog.InitActivation :487)."""
+        act.state = ActivationState.ACTIVATING
+        # stage 0: construct the grain instance
+        # (reference: Catalog.CreateGrainInstance :622)
+        instance = act.class_info.cls()
+        instance._activation = act
+        act.grain_instance = instance
+
+        # stage 1: register in the grain directory (single-activation race:
+        # the loser raises DuplicateActivationError and the dispatcher
+        # forwards to the winner).
+        if not act.class_info.stateless_worker and not act.grain_id.is_client:
+            winner = await self.silo.grain_directory.register_single_activation(
+                act.address)
+            if winner.activation != act.activation_id:
+                raise DuplicateActivationError(winner)
+
+        # stage 2: load persistent state
+        # (reference: Catalog.SetupActivationState :731)
+        if act.class_info.storage_provider is not None or hasattr(
+                instance, "_storage"):
+            from orleans_tpu.runtime.storage import GrainStateStorageBridge
+            provider = self.silo.storage_provider(act.class_info.storage_provider)
+            bridge = GrainStateStorageBridge(
+                grain_type=act.class_info.cls.__name__,
+                grain_id=act.grain_id,
+                provider=provider,
+                initial_state=act.class_info.initial_state,
+            )
+            instance._storage = bridge
+            if provider is not None:
+                await bridge.read_state()
+
+        # stage 3: user OnActivate (reference: Catalog.InvokeActivate)
+        from orleans_tpu.core import context as grain_ctx
+        from orleans_tpu.core.reference import _current_runtime, bind_runtime
+        rt_token = bind_runtime(self.runtime)
+        act_token = grain_ctx.set_current_activation(act)
+        try:
+            await act.run_closure_turn(instance.on_activate)
+        finally:
+            grain_ctx.reset_current_activation(act_token)
+            _current_runtime.reset(rt_token)
+        act.state = ActivationState.VALID
+        act._pump()
+
+    # -- deactivation (reference: Catalog.DeactivateActivations :836) -------
+
+    def schedule_deactivation(self, act: ActivationData) -> None:
+        if act.state != ActivationState.VALID:
+            return
+        act.state = ActivationState.DEACTIVATING
+        act.deactivation_task = asyncio.get_running_loop().create_task(
+            self._deactivate(act))
+
+    async def _deactivate(self, act: ActivationData) -> None:
+        self.deactivations_count += 1
+        act.stop_timers()
+        # wait for in-flight turns to finish
+        while act.running:
+            await asyncio.sleep(0.001)
+        from orleans_tpu.core import context as grain_ctx
+        from orleans_tpu.core.reference import _current_runtime, bind_runtime
+        rt_token = bind_runtime(self.runtime)
+        act_token = grain_ctx.set_current_activation(act)
+        try:
+            if act.grain_instance is not None:
+                await act.grain_instance.on_deactivate()
+        except Exception:
+            if act.logger:
+                act.logger.warn("on_deactivate failed", exc_info=True)
+        finally:
+            grain_ctx.reset_current_activation(act_token)
+            _current_runtime.reset(rt_token)
+        try:
+            if not act.class_info.stateless_worker and not act.grain_id.is_client:
+                await self.silo.grain_directory.unregister(act.address)
+        except Exception:
+            pass
+        act.state = ActivationState.INVALID
+        self.directory.remove(act)
+        for cb in act.on_destroyed:
+            cb()
+        # reroute any stragglers that queued during deactivation
+        # (reference: Catalog destroy path rerouting :945-1053)
+        while act.waiting:
+            msg, _ = act.waiting.popleft()
+            msg.target_activation = None
+            self.silo.dispatcher.resend_message(msg)
+
+    async def deactivate_all(self) -> None:
+        """Graceful shutdown: deactivate everything
+        (reference: Catalog.DeactivateAllActivations via Silo.Terminate)."""
+        tasks = []
+        for act in self.directory.all():
+            if act.state == ActivationState.VALID:
+                self.schedule_deactivation(act)
+            if act.deactivation_task is not None:
+                tasks.append(act.deactivation_task)
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    # -- collector (reference: ActivationCollector.cs:37) -------------------
+
+    def start_collector(self, quantum: float = 60.0) -> None:
+        self._collector_task = asyncio.get_running_loop().create_task(
+            self._collector_loop(quantum))
+
+    def stop_collector(self) -> None:
+        if self._collector_task is not None:
+            self._collector_task.cancel()
+            self._collector_task = None
+
+    async def _collector_loop(self, quantum: float) -> None:
+        try:
+            while True:
+                await asyncio.sleep(quantum)
+                self.collect_idle_activations()
+        except asyncio.CancelledError:
+            pass
+
+    def collect_idle_activations(self, age_limit: Optional[float] = None) -> int:
+        """Age-out scan (reference: Catalog.OnTimer :225 →
+        ActivationCollector time buckets)."""
+        limit = age_limit if age_limit is not None else self.age_limit
+        now = time.monotonic()
+        n = 0
+        for act in self.directory.all():
+            cls_limit = getattr(act.class_info.cls, "__collection_age_limit__",
+                                limit)
+            if act.is_collectible(cls_limit, now):
+                self.schedule_deactivation(act)
+                n += 1
+        return n

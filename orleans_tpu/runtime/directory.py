@@ -1,0 +1,219 @@
+"""Grain directory: the distributed grain→activation map.
+
+Parity: reference LocalGrainDirectory (reference: src/OrleansRuntime/
+GrainDirectory/LocalGrainDirectory.cs:34 — CalculateTargetSilo :439,
+RegisterSingleActivationAsync :510), per-silo partition
+(GrainDirectoryPartition.cs:186), remote access through the
+RemoteGrainDirectory system target (RemoteGrainDirectory.cs:32), LRU/adaptive
+caches (LRUBasedGrainDirectoryCache.cs:30, AdaptiveGrainDirectoryCache.cs:30)
+with invalidations piggybacked on messages (InsideGrainClient.cs:298-308),
+and partition handoff on silo death (GrainDirectoryHandoffManager.cs:40).
+
+TPU-first collapse: for ring-placed grains (HashBasedPlacement — the
+default here), *the directory IS the sharding map*: owner(grain) =
+ring-owner(hash(grain)), and the activation lives on its owner, so lookup
+is a pure local computation with no remote hop and no cache misses.  The
+full DHT path below exists for the general case (random/load-based
+placement, migrations, stateless workers) — exactly the "exception table"
+the north star calls for.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from orleans_tpu.ids import ActivationAddress, GrainId, SiloAddress
+from orleans_tpu.runtime.ring import VirtualBucketsRing
+
+
+class GrainDirectoryCache:
+    """LRU cache of remote directory entries
+    (reference: LRUBasedGrainDirectoryCache.cs:30)."""
+
+    def __init__(self, max_size: int = 100_000):
+        self.max_size = max_size
+        self._entries: "OrderedDict[GrainId, ActivationAddress]" = OrderedDict()
+
+    def get(self, grain_id: GrainId) -> Optional[ActivationAddress]:
+        addr = self._entries.get(grain_id)
+        if addr is not None:
+            self._entries.move_to_end(grain_id)
+        return addr
+
+    def put(self, grain_id: GrainId, addr: ActivationAddress) -> None:
+        self._entries[grain_id] = addr
+        self._entries.move_to_end(grain_id)
+        while len(self._entries) > self.max_size:
+            self._entries.popitem(last=False)
+
+    def invalidate(self, grain_id: GrainId) -> None:
+        self._entries.pop(grain_id, None)
+
+    def invalidate_silo(self, silo: SiloAddress) -> None:
+        dead = [g for g, a in self._entries.items() if a.silo == silo]
+        for g in dead:
+            del self._entries[g]
+
+
+class GrainDirectoryPartition:
+    """This silo's owned slice of the grain→activation map
+    (reference: GrainDirectoryPartition.cs:186)."""
+
+    def __init__(self) -> None:
+        self.entries: Dict[GrainId, ActivationAddress] = {}
+
+    def register_single(self, addr: ActivationAddress
+                        ) -> ActivationAddress:
+        """First writer wins; returns the winning registration
+        (reference: GrainDirectoryPartition.AddSingleActivation)."""
+        existing = self.entries.get(addr.grain)
+        if existing is not None:
+            return existing
+        self.entries[addr.grain] = addr
+        return addr
+
+    def lookup(self, grain_id: GrainId) -> Optional[ActivationAddress]:
+        return self.entries.get(grain_id)
+
+    def remove(self, addr: ActivationAddress) -> None:
+        existing = self.entries.get(addr.grain)
+        if existing is not None and existing.activation == addr.activation:
+            del self.entries[addr.grain]
+
+    def remove_silo_entries(self, silo: SiloAddress) -> List[GrainId]:
+        """Drop every activation hosted on a (dead) silo
+        (reference: GrainDirectoryPartition.RemoveSiloEntries)."""
+        dead = [g for g, a in self.entries.items() if a.silo == silo]
+        for g in dead:
+            del self.entries[g]
+        return dead
+
+    def items(self) -> List[Tuple[GrainId, ActivationAddress]]:
+        return list(self.entries.items())
+
+    def merge(self, entries: Dict[GrainId, ActivationAddress]) -> None:
+        """Handoff merge from a dying/dead silo's partition
+        (reference: GrainDirectoryHandoffManager.ProcessSiloRemoveEvent :141)."""
+        for g, a in entries.items():
+            self.entries.setdefault(g, a)
+
+    def split_out(self, predicate) -> Dict[GrainId, ActivationAddress]:
+        """Extract entries matching ``predicate(grain_id)`` (handoff split)."""
+        out = {g: a for g, a in self.entries.items() if predicate(g)}
+        for g in out:
+            del self.entries[g]
+        return out
+
+
+class LocalGrainDirectory:
+    """The per-silo directory service (reference: LocalGrainDirectory.cs:34).
+
+    Remote partition access goes through the DIRECTORY_SERVICE system
+    target on the owner silo via ``silo.system_rpc`` (reference:
+    RemoteGrainDirectory.cs:32).
+    """
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+        self.ring: VirtualBucketsRing = silo.ring
+        self.partition = GrainDirectoryPartition()
+        self.cache = GrainDirectoryCache()
+        self.lookups_local = 0
+        self.lookups_remote = 0
+
+    # -- ownership ----------------------------------------------------------
+
+    def owner_of(self, grain_id: GrainId) -> SiloAddress:
+        """(reference: LocalGrainDirectory.CalculateTargetSilo :439)"""
+        owner = self.ring.calculate_target_silo(grain_id)
+        return owner if owner is not None else self.silo.address
+
+    # -- registration -------------------------------------------------------
+
+    async def register_single_activation(self, addr: ActivationAddress
+                                         ) -> ActivationAddress:
+        """Register, resolving the single-activation race: the returned
+        address is the winner (may differ from ``addr``)
+        (reference: RegisterSingleActivationAsync :510)."""
+        owner = self.owner_of(addr.grain)
+        if owner == self.silo.address:
+            self.lookups_local += 1
+            return self.partition.register_single(addr)
+        self.lookups_remote += 1
+        winner = await self.silo.system_rpc(
+            owner, "directory", "remote_register_single", (addr,))
+        if winner.silo != self.silo.address:
+            self.cache.put(addr.grain, winner)
+        return winner
+
+    async def unregister(self, addr: ActivationAddress) -> None:
+        owner = self.owner_of(addr.grain)
+        self.cache.invalidate(addr.grain)
+        if owner == self.silo.address:
+            self.partition.remove(addr)
+            return
+        try:
+            await self.silo.system_rpc(owner, "directory",
+                                       "remote_unregister", (addr,))
+        except Exception:
+            pass  # owner unreachable → its partition dies with it
+
+    # -- lookup (reference: Catalog FastLookup :1213 / FullLookup :1224) ----
+
+    def try_local_lookup(self, grain_id: GrainId) -> Optional[ActivationAddress]:
+        """Local partition, then cache — no remote traffic."""
+        if self.ring.owns_hash(grain_id.ring_hash()):
+            return self.partition.lookup(grain_id)
+        return self.cache.get(grain_id)
+
+    async def full_lookup(self, grain_id: GrainId) -> Optional[ActivationAddress]:
+        owner = self.owner_of(grain_id)
+        if owner == self.silo.address:
+            self.lookups_local += 1
+            return self.partition.lookup(grain_id)
+        self.lookups_remote += 1
+        addr = await self.silo.system_rpc(owner, "directory",
+                                          "remote_lookup", (grain_id,))
+        if addr is not None:
+            self.cache.put(grain_id, addr)
+        return addr
+
+    # -- invalidation -------------------------------------------------------
+
+    def invalidate_cache_entry(self, addr: ActivationAddress) -> None:
+        """(reference: InsideGrainClient.cs:298-308 piggybacked invalidations)"""
+        self.cache.invalidate(addr.grain)
+
+    # -- silo lifecycle reactions ------------------------------------------
+
+    def on_silo_dead(self, silo: SiloAddress) -> None:
+        """Drop dead-silo entries + cache lines
+        (reference: LocalGrainDirectory.SiloStatusChangeNotification :390)."""
+        self.partition.remove_silo_entries(silo)
+        self.cache.invalidate_silo(silo)
+
+
+class RemoteGrainDirectory:
+    """System-target facade exposing partition ops to other silos
+    (reference: RemoteGrainDirectory.cs:32).  Registered on every silo under
+    the well-known name 'directory'."""
+
+    def __init__(self, directory: LocalGrainDirectory) -> None:
+        self.directory = directory
+
+    async def remote_register_single(self, addr: ActivationAddress
+                                     ) -> ActivationAddress:
+        return self.directory.partition.register_single(addr)
+
+    async def remote_unregister(self, addr: ActivationAddress) -> None:
+        self.directory.partition.remove(addr)
+
+    async def remote_lookup(self, grain_id: GrainId
+                            ) -> Optional[ActivationAddress]:
+        return self.directory.partition.lookup(grain_id)
+
+    async def accept_handoff(self, entries: Dict[GrainId, ActivationAddress]
+                             ) -> None:
+        """(reference: GrainDirectoryHandoffManager merge :141)"""
+        self.directory.partition.merge(entries)

@@ -1,0 +1,245 @@
+"""Dispatcher: message routing, reentrancy gate, forwarding, deadlock check.
+
+Parity: reference Dispatcher (reference: src/OrleansRuntime/Core/
+Dispatcher.cs:38 — ReceiveMessage :78, ReceiveRequest :265, reentrancy gate
+:316,:329, HandleIncomingRequest :375, deadlock check :345, AsyncSendMessage
+:519, AddressMessage :555 placement+directory resolution, TryForwardRequest
+:474, error injection :62-66,:687).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from orleans_tpu.core.grain import registry as type_registry
+from orleans_tpu.ids import GrainId
+from orleans_tpu.runtime.activation import ActivationData, ActivationState
+from orleans_tpu.runtime.catalog import DuplicateActivationError
+from orleans_tpu.runtime.messaging import (
+    Category,
+    Direction,
+    Message,
+    RejectionType,
+    ResponseKind,
+)
+
+
+class DeadlockError(Exception):
+    """Call-chain cycle detected (reference: DeadlockException;
+    Dispatcher.CheckDeadlock :345)."""
+
+
+class Dispatcher:
+    """Forward limit comes from MessagingConfig.max_forward_count via
+    silo.max_forward_count (reference: Constants MaxForwardCount)."""
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+        self.perform_deadlock_detection = True
+        # fault injection (reference: Dispatcher.cs:62-66)
+        self.rejection_injection_rate = 0.0
+        self._inject_rng = None
+        self.metrics = silo.metrics
+
+    @property
+    def catalog(self):
+        return self.silo.catalog
+
+    @property
+    def runtime_client(self):
+        return self.silo.runtime_client
+
+    # ======================= receive path ==================================
+
+    def receive_message(self, msg: Message) -> None:
+        """(reference: Dispatcher.ReceiveMessage :78)"""
+        self.metrics.dispatcher_received += 1
+        if msg.direction == Direction.RESPONSE:
+            self.runtime_client.receive_response(msg)
+            return
+        if self._should_inject_error(msg):
+            self._respond(msg.create_rejection(RejectionType.TRANSIENT,
+                                               "injected rejection"))
+            return
+        if msg.is_expired():
+            self.metrics.expired_dropped += 1
+            if msg.direction == Direction.REQUEST:
+                self._respond(msg.create_rejection(
+                    RejectionType.TRANSIENT, "request expired in transit"))
+            return
+        # piggybacked directory-cache invalidations
+        # (reference: InsideGrainClient.cs:298-308)
+        for addr in msg.cache_invalidation:
+            self.silo.grain_directory.invalidate_cache_entry(addr)
+
+        if msg.target_grain is not None and msg.target_grain.is_system_target:
+            self.silo.invoke_system_target(msg)
+            return
+        if msg.target_grain is not None and msg.target_grain.is_client:
+            self.silo.deliver_to_client(msg)
+            return
+        asyncio.get_running_loop().create_task(self._receive_request(msg))
+
+    async def _receive_request(self, msg: Message) -> None:
+        """(reference: Dispatcher.ReceiveRequest :265 + activation resolve)"""
+        try:
+            act = await self._resolve_target_activation(msg)
+        except DuplicateActivationError as dup:
+            # lost the single-activation race → forward to the winner
+            # (reference: Catalog.cs:533-563)
+            msg.target_silo = dup.winner.silo
+            msg.target_activation = dup.winner.activation
+            self.try_forward(msg, f"duplicate activation, winner {dup.winner}")
+            return
+        except Exception as exc:
+            self._respond_error(msg, exc)
+            return
+        if act is None:
+            self.try_forward(msg, "no valid activation on this silo")
+            return
+        msg.target_activation = act.activation_id
+
+        # deadlock detection over the carried call chain
+        # (reference: Dispatcher.CheckDeadlock :345)
+        if (self.perform_deadlock_detection
+                and msg.direction == Direction.REQUEST
+                and msg.target_grain in msg.call_chain
+                and not act.may_interleave(msg)):
+            self._respond_error(msg, DeadlockError(
+                f"deadlock: {msg.target_grain} already in call chain "
+                f"{[str(g) for g in msg.call_chain]}"))
+            return
+
+        overload = act.enqueue_or_start(msg, self.runtime_client.invoke)
+        if overload is not None:
+            self.metrics.rejections_sent += 1
+            self._respond(msg.create_rejection(RejectionType.OVERLOADED,
+                                               overload))
+
+    async def _resolve_target_activation(self, msg: Message
+                                         ) -> Optional[ActivationData]:
+        """Find or create the target activation on this silo."""
+        grain_id = msg.target_grain
+        assert grain_id is not None
+        class_info = type_registry.by_type_code.get(grain_id.type_code)
+        if class_info is not None and class_info.stateless_worker:
+            return await self.catalog.get_or_create_stateless_worker(
+                grain_id, class_info)
+        if msg.target_activation is not None:
+            act = self.catalog.directory.by_activation.get(msg.target_activation)
+            if act is not None:
+                if act.state == ActivationState.ACTIVATING:
+                    await self.catalog.wait_for_init(act)
+                if act.state in (ActivationState.VALID,
+                                 ActivationState.ACTIVATING):
+                    return act
+                if (act.state == ActivationState.DEACTIVATING
+                        and act.deactivation_task is not None):
+                    # transient race: the grain is going down — wait it out,
+                    # then re-activate (reference: Dispatcher queues and
+                    # reroutes rather than failing the caller)
+                    await asyncio.shield(act.deactivation_task)
+            # stale/dead address — re-resolve by grain identity
+            # (reference: Dispatcher forward-to-new-address :474)
+            msg.target_activation = None
+        act = await self.catalog.get_or_create_activation(grain_id)
+        if act.state not in (ActivationState.VALID, ActivationState.ACTIVATING):
+            return None
+        msg.target_activation = act.activation_id
+        return act
+
+    # ======================= send path =====================================
+
+    def send_message(self, msg: Message) -> None:
+        """(reference: Dispatcher.AsyncSendMessage :519)"""
+        if msg.target_silo is not None:
+            self.silo.message_center.send_message(msg)
+            return
+        asyncio.get_running_loop().create_task(self._address_and_send(msg))
+
+    async def _address_and_send(self, msg: Message) -> None:
+        """(reference: Dispatcher.AddressMessage :555 —
+        placement + directory resolution)"""
+        try:
+            await self.address_message(msg)
+        except Exception as exc:
+            if msg.direction == Direction.REQUEST:
+                self.runtime_client.receive_response(
+                    msg.create_response(exc, ResponseKind.ERROR))
+            return
+        self.silo.message_center.send_message(msg)
+
+    async def address_message(self, msg: Message) -> None:
+        grain_id = msg.target_grain
+        assert grain_id is not None
+        directory = self.silo.grain_directory
+        # fast path (reference: Catalog.FastLookup :1213)
+        addr = directory.try_local_lookup(grain_id)
+        if addr is None:
+            placement = self.silo.placement_manager
+            result = await placement.select_or_add_activation(grain_id, msg)
+            if result.address is not None:
+                addr = result.address
+            else:
+                # new placement on a chosen silo
+                msg.is_new_placement = True
+                msg.target_silo = result.silo
+                return
+        msg.target_silo = addr.silo
+        msg.target_activation = addr.activation
+
+    def resend_message(self, msg: Message) -> None:
+        """Re-address and resend after a stale target (reference:
+        Dispatcher rerouting on deactivation/catalog destroy)."""
+        msg.target_silo = None
+        msg.target_activation = None
+        self.send_message(msg)
+
+    # ======================= forwarding ====================================
+
+    def try_forward(self, msg: Message, reason: str) -> None:
+        """(reference: Dispatcher.TryForwardRequest :474)"""
+        if msg.direction == Direction.RESPONSE:
+            return
+        msg.forward_count += 1
+        if msg.forward_count > self.silo.max_forward_count:
+            self.metrics.rejections_sent += 1
+            self._respond(msg.create_rejection(
+                RejectionType.UNRECOVERABLE,
+                f"exceeded max forward count ({reason})"))
+            return
+        self.metrics.messages_forwarded += 1
+        if msg.target_silo == self.silo.address:
+            msg.target_silo = None
+        if msg.target_silo is None:
+            msg.target_activation = None
+        self.send_message(msg)
+
+    # ======================= responses =====================================
+
+    def _respond(self, response: Message) -> None:
+        if response.target_silo is None and response.target_grain is not None \
+                and response.target_grain.is_client:
+            self.silo.deliver_to_client(response)
+            return
+        self.silo.message_center.send_message(response)
+
+    def _respond_error(self, msg: Message, exc: Exception) -> None:
+        if msg.direction == Direction.ONE_WAY:
+            return
+        self._respond(msg.create_response(exc, ResponseKind.ERROR))
+
+    # ======================= fault injection ===============================
+
+    def set_rejection_injection(self, rate: float, seed: int = 0) -> None:
+        import random
+        self.rejection_injection_rate = rate
+        self._inject_rng = random.Random(seed) if rate > 0 else None
+
+    def _should_inject_error(self, msg: Message) -> bool:
+        """(reference: Dispatcher.ShouldInjectError :687)"""
+        return (self._inject_rng is not None
+                and msg.category == Category.APPLICATION
+                and msg.direction == Direction.REQUEST
+                and self._inject_rng.random() < self.rejection_injection_rate)

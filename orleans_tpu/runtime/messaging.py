@@ -1,0 +1,222 @@
+"""Message envelope + silo message center.
+
+Parity: the reference's `Message` is a header-dictionary + body-segments
+envelope (reference: src/Orleans/Messaging/Message.cs:35-145 — Categories
+Ping/System/Application :117, Directions Request/Response/OneWay :124,
+RejectionTypes :138, framing :87-88, serialization :518) and the silo hub is
+`MessageCenter` with per-category inbound queues and per-destination sender
+agents (reference: src/OrleansRuntime/Messaging/MessageCenter.cs:33,
+InboundMessageQueue.cs:30, OutboundMessageQueue.cs:33,
+SiloMessageSender.cs:32).
+
+TPU-first re-design: the envelope survives as the *control-plane* unit
+(system traffic, client gateway traffic, cold-path application calls).  The
+*hot* application data plane does not materialize envelopes at all — batched
+grain→grain traffic lives as (dst_row, method, payload) tensors inside the
+tensor engine, and only spills into `Message` objects when a hop leaves the
+device mesh (host grain, remote silo over DCN, client).  The
+Dispatcher/MessageCenter seam (routing policy vs transport) is preserved
+from the reference because it is exactly the tensor-engine/host boundary.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Any, Dict, List, Optional, Tuple
+
+from orleans_tpu.ids import ActivationAddress, ActivationId, GrainId, SiloAddress
+
+
+class Category(IntEnum):
+    """(reference: Message.cs Categories :117)"""
+
+    PING = 1
+    SYSTEM = 2
+    APPLICATION = 3
+
+
+class Direction(IntEnum):
+    """(reference: Message.cs Directions :124)"""
+
+    REQUEST = 1
+    RESPONSE = 2
+    ONE_WAY = 3
+
+
+class RejectionType(IntEnum):
+    """(reference: Message.cs RejectionTypes :138)"""
+
+    TRANSIENT = 1
+    OVERLOADED = 2
+    DUPLICATE_REQUEST = 3
+    UNRECOVERABLE = 4
+    GATEWAY_TOO_BUSY = 5
+
+
+class ResponseKind(IntEnum):
+    SUCCESS = 1
+    ERROR = 2
+    REJECTION = 3
+
+
+_message_ids = itertools.count(1)
+
+
+@dataclass
+class Message:
+    """The unit of control-plane communication.
+
+    Headers that the reference stores in its byte-coded header dictionary
+    (Message.cs:39-75) are plain fields here; the codec serializes the whole
+    dataclass for cross-host hops.
+    """
+
+    category: Category
+    direction: Direction
+    id: int = field(default_factory=lambda: next(_message_ids))
+
+    sending_silo: Optional[SiloAddress] = None
+    sending_grain: Optional[GrainId] = None
+    sending_activation: Optional[ActivationId] = None
+
+    target_silo: Optional[SiloAddress] = None
+    target_grain: Optional[GrainId] = None
+    target_activation: Optional[ActivationId] = None
+
+    interface_id: int = 0
+    method_id: int = 0
+    method_name: str = ""
+    args: Tuple[Any, ...] = ()
+
+    # response fields
+    response_kind: ResponseKind = ResponseKind.SUCCESS
+    result: Any = None
+    rejection_type: Optional[RejectionType] = None
+    rejection_info: str = ""
+
+    # semantics flags (reference: Message.cs IsReadOnly/IsAlwaysInterleave/
+    # IsNewPlacement/IsUnordered)
+    is_read_only: bool = False
+    is_always_interleave: bool = False
+    is_new_placement: bool = False
+    is_unordered: bool = False
+
+    # hop bookkeeping (reference: ForwardCount, ResendCount, MaxRetries)
+    forward_count: int = 0
+    resend_count: int = 0
+
+    # ambient context (reference: RequestContext export; call chain for
+    # deadlock detection, InsideGrainClient.cs:452-467)
+    request_context: Optional[Dict[str, Any]] = None
+    call_chain: Tuple[GrainId, ...] = ()
+
+    # expiry (reference: Message expiry from ResponseTimeout)
+    expiration: Optional[float] = None  # absolute time.monotonic() deadline
+
+    # cache invalidation piggyback (reference: CACHE_INVALIDATION_HEADER,
+    # InsideGrainClient.cs:298-308)
+    cache_invalidation: List[ActivationAddress] = field(default_factory=list)
+
+    # opt-in per-hop tracing (reference: Message.AddTimestamp :109)
+    timestamps: List[Tuple[str, float]] = field(default_factory=list)
+
+    def is_expired(self) -> bool:
+        return self.expiration is not None and time.monotonic() > self.expiration
+
+    def add_timestamp(self, tag: str) -> None:
+        self.timestamps.append((tag, time.monotonic()))
+
+    def target_address(self) -> Optional[ActivationAddress]:
+        if self.target_silo and self.target_grain and self.target_activation:
+            return ActivationAddress(self.target_silo, self.target_grain,
+                                     self.target_activation)
+        return None
+
+    # -- factory helpers ----------------------------------------------------
+
+    def create_response(self, result: Any,
+                        kind: ResponseKind = ResponseKind.SUCCESS) -> "Message":
+        """(reference: Message.CreateResponseMessage)"""
+        return Message(
+            category=self.category,
+            direction=Direction.RESPONSE,
+            id=self.id,
+            sending_silo=self.target_silo,
+            sending_grain=self.target_grain,
+            sending_activation=self.target_activation,
+            target_silo=self.sending_silo,
+            target_grain=self.sending_grain,
+            target_activation=self.sending_activation,
+            interface_id=self.interface_id,
+            method_id=self.method_id,
+            response_kind=kind,
+            result=result,
+            request_context=self.request_context,
+        )
+
+    def create_rejection(self, rejection: RejectionType, info: str) -> "Message":
+        """(reference: Message.CreateRejectionResponse)"""
+        msg = self.create_response(None, ResponseKind.REJECTION)
+        msg.rejection_type = rejection
+        msg.rejection_info = info
+        return msg
+
+    def __repr__(self) -> str:
+        return (f"Msg(#{self.id} {self.category.name}/{self.direction.name} "
+                f"{self.sending_grain}->{self.target_grain} "
+                f"m={self.method_id:x} fwd={self.forward_count})")
+
+
+class MessageCenter:
+    """Per-silo message hub (reference: MessageCenter.cs:33).
+
+    Local targets short-circuit to the dispatcher without transport
+    (reference: MessageCenter.SendMessage :184 local loopback); remote
+    targets go through the registered transport.  Per-category inbound
+    handling matches the reference's three IncomingMessageAgents
+    (reference: Silo.cs:322-324) — here, categories map to distinct asyncio
+    queues so ping/system traffic is never stuck behind application traffic.
+    """
+
+    def __init__(self, silo_address: SiloAddress) -> None:
+        self.my_address = silo_address
+        self.dispatcher = None          # wired by Silo
+        self.transport = None           # wired by Silo (InProcTransport/TCP)
+        self.running = False
+        # fault injection (reference: Dispatcher.cs:62-66 message loss knobs)
+        self.message_loss_rate = 0.0
+        self._drop_fn = None
+        self.on_silo_dead = None        # callback(SiloAddress) from oracle
+        self.metrics = None             # wired by Silo (MessagingStats)
+
+    def send_message(self, msg: Message) -> None:
+        if msg.sending_silo is None:
+            msg.sending_silo = self.my_address
+        if self.metrics is not None:
+            self.metrics.messages_sent += 1
+        if self._drop_fn is not None and self._drop_fn(msg):
+            return  # injected loss
+        if msg.target_silo is None or msg.target_silo == self.my_address:
+            msg.target_silo = self.my_address
+            self.deliver_local(msg)
+        else:
+            self.transport.send(msg)
+
+    def deliver_local(self, msg: Message) -> None:
+        if self.metrics is not None:
+            self.metrics.messages_received += 1
+        self.dispatcher.receive_message(msg)
+
+    def set_message_loss(self, rate: float, rng=None) -> None:
+        """Deterministic-seedable message loss injection
+        (reference: GlobalConfiguration MessageLossInjectionRate)."""
+        import random as _random
+        if rate <= 0:
+            self._drop_fn = None
+            return
+        rng = rng or _random.Random(0)
+        self._drop_fn = lambda msg: (msg.category == Category.APPLICATION
+                                     and rng.random() < rate)

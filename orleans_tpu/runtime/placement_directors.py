@@ -1,0 +1,91 @@
+"""Placement directors: execute per-class placement strategies.
+
+Parity: reference PlacementDirectorsManager + per-strategy directors
+(reference: src/OrleansRuntime/Placement/PlacementDirectorsManager.cs:32;
+RandomPlacementDirector.cs; PreferLocalPlacementDirector.cs;
+ActivationCountPlacementDirector.cs:35 — power-of-k choice :117 fed by
+DeploymentLoadPublisher.cs:39; StatelessWorkerDirector.cs).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from orleans_tpu.core.grain import registry as type_registry
+from orleans_tpu.ids import ActivationAddress, GrainId, SiloAddress
+from orleans_tpu.placement import (
+    ActivationCountBasedPlacement,
+    HashBasedPlacement,
+    PlacementStrategy,
+    PreferLocalPlacement,
+    RandomPlacement,
+    StatelessWorkerPlacement,
+)
+from orleans_tpu.runtime.messaging import Message
+
+
+@dataclass
+class PlacementResult:
+    address: Optional[ActivationAddress] = None  # existing activation found
+    silo: Optional[SiloAddress] = None           # new placement target
+
+
+class PlacementDirectorsManager:
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+        self._rng = random.Random(silo.address.ring_hash())
+        # silo → activation count, fed by the load publisher
+        # (reference: DeploymentLoadPublisher broadcasting silo stats)
+        self.load_view: Dict[SiloAddress, int] = {}
+
+    async def select_or_add_activation(self, grain_id: GrainId,
+                                       msg: Message) -> PlacementResult:
+        """(reference: PlacementDirectorsManager.SelectOrAddActivation,
+        called from Dispatcher.AddressMessage :564)"""
+        class_info = type_registry.by_type_code.get(grain_id.type_code)
+        strategy: PlacementStrategy = class_info.placement if class_info \
+            else HashBasedPlacement()
+
+        if isinstance(strategy, StatelessWorkerPlacement):
+            # stateless workers are always local, never in the directory
+            # (reference: StatelessWorkerDirector.cs)
+            return PlacementResult(silo=self.silo.address)
+
+        # select: does an activation already exist anywhere?
+        addr = await self.silo.grain_directory.full_lookup(grain_id)
+        if addr is not None and self.silo.is_silo_alive(addr.silo):
+            return PlacementResult(address=addr)
+
+        # add: choose a silo for a new activation
+        return PlacementResult(silo=self._choose_silo(strategy, grain_id))
+
+    def _choose_silo(self, strategy: PlacementStrategy,
+                     grain_id: GrainId) -> SiloAddress:
+        members = self.silo.active_silos()
+        if not members:
+            return self.silo.address
+        if isinstance(strategy, HashBasedPlacement):
+            owner = self.silo.grain_directory.owner_of(grain_id)
+            return owner if owner in members else self.silo.address
+        if isinstance(strategy, RandomPlacement):
+            return self._rng.choice(members)
+        if isinstance(strategy, PreferLocalPlacement):
+            return self.silo.address
+        if isinstance(strategy, ActivationCountBasedPlacement):
+            # power-of-k-choices (reference:
+            # ActivationCountPlacementDirector.SelectSiloPowerOfK :117)
+            k = min(strategy.choose_out_of, len(members))
+            candidates = self._rng.sample(members, k)
+            return min(candidates, key=lambda s: self._load_of(s))
+        return self.silo.address
+
+    def _load_of(self, silo: SiloAddress) -> int:
+        if silo == self.silo.address:
+            return len(self.silo.catalog.directory)
+        return self.load_view.get(silo, 0)
+
+    def update_load_view(self, silo: SiloAddress, activations: int) -> None:
+        self.load_view[silo] = activations

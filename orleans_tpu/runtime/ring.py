@@ -1,0 +1,151 @@
+"""Consistent-hash ring of silos.
+
+Parity: reference ConsistentRingProvider (one point per silo,
+reference: src/OrleansRuntime/ConsistentRing/ConsistentRingProvider.cs:39,
+GetPrimaryTargetSilo :74) and VirtualBucketsRingProvider (N virtual buckets
+per silo, reference: VirtualBucketsRingProvider.cs:38,:264), with
+range-change notifications consumed by reminders/streams
+(reference: IRingRangeListener).
+
+The ring is *also* the TPU sharding map: the tensor engine assigns grain
+rows to mesh devices with the same uniform hash the ring uses for silo
+ownership, so "which silo owns this grain" and "which device shard holds
+this grain's state row" are the same function at two granularities.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from orleans_tpu.hashing import jenkins_hash
+from orleans_tpu.ids import GrainId, SiloAddress
+
+RANGE_SIZE = 1 << 32
+
+
+@dataclass(frozen=True)
+class RingRange:
+    """Half-open hash range (begin, end] on the 32-bit ring
+    (reference: IRingRange / SingleRange)."""
+
+    begin: int
+    end: int
+
+    def contains(self, point: int) -> bool:
+        if self.begin == self.end:  # full ring
+            return True
+        if self.begin < self.end:
+            return self.begin < point <= self.end
+        return point > self.begin or point <= self.end
+
+    @property
+    def size(self) -> int:
+        if self.begin == self.end:
+            return RANGE_SIZE
+        return (self.end - self.begin) % RANGE_SIZE
+
+
+RingChangeListener = Callable[[List[SiloAddress], List[SiloAddress]], None]
+
+
+class VirtualBucketsRing:
+    """Ring with N virtual buckets per silo (the reference's recommended
+    provider; reference: VirtualBucketsRingProvider.cs:38).
+
+    Thread-safety is unnecessary (single event loop per silo); updates come
+    from membership notifications.
+    """
+
+    def __init__(self, my_address: SiloAddress, buckets_per_silo: int = 30):
+        self.my_address = my_address
+        self.buckets_per_silo = buckets_per_silo
+        self._points: List[int] = []          # sorted bucket hashes
+        self._owners: Dict[int, SiloAddress] = {}
+        self._members: List[SiloAddress] = []
+        self._listeners: List[RingChangeListener] = []
+        self.add_silo(my_address)
+
+    # -- membership-driven updates -----------------------------------------
+
+    def _bucket_hashes(self, silo: SiloAddress) -> List[int]:
+        return [jenkins_hash(f"{silo.host}:{silo.port}@{silo.generation}#{i}"
+                             .encode("utf-8"))
+                for i in range(self.buckets_per_silo)]
+
+    def add_silo(self, silo: SiloAddress) -> None:
+        if silo in self._members:
+            return
+        self._members.append(silo)
+        for h in self._bucket_hashes(silo):
+            if h in self._owners:
+                continue  # vanishing-probability collision: first owner wins
+            bisect.insort(self._points, h)
+            self._owners[h] = silo
+        self._notify()
+
+    def remove_silo(self, silo: SiloAddress) -> None:
+        if silo not in self._members:
+            return
+        self._members.remove(silo)
+        for h in self._bucket_hashes(silo):
+            if self._owners.get(h) == silo:
+                del self._owners[h]
+                idx = bisect.bisect_left(self._points, h)
+                if idx < len(self._points) and self._points[idx] == h:
+                    self._points.pop(idx)
+        self._notify()
+
+    @property
+    def members(self) -> List[SiloAddress]:
+        return list(self._members)
+
+    def subscribe(self, listener: RingChangeListener) -> None:
+        self._listeners.append(listener)
+
+    def _notify(self) -> None:
+        members = self.members
+        for listener in self._listeners:
+            listener(members, members)
+
+    # -- lookups (reference: ConsistentRingProvider.GetPrimaryTargetSilo :74)
+
+    def owner_of_hash(self, point: int) -> Optional[SiloAddress]:
+        if not self._points:
+            return None
+        # owner = first bucket clockwise from the point
+        idx = bisect.bisect_left(self._points, point % RANGE_SIZE)
+        if idx == len(self._points):
+            idx = 0
+        return self._owners[self._points[idx]]
+
+    def calculate_target_silo(self, grain_id: GrainId) -> Optional[SiloAddress]:
+        """(reference: LocalGrainDirectory.CalculateTargetSilo :439)"""
+        return self.owner_of_hash(grain_id.ring_hash())
+
+    def my_range(self) -> List[RingRange]:
+        """The hash ranges this silo owns (union of its buckets' ranges)."""
+        out: List[RingRange] = []
+        n = len(self._points)
+        for i, point in enumerate(self._points):
+            if self._owners[point] == self.my_address:
+                prev = self._points[(i - 1) % n] if n > 1 else point
+                out.append(RingRange(prev, point))
+        return out
+
+    def owns_hash(self, point: int) -> bool:
+        return self.owner_of_hash(point) == self.my_address
+
+    # ring-walk helpers (reference: LocalGrainDirectory FindPredecessors/
+    # FindSuccessors :346,:368 — used for directory handoff)
+    def successor_of(self, silo: SiloAddress) -> Optional[SiloAddress]:
+        members_sorted = sorted(self._members, key=lambda s: s.ring_hash())
+        if silo not in members_sorted:
+            members_sorted.append(silo)
+            members_sorted.sort(key=lambda s: s.ring_hash())
+        if len(members_sorted) < 2:
+            return None
+        idx = members_sorted.index(silo)
+        succ = members_sorted[(idx + 1) % len(members_sorted)]
+        return succ if succ != silo else None

@@ -1,0 +1,237 @@
+"""In-silo RPC endpoint: request/response correlation + method invocation.
+
+Parity: reference InsideRuntimeClient (reference: src/OrleansRuntime/Core/
+InsideGrainClient.cs:48 — SendRequest :112/:125, callbacks dict :57, Invoke
+:338 with RequestContext import :353 and codegen'd invoker dispatch :361-387,
+SendResponse :415, ReceiveResponse :469, BreakOutstandingMessagesToDeadSilo
+:754) and CallbackData's timeout/resend machinery
+(reference: CallbackData.cs:42,:97-124).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.core import context as ctx
+from orleans_tpu.core.grain import InterfaceInfo, MethodInfo
+from orleans_tpu.ids import GrainId, SiloAddress
+from orleans_tpu.runtime.messaging import (
+    Category,
+    Direction,
+    Message,
+    RejectionType,
+    ResponseKind,
+)
+
+
+class RequestTimeoutError(asyncio.TimeoutError):
+    """(reference: TimeoutException thrown by CallbackData.OnTimeout)"""
+
+
+class RejectionError(Exception):
+    def __init__(self, rejection: RejectionType, info: str):
+        super().__init__(f"{rejection.name}: {info}")
+        self.rejection = rejection
+        self.info = info
+
+
+@dataclass
+class CallbackData:
+    """(reference: CallbackData.cs:42)"""
+
+    future: asyncio.Future
+    message: Message
+    timeout_handle: Any = None
+    resend_count: int = 0
+
+
+class InsideRuntimeClient:
+    """One per silo; also serves in-process clients attached to the silo."""
+
+    DEFAULT_RESPONSE_TIMEOUT = 30.0  # (reference: ResponseTimeout default)
+    MAX_RESEND_COUNT = 3             # (reference: MaxResendCount)
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+        self.callbacks: Dict[int, CallbackData] = {}
+        self.response_timeout = self.DEFAULT_RESPONSE_TIMEOUT
+        self.max_resend_count = self.MAX_RESEND_COUNT
+        self.logger = silo.logger
+        self.resend_on_transient = True
+
+    # wired lazily by Silo
+    @property
+    def catalog(self):
+        return self.silo.catalog
+
+    @property
+    def dispatcher(self):
+        return self.silo.dispatcher
+
+    @property
+    def factory(self):
+        return self.silo.factory
+
+    @property
+    def reminder_registry(self):
+        return self.silo.reminder_service
+
+    def stream_provider(self, name: str):
+        return self.silo.stream_provider(name)
+
+    # ===================== send path =======================================
+
+    def send_request(self, target_grain: GrainId, iface: InterfaceInfo,
+                     method: MethodInfo, args: Tuple[Any, ...],
+                     timeout: Optional[float] = None) -> Optional[asyncio.Future]:
+        """Build, register, and dispatch a request
+        (reference: InsideGrainClient.SendRequestMessage :125).
+
+        Returns the response future, or None for one-way methods.
+        """
+        timeout = timeout if timeout is not None else self.response_timeout
+        sender = ctx.current_activation()
+        sending_grain = sender.grain_id if sender is not None \
+            else self.silo.client_grain_id
+        chain = ctx.current_call_chain()
+        if sending_grain is not None and sending_grain not in chain:
+            chain = chain + (sending_grain,)
+
+        msg = Message(
+            category=Category.APPLICATION,
+            direction=Direction.ONE_WAY if method.one_way else Direction.REQUEST,
+            sending_silo=self.silo.address,
+            sending_grain=sending_grain,
+            sending_activation=sender.activation_id if sender else None,
+            target_grain=target_grain,
+            interface_id=iface.interface_id,
+            method_id=method.method_id,
+            method_name=method.name,
+            # copy barrier for in-process isolation
+            # (reference: SerializationManager.DeepCopy on message bodies)
+            args=tuple(codec.deep_copy(a) for a in args),
+            is_read_only=method.read_only,
+            is_always_interleave=method.always_interleave,
+            request_context=ctx.RequestContext.export(),
+            call_chain=chain,
+            expiration=time.monotonic() + timeout,
+        )
+        self.silo.metrics.requests_sent += 1
+        if method.one_way:
+            self.dispatcher.send_message(msg)
+            return None
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        cb = CallbackData(future=future, message=msg)
+        cb.timeout_handle = loop.call_later(timeout, self._on_timeout, msg.id)
+        self.callbacks[msg.id] = cb
+        self.dispatcher.send_message(msg)
+        return future
+
+    def _on_timeout(self, message_id: int) -> None:
+        """(reference: CallbackData.OnTimeout :97)"""
+        cb = self.callbacks.pop(message_id, None)
+        if cb is None:
+            return
+        self.silo.metrics.requests_timed_out += 1
+        if not cb.future.done():
+            cb.future.set_exception(RequestTimeoutError(
+                f"request {cb.message} timed out after "
+                f"{self.response_timeout}s"))
+
+    # ===================== receive path ====================================
+
+    def receive_response(self, msg: Message) -> None:
+        """(reference: InsideGrainClient.ReceiveResponse :469)"""
+        cb = self.callbacks.get(msg.id)
+        if cb is None:
+            return  # late response after timeout — drop
+        if msg.response_kind == ResponseKind.REJECTION:
+            if (msg.rejection_type == RejectionType.TRANSIENT
+                    and self.resend_on_transient
+                    and cb.resend_count < self.max_resend_count):
+                # transparent resend with re-addressing
+                # (reference: CallbackData.DoResend / Message resend)
+                cb.resend_count += 1
+                cb.message.resend_count = cb.resend_count
+                cb.message.target_silo = None
+                cb.message.target_activation = None
+                self.silo.metrics.requests_resent += 1
+                self.dispatcher.send_message(cb.message)
+                return
+            self.callbacks.pop(msg.id, None)
+            self._cancel_timer(cb)
+            if not cb.future.done():
+                cb.future.set_exception(RejectionError(
+                    msg.rejection_type or RejectionType.UNRECOVERABLE,
+                    msg.rejection_info))
+            return
+        self.callbacks.pop(msg.id, None)
+        self._cancel_timer(cb)
+        if cb.future.done():
+            return
+        if msg.response_kind == ResponseKind.ERROR:
+            exc = msg.result if isinstance(msg.result, BaseException) \
+                else RuntimeError(str(msg.result))
+            cb.future.set_exception(exc)
+        else:
+            cb.future.set_result(msg.result)
+
+    @staticmethod
+    def _cancel_timer(cb: CallbackData) -> None:
+        if cb.timeout_handle is not None:
+            cb.timeout_handle.cancel()
+
+    def break_outstanding_messages_to_dead_silo(self, silo: SiloAddress) -> None:
+        """Fail pending callbacks targeted at a dead silo
+        (reference: InsideGrainClient.BreakOutstandingMessagesToDeadSilo :754)."""
+        broken = [mid for mid, cb in self.callbacks.items()
+                  if cb.message.target_silo == silo]
+        for mid in broken:
+            cb = self.callbacks.pop(mid)
+            self._cancel_timer(cb)
+            if not cb.future.done():
+                cb.future.set_exception(RejectionError(
+                    RejectionType.TRANSIENT,
+                    f"target silo {silo} declared dead"))
+
+    # ===================== invoke path =====================================
+
+    async def invoke(self, msg: Message) -> None:
+        """Execute one turn: deserialize → user method → respond
+        (reference: InsideGrainClient.Invoke :338)."""
+        act = self.catalog.directory.by_activation.get(msg.target_activation)
+        if act is None or act.grain_instance is None:
+            self.dispatcher.try_forward(msg, "activation vanished before turn")
+            return
+        self.silo.metrics.turns_executed += 1
+        from orleans_tpu.core.reference import bind_runtime
+        rt_token = bind_runtime(self)
+        token = ctx.set_current_activation(act)
+        ctx.set_call_chain(msg.call_chain + (msg.target_grain,))
+        ctx.RequestContext.import_(msg.request_context)
+        try:
+            method = getattr(act.grain_instance, msg.method_name, None)
+            if method is None:
+                raise AttributeError(
+                    f"{act.class_info.cls.__name__} has no method "
+                    f"{msg.method_name!r}")
+            result = await method(*msg.args)
+            if msg.direction != Direction.ONE_WAY:
+                response = msg.create_response(codec.deep_copy(result))
+                self.silo.message_center.send_message(response)
+        except Exception as exc:  # noqa: BLE001 — user faults flow to caller
+            self.silo.metrics.turns_faulted += 1
+            if msg.direction != Direction.ONE_WAY:
+                response = msg.create_response(exc, ResponseKind.ERROR)
+                self.silo.message_center.send_message(response)
+            else:
+                self.logger.warn(f"one-way turn failed on {act}: {exc!r}")
+        finally:
+            ctx.reset_current_activation(token)
+            from orleans_tpu.core.reference import _current_runtime
+            _current_runtime.reset(rt_token)

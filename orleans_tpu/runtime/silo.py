@@ -1,0 +1,309 @@
+"""Silo: assembles and runs every runtime component.
+
+Parity: reference Silo (reference: src/OrleansRuntime/Silo.cs:59 —
+constructor wiring :151-337, startup ordering :414-577, graceful stop
+:642-770, FastKill :776, system-target registration :339, status machine
+SystemStatus.cs) and SiloHost.cs.
+
+One silo == one asyncio event loop's worth of control plane + (optionally)
+one slice of the TPU device mesh for the tensor data plane.  Multiple silos
+may share a process and loop (the in-process test cluster — reference:
+TestingSiloHost) or run one per host over the DCN transport.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional
+
+from orleans_tpu.config import SiloConfig
+from orleans_tpu.core.factory import GrainFactory
+from orleans_tpu.ids import (
+    GrainId,
+    SiloAddress,
+    SystemTargetCodes,
+)
+from orleans_tpu.runtime.catalog import Catalog
+from orleans_tpu.runtime.directory import LocalGrainDirectory, RemoteGrainDirectory
+from orleans_tpu.runtime.dispatcher import Dispatcher
+from orleans_tpu.runtime.messaging import (
+    Category,
+    Direction,
+    Message,
+    MessageCenter,
+    ResponseKind,
+)
+from orleans_tpu.runtime.placement_directors import PlacementDirectorsManager
+from orleans_tpu.runtime.ring import VirtualBucketsRing
+from orleans_tpu.runtime.runtime_client import CallbackData, InsideRuntimeClient
+from orleans_tpu.runtime.storage import StorageProvider
+from orleans_tpu.stats import SiloMetrics
+from orleans_tpu.tracing import TraceLogger
+
+
+class SiloStatus(Enum):
+    """(reference: SystemStatus.cs / SiloStatus)"""
+
+    CREATED = "created"
+    JOINING = "joining"
+    ACTIVE = "active"
+    SHUTTING_DOWN = "shutting_down"
+    STOPPING = "stopping"
+    DEAD = "dead"
+
+
+_SYSTEM_TARGET_CODES: Dict[str, int] = {
+    "directory": int(SystemTargetCodes.DIRECTORY_SERVICE),
+    "silo_control": int(SystemTargetCodes.SILO_CONTROL),
+    "client_registrar": int(SystemTargetCodes.CLIENT_OBSERVER_REGISTRAR),
+    "catalog": int(SystemTargetCodes.CATALOG),
+    "membership": int(SystemTargetCodes.MEMBERSHIP_ORACLE),
+    "reminders": int(SystemTargetCodes.REMINDER_SERVICE),
+    "type_manager": int(SystemTargetCodes.TYPE_MANAGER),
+    "provider_manager": int(SystemTargetCodes.PROVIDER_MANAGER),
+    "load_publisher": int(SystemTargetCodes.DEPLOYMENT_LOAD_PUBLISHER),
+    "stream_pulling": int(SystemTargetCodes.STREAM_PULLING_MANAGER),
+}
+_CODE_TO_NAME = {v: k for k, v in _SYSTEM_TARGET_CODES.items()}
+
+
+class Silo:
+    """(reference: Silo.cs:59)"""
+
+    def __init__(self, config: Optional[SiloConfig] = None,
+                 name: str = "silo", port: int = 0,
+                 storage_providers: Optional[Dict[str, StorageProvider]] = None,
+                 ) -> None:
+        self.config = config or SiloConfig(name=name)
+        self.name = self.config.name if config else name
+        self.address = SiloAddress.new_local(host=self.name, port=port)
+        self.status = SiloStatus.CREATED
+        self.logger = TraceLogger(f"silo.{self.name}")
+        self.metrics = SiloMetrics()
+
+        # construction order mirrors reference Silo ctor :151-337
+        self.ring = VirtualBucketsRing(
+            self.address, self.config.directory.buckets_per_silo)
+        self.message_center = MessageCenter(self.address)
+        self.message_center.metrics = self.metrics
+        self.grain_directory = LocalGrainDirectory(self)
+        self.catalog = Catalog(self)
+        self.catalog.age_limit = self.config.collection.default_age_limit
+        self.runtime_client = InsideRuntimeClient(self)
+        self.runtime_client.response_timeout = \
+            self.config.messaging.response_timeout
+        self.runtime_client.max_resend_count = \
+            self.config.messaging.max_resend_count
+        self.grain_directory.cache.max_size = self.config.directory.cache_size
+        self.dispatcher = Dispatcher(self)
+        self.dispatcher.perform_deadlock_detection = \
+            self.config.messaging.deadlock_detection
+        self.placement_manager = PlacementDirectorsManager(self)
+        self.factory = GrainFactory()
+        self.max_forward_count = self.config.messaging.max_forward_count
+
+        self.message_center.dispatcher = self.dispatcher
+
+        # providers (reference: StorageProviderManager; Silo.cs:478-484)
+        self.storage_providers: Dict[str, StorageProvider] = \
+            dict(storage_providers or {})
+        self.stream_providers: Dict[str, Any] = {}
+
+        # system targets (reference: Silo.CreateSystemTargets :339)
+        self.system_targets: Dict[str, Any] = {}
+        self.register_system_target("directory",
+                                    RemoteGrainDirectory(self.grain_directory))
+
+        # identity for calls made from non-grain contexts attached to this
+        # silo (tests, hosted client) — reference: client GrainId
+        self.client_grain_id = GrainId.client(uuid.uuid4())
+
+        # membership wiring (phase 5): until the oracle runs, the ring is
+        # the membership view
+        self.membership_oracle = None
+        self.reminder_service = None
+        self.tensor_engine = None
+        self._stop_callbacks: List[Callable[[], Any]] = []
+
+    # ================= lifecycle (reference: Silo.cs :414,:642) ============
+
+    async def start(self) -> None:
+        self.status = SiloStatus.JOINING
+        for name, provider in self.storage_providers.items():
+            await provider.init(name, {})
+        self.catalog.start_collector(self.config.collection.collection_quantum)
+        if self.membership_oracle is not None:
+            await self.membership_oracle.start()
+        if self.reminder_service is not None:
+            await self.reminder_service.start()
+        for provider in self.stream_providers.values():
+            start = getattr(provider, "start", None)
+            if start is not None:
+                await start()
+        if self.tensor_engine is not None:
+            self.tensor_engine.start()
+        self.status = SiloStatus.ACTIVE
+        self.logger.info(f"silo {self.address} active")
+
+    async def stop(self, graceful: bool = True) -> None:
+        """(reference: Silo.Terminate :642-770 graceful / FastKill :776)"""
+        self.status = SiloStatus.SHUTTING_DOWN if graceful else SiloStatus.STOPPING
+        if self.tensor_engine is not None:
+            await self.tensor_engine.stop(drain=graceful)
+        if graceful:
+            if self.reminder_service is not None:
+                await self.reminder_service.stop()
+            for provider in self.stream_providers.values():
+                stop = getattr(provider, "stop", None)
+                if stop is not None:
+                    await stop()
+            await self.catalog.deactivate_all()
+            if self.membership_oracle is not None:
+                await self.membership_oracle.leave()
+        self.catalog.stop_collector()
+        for cb in self._stop_callbacks:
+            res = cb()
+            if asyncio.iscoroutine(res):
+                await res
+        for provider in self.storage_providers.values():
+            await provider.close()
+        self.status = SiloStatus.DEAD
+
+    def kill(self) -> None:
+        """Hard kill for tests: no deactivations, no handoff
+        (reference: Silo.FastKill :776; TestingSiloHost.KillSilo)."""
+        self.status = SiloStatus.DEAD
+        self.catalog.stop_collector()
+        if self.membership_oracle is not None:
+            self.membership_oracle.kill()
+
+    def on_stop(self, cb: Callable[[], Any]) -> None:
+        self._stop_callbacks.append(cb)
+
+    # ================= membership view =====================================
+
+    def active_silos(self) -> List[SiloAddress]:
+        if self.membership_oracle is not None:
+            return self.membership_oracle.active_silos()
+        return self.ring.members
+
+    def is_silo_alive(self, addr: SiloAddress) -> bool:
+        if self.membership_oracle is not None:
+            return self.membership_oracle.is_alive(addr)
+        return addr in self.ring.members
+
+    def on_silo_dead(self, addr: SiloAddress) -> None:
+        """Fan-out of a death notification (reference: Silo.cs:364-376
+        status-change listeners)."""
+        self.ring.remove_silo(addr)
+        self.grain_directory.on_silo_dead(addr)
+        self.runtime_client.break_outstanding_messages_to_dead_silo(addr)
+
+    # ================= system targets ======================================
+
+    def register_system_target(self, name: str, instance: Any) -> None:
+        self.system_targets[name] = instance
+
+    async def system_rpc(self, target_silo: SiloAddress, target_name: str,
+                         method: str, args: tuple,
+                         timeout: Optional[float] = None) -> Any:
+        """Invoke a system target on any silo
+        (reference: system-target GrainReferences, e.g.
+        RemoteGrainDirectory calls from LocalGrainDirectory)."""
+        if target_silo == self.address:
+            st = self.system_targets[target_name]
+            return await getattr(st, method)(*args)
+        loop = asyncio.get_running_loop()
+        msg = Message(
+            category=Category.SYSTEM,
+            direction=Direction.REQUEST,
+            sending_silo=self.address,
+            sending_grain=self.client_grain_id,
+            target_silo=target_silo,
+            target_grain=GrainId.system_target(
+                _SYSTEM_TARGET_CODES[target_name]),
+            method_name=method,
+            args=args,
+        )
+        future: asyncio.Future = loop.create_future()
+        cb = CallbackData(future=future, message=msg)
+        t = timeout if timeout is not None else self.runtime_client.response_timeout
+        cb.timeout_handle = loop.call_later(
+            t, self.runtime_client._on_timeout, msg.id)
+        self.runtime_client.callbacks[msg.id] = cb
+        self.message_center.send_message(msg)
+        return await future
+
+    def invoke_system_target(self, msg: Message) -> None:
+        """Dispatcher entry for inbound system-target messages."""
+        name = _CODE_TO_NAME.get(msg.target_grain.type_code)
+        st = self.system_targets.get(name) if name else None
+
+        async def run() -> None:
+            try:
+                if st is None:
+                    raise KeyError(f"no system target {name!r} on {self.address}")
+                result = await getattr(st, msg.method_name)(*msg.args)
+                if msg.direction != Direction.ONE_WAY:
+                    self.message_center.send_message(msg.create_response(result))
+            except Exception as exc:  # noqa: BLE001
+                if msg.direction != Direction.ONE_WAY:
+                    self.message_center.send_message(
+                        msg.create_response(exc, ResponseKind.ERROR))
+
+        asyncio.get_running_loop().create_task(run())
+
+    # ================= providers ===========================================
+
+    def storage_provider(self, name: Optional[str]) -> Optional[StorageProvider]:
+        if name is None:
+            return self.storage_providers.get("Default")
+        provider = self.storage_providers.get(name)
+        if provider is None:
+            raise KeyError(
+                f"storage provider {name!r} not configured on silo "
+                f"{self.name} (reference: StorageProviderManager lookup)")
+        return provider
+
+    def add_storage_provider(self, name: str, provider: StorageProvider) -> None:
+        self.storage_providers[name] = provider
+
+    def stream_provider(self, name: str):
+        provider = self.stream_providers.get(name)
+        if provider is None:
+            raise KeyError(f"stream provider {name!r} not configured")
+        return provider
+
+    def attach_client(self) -> GrainFactory:
+        """Bind the calling context to this silo as an in-process client
+        (reference: GrainClient.Initialize for the hosted-client case).
+        Returns the grain factory; subsequent grain calls in this task (and
+        its children) route through this silo."""
+        from orleans_tpu.core.reference import bind_runtime
+        bind_runtime(self.runtime_client)
+        return self.factory
+
+    # ================= client edge =========================================
+
+    def deliver_to_client(self, msg: Message) -> None:
+        """Deliver a message addressed to a client grain-id (observer calls,
+        gateway replies) — wired by the gateway (phase: client runtime)."""
+        gateway = self.system_targets.get("gateway")
+        if gateway is not None:
+            gateway.deliver(msg)
+        else:
+            self.logger.warn(f"dropping client-bound message {msg}: no gateway")
+
+    # ================= debug ===============================================
+
+    def get_debug_dump(self) -> Dict[str, Any]:
+        """(reference: Silo.GetDebugDump :1057)"""
+        return {
+            "address": str(self.address),
+            "status": self.status.value,
+            "activations": len(self.catalog.directory),
+            "metrics": self.metrics.snapshot(),
+            "ring_members": [str(s) for s in self.ring.members],
+        }

@@ -1,0 +1,115 @@
+"""Persistence bridge: grain state ↔ storage providers.
+
+Parity: reference IStorageProvider / GrainStateStorageBridge
+(reference: src/Orleans/Storage/IStorageProvider.cs; src/Orleans/Core/
+GrainStateStorageBridge.cs; etag discipline per provider, e.g.
+AzureTableStorage.cs:68), loaded during activation stage 2
+(reference: Catalog.SetupActivationState, Catalog.cs:731).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from orleans_tpu.ids import GrainId
+
+
+class InconsistentStateError(Exception):
+    """Etag mismatch on write (reference: InconsistentStateException)."""
+
+    def __init__(self, stored_etag: Optional[str], current_etag: Optional[str]):
+        super().__init__(
+            f"etag conflict: stored={stored_etag!r} current={current_etag!r}")
+        self.stored_etag = stored_etag
+        self.current_etag = current_etag
+
+
+@dataclass
+class GrainState:
+    """State record + etag (reference: GrainState.cs / IGrainState)."""
+
+    data: Any = None
+    etag: Optional[str] = None
+    record_exists: bool = False
+
+
+class StorageProvider:
+    """Provider contract (reference: IStorageProvider.cs).
+
+    Implementations must honor etags: a write with a stale etag raises
+    InconsistentStateError; a successful write returns the new etag.
+    """
+
+    name: str = "?"
+
+    async def init(self, name: str, config: Dict[str, Any]) -> None:
+        self.name = name
+
+    async def close(self) -> None:
+        pass
+
+    async def read_state(self, grain_type: str, grain_id: GrainId,
+                         state: GrainState) -> None:
+        raise NotImplementedError
+
+    async def write_state(self, grain_type: str, grain_id: GrainId,
+                          state: GrainState) -> None:
+        raise NotImplementedError
+
+    async def clear_state(self, grain_type: str, grain_id: GrainId,
+                          state: GrainState) -> None:
+        raise NotImplementedError
+
+
+class GrainStateStorageBridge:
+    """Per-activation storage facade injected into StatefulGrain
+    (reference: GrainStateStorageBridge.cs)."""
+
+    def __init__(self, grain_type: str, grain_id: GrainId,
+                 provider: Optional[StorageProvider],
+                 initial_state: Optional[Callable[[], Any]] = None) -> None:
+        self.grain_type = grain_type
+        self.grain_id = grain_id
+        self.provider = provider
+        self._initial_state = initial_state
+        self.grain_state = GrainState()
+        if initial_state is not None:
+            self.grain_state.data = initial_state()
+
+    @property
+    def state(self) -> Any:
+        return self.grain_state.data
+
+    @state.setter
+    def state(self, value: Any) -> None:
+        self.grain_state.data = value
+
+    @property
+    def etag(self) -> Optional[str]:
+        return self.grain_state.etag
+
+    async def read_state(self) -> None:
+        if self.provider is None:
+            return
+        await self.provider.read_state(self.grain_type, self.grain_id,
+                                       self.grain_state)
+        if not self.grain_state.record_exists and self._initial_state is not None:
+            self.grain_state.data = self._initial_state()
+
+    async def write_state(self) -> None:
+        if self.provider is None:
+            raise RuntimeError(
+                f"grain type {self.grain_type} has no storage provider "
+                f"configured (reference: [StorageProvider] attribute missing)")
+        await self.provider.write_state(self.grain_type, self.grain_id,
+                                        self.grain_state)
+
+    async def clear_state(self) -> None:
+        if self.provider is None:
+            raise RuntimeError(
+                f"grain type {self.grain_type} has no storage provider configured")
+        await self.provider.clear_state(self.grain_type, self.grain_id,
+                                        self.grain_state)
+        if self._initial_state is not None:
+            self.grain_state.data = self._initial_state()

@@ -1,0 +1,81 @@
+"""Statistics and metrics registry.
+
+Parity: reference counter/statistic groups (reference: src/Orleans/
+Statistics/CounterStatistic.cs, MessagingStatisticsGroup.cs,
+SchedulerStatisticsGroup.cs, ApplicationRequestsStatisticsGroup.cs;
+periodic dump LogStatistics.cs:33; silo aggregation
+SiloStatisticsManager.cs:31).
+
+TPU-first note: hot-path counters on the device side are accumulated *in*
+the tick kernels (one scalar per metric per tick, reduced with the step) and
+folded into this registry by the tensor engine after each tick — the
+reference's interlocked per-message increments would serialize the device.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Histogram:
+    """Fixed log-scale histogram
+    (reference: HistogramValueStatistic.cs exponential buckets)."""
+
+    buckets: List[int] = field(default_factory=lambda: [0] * 64)
+    count: int = 0
+    total: float = 0.0
+
+    def add(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        ns = max(1, int(value * 1e9))
+        self.buckets[min(63, ns.bit_length() - 1)] += 1
+
+    def percentile(self, p: float) -> float:
+        """Approximate percentile from log buckets (upper bound of bucket)."""
+        if self.count == 0:
+            return 0.0
+        target = p * self.count
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            seen += n
+            if seen >= target:
+                return (1 << (i + 1)) / 1e9
+        return (1 << 63) / 1e9
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class SiloMetrics:
+    """Per-silo counter group (a flattened union of the reference's
+    MessagingStatisticsGroup + MessagingProcessingStatisticsGroup +
+    ApplicationRequestsStatisticsGroup + SchedulerStatisticsGroup)."""
+
+    def __init__(self) -> None:
+        self.messages_sent = 0
+        self.messages_received = 0
+        self.messages_forwarded = 0
+        self.dispatcher_received = 0
+        self.expired_dropped = 0
+        self.rejections_sent = 0
+        self.requests_sent = 0
+        self.requests_resent = 0
+        self.requests_timed_out = 0
+        self.turns_executed = 0
+        self.turns_faulted = 0
+        self.turn_latency = Histogram()
+        self.custom: Dict[str, float] = defaultdict(float)
+
+    def snapshot(self) -> Dict[str, float]:
+        out = {k: v for k, v in self.__dict__.items()
+               if isinstance(v, (int, float))}
+        out.update(self.custom)
+        out["turn_latency_p99"] = self.turn_latency.percentile(0.99)
+        out["turn_latency_mean"] = self.turn_latency.mean
+        return out

@@ -1,0 +1,23 @@
+"""HelloWorld sample — the minimum end-to-end slice.
+
+Parity: reference Samples/HelloWorld (HelloGrain.cs; IHello interface;
+single silo, one grain, one RPC).
+"""
+
+from __future__ import annotations
+
+from orleans_tpu import Grain, grain_interface
+from orleans_tpu.core.grain import grain_class
+
+
+@grain_interface
+class IHello:
+    async def say_hello(self, greeting: str) -> str: ...
+
+
+@grain_class
+class HelloGrain(Grain, IHello):
+    """(reference: Samples/HelloWorld/HelloWorldGrains/HelloGrain.cs)"""
+
+    async def say_hello(self, greeting: str) -> str:
+        return f"You said: '{greeting}', I say: Hello!"

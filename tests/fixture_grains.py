@@ -1,0 +1,212 @@
+"""Test fixture grains (reference analog: src/TestGrains + TestInternalGrains)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List
+
+from orleans_tpu import (
+    Grain,
+    StatefulGrain,
+    grain_interface,
+    one_way,
+    read_only,
+    reentrant,
+    stateless_worker,
+)
+from orleans_tpu.core.grain import grain_class
+
+
+@grain_interface
+class ISlowGrain:
+    async def slow_echo(self, v, delay: float): ...
+    async def get_log(self) -> list: ...
+
+    @read_only
+    async def peek(self) -> int: ...
+
+
+@grain_class
+class SlowGrain(Grain, ISlowGrain):
+    """Serialization probe: records turn overlap."""
+
+    def __init__(self) -> None:
+        self.log: List[str] = []
+        self.active_turns = 0
+        self.max_overlap = 0
+
+    async def slow_echo(self, v, delay: float):
+        self.active_turns += 1
+        self.max_overlap = max(self.max_overlap, self.active_turns)
+        self.log.append(f"start:{v}")
+        await asyncio.sleep(delay)
+        self.log.append(f"end:{v}")
+        self.active_turns -= 1
+        return v
+
+    async def get_log(self):
+        return list(self.log)
+
+    @read_only
+    async def peek(self) -> int:
+        self.active_turns += 1
+        self.max_overlap = max(self.max_overlap, self.active_turns)
+        await asyncio.sleep(0.01)
+        self.active_turns -= 1
+        return self.max_overlap
+
+
+@grain_interface
+class IReentrantGrain:
+    async def slow(self, delay: float): ...
+    async def overlap(self) -> int: ...
+
+
+@reentrant
+@grain_class
+class ReentrantGrain(Grain, IReentrantGrain):
+    def __init__(self) -> None:
+        self.active = 0
+        self.max_overlap = 0
+
+    async def slow(self, delay: float):
+        self.active += 1
+        self.max_overlap = max(self.max_overlap, self.active)
+        await asyncio.sleep(delay)
+        self.active -= 1
+
+    async def overlap(self) -> int:
+        return self.max_overlap
+
+
+@grain_interface
+class IPingA:
+    async def start_cycle(self, other_key: int): ...
+    async def touch(self) -> str: ...
+
+
+@grain_interface
+class IPingB:
+    async def call_back(self, a_key: int): ...
+
+
+@grain_class
+class PingAGrain(Grain, IPingA):
+    async def start_cycle(self, other_key: int):
+        b = self.get_grain(IPingB, other_key)
+        return await b.call_back(self.primary_key)
+
+    async def touch(self) -> str:
+        return "touched"
+
+
+@grain_class
+class PingBGrain(Grain, IPingB):
+    async def call_back(self, a_key: int):
+        a = self.get_grain(IPingA, a_key)
+        return await a.touch()
+
+
+@grain_interface
+class ILifecycleGrain:
+    async def events(self) -> list: ...
+    async def die(self): ...
+
+
+@grain_class
+class LifecycleGrain(Grain, ILifecycleGrain):
+    activated = 0
+    deactivated = 0
+
+    def __init__(self) -> None:
+        self.local_events: List[str] = []
+
+    async def on_activate(self) -> None:
+        LifecycleGrain.activated += 1
+        self.local_events.append("activate")
+
+    async def on_deactivate(self) -> None:
+        LifecycleGrain.deactivated += 1
+        self.local_events.append("deactivate")
+
+    async def events(self) -> list:
+        return list(self.local_events)
+
+    async def die(self):
+        self.deactivate_on_idle()
+
+
+@grain_interface
+class ITimerGrain:
+    async def start(self, period: float): ...
+    async def ticks(self) -> int: ...
+
+
+@grain_class
+class TimerGrain(Grain, ITimerGrain):
+    def __init__(self) -> None:
+        self.tick_count = 0
+        self._timer = None
+
+    async def start(self, period: float):
+        async def on_tick(_state):
+            self.tick_count += 1
+
+        self._timer = self.register_timer(on_tick, period, period)
+
+    async def ticks(self) -> int:
+        return self.tick_count
+
+
+@grain_interface
+class IWorkerGrain:
+    async def work(self, delay: float) -> str: ...
+
+
+@stateless_worker(max_local=4)
+@grain_class
+class WorkerGrain(Grain, IWorkerGrain):
+    async def work(self, delay: float) -> str:
+        await asyncio.sleep(delay)
+        return str(self._activation.activation_id)
+
+
+@grain_interface
+class ICounterGrain:
+    async def add(self, n: int) -> int: ...
+    async def get(self) -> int: ...
+    async def save(self): ...
+    async def wipe(self): ...
+
+
+@grain_class(storage_provider="Default", initial_state=lambda: {"count": 0})
+class CounterGrain(StatefulGrain, ICounterGrain):
+    """(reference analog: persistence test grains over MemoryStorage)"""
+
+    async def add(self, n: int) -> int:
+        self.state["count"] += n
+        return self.state["count"]
+
+    async def get(self) -> int:
+        return self.state["count"]
+
+    async def save(self):
+        await self.write_state()
+
+    async def wipe(self):
+        await self.clear_state()
+
+
+@grain_interface
+class IFailingGrain:
+    async def boom(self): ...
+    async def ok(self) -> str: ...
+
+
+@grain_class
+class FailingGrain(Grain, IFailingGrain):
+    async def boom(self):
+        raise ValueError("kaboom")
+
+    async def ok(self) -> str:
+        return "fine"

@@ -1,0 +1,202 @@
+"""Turn semantics: single-threading, reentrancy, deadlock, lifecycle, timers.
+
+Reference analogs: Tester/BasicActivationTests, GrainActivateDeactivateTests,
+ReentrancyTests, DeadlockDetectionTests, TimerTests, StatelessWorkerTests,
+ExceptionPropagationTests.
+"""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.runtime.dispatcher import DeadlockError
+from orleans_tpu.runtime.silo import Silo
+from orleans_tpu.providers.memory_storage import MemoryStorage
+
+from tests.fixture_grains import (
+    IFailingGrain,
+    ILifecycleGrain,
+    IPingA,
+    IReentrantGrain,
+    ISlowGrain,
+    ITimerGrain,
+    IWorkerGrain,
+    LifecycleGrain,
+)
+
+
+async def make_silo(**kw) -> Silo:
+    silo = Silo(storage_providers={"Default": MemoryStorage()}, **kw)
+    await silo.start()
+    return silo
+
+
+def test_non_reentrant_turns_serialize(run):
+    async def main():
+        silo = await make_silo()
+        try:
+            g = silo.attach_client().get_grain(ISlowGrain, 1)
+            await asyncio.gather(g.slow_echo("a", 0.02), g.slow_echo("b", 0.02),
+                                 g.slow_echo("c", 0.02))
+            log = await g.get_log()
+            # no interleaving: every start is immediately followed by its end
+            for i in range(0, len(log), 2):
+                assert log[i].split(":")[1] == log[i + 1].split(":")[1]
+                assert log[i].startswith("start") and log[i + 1].startswith("end")
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_read_only_interleaves(run):
+    async def main():
+        silo = await make_silo()
+        try:
+            g = silo.attach_client().get_grain(ISlowGrain, 2)
+            results = await asyncio.gather(*(g.peek() for _ in range(5)))
+            assert max(results) > 1  # read-only turns overlapped
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_reentrant_interleaves(run):
+    async def main():
+        silo = await make_silo()
+        try:
+            g = silo.attach_client().get_grain(IReentrantGrain, 1)
+            await asyncio.gather(*(g.slow(0.02) for _ in range(4)))
+            assert await g.overlap() > 1
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_deadlock_detection(run):
+    async def main():
+        silo = await make_silo()
+        try:
+            a = silo.attach_client().get_grain(IPingA, 1)
+            # A(1) → B(2) → A(1).touch() is a call-chain cycle
+            with pytest.raises(DeadlockError):
+                await a.start_cycle(2)
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_deadlock_detection_disabled_times_out(run):
+    async def main():
+        silo = await make_silo()
+        silo.dispatcher.perform_deadlock_detection = False
+        silo.runtime_client.response_timeout = 0.2
+        try:
+            a = silo.attach_client().get_grain(IPingA, 1)
+            with pytest.raises(asyncio.TimeoutError):
+                await a.start_cycle(2)
+        finally:
+            silo.kill()
+
+    run(main())
+
+
+def test_lifecycle_and_deactivate_on_idle(run):
+    async def main():
+        silo = await make_silo()
+        try:
+            g = silo.attach_client().get_grain(ILifecycleGrain, 7)
+            before_act = LifecycleGrain.activated
+            assert await g.events() == ["activate"]
+            assert LifecycleGrain.activated == before_act + 1
+            before = LifecycleGrain.deactivated
+            await g.die()
+            await asyncio.sleep(0.05)
+            assert LifecycleGrain.deactivated == before + 1
+            assert len(silo.catalog.directory) == 0
+            # next call re-activates transparently (virtual actor contract)
+            assert await g.events() == ["activate"]
+            assert LifecycleGrain.activated == before_act + 2
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_age_based_collection(run):
+    async def main():
+        silo = await make_silo()
+        try:
+            g = silo.attach_client().get_grain(ILifecycleGrain, 8)
+            await g.events()
+            assert len(silo.catalog.directory) == 1
+            await asyncio.sleep(0.05)
+            collected = silo.catalog.collect_idle_activations(age_limit=0.01)
+            assert collected == 1
+            await asyncio.sleep(0.05)
+            assert len(silo.catalog.directory) == 0
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_timers(run):
+    async def main():
+        silo = await make_silo()
+        try:
+            g = silo.attach_client().get_grain(ITimerGrain, 1)
+            await g.start(0.02)
+            await asyncio.sleep(0.15)
+            ticks = await g.ticks()
+            assert ticks >= 3
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_stateless_worker_scales_out(run):
+    async def main():
+        silo = await make_silo()
+        try:
+            g = silo.attach_client().get_grain(IWorkerGrain, 0)
+            ids = await asyncio.gather(*(g.work(0.03) for _ in range(4)))
+            assert len(set(ids)) > 1  # multiple local replicas served
+            assert len(set(ids)) <= 4  # bounded by max_local
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_exception_propagation(run):
+    async def main():
+        silo = await make_silo()
+        try:
+            g = silo.attach_client().get_grain(IFailingGrain, 1)
+            with pytest.raises(ValueError, match="kaboom"):
+                await g.boom()
+            assert await g.ok() == "fine"  # activation survives user faults
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_request_context_flows(run):
+    async def main():
+        from orleans_tpu import RequestContext
+
+        silo = await make_silo()
+        try:
+            g = silo.attach_client().get_grain(IFailingGrain, 2)
+            RequestContext.set("trace_id", "t-123")
+            assert await g.ok() == "fine"
+        finally:
+            await silo.stop()
+
+    run(main())

@@ -1,0 +1,49 @@
+"""End-to-end HelloWorld: the PR1 slice (reference: Samples/HelloWorld;
+test analog: Tester/HelloWorldTests pattern via TestingSiloHost)."""
+
+import asyncio
+
+from orleans_tpu.runtime.silo import Silo
+from samples.helloworld import IHello
+
+
+def test_hello_end_to_end(run):
+    async def main():
+        silo = Silo(name="s1")
+        await silo.start()
+        try:
+            factory = silo.attach_client()
+            hello = factory.get_grain(IHello, 0)
+            reply = await hello.say_hello("Good morning, my friend!")
+            assert reply == "You said: 'Good morning, my friend!', I say: Hello!"
+            # same logical grain → same activation (single-activation)
+            assert len(silo.catalog.directory) == 1
+            await hello.say_hello("again")
+            assert len(silo.catalog.directory) == 1
+            # different key → different activation
+            other = factory.get_grain(IHello, 1)
+            await other.say_hello("hi")
+            assert len(silo.catalog.directory) == 2
+        finally:
+            await silo.stop()
+        assert len(silo.catalog.directory) == 0  # graceful stop deactivated all
+
+    run(main())
+
+
+def test_many_grains_concurrent(run):
+    async def main():
+        silo = Silo(name="s1")
+        await silo.start()
+        try:
+            factory = silo.attach_client()
+            refs = [factory.get_grain(IHello, i) for i in range(200)]
+            replies = await asyncio.gather(
+                *(r.say_hello(str(i)) for i, r in enumerate(refs)))
+            assert len(replies) == 200
+            assert all("I say: Hello!" in r for r in replies)
+            assert len(silo.catalog.directory) == 200
+        finally:
+            await silo.stop()
+
+    run(main())

@@ -1,0 +1,81 @@
+"""Identity layer tests (reference analog: TesterInternal/Identifiertests.cs)."""
+
+import uuid
+
+from orleans_tpu.hashing import combine_hashes, jenkins_hash, stable_hash_u64
+from orleans_tpu.ids import (
+    ActivationAddress,
+    ActivationId,
+    GrainCategory,
+    GrainId,
+    SiloAddress,
+)
+
+
+def test_jenkins_hash_stable_and_spread():
+    h1 = jenkins_hash(b"hello world")
+    assert h1 == jenkins_hash(b"hello world")
+    assert jenkins_hash(b"hello worlc") != h1
+    # spread: 1000 sequential keys should hit many distinct high bytes
+    buckets = {jenkins_hash(str(i).encode()) >> 24 for i in range(1000)}
+    assert len(buckets) > 200
+
+
+def test_stable_hash_u64():
+    assert stable_hash_u64(42) == stable_hash_u64(42)
+    assert stable_hash_u64(42) != stable_hash_u64(43)
+    assert 0 <= stable_hash_u64(2**64 - 1) < 2**64
+    assert combine_hashes(1, 2) != combine_hashes(2, 1)
+
+
+def test_grain_id_interning_and_equality():
+    a = GrainId.from_int(7, 123)
+    b = GrainId.from_int(7, 123)
+    assert a is b  # interned (reference: Interner.cs)
+    assert a == b
+    c = GrainId.from_int(7, 124)
+    assert a != c
+    assert a != GrainId.from_int(8, 123)
+
+
+def test_grain_id_key_kinds():
+    gi = GrainId.from_int(1, 99)
+    assert gi.primary_key_int == 99
+    u = uuid.uuid4()
+    gg = GrainId.from_guid(1, u)
+    assert gg.primary_key_guid == u
+    gs = GrainId.from_string(1, "player/42")
+    assert gs.primary_key_str == "player/42"
+    assert gs.category == GrainCategory.KEY_EXT_GRAIN
+    assert gs == GrainId.from_string(1, "player/42")
+    assert gs != GrainId.from_string(1, "player/43")
+
+
+def test_grain_id_packed_distinct():
+    seen = {GrainId.from_int(5, k).packed() for k in range(10_000)}
+    assert len(seen) == 10_000
+
+
+def test_ring_hash_uniformity():
+    # 8 equal-ish buckets over 10k grains
+    counts = [0] * 8
+    for k in range(10_000):
+        h = GrainId.from_int(3, k).ring_hash()
+        counts[h >> 29] += 1
+    assert min(counts) > 800  # no empty/starved bucket
+
+
+def test_silo_address():
+    s1 = SiloAddress.new_local("hostA", 11111)
+    s2 = SiloAddress.new_local("hostA", 11111)
+    assert s1 != s2                      # generations differ
+    assert s1.matches(s2)                # same endpoint
+    assert s1.ring_hash() != s2.ring_hash()
+
+
+def test_activation_address_roundtrip_str():
+    silo = SiloAddress.new_local()
+    grain = GrainId.from_int(2, 5)
+    act = ActivationId.new()
+    addr = ActivationAddress(silo, grain, act)
+    assert str(grain) in str(addr)
