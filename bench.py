@@ -1,0 +1,105 @@
+"""Benchmark driver: Presence @ 1M grains, messages/sec vs single-silo CPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "msg/s", "vs_baseline": N, ...}
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+measured against a live single-silo CPU actor baseline: the same Presence
+workload executed through this framework's *host path* — per-message
+dispatch through an asyncio actor runtime with mailboxes, directory lookup
+and request/response correlation, structurally equivalent to the
+reference's per-message Dispatcher/Scheduler pipeline
+(reference: src/OrleansRuntime/Core/Dispatcher.cs,
+Scheduler/OrleansTaskScheduler.cs).  North star: ≥50× (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+import time
+
+
+def _quiet() -> None:
+    logging.disable(logging.WARNING)
+    os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+
+
+async def _tensor_presence(n_players: int, n_games: int, n_ticks: int,
+                           warmup_ticks: int = 2) -> dict:
+    from orleans_tpu.tensor import TensorEngine
+    from samples.presence import run_presence_load
+
+    engine = TensorEngine()
+    await run_presence_load(engine, n_players=n_players, n_games=n_games,
+                            n_ticks=warmup_ticks)
+    return await run_presence_load(engine, n_players=n_players,
+                                   n_games=n_games, n_ticks=n_ticks)
+
+
+async def _host_baseline(n_players: int = 2000, n_games: int = 20,
+                         n_rounds: int = 3) -> float:
+    """Single-silo CPU actor path: one heartbeat RPC per player per round,
+    each fanning one update into its game grain (2 logical messages), with
+    per-message dispatch — the reference's execution model."""
+    from samples.presence_host import HostPresenceGrain, IHostPresence  # noqa: F401
+    from orleans_tpu.runtime.silo import Silo
+
+    silo = Silo(name="baseline")
+    await silo.start()
+    try:
+        factory = silo.attach_client()
+        refs = [factory.get_grain(IHostPresence, i) for i in range(n_players)]
+        # warm activation pass (activation cost is not the steady state)
+        await asyncio.gather(*(r.heartbeat(i % n_games, 0.0, 0)
+                               for i, r in enumerate(refs)))
+        t0 = time.perf_counter()
+        for t in range(n_rounds):
+            await asyncio.gather(*(r.heartbeat(i % n_games, 1.0, t + 1)
+                                   for i, r in enumerate(refs)))
+        elapsed = time.perf_counter() - t0
+        messages = 2 * n_players * n_rounds
+        return messages / elapsed
+    finally:
+        await silo.stop(graceful=False)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="small sizes for a quick correctness pass")
+    parser.add_argument("--players", type=int, default=1_000_000)
+    parser.add_argument("--games", type=int, default=10_000)
+    parser.add_argument("--ticks", type=int, default=20)
+    args = parser.parse_args()
+    _quiet()
+
+    if args.smoke:
+        args.players, args.games, args.ticks = 10_000, 100, 5
+
+    async def run() -> dict:
+        stats = await _tensor_presence(args.players, args.games, args.ticks)
+        baseline = await _host_baseline()
+        return {
+            "metric": "presence_grain_messages_per_sec",
+            "value": round(stats["messages_per_sec"], 1),
+            "unit": "msg/s",
+            "vs_baseline": round(stats["messages_per_sec"] / baseline, 2),
+            "baseline_msgs_per_sec": round(baseline, 1),
+            "baseline_def": "single-silo CPU per-message actor dispatch "
+                            "(host path), same workload",
+            "grains": args.players + args.games,
+            "ticks": args.ticks,
+            "p99_turn_latency_s": round(stats["p99_tick_seconds"], 4),
+        }
+
+    result = asyncio.run(run())
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

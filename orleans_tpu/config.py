@@ -62,6 +62,9 @@ class TensorEngineConfig:
     max_rounds_per_tick: int = 4          # intra-tick call-chain rounds
     bucket_sizes: tuple = (256, 4096, 65536, 1 << 20)  # padded batch buckets
     mesh_axis: str = "grains"
+    # max parked optimistic miss-checks before a forced (synchronizing)
+    # drain — bounds device memory pinned by deferred delivery checks
+    miss_check_cap: int = 16
 
 
 @dataclass

@@ -163,6 +163,10 @@ def placement(strategy: PlacementStrategy) -> Callable[[type], type]:
 _INTERFACES: Dict[int, InterfaceInfo] = {}
 _INTERFACES_BY_NAME: Dict[str, InterfaceInfo] = {}
 
+# interface_id → implementation type code for grain kinds implemented
+# outside the host registry (tensor-path vector grains register here)
+external_impl_type_codes: Dict[int, int] = {}
+
 
 def grain_interface(cls: type) -> type:
     """Declare a grain interface: every public ``async def`` (or
@@ -412,12 +416,19 @@ def grain_id_for(interface, key) -> GrainId:
     type code, so references and activations agree on identity
     (reference: TypeCodeMapper.ComposeGrainId)."""
     iface = get_interface(interface)
-    impl = registry.implementation_of(iface.interface_id)
+    try:
+        type_code = registry.implementation_of(iface.interface_id).type_code
+    except KeyError:
+        # non-host implementations (vector grains) record their type code
+        # here at decoration time — no core→tensor dependency
+        type_code = external_impl_type_codes.get(iface.interface_id)
+        if type_code is None:
+            raise
     import uuid as _uuid
-    if isinstance(key, int):
-        return GrainId.from_int(impl.type_code, key)
+    if isinstance(key, int) and not isinstance(key, bool):
+        return GrainId.from_int(type_code, key)
     if isinstance(key, str):
-        return GrainId.from_string(impl.type_code, key)
+        return GrainId.from_string(type_code, key)
     if isinstance(key, _uuid.UUID):
-        return GrainId.from_guid(impl.type_code, key)
+        return GrainId.from_guid(type_code, key)
     raise TypeError(f"unsupported grain key type {type(key)}")

@@ -93,6 +93,23 @@ class InsideRuntimeClient:
 
         Returns the response future, or None for one-way methods.
         """
+        if method.batched:
+            # tensor-path grain: route into the tick machine, not the
+            # per-message dispatcher
+            if self.silo.tensor_engine is None:
+                raise RuntimeError(
+                    f"vector grain call {method.name} but the silo has no "
+                    f"tensor engine (TensorEngineConfig.enabled=False?)")
+            fut = self.silo.tensor_engine.send_one(target_grain, method, args)
+            if fut is not None:
+                # same response-timeout discipline as host-path calls
+                t = timeout if timeout is not None else self.response_timeout
+                handle = asyncio.get_running_loop().call_later(
+                    t, lambda: fut.done() or fut.set_exception(
+                        RequestTimeoutError(
+                            f"vector call {method.name} timed out")))
+                fut.add_done_callback(lambda _f: handle.cancel())
+            return fut
         timeout = timeout if timeout is not None else self.response_timeout
         sender = ctx.current_activation()
         sending_grain = sender.grain_id if sender is not None \

@@ -124,8 +124,14 @@ class Silo:
         # the membership view
         self.membership_oracle = None
         self.reminder_service = None
-        self.tensor_engine = None
         self._stop_callbacks: List[Callable[[], Any]] = []
+
+        # the TPU data plane (SURVEY.md §7 design stance)
+        if self.config.tensor.enabled:
+            from orleans_tpu.tensor.engine import TensorEngine
+            self.tensor_engine = TensorEngine(self, self.config.tensor)
+        else:
+            self.tensor_engine = None
 
     # ================= lifecycle (reference: Silo.cs :414,:642) ============
 

@@ -1,0 +1,42 @@
+"""The TPU data plane: batched, tick-based grain execution.
+
+This package is the rebuild's answer to the reference's hot path — the
+per-message Dispatcher/Scheduler traversal (reference: src/OrleansRuntime/
+Core/Dispatcher.cs, Scheduler/OrleansTaskScheduler.cs).  Instead of routing
+one message at a time through queues and threads, each tick:
+
+1. collects the tick's messages into dense (dst_row, payload) tensors,
+2. routes them to the owning state shard (host index + XLA collectives),
+3. applies one vectorized state-transition kernel per (grain type, method)
+   — ``segment_sum``/gather-scatter fan-in on the MXU/VPU,
+4. emits next-tick messages and host-bound responses.
+
+Grain identity, the directory, persistence and RPC surfaces are shared with
+the host path: a vector grain is still a grain.
+"""
+
+from orleans_tpu.tensor.vector_grain import (
+    Batch,
+    Emit,
+    VectorGrain,
+    field,
+    seg_max,
+    seg_mean,
+    seg_sum,
+    scatter_rows,
+    vector_grain,
+)
+from orleans_tpu.tensor.engine import TensorEngine
+
+__all__ = [
+    "Batch",
+    "Emit",
+    "VectorGrain",
+    "field",
+    "seg_sum",
+    "seg_max",
+    "seg_mean",
+    "scatter_rows",
+    "vector_grain",
+    "TensorEngine",
+]

@@ -1,0 +1,239 @@
+"""GrainArena: the stacked state store for one vector grain type.
+
+The arena is the tensor-path Catalog + ActivationDirectory (reference:
+Catalog.cs:43, ActivationDirectory.cs:33): an activation is a *row*; the
+host keeps the key→row index (the local directory partition) and the device
+holds the state columns.  Row blocks are assigned to mesh shards by grain
+key hash, so "which device owns this grain" is the same stable function the
+silo ring uses — the directory IS the sharding map (BASELINE.json north
+star).
+
+Auto-activation: resolving an unseen key allocates a row in the key's home
+shard block and initializes its columns from the declared field inits —
+the batched analog of GetOrCreateActivation (reference: Catalog.cs:411).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.hashing import stable_hash_u64
+from orleans_tpu.tensor.vector_grain import StateField, VectorGrainInfo
+
+
+class ArenaFullError(RuntimeError):
+    pass
+
+
+def _hash_keys_u64(keys: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 matching hashing.stable_hash_u64, so host row
+    assignment and any device-side bucketing agree."""
+    x = keys.astype(np.uint64)
+    x = x + np.uint64(0x9E3779B97F4A7C15)
+    z = x
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+class GrainArena:
+
+    def __init__(self, info: VectorGrainInfo, capacity: int = 1024,
+                 n_shards: int = 1, sharding: Optional[Any] = None) -> None:
+        self.info = info
+        self.n_shards = max(1, n_shards)
+        # capacity must divide evenly into shard blocks
+        per_shard = max(1, -(-capacity // self.n_shards))
+        self.shard_capacity = per_shard
+        self.capacity = per_shard * self.n_shards
+        self.sharding = sharding
+
+        self.state: Dict[str, jnp.ndarray] = {}
+        self._init_state_columns(self.capacity)
+        # bumped whenever rows move (growth/repack); consumers holding
+        # resolved row vectors must re-resolve on mismatch
+        self.generation = 0
+
+        # host-side directory partition: key → row
+        self._key_of_row = np.full(self.capacity, -1, dtype=np.int64)
+        self._shard_next = np.zeros(self.n_shards, dtype=np.int64)
+        self._sorted_keys = np.empty(0, dtype=np.int64)
+        self._sorted_rows = np.empty(0, dtype=np.int32)
+        self._dirty = False
+        self.live_count = 0
+        self.last_use_tick = np.zeros(self.capacity, dtype=np.int64)
+
+        # device-side directory mirror (int32 keys only — see device_resolve):
+        # lets emit routing resolve key→row without any host round-trip,
+        # which matters because d2h transfers are the slowest link.
+        self._dev_sorted_keys: Optional[jnp.ndarray] = None
+        self._dev_sorted_rows: Optional[jnp.ndarray] = None
+        self._dev_index_stale = True
+
+    # -- state columns ------------------------------------------------------
+
+    def _make_column(self, f: StateField, capacity: int) -> jnp.ndarray:
+        col = jnp.full((capacity, *f.shape), f.init, dtype=f.dtype)
+        if self.sharding is not None:
+            col = jax.device_put(col, self.sharding)
+        return col
+
+    def _init_state_columns(self, capacity: int) -> None:
+        self.state = {name: self._make_column(f, capacity)
+                      for name, f in self.info.state_fields.items()}
+
+    # -- key → row resolution ----------------------------------------------
+
+    def _rebuild_index(self) -> None:
+        live = self._key_of_row >= 0
+        rows = np.nonzero(live)[0].astype(np.int32)
+        keys = self._key_of_row[rows]
+        order = np.argsort(keys, kind="stable")
+        self._sorted_keys = keys[order]
+        self._sorted_rows = rows[order]
+        self._dirty = False
+        self._dev_index_stale = True
+
+    # -- device-side directory mirror ---------------------------------------
+
+    def device_index(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """The key→row map as device arrays (sorted int32 keys + rows).
+
+        This is the 'directory == sharding map' realization: the same
+        partition the host serves to the control plane is resident on the
+        mesh, so batched routing (emits, injections) resolves destinations
+        with a vectorized searchsorted instead of a host hop.  Keys wider
+        than int32 fall back to the host path (hashed/string grain keys are
+        rare on the hot path; int-keyed grains cover the benchmarks)."""
+        if self._dirty:
+            self._rebuild_index()
+        if self._dev_index_stale or self._dev_sorted_keys is None:
+            keys32 = self._sorted_keys.astype(np.int32)
+            if np.any(keys32.astype(np.int64) != self._sorted_keys):
+                raise OverflowError(
+                    f"arena {self.info.name}: keys exceed int32; device "
+                    f"routing unavailable (use host-side resolution)")
+            # pad to capacity with the sentinel so the resolve kernel's
+            # shapes only change on capacity growth (not per activation)
+            pad = self.capacity - len(keys32)
+            keys_padded = np.concatenate(
+                [keys32, np.full(pad, 2**31 - 1, np.int32)])
+            rows_padded = np.concatenate(
+                [self._sorted_rows, np.full(pad, -1, np.int32)])
+            dk = jnp.asarray(keys_padded)
+            dr = jnp.asarray(rows_padded)
+            if self.sharding is not None:
+                # replicate the index: every shard routes locally
+                from jax.sharding import NamedSharding, PartitionSpec
+                repl = NamedSharding(self.sharding.mesh, PartitionSpec())
+                dk = jax.device_put(dk, repl)
+                dr = jax.device_put(dr, repl)
+            self._dev_sorted_keys = dk
+            self._dev_sorted_rows = dr
+            self._dev_index_stale = False
+        return self._dev_sorted_keys, self._dev_sorted_rows
+
+    def lookup_rows(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized lookup; returns (rows int32, found bool)."""
+        if self._dirty:
+            self._rebuild_index()
+        if len(self._sorted_keys) == 0:
+            return (np.full(len(keys), -1, np.int32),
+                    np.zeros(len(keys), bool))
+        idx = np.searchsorted(self._sorted_keys, keys)
+        idx = np.minimum(idx, len(self._sorted_keys) - 1)
+        found = self._sorted_keys[idx] == keys
+        rows = np.where(found, self._sorted_rows[idx], -1).astype(np.int32)
+        return rows, found
+
+    def resolve_rows(self, keys: np.ndarray, auto_activate: bool = True,
+                     tick: int = 0) -> np.ndarray:
+        """key→row with auto-activation of unseen keys
+        (batched GetOrCreateActivation)."""
+        keys = np.asarray(keys, dtype=np.int64)
+        rows, found = self.lookup_rows(keys)
+        if auto_activate and not found.all():
+            missing = np.unique(keys[~found])
+            self._activate_keys(missing)
+            rows, found = self.lookup_rows(keys)
+            if not found.all():
+                raise ArenaFullError(
+                    f"arena {self.info.name}: activation failed for "
+                    f"{(~found).sum()} keys")
+        self.last_use_tick[rows[rows >= 0]] = tick
+        return rows
+
+    def _activate_keys(self, keys: np.ndarray) -> None:
+        shards = (_hash_keys_u64(keys) % np.uint64(self.n_shards)).astype(np.int64)
+        # check capacity per shard; grow if any block would overflow
+        counts = np.bincount(shards, minlength=self.n_shards)
+        while np.any(self._shard_next + counts > self.shard_capacity):
+            self._grow()
+        for s in range(self.n_shards):
+            ks = keys[shards == s]
+            if len(ks) == 0:
+                continue
+            start = int(self._shard_next[s])
+            base = s * self.shard_capacity
+            rows = np.arange(start, start + len(ks)) + base
+            self._key_of_row[rows] = ks
+            self._shard_next[s] += len(ks)
+        self.live_count += len(keys)
+        self._dirty = True
+
+    # -- growth -------------------------------------------------------------
+
+    def _grow(self) -> None:
+        """Double the per-shard block size, repacking rows so each shard's
+        block stays contiguous (rows move; the key index is rebuilt —
+        resharding is the same op at a bigger granularity)."""
+        old_per = self.shard_capacity
+        new_per = old_per * 2
+        new_capacity = new_per * self.n_shards
+        old_rows = np.nonzero(self._key_of_row >= 0)[0]
+        old_shards = old_rows // old_per
+        new_rows = (old_shards * new_per) + (old_rows % old_per)
+
+        new_key_of_row = np.full(new_capacity, -1, dtype=np.int64)
+        new_key_of_row[new_rows] = self._key_of_row[old_rows]
+        new_last_use = np.zeros(new_capacity, dtype=np.int64)
+        new_last_use[new_rows] = self.last_use_tick[old_rows]
+
+        new_state: Dict[str, jnp.ndarray] = {}
+        idx = jnp.asarray(old_rows, dtype=jnp.int32)
+        dst = jnp.asarray(new_rows, dtype=jnp.int32)
+        for name, f in self.info.state_fields.items():
+            col = self._make_column(f, new_capacity)
+            col = col.at[dst].set(self.state[name][idx])
+            new_state[name] = col
+
+        self.state = new_state
+        self.shard_capacity = new_per
+        self.capacity = new_capacity
+        self._key_of_row = new_key_of_row
+        self.last_use_tick = new_last_use
+        self._dirty = True
+        self.generation += 1
+
+    def reserve(self, n: int) -> None:
+        """Pre-size so ~n activations fit without growth mid-benchmark."""
+        per_shard_target = -(-n // self.n_shards)
+        while self.shard_capacity < per_shard_target * 2:
+            self._grow()
+
+    # -- host access (debug / persistence / host-path interop) --------------
+
+    def read_row(self, key: int) -> Optional[Dict[str, np.ndarray]]:
+        rows, found = self.lookup_rows(np.array([key], dtype=np.int64))
+        if not found[0]:
+            return None
+        r = int(rows[0])
+        return {name: np.asarray(col[r]) for name, col in self.state.items()}
+
+    def keys(self) -> np.ndarray:
+        return self._key_of_row[self._key_of_row >= 0]

@@ -1,0 +1,565 @@
+"""TensorEngine: the batched tick machine.
+
+This is the rebuild's hot data plane, replacing the reference's per-message
+Dispatcher/MessageCenter/Scheduler traversal (reference: Dispatcher.cs:38,
+MessageCenter.cs:33, OrleansTaskScheduler.cs:37) with the north star's
+tick pipeline:
+
+    collect → resolve rows (directory) → apply batched kernels → route emits
+
+A *tick* runs up to ``max_rounds_per_tick`` rounds so intra-tick call
+chains (grain A's handler emitting to grain B) propagate without waiting
+for the next tick — the batched analog of Orleans' continuation
+interleaving (SURVEY.md §7 hard-part 2).  Messages still queued after the
+round cap spill to the next tick.
+
+Data-movement discipline (the design driver — measured on this platform,
+d2h is orders of magnitude slower than device compute):
+
+* host→device happens once per externally-injected batch (the client edge);
+  ``BatchInjector`` amortizes even that by caching resolved destination
+  rows for a stable key set.
+* emit routing — the grain→grain hot path — never touches the host: each
+  arena keeps a replicated device mirror of its key→row directory
+  partition, and destinations resolve with a vectorized searchsorted
+  *on the mesh*.  Only a scalar "unseen keys?" count crosses to the host
+  per routed round, and only cold-start batches pay the (bounded,
+  compacted) miss-key fetch that activates new rows.
+* device→host happens only for explicitly requested results.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field as dc_field
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.config import TensorEngineConfig
+from orleans_tpu.core.grain import MethodInfo
+from orleans_tpu.ids import GrainId
+from orleans_tpu.tensor.arena import GrainArena
+from orleans_tpu.tensor.vector_grain import (
+    Batch,
+    Emit,
+    VectorGrainInfo,
+    vector_type,
+)
+
+KEY_SENTINEL = np.int32(2**31 - 1)  # device-path keys must be < this
+MISS_BUF = 8192                     # unique unseen keys activated per pass
+
+
+@dataclass
+class PendingBatch:
+    """One queued slab of messages for a (type, method).
+
+    Exactly one of (rows, keys_host, keys_dev) identifies destinations:
+    ``rows`` = already resolved (injector fast path), ``keys_host`` = host
+    resolution at dequeue, ``keys_dev`` = device resolution (emits).
+    """
+
+    args: Any                                  # pytree [m, ...] np or device
+    rows: Optional[jnp.ndarray] = None         # int32[m] device
+    keys_host: Optional[np.ndarray] = None     # int64[m]
+    keys_dev: Optional[jnp.ndarray] = None     # int32[m] device
+    mask: Optional[jnp.ndarray] = None         # bool[m] device (None = all)
+    future: Optional[asyncio.Future] = None    # resolves to results[m]
+    generation: int = -1                       # arena generation rows assume
+
+    def __len__(self) -> int:
+        for c in (self.rows, self.keys_host, self.keys_dev):
+            if c is not None:
+                return len(c)
+        raise ValueError("empty batch")
+
+
+@dataclass
+class _MissCheck:
+    """A parked optimistic-resolution check (see _resolve_batch)."""
+
+    arena: Any
+    type_name: str
+    method: str
+    keys: jnp.ndarray
+    valid: jnp.ndarray
+    rows: jnp.ndarray
+    miss_count: jnp.ndarray
+    args: Any
+
+
+@jax.jit
+def _resolve_rows_kernel(sorted_keys, sorted_rows, keys, valid):
+    """Device-side directory lookup: keys → rows (-1 = unseen).
+
+    The batched analog of LocalGrainDirectory lookup (reference:
+    LocalGrainDirectory.cs:34): the sorted index IS this type's directory
+    partition, replicated across the mesh."""
+    n = sorted_keys.shape[0]
+    valid = valid & (keys < KEY_SENTINEL)
+    idx = jnp.clip(jnp.searchsorted(sorted_keys, keys), 0, n - 1)
+    hit = (sorted_keys[idx] == keys) & valid
+    rows = jnp.where(hit, sorted_rows[idx], -1)
+    return rows, jnp.sum(hit ^ valid)  # miss count
+
+
+@partial(jax.jit, static_argnames=("miss_buf",))
+def _miss_keys_kernel(keys, rows, valid, miss_buf: int):
+    """Compact the unseen keys (cold path only — involves a device sort)."""
+    missing = (rows < 0) & valid & (keys < KEY_SENTINEL)
+    return jnp.unique(jnp.where(missing, keys, KEY_SENTINEL),
+                      size=miss_buf, fill_value=KEY_SENTINEL), missing
+
+
+class TensorEngine:
+
+    def __init__(self, silo=None, config: Optional[TensorEngineConfig] = None,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 initial_capacity: int = 1024) -> None:
+        self.silo = silo
+        self.config = config or TensorEngineConfig()
+        self.mesh = mesh
+        self.initial_capacity = initial_capacity
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self.n_shards = mesh.devices.size
+            self.sharding = NamedSharding(mesh,
+                                          PartitionSpec(self.config.mesh_axis))
+            self.replicated = NamedSharding(mesh, PartitionSpec())
+        else:
+            self.n_shards = 1
+            self.sharding = None
+            self.replicated = None
+
+        self.arenas: Dict[str, GrainArena] = {}
+        self.queues: Dict[Tuple[str, str], List[PendingBatch]] = defaultdict(list)
+        self.tick_number = 0
+        self.ticks_run = 0
+        self.rounds_run = 0
+        self.messages_processed = 0
+        self.tick_seconds = 0.0
+        self.activation_passes = 0
+
+        self._step_cache: Dict[Tuple[str, str, int], Callable] = {}
+        self._pending_checks: List[_MissCheck] = []
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+        self._wake: Optional[asyncio.Event] = None
+
+    # ================= arenas =============================================
+
+    def arena_for(self, type_name: str) -> GrainArena:
+        arena = self.arenas.get(type_name)
+        if arena is None:
+            info = vector_type(type_name)
+            if info is None:
+                raise KeyError(f"{type_name!r} is not a @vector_grain type")
+            arena = GrainArena(info, capacity=self.initial_capacity,
+                               n_shards=self.n_shards, sharding=self.sharding)
+            self.arenas[type_name] = arena
+        return arena
+
+    # ================= submission (the client/batch edge) =================
+
+    @staticmethod
+    def _type_name(interface) -> str:
+        return interface if isinstance(interface, str) else interface.__name__
+
+    def send_batch(self, interface, method: str, keys: np.ndarray, args: Any,
+                   want_results: bool = False) -> Optional[asyncio.Future]:
+        """Bulk message injection — the TPU-native client edge: one call
+        carries a whole (dst, payload) tensor (north star: 'batched
+        adjacency+payload tensors')."""
+        type_name = self._type_name(interface)
+        future = asyncio.get_running_loop().create_future() \
+            if want_results else None
+        if (isinstance(keys, jnp.ndarray) and keys.dtype == jnp.int32
+                and not want_results):
+            # device keys resolve optimistically (unseen keys re-delivered
+            # later) — that cannot retroactively fix an already-resolved
+            # result future, so want_results forces the host path
+            batch = PendingBatch(args=args, keys_dev=keys, future=future)
+        else:
+            batch = PendingBatch(args=args,
+                                 keys_host=np.asarray(keys, dtype=np.int64),
+                                 future=future)
+        self.queues[(type_name, method)].append(batch)
+        self._wake_up()
+        return future
+
+    def make_injector(self, interface, method: str,
+                      keys: np.ndarray) -> "BatchInjector":
+        """Pre-resolve a stable destination set once; subsequent injections
+        are zero-lookup (the gateway's steady-state client edge)."""
+        return BatchInjector(self, self._type_name(interface), method,
+                             np.asarray(keys, dtype=np.int64))
+
+    def send_one(self, grain_id: GrainId, method: MethodInfo,
+                 args: tuple) -> Optional[asyncio.Future]:
+        """Single-message path used by GrainReference proxies — vector
+        grains stay callable exactly like host grains."""
+        info = vector_type(grain_id.type_code)
+        if info is None:
+            raise KeyError(f"{grain_id} is not a vector grain")
+        payload = args[0] if args else {}
+        one = jax.tree_util.tree_map(lambda x: np.asarray([x]), payload)
+        fut = self.send_batch(info.name, method.name,
+                              np.array([grain_id.primary_key_int]), one,
+                              want_results=not method.one_way)
+        if fut is None:
+            return None
+        loop = asyncio.get_running_loop()
+        scalar: asyncio.Future = loop.create_future()
+
+        def unwrap(f: asyncio.Future) -> None:
+            if scalar.done():
+                return
+            if f.exception() is not None:
+                scalar.set_exception(f.exception())
+            else:
+                res = f.result()
+                scalar.set_result(
+                    jax.tree_util.tree_map(lambda x: np.asarray(x)[0], res)
+                    if res is not None else None)
+
+        fut.add_done_callback(unwrap)
+        return scalar
+
+    # ================= tick loop ==========================================
+
+    def start(self) -> None:
+        self._running = True
+        self._wake = asyncio.Event()
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self, drain: bool = True) -> None:
+        if drain and self._running:
+            await self.flush()
+        self._running = False
+        if self._wake is not None:
+            self._wake.set()
+        if self._task is not None:
+            await self._task
+            self._task = None
+
+    def _wake_up(self) -> None:
+        if self._wake is not None:
+            self._wake.set()
+
+    async def _loop(self) -> None:
+        while self._running:
+            await self._wake.wait()
+            self._wake.clear()
+            while self._running:
+                while self._running and any(self.queues.values()):
+                    self.run_tick()
+                    # yield so producers can batch up the next tick
+                    await asyncio.sleep(self.config.tick_interval)
+                if not self._drain_checks():
+                    break
+
+    async def drain_queues(self) -> None:
+        """Dispatch all queued work to the device without waiting for
+        deferred miss-checks (the pipelined steady-state path)."""
+        while any(self.queues.values()):
+            self.run_tick()
+            await asyncio.sleep(0)
+
+    async def flush(self) -> None:
+        """Run ticks until every queue drains AND all optimistic
+        miss-checks have settled (full delivery — tests/benchmark ends)."""
+        while True:
+            await self.drain_queues()
+            if not self._drain_checks():
+                break
+
+    # ================= tick execution =====================================
+
+    def run_tick(self) -> None:
+        t0 = time.perf_counter()
+        self.tick_number += 1
+        self.ticks_run += 1
+        if len(self._pending_checks) >= self.config.miss_check_cap:
+            # bound device memory pinned by parked optimistic checks
+            self._drain_checks()
+        rounds = 0
+        while rounds < self.config.max_rounds_per_tick:
+            pending = {k: v for k, v in self.queues.items() if v}
+            if not pending:
+                break
+            self.queues = defaultdict(list)
+            for (type_name, method), batches in pending.items():
+                self._run_group(type_name, method, batches)
+            rounds += 1
+            self.rounds_run += 1
+        self.tick_seconds += time.perf_counter() - t0
+
+    # -- destination resolution --------------------------------------------
+
+    def _resolve_batch(self, arena: GrainArena, b: PendingBatch,
+                       method: str) -> Tuple[jnp.ndarray, Any]:
+        """Normalize a batch to (rows int32[m] device, args device).
+
+        Device-key batches resolve *optimistically*: messages to unseen
+        keys get row -1 (dropped by the kernels) and a deferred miss-check
+        is parked; at the next quiescence point the engine activates the
+        unseen keys and re-delivers exactly the dropped messages.  This is
+        the batched analog of at-least-once delivery with resend
+        (reference: CallbackData resend, Dispatcher rerouting) and keeps
+        the hot path free of host synchronization."""
+        args = b.args
+        if b.rows is not None and b.generation == arena.generation:
+            return b.rows, args
+        if b.keys_host is not None:
+            # pre-resolved rows gone stale (arena growth repacked rows) fall
+            # through to here too, re-resolving from the kept keys
+            rows = arena.resolve_rows(b.keys_host, tick=self.tick_number)
+            return rows.astype(np.int32), args  # numpy → host-pad path
+        keys = b.keys_dev
+        valid = b.mask if b.mask is not None \
+            else jnp.ones(keys.shape[0], dtype=bool)
+        sk, sr = arena.device_index()
+        rows, miss_count = _resolve_rows_kernel(sk, sr, keys, valid)
+        self._pending_checks.append(
+            _MissCheck(arena=arena, type_name=arena.info.name,
+                       method=method, keys=keys, valid=valid,
+                       rows=rows, miss_count=miss_count, args=args))
+        return rows, args
+
+    def _drain_checks(self) -> bool:
+        """Quiescence point: activate unseen keys discovered by optimistic
+        resolution and re-deliver their (and only their) messages.
+        Returns True if new work was queued."""
+        if not self._pending_checks:
+            return False
+        checks = self._pending_checks
+        self._pending_checks = []
+        requeued = False
+        # one batched sync for all parked counts
+        counts = [int(c.miss_count) for c in checks]
+        for c, cnt in zip(checks, counts):
+            if cnt == 0:
+                continue
+            self.activation_passes += 1
+            miss_keys, missing = _miss_keys_kernel(c.keys, c.rows, c.valid,
+                                                   miss_buf=MISS_BUF)
+            mk = np.asarray(miss_keys)
+            mk = mk[mk != KEY_SENTINEL].astype(np.int64)
+            if len(mk):
+                c.arena.resolve_rows(mk, tick=self.tick_number)  # activates
+            # re-deliver only the dropped messages; convergence across
+            # cycles even when unique misses exceed MISS_BUF
+            self.queues[(c.type_name, c.method)].append(PendingBatch(
+                args=c.args, keys_dev=c.keys, mask=missing))
+            requeued = True
+        return requeued
+
+    # -- group execution ----------------------------------------------------
+
+    def _run_group(self, type_name: str, method: str,
+                   batches: List[PendingBatch]) -> None:
+        """Execute one (type, method) group.
+
+        Latency discipline: the steady-state path (one device-resident
+        batch of a stable size) performs ZERO eager device ops — one jitted
+        resolve (emit batches) + one jitted step.  Eager jax ops are ~1000×
+        a jit dispatch on tunneled TPU runtimes, so host-side batches are
+        padded in numpy and device batches are compiled at their natural
+        (stable) sizes instead of being padded to buckets."""
+        info = vector_type(type_name)
+        arena = self.arena_for(type_name)
+
+        # re-resolve if any batch's resolution itself grew/repacked the
+        # arena (growth is rare; the loop converges immediately after)
+        while True:
+            gen0 = arena.generation
+            resolved = [self._resolve_batch(arena, b, method)
+                        for b in batches]
+            if arena.generation == gen0:
+                break
+        masks = [b.mask for b in batches]
+        if len(resolved) == 1:
+            rows, args = resolved[0]
+            mask = masks[0]
+        else:
+            # multi-batch rounds are rare (fan-in of emits from several
+            # producer groups); one eager concat per input
+            rows = jnp.concatenate([jnp.asarray(r) for r, _ in resolved])
+            args = jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(
+                    [jnp.broadcast_to(jnp.asarray(x),
+                                      (len(resolved[i][0]),)
+                                      + jnp.shape(x)[1:])
+                     if jnp.ndim(x) == 0 else jnp.asarray(x)
+                     for i, x in enumerate(xs)]),
+                *(a for _, a in resolved))
+            mask = None if all(m is None for m in masks) else \
+                jnp.concatenate([m if m is not None
+                                 else jnp.ones(len(b), dtype=bool)
+                                 for m, b in zip(masks, batches)])
+
+        if isinstance(rows, np.ndarray):
+            # host batch: pad in numpy (cheap) to a bucket for compile reuse
+            m_real = len(rows)
+            bucket = self._bucket_for(m_real)
+            if bucket != m_real:
+                rows = np.concatenate(
+                    [rows, np.full(bucket - m_real, -1, np.int32)])
+                args = jax.tree_util.tree_map(
+                    lambda a: _pad_np(np.asarray(a), bucket), args)
+            mask_np = np.zeros(bucket, bool)
+            mask_np[:m_real] = True
+            mask = mask_np
+            m_total = m_real
+        else:
+            m_total = rows.shape[0]
+
+        self.messages_processed += m_total
+        want_results = any(b.future is not None for b in batches)
+
+        step = self._get_step(info, method)
+        if mask is None:
+            mask = _mask_for(rows.shape[0] if hasattr(rows, "shape")
+                             else len(rows))
+        new_state, results, emits = step(arena.state, rows, args, mask)
+        arena.state = new_state
+        self._route_emits(emits)
+        if want_results:
+            self._deliver_results(batches, results)
+
+    def _deliver_results(self, batches: List[PendingBatch],
+                         results: Any) -> None:
+        start = 0
+        for b in batches:
+            m = len(b)
+            if b.future is not None and not b.future.done():
+                if results is None:
+                    b.future.set_result(None)
+                else:
+                    # d2h only here — the caller explicitly asked
+                    b.future.set_result(jax.tree_util.tree_map(
+                        lambda x: np.asarray(x[start:start + m]), results))
+            start += m
+
+    def _route_emits(self, emits) -> None:
+        if not emits:
+            return
+        for emit in (emits if isinstance(emits, (tuple, list)) else (emits,)):
+            if emit is None:
+                continue
+            keys = emit.keys
+            if not (isinstance(keys, jnp.ndarray) and keys.dtype == jnp.int32):
+                keys = jnp.asarray(keys, dtype=jnp.int32)
+            self.queues[(emit.interface, emit.method)].append(PendingBatch(
+                args=emit.args, keys_dev=keys, mask=emit.mask))
+
+    # ================= compilation ========================================
+
+    def _bucket_for(self, m: int) -> int:
+        for b in self.config.bucket_sizes:
+            if m <= b:
+                return b
+        return self.config.bucket_sizes[-1]
+
+    def _get_step(self, info: VectorGrainInfo, method: str) -> Callable:
+        key = (info.name, method)
+        step = self._step_cache.get(key)
+        if step is not None:
+            return step
+        handler = info.handlers[method]
+
+        def step_fn(state, rows, args, mask):
+            n_rows = next(iter(state.values())).shape[0]
+            out = handler(state, Batch(rows=rows, args=args, mask=mask),
+                          n_rows)
+            # normalize handler returns: state | (state,) | (state, results)
+            # | (state, results, emits)
+            if isinstance(out, dict):
+                return out, None, ()
+            out = tuple(out)
+            state2 = out[0]
+            results = out[1] if len(out) > 1 else None
+            emits = out[2] if len(out) > 2 else ()
+            return state2, results, emits
+
+        step = jax.jit(step_fn, donate_argnums=(0,))
+        self._step_cache[key] = step
+        return step
+
+    # ================= stats ==============================================
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "ticks": self.ticks_run,
+            "rounds": self.rounds_run,
+            "messages": self.messages_processed,
+            "tick_seconds": self.tick_seconds,
+            "msgs_per_sec": (self.messages_processed / self.tick_seconds
+                             if self.tick_seconds > 0 else 0.0),
+            "activation_passes": self.activation_passes,
+            "arenas": {name: a.live_count for name, a in self.arenas.items()},
+        }
+
+
+class BatchInjector:
+    """Cached-destination injection: the steady-state client edge.
+
+    Resolves the key set once (host directory), keeps the row vector on
+    device, and thereafter every ``inject`` is pure h2d of payload (or zero
+    transfer if args are produced on device)."""
+
+    def __init__(self, engine: TensorEngine, type_name: str, method: str,
+                 keys: np.ndarray) -> None:
+        self.engine = engine
+        self.type_name = type_name
+        self.method = method
+        self.keys = keys
+        self._arena = engine.arena_for(type_name)
+        self._refresh()
+        self.n = len(keys)
+
+    def _refresh(self) -> None:
+        rows = self._arena.resolve_rows(self.keys,
+                                        tick=self.engine.tick_number)
+        self.rows = jnp.asarray(rows)
+        self.generation = self._arena.generation
+
+    def inject(self, args: Any, want_results: bool = False
+               ) -> Optional[asyncio.Future]:
+        if self.generation != self._arena.generation:
+            # arena growth repacked rows — re-resolve the cached set
+            self._refresh()
+        future = asyncio.get_running_loop().create_future() \
+            if want_results else None
+        self.engine.queues[(self.type_name, self.method)].append(
+            PendingBatch(args=args, rows=self.rows, future=future,
+                         keys_host=self.keys, generation=self.generation))
+        self.engine._wake_up()
+        return future
+
+
+# module-level caches for tiny helper arrays (one eager creation per size)
+_mask_cache: Dict[int, jnp.ndarray] = {}
+
+
+def _mask_for(n: int) -> jnp.ndarray:
+    m = _mask_cache.get(n)
+    if m is None:
+        m = jnp.asarray(np.ones(n, dtype=bool))
+        _mask_cache[n] = m
+    return m
+
+
+def _pad_np(a: np.ndarray, n: int) -> np.ndarray:
+    if a.ndim == 0:
+        return a  # scalar leaves broadcast in the kernel
+    if a.shape[0] == n:
+        return a
+    pad_width = [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad_width)

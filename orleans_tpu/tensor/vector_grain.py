@@ -1,0 +1,227 @@
+"""Vector grains: grain types whose activations live as tensor rows.
+
+A ``VectorGrain`` declares its per-activation state as typed fields; every
+activation of the type occupies one row of a stacked state pytree, and its
+methods are *batched*: one jitted call processes every message sent to any
+activation of the type this tick.
+
+This is the TPU-native replacement for the reference's per-activation
+object + mailbox + scheduler group (reference: ActivationData.cs:42,
+WorkItemGroup.cs:36): single-threaded turn semantics hold structurally —
+each row is updated exactly once per tick by one kernel, with fan-in
+combined explicitly via segment reductions (the batched analog of a
+non-reentrant mailbox drain).
+
+Handler contract::
+
+    @vector_grain
+    class GameGrain(VectorGrain):
+        score = field(jnp.float32, 0.0)
+
+        @batched_method
+        def update(state, batch: Batch, n_rows):
+            # state: pytree of [N, ...] arrays (whole arena)
+            # batch.rows: int32[M] destination row per message (-1 = pad)
+            # batch.args: pytree of [M, ...] argument arrays
+            total = seg_sum(batch.args["delta"], batch.rows, n_rows)
+            state = {**state, "score": state["score"] + total}
+            return state, None, ()          # (state', results[M]|None, emits)
+
+Handlers are pure jax functions — they are traced once per (bucket size,
+capacity) and cached.  Messages to another vector type are *emitted* as
+``Emit`` records (dst keys + args); the engine routes them next round,
+which is how intra-tick call chains become multi-round ticks
+(SURVEY.md §7 hard-part 2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.core.grain import (
+    InterfaceInfo,
+    MethodInfo,
+    batched_method,  # re-exported for convenience
+    grain_interface,
+    method_id_of,
+)
+from orleans_tpu.hashing import jenkins_hash
+from orleans_tpu.ids import type_code_of
+
+
+@dataclass(frozen=True)
+class StateField:
+    """One per-activation state column."""
+
+    shape: Tuple[int, ...]
+    dtype: Any
+    init: Any  # scalar or array broadcast to shape
+
+
+def field(dtype, init=0, shape: Tuple[int, ...] = ()) -> StateField:
+    return StateField(shape=tuple(shape), dtype=dtype, init=init)
+
+
+class Batch(NamedTuple):
+    """The messages for one (type, method) this round.
+
+    ``rows`` is -1 for padding entries; scatter helpers drop them via XLA's
+    out-of-bounds-drop semantics, so handlers rarely need ``mask``.
+    """
+
+    rows: jnp.ndarray          # int32[M], -1 = padding
+    args: Any                  # pytree of [M, ...]
+    mask: jnp.ndarray          # bool[M]
+
+
+@dataclass
+class Emit:
+    """Messages emitted by a handler to another vector grain type.
+
+    ``keys`` are *grain primary keys* (not rows): the engine resolves
+    key→row on the destination type's arena (auto-activating unseen keys),
+    which is the batched analog of the dispatcher's directory lookup +
+    catalog get-or-create (reference: Dispatcher.cs:555, Catalog.cs:411).
+
+    Registered as a jax pytree with (interface, method) static so handlers
+    can return Emits from jitted code.
+    """
+
+    interface: str             # target interface name (static under jit)
+    method: str                # target method name (static under jit)
+    # grain primary keys [M'] (may repeat).  Device routing requires keys
+    # in [0, 2**31-1): wider keys cannot ride the int32 device directory
+    # mirror and must go through host-side send_batch instead (the arena
+    # raises OverflowError if a >int32 key ever reaches its device index).
+    keys: jnp.ndarray
+    args: Any                  # pytree of [M', ...]
+    mask: Optional[jnp.ndarray] = None  # bool[M']; None = all valid
+
+
+jax.tree_util.register_pytree_node(
+    Emit,
+    lambda e: ((e.keys, e.args, e.mask), (e.interface, e.method)),
+    lambda aux, ch: Emit(aux[0], aux[1], ch[0], ch[1], ch[2]),
+)
+
+
+# ---------------------------------------------------------------------------
+# segment helpers (fan-in combiners)
+# ---------------------------------------------------------------------------
+
+def seg_sum(values: jnp.ndarray, rows: jnp.ndarray, n_rows: int) -> jnp.ndarray:
+    """Sum ``values`` per destination row; padding rows (-1) are dropped.
+
+    The batched analog of mailbox fan-in: all messages to one grain in a
+    tick combine associatively (reference behavior: sequential mailbox
+    drain — for commutative updates the result is identical)."""
+    safe = jnp.where(rows >= 0, rows, n_rows)
+    return jax.ops.segment_sum(values, safe, num_segments=n_rows + 1)[:n_rows]
+
+
+def seg_max(values: jnp.ndarray, rows: jnp.ndarray, n_rows: int) -> jnp.ndarray:
+    safe = jnp.where(rows >= 0, rows, n_rows)
+    return jax.ops.segment_max(values, safe, num_segments=n_rows + 1)[:n_rows]
+
+
+def seg_mean(values: jnp.ndarray, rows: jnp.ndarray, n_rows: int) -> jnp.ndarray:
+    total = seg_sum(values, rows, n_rows)
+    ones = jnp.ones(values.shape[0], dtype=values.dtype)
+    count = seg_sum(ones, rows, n_rows)
+    return total / jnp.maximum(count, 1)
+
+
+def scatter_rows(column: jnp.ndarray, rows: jnp.ndarray,
+                 values: jnp.ndarray) -> jnp.ndarray:
+    """Overwrite ``column[rows] = values``; padding rows (-1) dropped.
+    Last writer wins for duplicate rows (matching arrival order is not
+    guaranteed across a tick — use seg_* for order-free combining)."""
+    return column.at[rows].set(values, mode="drop")
+
+
+def scatter_add_rows(column: jnp.ndarray, rows: jnp.ndarray,
+                     values: jnp.ndarray) -> jnp.ndarray:
+    return column.at[rows].add(values, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# declaration
+# ---------------------------------------------------------------------------
+
+class VectorGrain:
+    """Base marker for tensor-path grain types.
+
+    Subclasses declare state columns via ``field(...)`` class attributes and
+    batched methods via ``@batched_method`` staticmethod-style functions
+    ``(state, batch, n_rows) -> (state', results|None, emits)``.
+    """
+
+    __vector_grain__ = True
+
+
+@dataclass
+class VectorGrainInfo:
+    cls: type
+    name: str
+    type_code: int
+    interface: InterfaceInfo
+    state_fields: Dict[str, StateField]
+    handlers: Dict[str, Callable]       # method name → handler fn
+    methods: Dict[str, MethodInfo]
+
+
+_VECTOR_TYPES: Dict[str, VectorGrainInfo] = {}
+_VECTOR_BY_CODE: Dict[int, VectorGrainInfo] = {}
+
+
+def vector_grain(cls: type) -> type:
+    """Register a VectorGrain subclass: collect state fields + handlers and
+    expose it under the normal grain interface machinery so references,
+    directory and identity work unchanged."""
+    state_fields: Dict[str, StateField] = {}
+    handlers: Dict[str, Callable] = {}
+    methods: Dict[str, MethodInfo] = {}
+    for name, attr in list(vars(cls).items()):
+        if isinstance(attr, StateField):
+            state_fields[name] = attr
+        elif getattr(attr, "__grain_batched__", False):
+            fn = attr.__func__ if isinstance(attr, staticmethod) else attr
+            handlers[name] = fn
+            methods[name] = MethodInfo(
+                name=name, method_id=method_id_of(name),
+                one_way=getattr(fn, "__grain_one_way__", False),
+                batched=True)
+    iface = InterfaceInfo(name=cls.__name__,
+                          interface_id=type_code_of(cls.__name__), cls=cls)
+    for m in methods.values():
+        iface.add(m)
+    cls.__grain_interface_info__ = iface
+
+    info = VectorGrainInfo(
+        cls=cls, name=cls.__name__, type_code=type_code_of(cls.__name__),
+        interface=iface, state_fields=state_fields, handlers=handlers,
+        methods=methods)
+    _VECTOR_TYPES[cls.__name__] = info
+    _VECTOR_BY_CODE[info.type_code] = info
+
+    # register in the interface registry so get_interface()/references work
+    from orleans_tpu.core import grain as grain_mod
+    grain_mod._INTERFACES[iface.interface_id] = iface
+    grain_mod._INTERFACES_BY_NAME[iface.name] = iface
+    grain_mod.external_impl_type_codes[iface.interface_id] = info.type_code
+    return cls
+
+
+def vector_type(name_or_code) -> Optional[VectorGrainInfo]:
+    if isinstance(name_or_code, int):
+        return _VECTOR_BY_CODE.get(name_or_code)
+    return _VECTOR_TYPES.get(name_or_code)
+
+
+def all_vector_types() -> Dict[str, VectorGrainInfo]:
+    return dict(_VECTOR_TYPES)

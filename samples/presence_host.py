@@ -1,0 +1,55 @@
+"""Presence on the host (per-message) path — the single-silo CPU baseline.
+
+Same workload shape as samples/presence.py but executed as classic virtual
+actors: one turn per heartbeat, one grain-to-grain RPC per game update —
+structurally the reference's execution model
+(reference: Samples/Presence/PresenceGrains/PresenceGrain.cs:40 →
+GameGrain.UpdateGameStatus, GameGrain.cs:62).  Used by bench.py to measure
+the per-message dispatch baseline the tensor engine is compared against.
+"""
+
+from __future__ import annotations
+
+from orleans_tpu import Grain, grain_interface, one_way
+from orleans_tpu.core.grain import grain_class
+
+
+@grain_interface
+class IHostGame:
+    @one_way
+    async def update_game_status(self, score: float, count: int): ...
+    async def totals(self) -> tuple: ...
+
+
+@grain_interface
+class IHostPresence:
+    async def heartbeat(self, game: int, score: float, tick: int): ...
+
+
+@grain_class
+class HostGameGrain(Grain, IHostGame):
+    def __init__(self) -> None:
+        self.total_score = 0.0
+        self.updates = 0
+
+    async def update_game_status(self, score: float, count: int):
+        self.total_score += score
+        self.updates += count
+
+    async def totals(self) -> tuple:
+        return (self.total_score, self.updates)
+
+
+@grain_class
+class HostPresenceGrain(Grain, IHostPresence):
+    def __init__(self) -> None:
+        self.last_heartbeat = 0
+        self.game = -1
+        self.heartbeats = 0
+
+    async def heartbeat(self, game: int, score: float, tick: int):
+        self.last_heartbeat = tick
+        self.game = game
+        self.heartbeats += 1
+        game_ref = self.get_grain(IHostGame, game)
+        await game_ref.update_game_status(score, 1)

@@ -9,11 +9,30 @@ event loop (see orleans_tpu/testing).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon (tunneled-TPU) platform registers itself from sitecustomize at
+# interpreter start; if the tunnel is unhealthy its lazy client init can
+# hang every jax call even under JAX_PLATFORMS=cpu.  Tests are CPU-only by
+# design (multi-device via the virtual host-platform mesh), so drop the
+# axon backend factory before any backend is initialized.
+try:  # best-effort; registry layout is jax-version-specific
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    # sitecustomize imported jax before this conftest ran, so the env var
+    # alone is too late — update the live config too.
+    jax.config.update("jax_platforms", "cpu")
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name == "axon":
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
 
 import asyncio  # noqa: E402
 import pytest  # noqa: E402
