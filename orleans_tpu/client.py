@@ -1,0 +1,203 @@
+"""Out-of-cluster client runtime.
+
+Parity: reference GrainClient/OutsideRuntimeClient (reference:
+src/Orleans/Runtime/GrainClient.cs:42 Initialize; OutsideRuntimeClient.cs:44
+— message pump :303,:315, callbacks dict, CreateObjectReference / observer
+local-object dispatch :389) with the gateway pool
+(reference: ProxiedMessageCenter.cs:82, GatewayManager.cs:41).
+
+The client owns its own correlation table and identity; it speaks to the
+cluster only through a gateway silo's Gateway system target.  In-process
+connections model the reference's TCP gateway sockets (with wire-fidelity
+serialization on every hop); the same client works over the TcpTransport
+for real deployments.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from orleans_tpu.core import context as ctx
+from orleans_tpu.core.factory import GrainFactory
+from orleans_tpu.core.grain import InterfaceInfo, MethodInfo, get_interface
+from orleans_tpu.core.reference import GrainReference, bind_runtime
+from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.ids import GrainId
+from orleans_tpu.runtime.messaging import (
+    Category,
+    Direction,
+    Message,
+    RejectionType,
+    ResponseKind,
+)
+from orleans_tpu.runtime.runtime_client import (
+    CallbackData,
+    RejectionError,
+    RequestTimeoutError,
+)
+
+
+class GrainClient:
+    """(reference: GrainClient.Initialize + OutsideRuntimeClient)"""
+
+    def __init__(self, response_timeout: float = 30.0) -> None:
+        self.client_id = GrainId.client(uuid.uuid4())
+        self.response_timeout = response_timeout
+        self.callbacks: Dict[int, CallbackData] = {}
+        self.factory = GrainFactory()
+        self._gateways: List[Any] = []  # Gateway handles (round-robin pool)
+        self._gw_cycle = None
+        self._observers: Dict[GrainId, Any] = {}
+        self._connected = False
+
+    # ================= connection =========================================
+
+    async def connect(self, *gateway_silos) -> "GrainClient":
+        """Connect through one or more gateway silos (reference:
+        GatewayManager's live-gateway pool :41)."""
+        for silo in gateway_silos:
+            gateway = silo.system_targets.get("gateway")
+            if gateway is None:
+                raise RuntimeError(f"silo {silo.name} has no gateway")
+            await gateway.connect_client(self.client_id, self._on_message)
+            self._gateways.append(gateway)
+        self._gw_cycle = itertools.cycle(self._gateways)
+        self._connected = True
+        bind_runtime(self)
+        return self
+
+    async def close(self) -> None:
+        for gateway in self._gateways:
+            try:
+                await gateway.disconnect_client(self.client_id)
+                for obs_id in self._observers:
+                    await gateway.disconnect_client(obs_id)
+            except Exception:
+                pass
+        self._gateways.clear()
+        self._connected = False
+        # break outstanding calls (reference: client shutdown behavior)
+        for cb in list(self.callbacks.values()):
+            if not cb.future.done():
+                cb.future.set_exception(
+                    RejectionError(RejectionType.UNRECOVERABLE,
+                                   "client disconnected"))
+        self.callbacks.clear()
+
+    def _next_gateway(self):
+        """Round-robin over LIVE gateways only (reference:
+        GatewayManager.GetLiveGateways :170 — dead gateways are skipped
+        until they rejoin)."""
+        if not self._gateways:
+            raise RuntimeError("client not connected to any gateway "
+                               "(reference: GrainClient.Initialize)")
+        for _ in range(len(self._gateways)):
+            gateway = next(self._gw_cycle)
+            if gateway.alive:
+                return gateway
+        raise RuntimeError("no live gateways "
+                           "(reference: GatewayManager empty live list)")
+
+    def get_grain(self, interface, key) -> GrainReference:
+        return self.factory.get_grain(interface, key)
+
+    # ================= send path (RuntimeClient duck-type) ================
+
+    def send_request(self, target_grain: GrainId, iface: InterfaceInfo,
+                     method: MethodInfo, args, timeout: Optional[float] = None
+                     ) -> Optional[asyncio.Future]:
+        timeout = timeout if timeout is not None else self.response_timeout
+        msg = Message(
+            category=Category.APPLICATION,
+            direction=Direction.ONE_WAY if method.one_way else Direction.REQUEST,
+            sending_grain=self.client_id,
+            target_grain=target_grain,
+            interface_id=iface.interface_id,
+            method_id=method.method_id,
+            method_name=method.name,
+            args=tuple(codec.deep_copy(a) for a in args),
+            is_read_only=method.read_only,
+            is_always_interleave=method.always_interleave,
+            request_context=ctx.RequestContext.export(),
+            expiration=time.monotonic() + timeout,
+        )
+        gateway = self._next_gateway()
+        if method.one_way:
+            gateway.submit(msg)
+            return None
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        cb = CallbackData(future=future, message=msg)
+        cb.timeout_handle = loop.call_later(timeout, self._on_timeout, msg.id)
+        self.callbacks[msg.id] = cb
+        gateway.submit(msg)
+        return future
+
+    def _on_timeout(self, message_id: int) -> None:
+        cb = self.callbacks.pop(message_id, None)
+        if cb is not None and not cb.future.done():
+            cb.future.set_exception(RequestTimeoutError(
+                f"client request {cb.message} timed out"))
+
+    # ================= receive path =======================================
+
+    def _on_message(self, msg: Message) -> None:
+        """(reference: OutsideRuntimeClient.RunClientMessagePump :315)"""
+        if msg.direction == Direction.RESPONSE:
+            self._receive_response(msg)
+            return
+        # request to a local observer object
+        # (reference: OutsideRuntimeClient local-object dispatch :389)
+        asyncio.get_running_loop().create_task(self._invoke_observer(msg))
+
+    def _receive_response(self, msg: Message) -> None:
+        cb = self.callbacks.pop(msg.id, None)
+        if cb is None or cb.future.done():
+            return
+        if cb.timeout_handle is not None:
+            cb.timeout_handle.cancel()
+        if msg.response_kind == ResponseKind.REJECTION:
+            cb.future.set_exception(RejectionError(
+                msg.rejection_type or RejectionType.UNRECOVERABLE,
+                msg.rejection_info))
+        elif msg.response_kind == ResponseKind.ERROR:
+            exc = msg.result if isinstance(msg.result, BaseException) \
+                else RuntimeError(str(msg.result))
+            cb.future.set_exception(exc)
+        else:
+            cb.future.set_result(msg.result)
+
+    async def _invoke_observer(self, msg: Message) -> None:
+        obj = self._observers.get(msg.target_grain)
+        gateway = self._next_gateway()
+        try:
+            if obj is None:
+                raise KeyError(f"no local observer {msg.target_grain}")
+            method = getattr(obj, msg.method_name)
+            result = await method(*msg.args)
+            if msg.direction != Direction.ONE_WAY:
+                gateway.submit(msg.create_response(result))
+        except Exception as exc:  # noqa: BLE001
+            if msg.direction != Direction.ONE_WAY:
+                gateway.submit(msg.create_response(exc, ResponseKind.ERROR))
+
+    # ================= observers ==========================================
+
+    async def create_object_reference(self, interface, obj) -> GrainReference:
+        """Expose a local object as a grain-callable observer
+        (reference: GrainFactory.CreateObjectReference / IGrainObserver)."""
+        iface = get_interface(interface)
+        observer_id = GrainId.client(uuid.uuid4())
+        self._observers[observer_id] = obj
+        for gateway in self._gateways:
+            await gateway.register_observer(self.client_id, observer_id)
+        return GrainReference(observer_id, iface.interface_id)
+
+    async def delete_object_reference(self, ref: GrainReference) -> None:
+        self._observers.pop(ref.grain_id, None)
+        for gateway in self._gateways:
+            await gateway.disconnect_client(ref.grain_id)

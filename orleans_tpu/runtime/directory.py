@@ -121,6 +121,8 @@ class LocalGrainDirectory:
         self.cache = GrainDirectoryCache()
         self.lookups_local = 0
         self.lookups_remote = 0
+        self._heal_task = None
+        self._heal_requested = False
 
     # -- ownership ----------------------------------------------------------
 
@@ -192,6 +194,74 @@ class LocalGrainDirectory:
         (reference: LocalGrainDirectory.SiloStatusChangeNotification :390)."""
         self.partition.remove_silo_entries(silo)
         self.cache.invalidate_silo(silo)
+
+    async def heal_after_ring_change(self) -> None:
+        """Re-assert every local activation with its (possibly new)
+        directory owner after membership changed.
+
+        This plays the role of the reference's partition handoff
+        (reference: GrainDirectoryHandoffManager.cs:40 — split to a
+        joining silo, merge from a dead one): (1) prune partition entries
+        for hash ranges this silo no longer owns (they are rebuilt at the
+        new owner by the hosting silos' heals — the split half), then
+        (2) re-register what this silo *hosts* with the current owners
+        (the merge half).  If re-registration loses the single-activation
+        race, the winner is verified to actually exist before the local
+        activation is deactivated as a duplicate
+        (reference: Catalog.cs:533-563 DuplicateActivationException)."""
+        from orleans_tpu.runtime.activation import ActivationState
+
+        # (1) prune ranges we no longer own — prevents stale entries from
+        # resurrecting if ownership later reverts to us
+        self.partition.split_out(
+            lambda g: not self.ring.owns_hash(g.ring_hash()))
+
+        # (2) re-assert hosted activations
+        for act in self.silo.catalog.directory.all():
+            if act.class_info.stateless_worker or act.grain_id.is_client:
+                continue
+            if act.state not in (ActivationState.VALID,
+                                 ActivationState.ACTIVATING):
+                continue
+            try:
+                winner = await self.register_single_activation(act.address)
+                if winner.activation == act.activation_id:
+                    continue
+                # lost the race — verify the winner is real before killing
+                # our activation (the entry may be stale)
+                alive = False
+                if self.silo.is_silo_alive(winner.silo):
+                    try:
+                        alive = await self.silo.system_rpc(
+                            winner.silo, "catalog", "has_activation",
+                            (winner,), timeout=2.0)
+                    except Exception:
+                        alive = False
+                if alive:
+                    self.silo.catalog.schedule_deactivation(act)
+                else:
+                    # stale winner: purge it and re-assert ourselves
+                    await self.unregister(winner)
+                    await self.register_single_activation(act.address)
+            except Exception:
+                continue
+
+    def schedule_heal(self) -> None:
+        """Coalesce ring-change storms into at most one in-flight heal
+        (plus one queued re-run)."""
+        import asyncio
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        self._heal_requested = True
+        if self._heal_task is None or self._heal_task.done():
+            self._heal_task = loop.create_task(self._heal_runner())
+
+    async def _heal_runner(self) -> None:
+        while self._heal_requested:
+            self._heal_requested = False
+            await self.heal_after_ring_change()
 
 
 class RemoteGrainDirectory:

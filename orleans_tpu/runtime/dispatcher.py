@@ -56,6 +56,15 @@ class Dispatcher:
         """(reference: Dispatcher.ReceiveMessage :78)"""
         self.metrics.dispatcher_received += 1
         if msg.direction == Direction.RESPONSE:
+            # connected-client responses route out the gateway; in-silo
+            # callers (including the hosted client) resolve locally
+            # (reference: MessageCenter.TryDeliverToProxy :55)
+            gateway = self.silo.system_targets.get("gateway")
+            if (msg.target_grain is not None and msg.target_grain.is_client
+                    and gateway is not None
+                    and msg.target_grain in gateway._clients):
+                gateway.deliver(msg)
+                return
             self.runtime_client.receive_response(msg)
             return
         if self._should_inject_error(msg):
@@ -83,6 +92,14 @@ class Dispatcher:
 
     async def _receive_request(self, msg: Message) -> None:
         """(reference: Dispatcher.ReceiveRequest :265 + activation resolve)"""
+        # vector (tensor-path) grains: bridge the message into the tick
+        # machine — this is how gateway/remote-silo traffic reaches the
+        # device data plane
+        from orleans_tpu.tensor.vector_grain import vector_type
+        vt = vector_type(msg.target_grain.type_code)
+        if vt is not None:
+            self._bridge_to_engine(vt, msg)
+            return
         try:
             act = await self._resolve_target_activation(msg)
         except DuplicateActivationError as dup:
@@ -116,6 +133,29 @@ class Dispatcher:
             self.metrics.rejections_sent += 1
             self._respond(msg.create_rejection(RejectionType.OVERLOADED,
                                                overload))
+
+    def _bridge_to_engine(self, vt, msg: Message) -> None:
+        engine = self.silo.tensor_engine
+        if engine is None:
+            self._respond_error(msg, RuntimeError(
+                "vector grain message but tensor engine disabled"))
+            return
+        minfo = vt.methods.get(msg.method_name)
+        if minfo is None:
+            self._respond_error(msg, AttributeError(
+                f"{vt.name} has no batched method {msg.method_name!r}"))
+            return
+        fut = engine.send_one(msg.target_grain, minfo, msg.args)
+        if fut is None or msg.direction == Direction.ONE_WAY:
+            return
+
+        def relay(f: asyncio.Future) -> None:
+            if f.exception() is not None:
+                self._respond_error(msg, f.exception())
+            else:
+                self._respond(msg.create_response(f.result()))
+
+        fut.add_done_callback(relay)
 
     async def _resolve_target_activation(self, msg: Message
                                          ) -> Optional[ActivationData]:

@@ -1,0 +1,100 @@
+"""Client gateway: the silo-side edge for out-of-cluster clients.
+
+Parity: reference Gateway inside gateway-silos (reference:
+src/OrleansRuntime/Messaging/Gateway.cs:37 — per-client ClientState,
+RecordOpenedSocket :109, reply routing via TryDeliverToProxy,
+MessageCenter.cs:55) and the ClientObserverRegistrar system target that
+registers client ids in the grain directory so any silo can route
+observer calls (reference: ClientObserverRegistrar.cs:35).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, Optional
+
+from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.ids import ActivationAddress, ActivationId, GrainId
+from orleans_tpu.runtime.messaging import Message
+
+
+class Gateway:
+    """System target 'gateway' on every silo."""
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+        # client grain id → deliver callable (the 'socket' to the client)
+        self._clients: Dict[GrainId, Callable[[Message], None]] = {}
+        self.wire_fidelity = True
+
+    @property
+    def alive(self) -> bool:
+        from orleans_tpu.runtime.silo import SiloStatus
+        return self.silo.status == SiloStatus.ACTIVE
+
+    # -- connection management (reference: Gateway.RecordOpenedSocket :109)
+
+    async def connect_client(self, client_id: GrainId,
+                             deliver: Callable[[Message], None]) -> None:
+        self._clients[client_id] = deliver
+        await self._register_client_route(client_id)
+
+    async def disconnect_client(self, client_id: GrainId) -> None:
+        self._clients.pop(client_id, None)
+        addr = ActivationAddress(self.silo.address, client_id,
+                                 ActivationId(0, 0))
+        try:
+            await self.silo.grain_directory.unregister(addr)
+        except Exception:
+            pass
+
+    async def register_observer(self, client_id: GrainId,
+                                observer_id: GrainId) -> None:
+        """Route an observer id to this client's connection
+        (reference: ClientObserverRegistrar registration)."""
+        deliver = self._clients.get(client_id)
+        if deliver is None:
+            raise KeyError(f"client {client_id} not connected to this gateway")
+        self._clients[observer_id] = deliver
+        await self._register_client_route(observer_id)
+
+    async def _register_client_route(self, grain_id: GrainId) -> None:
+        """Register the client id in the grain directory so messages from
+        any silo route to this gateway silo."""
+        addr = ActivationAddress(self.silo.address, grain_id,
+                                 ActivationId(0, 0))
+        await self.silo.grain_directory.register_single_activation(addr)
+
+    async def reregister_routes(self) -> None:
+        """Re-assert client routes after ring ownership changed."""
+        for grain_id in list(self._clients):
+            try:
+                await self._register_client_route(grain_id)
+            except Exception:
+                pass
+
+    # -- inbound from clients ----------------------------------------------
+
+    def submit(self, msg: Message) -> None:
+        """A client pushed a message into the cluster through this silo
+        (reference: GatewayAcceptor receive → MessageCenter inbound)."""
+        if self.wire_fidelity:
+            msg = codec.deserialize(codec.serialize(msg))
+        if msg.target_silo is None:
+            # gateway addresses the message like any in-silo send
+            self.silo.dispatcher.send_message(msg)
+        else:
+            self.silo.message_center.send_message(msg)
+
+    # -- outbound to clients (reference: Gateway reply routing) ------------
+
+    def deliver(self, msg: Message) -> None:
+        deliver = self._clients.get(msg.target_grain)
+        if deliver is None:
+            self.silo.logger.warn(
+                f"gateway: no client connection for {msg.target_grain}; "
+                f"dropping {msg}")
+            return
+        if self.wire_fidelity:
+            msg = codec.deserialize(codec.serialize(msg))
+        asyncio.get_running_loop().call_soon(deliver, msg)

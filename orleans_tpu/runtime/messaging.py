@@ -170,6 +170,13 @@ class Message:
                 f"m={self.method_id:x} fwd={self.forward_count})")
 
 
+# wire registration (reference: Message headers serialized via
+# SerializationManager, Message.cs:518)
+from orleans_tpu.codec import default_manager as _codec  # noqa: E402
+
+_codec.register(Message, name="orleans.Message")
+
+
 class MessageCenter:
     """Per-silo message hub (reference: MessageCenter.cs:33).
 

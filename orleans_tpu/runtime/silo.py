@@ -69,12 +69,30 @@ _SYSTEM_TARGET_CODES: Dict[str, int] = {
 _CODE_TO_NAME = {v: k for k, v in _SYSTEM_TARGET_CODES.items()}
 
 
+class _CatalogTarget:
+    """Catalog system target: remote existence checks + admin ops
+    (reference: Catalog as SystemTarget, Constants catalog=14)."""
+
+    def __init__(self, silo: "Silo") -> None:
+        self.silo = silo
+
+    async def has_activation(self, addr) -> bool:
+        from orleans_tpu.runtime.activation import ActivationState
+        act = self.silo.catalog.directory.by_activation.get(addr.activation)
+        return act is not None and act.state in (ActivationState.VALID,
+                                                 ActivationState.ACTIVATING)
+
+    async def activation_count(self) -> int:
+        return len(self.silo.catalog.directory)
+
+
 class Silo:
     """(reference: Silo.cs:59)"""
 
     def __init__(self, config: Optional[SiloConfig] = None,
                  name: str = "silo", port: int = 0,
                  storage_providers: Optional[Dict[str, StorageProvider]] = None,
+                 fabric=None, membership_table=None,
                  ) -> None:
         self.config = config or SiloConfig(name=name)
         self.name = self.config.name if config else name
@@ -115,16 +133,29 @@ class Silo:
         self.system_targets: Dict[str, Any] = {}
         self.register_system_target("directory",
                                     RemoteGrainDirectory(self.grain_directory))
+        from orleans_tpu.runtime.gateway import Gateway
+        self.register_system_target("gateway", Gateway(self))
+        self.register_system_target("catalog", _CatalogTarget(self))
 
         # identity for calls made from non-grain contexts attached to this
         # silo (tests, hosted client) — reference: client GrainId
         self.client_grain_id = GrainId.client(uuid.uuid4())
 
-        # membership wiring (phase 5): until the oracle runs, the ring is
-        # the membership view
+        # cluster fabric + membership (single-silo when both are None:
+        # the ring is the membership view)
+        self._fabric = fabric
+        self._bound_transport = None
         self.membership_oracle = None
+        if membership_table is not None:
+            from orleans_tpu.runtime.membership import MembershipOracle
+            self.membership_oracle = MembershipOracle(
+                self, membership_table, self.config.liveness)
         self.reminder_service = None
         self._stop_callbacks: List[Callable[[], Any]] = []
+
+        # elasticity: membership-driven ring changes re-assert directory
+        # entries + client routes (reference: GrainDirectoryHandoffManager)
+        self.ring.subscribe(lambda *_: self._on_ring_changed())
 
         # the TPU data plane (SURVEY.md §7 design stance)
         if self.config.tensor.enabled:
@@ -137,6 +168,9 @@ class Silo:
 
     async def start(self) -> None:
         self.status = SiloStatus.JOINING
+        if self._fabric is not None:
+            self._bound_transport = self._fabric.attach(self)
+            self.message_center.transport = self._bound_transport
         for name, provider in self.storage_providers.items():
             await provider.init(name, {})
         self.catalog.start_collector(self.config.collection.collection_quantum)
@@ -175,6 +209,8 @@ class Silo:
                 await res
         for provider in self.storage_providers.values():
             await provider.close()
+        if self._bound_transport is not None:
+            self._bound_transport.close()
         self.status = SiloStatus.DEAD
 
     def kill(self) -> None:
@@ -184,6 +220,8 @@ class Silo:
         self.catalog.stop_collector()
         if self.membership_oracle is not None:
             self.membership_oracle.kill()
+        if self._bound_transport is not None:
+            self._bound_transport.close()
 
     def on_stop(self, cb: Callable[[], Any]) -> None:
         self._stop_callbacks.append(cb)
@@ -206,6 +244,15 @@ class Silo:
         self.ring.remove_silo(addr)
         self.grain_directory.on_silo_dead(addr)
         self.runtime_client.break_outstanding_messages_to_dead_silo(addr)
+
+    def _on_ring_changed(self) -> None:
+        if self.status != SiloStatus.ACTIVE:
+            return
+        self.grain_directory.schedule_heal()
+        gateway = self.system_targets.get("gateway")
+        if gateway is not None and gateway._clients:
+            asyncio.get_running_loop().create_task(
+                gateway.reregister_routes())
 
     # ================= system targets ======================================
 

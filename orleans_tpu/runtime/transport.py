@@ -1,0 +1,196 @@
+"""Silo-to-silo transport.
+
+Parity: the reference's silo transport is a custom TCP stack with
+per-destination sender agents and length-prefixed framing
+(reference: src/OrleansRuntime/Messaging/SiloMessageSender.cs:32,
+OutgoingMessageSender.cs:41, IncomingMessageAcceptor.cs:32,
+SocketManager.cs:31).
+
+TPU-first mapping: the *application data plane* between silos rides the
+device mesh (XLA collectives over ICI — see orleans_tpu.tensor), so what
+remains here is the control plane (system/membership/directory traffic and
+cold-path application messages).  Two implementations:
+
+* ``InProcTransport`` — multiple silos in one process/event loop, used by
+  the test cluster (reference analog: TestingSiloHost's AppDomains,
+  TestingSiloHost.cs:58).  ``wire_fidelity`` pushes every message through
+  the binary codec so serialization bugs surface in-process.
+* ``TcpTransport`` — asyncio streams with length-prefixed codec frames for
+  real multi-host deployments (DCN).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Callable, Dict, Optional
+
+from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.ids import SiloAddress
+from orleans_tpu.runtime.messaging import Message
+
+
+class TransportError(Exception):
+    pass
+
+
+class InProcTransport:
+    """Shared in-process fabric: a registry of silo inboxes.
+
+    One instance is shared by every silo of an in-process cluster; killed
+    silos unregister, so sends to them fail like a closed socket.
+    """
+
+    def __init__(self, wire_fidelity: bool = True) -> None:
+        self._inboxes: Dict[SiloAddress, Callable[[Message], None]] = {}
+        self.wire_fidelity = wire_fidelity
+        # deterministic fault injection: drop predicate applied per message
+        self.drop_predicate: Optional[Callable[[Message], bool]] = None
+        self.messages_carried = 0
+
+    def attach(self, silo) -> "BoundTransport":
+        self._inboxes[silo.address] = silo.message_center.deliver_local
+        return BoundTransport(self, silo.address)
+
+    def detach(self, address: SiloAddress) -> None:
+        self._inboxes.pop(address, None)
+
+    def send(self, sender: SiloAddress, msg: Message) -> None:
+        if self.drop_predicate is not None and self.drop_predicate(msg):
+            return
+        deliver = self._inboxes.get(msg.target_silo)
+        if deliver is None:
+            # closed socket analog: silently dropped; callers detect via
+            # timeouts + membership (reference: socket send failure →
+            # eventual probe failure)
+            return
+        self.messages_carried += 1
+        if self.wire_fidelity:
+            msg = codec.deserialize(codec.serialize(msg))
+        # schedule rather than call: preserves one-way send semantics and
+        # avoids reentrant dispatcher stacks
+        asyncio.get_running_loop().call_soon(deliver, msg)
+
+
+class BoundTransport:
+    """A silo's handle on the shared fabric (what MessageCenter calls)."""
+
+    def __init__(self, fabric: InProcTransport, address: SiloAddress) -> None:
+        self.fabric = fabric
+        self.address = address
+
+    def send(self, msg: Message) -> None:
+        self.fabric.send(self.address, msg)
+
+    def close(self) -> None:
+        self.fabric.detach(self.address)
+
+
+class TcpTransport:
+    """Length-prefixed codec frames over asyncio TCP (DCN control plane).
+
+    Framing parity: 4-byte magic+length header like the reference's
+    framing words (reference: Message.cs:87-88).  One dedicated sender
+    task per destination gives per-connection FIFO and a single socket
+    per peer — the asyncio analog of the reference's per-destination
+    sender agents (reference: SiloMessageSender.cs:32,
+    OutgoingMessageSender.cs:41).
+
+    Clock discipline: ``Message.expiration`` is a local ``time.monotonic``
+    deadline, meaningless on another host — on the wire it is rewritten to
+    remaining-TTL and rebased against the receiver's clock.
+    """
+
+    MAGIC = 0x4F54  # "OT"
+
+    def __init__(self, silo, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.silo = silo
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._queues: Dict[SiloAddress, asyncio.Queue] = {}
+        self._senders: Dict[SiloAddress, asyncio.Task] = {}
+        self._endpoints: Dict[SiloAddress, tuple] = {}
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    def register_endpoint(self, silo: SiloAddress, host: str, port: int) -> None:
+        self._endpoints[silo] = (host, port)
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        import time
+        try:
+            while True:
+                header = await reader.readexactly(8)
+                magic, length = struct.unpack("<II", header)
+                if magic != self.MAGIC:
+                    raise TransportError(f"bad frame magic {magic:#x}")
+                payload = await reader.readexactly(length)
+                msg = codec.deserialize(payload)
+                if msg.expiration is not None:
+                    # wire carries remaining TTL → rebase on our clock
+                    msg.expiration = time.monotonic() + msg.expiration
+                self.silo.message_center.deliver_local(msg)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def send(self, msg: Message) -> None:
+        target = msg.target_silo
+        queue = self._queues.get(target)
+        if queue is None:
+            queue = asyncio.Queue()
+            self._queues[target] = queue
+            self._senders[target] = asyncio.get_running_loop().create_task(
+                self._sender_loop(target, queue))
+        queue.put_nowait(msg)
+
+    async def _sender_loop(self, target: SiloAddress,
+                           queue: asyncio.Queue) -> None:
+        """Single connection + FIFO per destination."""
+        import dataclasses
+        import time
+        writer: Optional[asyncio.StreamWriter] = None
+        try:
+            while True:
+                msg = await queue.get()
+                if msg is None:
+                    break
+                if writer is None or writer.is_closing():
+                    endpoint = self._endpoints.get(
+                        target, (target.host, target.port))
+                    try:
+                        _, writer = await asyncio.open_connection(*endpoint)
+                    except OSError:
+                        writer = None
+                        continue  # closed-socket analog; membership notices
+                wire = dataclasses.replace(msg)
+                if wire.expiration is not None:
+                    wire.expiration = max(0.0,
+                                          wire.expiration - time.monotonic())
+                payload = codec.serialize(wire)
+                writer.write(struct.pack("<II", self.MAGIC, len(payload))
+                             + payload)
+                try:
+                    await writer.drain()
+                except ConnectionError:
+                    writer = None
+        except asyncio.CancelledError:
+            pass
+        finally:
+            if writer is not None:
+                writer.close()
+
+    async def close(self) -> None:
+        for task in self._senders.values():
+            task.cancel()
+        self._senders.clear()
+        self._queues.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()

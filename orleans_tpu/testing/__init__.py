@@ -1,0 +1,3 @@
+from orleans_tpu.testing.cluster import TestingCluster
+
+__all__ = ["TestingCluster"]

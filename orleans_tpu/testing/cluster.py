@@ -1,0 +1,131 @@
+"""In-process multi-silo test cluster.
+
+Parity: reference TestingSiloHost (reference: src/OrleansTestingHost/
+TestingSiloHost.cs:58 — Primary+Secondary in AppDomains, client attached
+in-process, StartAdditionalSilos :235, KillSilo :334 hard-kill,
+RestartSilo :347) plus its shared in-process store so MemoryStorage
+survives topology changes (reference: Silo.cs:217-221,
+HierarchicalKeyStore.cs:33).
+
+Here "AppDomain" isolation becomes: silos on one event loop joined by an
+InProcTransport fabric (wire-fidelity serialization on every hop) and one
+shared InMemoryMembershipTable — the same trust boundaries, minus threads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, List, Optional
+
+from orleans_tpu.config import SiloConfig
+from orleans_tpu.core.factory import GrainFactory
+from orleans_tpu.providers.memory_storage import MemoryStorage
+from orleans_tpu.runtime.membership import InMemoryMembershipTable
+from orleans_tpu.runtime.silo import Silo
+from orleans_tpu.runtime.transport import InProcTransport
+
+
+class TestingCluster:
+
+    __test__ = False  # not a pytest collection target
+
+    def __init__(self, n_silos: int = 2,
+                 config_factory: Optional[Callable[[str], SiloConfig]] = None,
+                 wire_fidelity: bool = True) -> None:
+        self.n_initial = n_silos
+        self.config_factory = config_factory or self._default_config
+        self.fabric = InProcTransport(wire_fidelity=wire_fidelity)
+        self.table = InMemoryMembershipTable()
+        self.storage_backing = MemoryStorage.shared_backing()
+        self.silos: List[Silo] = []
+        self._counter = 0
+
+    @staticmethod
+    def _default_config(name: str) -> SiloConfig:
+        cfg = SiloConfig(name=name)
+        # fast liveness for tests (reference: TestingSiloHost liveness
+        # config with shortened probe/vote timings)
+        cfg.liveness.probe_period = 0.1
+        cfg.liveness.probe_timeout = 0.1
+        cfg.liveness.num_missed_probes_limit = 2
+        cfg.liveness.table_refresh_timeout = 0.2
+        cfg.liveness.iam_alive_table_publish = 0.5
+        return cfg
+
+    # ================= lifecycle ==========================================
+
+    async def start(self) -> "TestingCluster":
+        for _ in range(self.n_initial):
+            await self.start_additional_silo()
+        return self
+
+    async def start_additional_silo(self, name: Optional[str] = None) -> Silo:
+        """(reference: TestingSiloHost.StartAdditionalSilos :235)"""
+        if name is None:
+            self._counter += 1
+            name = f"silo{self._counter}"
+        silo = Silo(
+            config=self.config_factory(name),
+            storage_providers={"Default": MemoryStorage(self.storage_backing)},
+            fabric=self.fabric,
+            membership_table=self.table,
+        )
+        await silo.start()
+        self.silos.append(silo)
+        # let membership settle (gossip + view refresh)
+        await asyncio.sleep(0)
+        return silo
+
+    def kill_silo(self, silo: Silo) -> None:
+        """Hard kill — no goodbye, no handoff; peers must detect it
+        (reference: TestingSiloHost.KillSilo :334)."""
+        silo.kill()
+        if silo in self.silos:
+            self.silos.remove(silo)
+
+    async def stop_silo(self, silo: Silo) -> None:
+        """Graceful single-silo shutdown."""
+        await silo.stop()
+        if silo in self.silos:
+            self.silos.remove(silo)
+
+    async def restart_silo(self, silo: Silo) -> Silo:
+        """Kill + start a fresh incarnation at the same endpoint — new
+        generation, so the membership protocol declares the old one dead
+        (reference: TestingSiloHost.RestartSilo :347)."""
+        self.kill_silo(silo)
+        return await self.start_additional_silo(name=silo.name)
+
+    async def stop(self) -> None:
+        for silo in list(reversed(self.silos)):
+            await silo.stop()
+        self.silos.clear()
+
+    # ================= client =============================================
+
+    def attach_client(self, silo_index: int = 0) -> GrainFactory:
+        """In-process client bound to one silo (reference: TestingSiloHost
+        initializes GrainClient against the primary gateway)."""
+        return self.silos[silo_index].attach_client()
+
+    # ================= convenience ========================================
+
+    async def wait_for_liveness_convergence(self, timeout: float = 10.0) -> None:
+        """Wait until every silo's view agrees on the active set."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            views = [frozenset(s.active_silos()) for s in self.silos]
+            if len(set(views)) <= 1:
+                return
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(f"liveness did not converge: {views}")
+            await asyncio.sleep(0.05)
+
+    def total_activations(self) -> int:
+        return sum(len(s.catalog.directory) for s in self.silos)
+
+    def find_silo_hosting(self, grain_id) -> Optional[Silo]:
+        for s in self.silos:
+            if s.catalog.directory.by_grain.get(grain_id):
+                return s
+        return None

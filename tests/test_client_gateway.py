@@ -1,0 +1,137 @@
+"""Out-of-cluster client + gateway + observer tests.
+
+Reference analogs: Tester/ObserverTests, ClientAddressableTests, gateway
+connection handling in MembershipTests.
+"""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.client import GrainClient
+from orleans_tpu.runtime.runtime_client import RejectionError
+from orleans_tpu.testing import TestingCluster
+from orleans_tpu import Grain, grain_interface, one_way
+from orleans_tpu.core.grain import grain_class
+
+from tests.fixture_grains import ICounterGrain, IFailingGrain
+
+
+@grain_interface
+class IObserverCallback:
+    @one_way
+    async def on_event(self, value: int): ...
+
+
+@grain_interface
+class IPublisher:
+    async def subscribe(self, observer): ...
+    async def publish(self, value: int): ...
+
+
+@grain_class
+class PublisherGrain(Grain, IPublisher):
+    def __init__(self) -> None:
+        self.subscribers = []
+
+    async def subscribe(self, observer):
+        self.subscribers.append(observer)
+
+    async def publish(self, value: int):
+        for ref in self.subscribers:
+            await ref.on_event(value)
+
+
+class LocalObserver:
+    """Client-side plain object exposed via create_object_reference."""
+
+    def __init__(self) -> None:
+        self.events = []
+
+    async def on_event(self, value: int):
+        self.events.append(value)
+
+
+def test_client_roundtrip_and_errors(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        client = None
+        try:
+            await cluster.wait_for_liveness_convergence()
+            client = await GrainClient().connect(*cluster.silos)
+            counter = client.get_grain(ICounterGrain, 7)
+            assert await counter.add(3) == 3
+            assert await counter.add(4) == 7
+            failing = client.get_grain(IFailingGrain, 1)
+            with pytest.raises(ValueError, match="kaboom"):
+                await failing.boom()
+        finally:
+            if client:
+                await client.close()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_observer_notifications(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        client = None
+        try:
+            await cluster.wait_for_liveness_convergence()
+            client = await GrainClient().connect(cluster.silos[0])
+            observer = LocalObserver()
+            obs_ref = await client.create_object_reference(
+                IObserverCallback, observer)
+            pub = client.get_grain(IPublisher, 1)
+            await pub.subscribe(obs_ref)
+            await pub.publish(41)
+            await pub.publish(42)
+            # one-way delivery: give the pump a moment
+            await asyncio.sleep(0.1)
+            assert observer.events == [41, 42]
+        finally:
+            if client:
+                await client.close()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_client_disconnect_breaks_calls(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=1).start()
+        try:
+            client = await GrainClient().connect(cluster.silos[0])
+            counter = client.get_grain(ICounterGrain, 9)
+            assert await counter.add(1) == 1
+            await client.close()
+            with pytest.raises((RejectionError, RuntimeError)):
+                await counter.add(1)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_client_vector_grain_via_gateway(run):
+    """A remote client can call tensor-path grains through the gateway."""
+
+    async def main():
+        import numpy as np
+
+        from tests.test_tensor_engine import AccumGrain  # noqa: F401 — registers
+
+        cluster = await TestingCluster(n_silos=1).start()
+        client = None
+        try:
+            client = await GrainClient().connect(cluster.silos[0])
+            ref = client.get_grain("AccumGrain", 123)
+            res = await ref.add({"v": np.float32(5.0)})
+            assert float(res["echo"]) == 10.0
+        finally:
+            if client:
+                await client.close()
+            await cluster.stop()
+
+    run(main())

@@ -1,0 +1,105 @@
+"""Elasticity: joining silos, directory healing, single-activation under
+topology change (reference analogs: SilosStopTests.cs, directory handoff
+suites)."""
+
+import asyncio
+
+from orleans_tpu.core.grain import grain_id_for
+from orleans_tpu.testing import TestingCluster
+
+from tests.fixture_grains import ICounterGrain
+
+
+def hosts_of(cluster, gid):
+    return [s for s in cluster.silos if s.catalog.directory.by_grain.get(gid)]
+
+
+def test_join_preserves_single_activation(run):
+    """A joining silo takes over ring ranges; existing activations must
+    keep their single-activation guarantee (directory heal replaces the
+    reference's partition split handoff)."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            factory = cluster.attach_client(0)
+            refs = [factory.get_grain(ICounterGrain, i) for i in range(30)]
+            await asyncio.gather(*(r.add(1) for r in refs))
+
+            await cluster.start_additional_silo()
+            await cluster.wait_for_liveness_convergence()
+            await asyncio.sleep(0.3)  # let the heal pass run
+
+            # calls keep hitting the same activations: counters stay linear
+            values = await asyncio.gather(*(r.add(1) for r in refs))
+            assert values == [2] * 30, values
+            for i in range(30):
+                gid = grain_id_for(ICounterGrain, i)
+                assert len(hosts_of(cluster, gid)) == 1, f"grain {i} duplicated"
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_dead_silo_entries_heal_to_successor(run):
+    """After a hard kill, directory ranges owned by the dead silo move to
+    survivors and hosted activations re-register — the merge half of the
+    reference's handoff (GrainDirectoryHandoffManager.cs:141)."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=3).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            factory = cluster.attach_client(0)
+            refs = [factory.get_grain(ICounterGrain, i) for i in range(30)]
+            await asyncio.gather(*(r.add(1) for r in refs))
+
+            victim = cluster.silos[2]
+            cluster.kill_silo(victim)
+            deadline = asyncio.get_running_loop().time() + 10
+            while any(victim.address in s.active_silos()
+                      for s in cluster.silos):
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+            await asyncio.sleep(0.3)  # heal pass
+
+            values = await asyncio.gather(*(r.add(1) for r in refs))
+            assert len(values) == 30
+            for i in range(30):
+                gid = grain_id_for(ICounterGrain, i)
+                assert len(hosts_of(cluster, gid)) == 1, f"grain {i} duplicated"
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_scale_out_scale_in_cycle(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=1).start()
+        try:
+            factory = cluster.attach_client(0)
+            refs = [factory.get_grain(ICounterGrain, i) for i in range(10)]
+            await asyncio.gather(*(r.add(1) for r in refs))
+            # scale out to 3
+            await cluster.start_additional_silo()
+            await cluster.start_additional_silo()
+            await cluster.wait_for_liveness_convergence()
+            await asyncio.sleep(0.3)
+            await asyncio.gather(*(r.add(1) for r in refs))
+            # scale back in (graceful)
+            await cluster.stop_silo(cluster.silos[2])
+            await cluster.stop_silo(cluster.silos[1])
+            await cluster.wait_for_liveness_convergence()
+            values = await asyncio.gather(*(r.add(1) for r in refs))
+            # grains that moved lose unsaved in-memory count (no storage
+            # write) — but every call must succeed and the count per grain
+            # is consistent with exactly-one-activation semantics
+            assert all(v >= 1 for v in values)
+            assert cluster.total_activations() == 10
+        finally:
+            await cluster.stop()
+
+    run(main())

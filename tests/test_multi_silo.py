@@ -1,0 +1,204 @@
+"""Multi-silo cluster tests: cross-silo RPC, placement, membership,
+failure detection, recovery.
+
+Reference analogs: Tester/MembershipTests/LivenessTests.cs,
+SilosStopTests.cs, and the directory/single-activation suites.
+"""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.core.grain import grain_id_for
+from orleans_tpu.testing import TestingCluster
+
+from tests.fixture_grains import ICounterGrain, IFailingGrain, ISlowGrain
+
+
+def test_cross_silo_rpc(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=3).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            factory = cluster.attach_client(0)
+            # spread 30 grains — hash placement should use several silos
+            refs = [factory.get_grain(IFailingGrain, i) for i in range(30)]
+            results = await asyncio.gather(*(r.ok() for r in refs))
+            assert all(r == "fine" for r in results)
+            hosting = [len(s.catalog.directory) for s in cluster.silos]
+            assert sum(hosting) == 30
+            assert sum(1 for h in hosting if h > 0) >= 2, hosting
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_single_activation_across_silos(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=3).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            # clients attached to different silos call the same grain
+            f0 = cluster.attach_client(0)
+            ref0 = f0.get_grain(ICounterGrain, 42)
+            r0 = await asyncio.gather(*(ref0.add(1) for _ in range(5)))
+            f1 = cluster.attach_client(1)
+            ref1 = f1.get_grain(ICounterGrain, 42)
+            r1 = await ref1.add(1)
+            # one activation total, counter is linear
+            gid = grain_id_for(ICounterGrain, 42)
+            hosts = [s for s in cluster.silos
+                     if s.catalog.directory.by_grain.get(gid)]
+            assert len(hosts) == 1
+            assert r1 == 6
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_kill_silo_detected_and_grain_reactivates(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=3).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            factory = cluster.attach_client(0)
+            refs = [factory.get_grain(ICounterGrain, i) for i in range(20)]
+            await asyncio.gather(*(r.add(1) for r in refs))
+
+            # find a victim hosting at least one grain, not the client silo
+            victim = next(s for s in cluster.silos[1:]
+                          if len(s.catalog.directory) > 0)
+            lost = len(victim.catalog.directory)
+            cluster.kill_silo(victim)
+
+            # survivors must declare it dead via probes + votes
+            deadline = asyncio.get_running_loop().time() + 10
+            while any(victim.address in s.active_silos()
+                      for s in cluster.silos):
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "victim never declared dead"
+                await asyncio.sleep(0.1)
+
+            # every grain remains callable (dead ones re-activate elsewhere)
+            results = await asyncio.gather(*(r.add(1) for r in refs))
+            assert len(results) == 20
+            assert lost > 0
+            for s in cluster.silos:
+                assert victim.address not in s.active_silos()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_graceful_shutdown_moves_grains(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            factory = cluster.attach_client(0)
+            refs = [factory.get_grain(ICounterGrain, i) for i in range(10)]
+            await asyncio.gather(*(r.add(5) for r in refs))
+            # persist so state survives the move
+            await asyncio.gather(*(r.save() for r in refs))
+
+            leaver = cluster.silos[1]
+            await cluster.stop_silo(leaver)
+            await cluster.wait_for_liveness_convergence()
+
+            values = await asyncio.gather(*(r.get() for r in refs))
+            assert all(v == 5 for v in values), values
+            # everything now lives on the surviving silo
+            assert len(cluster.silos[0].catalog.directory) == 10
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_restarted_silo_is_new_incarnation(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            old = cluster.silos[1]
+            old_addr = old.address
+            new = await cluster.restart_silo(old)
+            assert new.address.matches(old_addr)          # same endpoint
+            assert new.address.generation > old_addr.generation
+            await cluster.wait_for_liveness_convergence()
+            for s in cluster.silos:
+                assert old_addr not in s.active_silos()
+                assert new.address in s.active_silos() \
+                    or s.address == new.address
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_silo_kills_itself_when_declared_dead(run):
+    """A falsely-suspected silo must stop serving when it sees its own
+    DEAD row — split-brain prevention (reference: MembershipOracle
+    self-death on own DEAD entry)."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            victim = cluster.silos[1]
+            # peers vote it dead behind its back (as after a long stall)
+            await cluster.silos[0].membership_oracle.try_suspect_or_kill(
+                victim.address)
+            deadline = asyncio.get_running_loop().time() + 5
+            from orleans_tpu.runtime.silo import SiloStatus
+            while victim.status != SiloStatus.DEAD:
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "victim kept running after being declared dead"
+                await asyncio.sleep(0.05)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_message_loss_injection_resend(run):
+    """Transient loss is healed by timeouts + the membership layer
+    (reference analog: MessageLossInjectionRate + resend machinery)."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            # drop ~30% of APPLICATION messages crossing the fabric
+            import random
+            rng = random.Random(7)
+            from orleans_tpu.runtime.messaging import Category
+
+            def drop(msg):
+                return (msg.category == Category.APPLICATION
+                        and rng.random() < 0.3)
+
+            cluster.fabric.drop_predicate = drop
+            for s in cluster.silos:
+                s.runtime_client.response_timeout = 0.3
+            factory = cluster.attach_client(0)
+            refs = [factory.get_grain(IFailingGrain, i) for i in range(20)]
+
+            async def robust_call(r):
+                for _ in range(20):
+                    try:
+                        return await r.ok()
+                    except Exception:
+                        continue
+                raise AssertionError("never succeeded")
+
+            results = await asyncio.gather(*(robust_call(r) for r in refs))
+            assert all(x == "fine" for x in results)
+        finally:
+            cluster.fabric.drop_predicate = None
+            await cluster.stop()
+
+    run(main())
