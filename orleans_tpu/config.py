@@ -53,6 +53,14 @@ class MessagingConfig:
 
 
 @dataclass
+class RemindersConfig:
+    """(reference: GlobalConfiguration reminder service section :84)"""
+
+    enabled: bool = True
+    refresh_period: float = 30.0          # table re-read cadence
+
+
+@dataclass
 class TensorEngineConfig:
     """TPU data-plane knobs (no reference analog — this is the rebuild's
     batched dispatch engine)."""
@@ -74,6 +82,7 @@ class SiloConfig:
     directory: DirectoryConfig = field(default_factory=DirectoryConfig)
     collection: CollectionConfig = field(default_factory=CollectionConfig)
     messaging: MessagingConfig = field(default_factory=MessagingConfig)
+    reminders: RemindersConfig = field(default_factory=RemindersConfig)
     tensor: TensorEngineConfig = field(default_factory=TensorEngineConfig)
     extra: Dict[str, Any] = field(default_factory=dict)
 

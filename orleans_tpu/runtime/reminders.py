@@ -1,0 +1,528 @@
+"""Durable reminders: persistent timers that survive deactivation and
+silo failure.
+
+Parity: reference LocalReminderService (reference:
+src/OrleansRuntime/ReminderService/LocalReminderService.cs:36 — ring-range
+partitioned ownership :96-108, tick firing :227), the pluggable reminder
+table (reference: src/OrleansRuntime/ReminderService/ReminderTable.cs:30,
+IReminderTable contract), the dev-mode grain-backed table (reference:
+GrainBasedReminderTable.cs:34 wrapping InMemoryRemindersTable.cs:32) and
+the latency-injecting test table (reference: MockReminderTable.cs:30).
+
+Ownership model: the consistent ring partitions the reminder key space —
+the silo whose ring range covers ``grain_id.ring_hash()`` runs the timers
+for that grain's reminders.  Ring changes (silo join/leave/death) shift
+ranges; each service re-reads the table and starts/stops local timers to
+match its new range (reference: LocalReminderService as IRingRangeListener).
+
+Delivery: a reminder tick is an ordinary grain call
+(``receive_reminder(name, status)`` on the IRemindable interface), so it
+gets single-threaded turn semantics, placement, and directory resolution
+like any message (reference: ReminderService GrainReference cast to
+IRemindable).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Tuple
+
+from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.core.grain import Grain, grain_class, grain_interface
+from orleans_tpu.ids import GrainId
+from orleans_tpu.tracing import TraceLogger
+
+
+# ---------------------------------------------------------------------------
+# model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TickStatus:
+    """Passed to receive_reminder (reference: TickStatus struct)."""
+
+    first_tick_time: float      # epoch seconds of the first scheduled tick
+    period: float               # seconds between ticks (0 = one-shot)
+    current_tick_time: float    # epoch seconds this tick was scheduled for
+
+
+@dataclass
+class ReminderEntry:
+    """One table row (reference: ReminderEntry in ReminderTable.cs)."""
+
+    grain_id: GrainId
+    name: str
+    start_at: float             # epoch seconds of the first tick
+    period: float               # seconds; 0 = fire once
+    etag: str = ""
+
+    @property
+    def key(self) -> Tuple[GrainId, str]:
+        return (self.grain_id, self.name)
+
+
+@dataclass
+class ReminderRegistration:
+    """Handle returned to grains (reference: IGrainReminder)."""
+
+    grain_id: GrainId
+    name: str
+    etag: str = field(default="", compare=False)
+
+
+codec.register(TickStatus)
+codec.register(ReminderEntry)
+codec.register(ReminderRegistration)
+
+
+@grain_interface
+class IRemindable:
+    """Grains that accept reminder ticks implement this
+    (reference: IRemindable interface)."""
+
+    async def receive_reminder(self, reminder_name: str,
+                               status: TickStatus) -> None: ...
+
+
+# ---------------------------------------------------------------------------
+# tables (reference: IReminderTable contract)
+# ---------------------------------------------------------------------------
+
+class ReminderTable:
+    """Pluggable durable store for reminder rows.  Etag discipline matches
+    the reference: upsert returns a fresh etag, remove requires the current
+    one (reference: IReminderTable.UpsertRow/RemoveRow)."""
+
+    async def init(self) -> None:  # noqa: B027
+        pass
+
+    async def read_row(self, grain_id: GrainId,
+                       name: str) -> Optional[ReminderEntry]:
+        raise NotImplementedError
+
+    async def read_rows(self, grain_id: GrainId) -> List[ReminderEntry]:
+        raise NotImplementedError
+
+    async def read_all(self) -> List[ReminderEntry]:
+        raise NotImplementedError
+
+    async def upsert_row(self, entry: ReminderEntry) -> str:
+        raise NotImplementedError
+
+    async def remove_row(self, grain_id: GrainId, name: str,
+                         etag: str) -> bool:
+        raise NotImplementedError
+
+
+class InMemoryReminderTable(ReminderTable):
+    """(reference: InMemoryRemindersTable.cs:32)"""
+
+    def __init__(self) -> None:
+        self._rows: Dict[Tuple[GrainId, str], ReminderEntry] = {}
+        self._etag = 0
+
+    def _next_etag(self) -> str:
+        self._etag += 1
+        return str(self._etag)
+
+    async def read_row(self, grain_id, name):
+        row = self._rows.get((grain_id, name))
+        return replace(row) if row is not None else None
+
+    async def read_rows(self, grain_id):
+        return [replace(r) for (g, _), r in self._rows.items()
+                if g == grain_id]
+
+    async def read_all(self):
+        return [replace(r) for r in self._rows.values()]
+
+    async def upsert_row(self, entry):
+        etag = self._next_etag()
+        self._rows[entry.key] = replace(entry, etag=etag)
+        return etag
+
+    async def remove_row(self, grain_id, name, etag):
+        row = self._rows.get((grain_id, name))
+        if row is None or row.etag != etag:
+            return False
+        del self._rows[(grain_id, name)]
+        return True
+
+
+class MockReminderTable(ReminderTable):
+    """Latency-injecting wrapper for tests
+    (reference: MockReminderTable.cs:30 — configurable delay)."""
+
+    def __init__(self, inner: Optional[ReminderTable] = None,
+                 delay: float = 0.0) -> None:
+        self.inner = inner or InMemoryReminderTable()
+        self.delay = delay
+
+    async def _lag(self) -> None:
+        if self.delay > 0:
+            await asyncio.sleep(self.delay)
+
+    async def read_row(self, grain_id, name):
+        await self._lag()
+        return await self.inner.read_row(grain_id, name)
+
+    async def read_rows(self, grain_id):
+        await self._lag()
+        return await self.inner.read_rows(grain_id)
+
+    async def read_all(self):
+        await self._lag()
+        return await self.inner.read_all()
+
+    async def upsert_row(self, entry):
+        await self._lag()
+        return await self.inner.upsert_row(entry)
+
+    async def remove_row(self, grain_id, name, etag):
+        await self._lag()
+        return await self.inner.remove_row(grain_id, name, etag)
+
+
+# -- grain-backed table (dev mode) ------------------------------------------
+
+@grain_interface
+class IReminderTableGrain:
+    async def table_read_row(self, grain_id, name): ...
+    async def table_read_rows(self, grain_id): ...
+    async def table_read_all(self): ...
+    async def table_upsert_row(self, entry): ...
+    async def table_remove_row(self, grain_id, name, etag): ...
+
+
+@grain_class
+class ReminderTableGrain(Grain, IReminderTableGrain):
+    """The reminder table hosted as a single grain — the dev/test liveness
+    mode where no external store exists (reference:
+    GrainBasedReminderTable.cs:34)."""
+
+    def __init__(self) -> None:
+        self.table = InMemoryReminderTable()
+
+    async def table_read_row(self, grain_id, name):
+        return await self.table.read_row(grain_id, name)
+
+    async def table_read_rows(self, grain_id):
+        return await self.table.read_rows(grain_id)
+
+    async def table_read_all(self):
+        return await self.table.read_all()
+
+    async def table_upsert_row(self, entry):
+        return await self.table.upsert_row(entry)
+
+    async def table_remove_row(self, grain_id, name, etag):
+        return await self.table.remove_row(grain_id, name, etag)
+
+
+class GrainBasedReminderTable(ReminderTable):
+    """Adapter calling the table grain through the normal RPC path, so the
+    row store is shared cluster-wide without external I/O
+    (reference: ReminderTable.GrainService path)."""
+
+    TABLE_KEY = 0
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+
+    def _ref(self):
+        from orleans_tpu.core.factory import factory
+        return factory.get_grain(IReminderTableGrain, self.TABLE_KEY)
+
+    async def _call(self, method: str, *args):
+        from orleans_tpu.core.reference import _current_runtime, bind_runtime
+        token = bind_runtime(self.silo.runtime_client)
+        try:
+            return await getattr(self._ref(), method)(*args)
+        finally:
+            _current_runtime.reset(token)
+
+    async def read_row(self, grain_id, name):
+        return await self._call("table_read_row", grain_id, name)
+
+    async def read_rows(self, grain_id):
+        return await self._call("table_read_rows", grain_id)
+
+    async def read_all(self):
+        return await self._call("table_read_all")
+
+    async def upsert_row(self, entry):
+        return await self._call("table_upsert_row", entry)
+
+    async def remove_row(self, grain_id, name, etag):
+        return await self._call("table_remove_row", grain_id, name, etag)
+
+
+# ---------------------------------------------------------------------------
+# the per-silo service
+# ---------------------------------------------------------------------------
+
+class _LocalReminder:
+    """One running timer (reference: LocalReminderService.LocalReminderData)."""
+
+    __slots__ = ("entry", "task")
+
+    def __init__(self, entry: ReminderEntry, task: asyncio.Task) -> None:
+        self.entry = entry
+        self.task = task
+
+
+class LocalReminderService:
+    """Ring-range-partitioned reminder runner; registered as the
+    "reminders" system target (reference: LocalReminderService.cs:36,
+    Constants reminder-service id=16)."""
+
+    def __init__(self, silo, table: ReminderTable,
+                 refresh_period: float = 30.0,
+                 retry_delay: float = 1.0) -> None:
+        self.silo = silo
+        self.table = table
+        self.refresh_period = refresh_period
+        self.retry_delay = retry_delay  # failed one-shot delivery backoff
+        self.logger = TraceLogger(f"reminders.{silo.name}")
+        self.local: Dict[Tuple[GrainId, str], _LocalReminder] = {}
+        self.ticks_delivered = 0
+        self._refresh_task: Optional[asyncio.Task] = None
+        self._running = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._running = True
+        await self.table.init()
+        self.silo.register_system_target("reminders", self)
+        self.silo.ring.subscribe(lambda *_: self._schedule_refresh())
+        await self._refresh()
+        self._refresh_task = asyncio.get_running_loop().create_task(
+            self._refresh_loop())
+
+    async def stop(self) -> None:
+        self.kill()
+
+    def kill(self) -> None:
+        """Synchronous teardown (hard-kill path): cancel every timer and
+        the refresh loop so a dead silo never touches the table again."""
+        self._running = False
+        if self._refresh_task is not None:
+            self._refresh_task.cancel()
+            self._refresh_task = None
+        for rem in list(self.local.values()):
+            rem.task.cancel()
+        self.local.clear()
+
+    # -- ownership ----------------------------------------------------------
+
+    def _owner_of(self, grain_id: GrainId):
+        return self.silo.ring.owner_of_hash(grain_id.ring_hash())
+
+    def _i_own(self, grain_id: GrainId) -> bool:
+        owner = self._owner_of(grain_id)
+        return owner is None or owner == self.silo.address
+
+    # -- registration API (invoked via Grain.register_reminder) -------------
+
+    async def register_or_update(self, grain_id: GrainId, name: str,
+                                 due: float, period: float
+                                 ) -> ReminderRegistration:
+        """(reference: ReminderService.RegisterOrUpdateReminder)"""
+        entry = ReminderEntry(grain_id=grain_id, name=name,
+                              start_at=time.time() + due, period=period)
+        etag = await self.table.upsert_row(entry)
+        entry.etag = etag
+        await self._notify_owner_start(entry)
+        return ReminderRegistration(grain_id, name, etag)
+
+    async def unregister(self, grain_id: GrainId, name: str) -> None:
+        row = await self.table.read_row(grain_id, name)
+        if row is not None:
+            await self.table.remove_row(grain_id, name, row.etag)
+        owner = self._owner_of(grain_id)
+        if owner is None or owner == self.silo.address:
+            self._stop_local(grain_id, name)
+        else:
+            try:
+                await self.silo.system_rpc(owner, "reminders",
+                                           "stop_reminder", (grain_id, name))
+            except Exception:  # noqa: BLE001 — table row is gone; timers
+                pass           # on the (possibly dead) owner self-cancel
+
+    async def get_reminder(self, grain_id: GrainId,
+                           name: str) -> Optional[ReminderRegistration]:
+        row = await self.table.read_row(grain_id, name)
+        if row is None:
+            return None
+        return ReminderRegistration(row.grain_id, row.name, row.etag)
+
+    async def get_reminders(self, grain_id: GrainId
+                            ) -> List[ReminderRegistration]:
+        rows = await self.table.read_rows(grain_id)
+        return [ReminderRegistration(r.grain_id, r.name, r.etag)
+                for r in rows]
+
+    async def _notify_owner_start(self, entry: ReminderEntry) -> None:
+        owner = self._owner_of(entry.grain_id)
+        if owner is None or owner == self.silo.address:
+            self._start_local(entry)
+        else:
+            try:
+                await self.silo.system_rpc(
+                    owner, "reminders", "start_reminder",
+                    (entry.grain_id, entry.name, entry.start_at,
+                     entry.period, entry.etag))
+            except Exception as exc:  # noqa: BLE001
+                # owner unreachable: the row is durable; the next refresh
+                # on whichever silo owns the range picks it up
+                self.logger.warn(
+                    f"start notify to {owner} failed ({exc!r}); relying on "
+                    f"table refresh")
+
+    # -- system-target RPCs -------------------------------------------------
+
+    async def start_reminder(self, grain_id: GrainId, name: str,
+                             start_at: float, period: float,
+                             etag: str) -> None:
+        self._start_local(ReminderEntry(grain_id=grain_id, name=name,
+                                        start_at=start_at, period=period,
+                                        etag=etag))
+
+    async def stop_reminder(self, grain_id: GrainId, name: str) -> None:
+        self._stop_local(grain_id, name)
+
+    async def local_reminder_count(self) -> int:
+        return len(self.local)
+
+    # -- timers -------------------------------------------------------------
+
+    def _start_local(self, entry: ReminderEntry) -> None:
+        import contextvars
+        self._stop_local(entry.grain_id, entry.name)
+        # fresh context: a reminder registered from inside a grain turn must
+        # NOT inherit that turn's call chain / activation (its ticks are new
+        # top-level requests, not continuations — else deadlock detection
+        # sees the registering grain in its own chain)
+        task = asyncio.get_running_loop().create_task(
+            self._run(entry), context=contextvars.Context())
+        self.local[entry.key] = _LocalReminder(entry, task)
+
+    def _stop_local(self, grain_id: GrainId, name: str) -> None:
+        rem = self.local.pop((grain_id, name), None)
+        if rem is not None:
+            rem.task.cancel()
+
+    async def _run(self, entry: ReminderEntry) -> None:
+        """Fire loop for one reminder.  Schedule is absolute
+        (start_at + k·period), so late ticks don't drift the phase
+        (reference: LocalReminderService tick scheduling :227)."""
+        key = entry.key
+        next_due = entry.start_at
+        if entry.period > 0:
+            # if we adopted an old row (failover), skip straight to the
+            # next future tick
+            now = time.time()
+            while next_due <= now - entry.period:
+                next_due += entry.period
+        try:
+            while self._running:
+                delay = next_due - time.time()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                if self.local.get(key) is None \
+                        or self.local[key].task is not asyncio.current_task():
+                    return
+                # confirm the row still exists with our etag (unregistered /
+                # re-registered reminders must stop firing here)
+                row = await self.table.read_row(entry.grain_id, entry.name)
+                if row is None or row.etag != entry.etag:
+                    self.local.pop(key, None)
+                    return
+                if not self._i_own(entry.grain_id):
+                    # range moved away between sleeps
+                    self.local.pop(key, None)
+                    return
+                delivered = await self._fire(entry, next_due)
+                if entry.period <= 0:
+                    if delivered:
+                        await self.table.remove_row(entry.grain_id,
+                                                    entry.name, entry.etag)
+                        self.local.pop(key, None)
+                        return
+                    # durable one-shot: a failed delivery must NOT consume
+                    # the row — retry after a backoff (row/ownership checks
+                    # at the top of the loop keep this self-correcting)
+                    await asyncio.sleep(self.retry_delay)
+                    continue
+                next_due += entry.period
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001
+            self.logger.warn(f"reminder loop {key} died: {exc!r}")
+            self.local.pop(key, None)
+
+    async def _fire(self, entry: ReminderEntry, scheduled: float) -> bool:
+        from orleans_tpu.core.reference import (
+            GrainReference,
+            _current_runtime,
+            bind_runtime,
+        )
+        iface = IRemindable.__grain_interface_info__
+        ref = GrainReference(entry.grain_id, iface.interface_id)
+        status = TickStatus(first_tick_time=entry.start_at,
+                            period=entry.period,
+                            current_tick_time=scheduled)
+        token = bind_runtime(self.silo.runtime_client)
+        try:
+            await ref.receive_reminder(entry.name, status)
+            self.ticks_delivered += 1
+            return True
+        except Exception as exc:  # noqa: BLE001 — a failing grain must not
+            self.logger.warn(     # kill the reminder (reference behavior)
+                f"receive_reminder({entry.name}) on {entry.grain_id} "
+                f"failed: {exc!r}")
+            return False
+        finally:
+            _current_runtime.reset(token)
+
+    # -- range refresh ------------------------------------------------------
+
+    def _schedule_refresh(self) -> None:
+        if not self._running:
+            return
+        asyncio.get_running_loop().create_task(self._refresh())
+
+    async def _refresh_loop(self) -> None:
+        while self._running:
+            await asyncio.sleep(self.refresh_period)
+            try:
+                await self._refresh()
+            except Exception as exc:  # noqa: BLE001
+                self.logger.warn(f"reminder refresh failed: {exc!r}")
+
+    async def _refresh(self) -> None:
+        """Reconcile local timers with the table under the current ring
+        ranges (reference: LocalReminderService.ReadAndUpdateReminders
+        :96-108)."""
+        if not self._running:
+            return
+        rows = await self.table.read_all()
+        owned = {r.key: r for r in rows if self._i_own(r.grain_id)}
+        # stop what we no longer own or what no longer exists
+        for key in list(self.local):
+            if key not in owned:
+                self._stop_local(*key)
+        # start/update what we own
+        for key, row in owned.items():
+            cur = self.local.get(key)
+            if cur is None or cur.entry.etag != row.etag:
+                self._start_local(row)
+
+    # -- stats --------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"local_reminders": len(self.local),
+                "ticks_delivered": self.ticks_delivered}

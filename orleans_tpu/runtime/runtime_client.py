@@ -78,7 +78,12 @@ class InsideRuntimeClient:
 
     @property
     def reminder_registry(self):
-        return self.silo.reminder_service
+        svc = self.silo.reminder_service
+        if svc is None:
+            raise RuntimeError(
+                "reminder service disabled on this silo "
+                "(SiloConfig.reminders.enabled=False)")
+        return svc
 
     def stream_provider(self, name: str):
         return self.silo.stream_provider(name)

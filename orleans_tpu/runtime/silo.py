@@ -93,6 +93,7 @@ class Silo:
                  name: str = "silo", port: int = 0,
                  storage_providers: Optional[Dict[str, StorageProvider]] = None,
                  fabric=None, membership_table=None,
+                 reminder_table=None,
                  ) -> None:
         self.config = config or SiloConfig(name=name)
         self.name = self.config.name if config else name
@@ -151,6 +152,23 @@ class Silo:
             self.membership_oracle = MembershipOracle(
                 self, membership_table, self.config.liveness)
         self.reminder_service = None
+        if self.config.reminders.enabled:
+            from orleans_tpu.runtime.reminders import (
+                GrainBasedReminderTable,
+                InMemoryReminderTable,
+                LocalReminderService,
+            )
+            if reminder_table is None:
+                # clustered silos without an explicit table share rows via
+                # the table *grain* (reference: GrainBasedReminderTable dev
+                # mode) — a private in-memory table would strand reminders
+                # whose ring owner isn't the registering silo
+                reminder_table = (GrainBasedReminderTable(self)
+                                  if fabric is not None
+                                  else InMemoryReminderTable())
+            self.reminder_service = LocalReminderService(
+                self, reminder_table,
+                refresh_period=self.config.reminders.refresh_period)
         self._stop_callbacks: List[Callable[[], Any]] = []
 
         # elasticity: membership-driven ring changes re-assert directory
@@ -192,9 +210,11 @@ class Silo:
         self.status = SiloStatus.SHUTTING_DOWN if graceful else SiloStatus.STOPPING
         if self.tensor_engine is not None:
             await self.tensor_engine.stop(drain=graceful)
+        # reminder timers must die on ANY stop — a zombie service would
+        # keep mutating the shared durable table after "death"
+        if self.reminder_service is not None:
+            await self.reminder_service.stop()
         if graceful:
-            if self.reminder_service is not None:
-                await self.reminder_service.stop()
             for provider in self.stream_providers.values():
                 stop = getattr(provider, "stop", None)
                 if stop is not None:
@@ -218,6 +238,8 @@ class Silo:
         (reference: Silo.FastKill :776; TestingSiloHost.KillSilo)."""
         self.status = SiloStatus.DEAD
         self.catalog.stop_collector()
+        if self.reminder_service is not None:
+            self.reminder_service.kill()
         if self.membership_oracle is not None:
             self.membership_oracle.kill()
         if self._bound_transport is not None:

@@ -21,6 +21,7 @@ from orleans_tpu.config import SiloConfig
 from orleans_tpu.core.factory import GrainFactory
 from orleans_tpu.providers.memory_storage import MemoryStorage
 from orleans_tpu.runtime.membership import InMemoryMembershipTable
+from orleans_tpu.runtime.reminders import InMemoryReminderTable
 from orleans_tpu.runtime.silo import Silo
 from orleans_tpu.runtime.transport import InProcTransport
 
@@ -36,6 +37,9 @@ class TestingCluster:
         self.config_factory = config_factory or self._default_config
         self.fabric = InProcTransport(wire_fidelity=wire_fidelity)
         self.table = InMemoryMembershipTable()
+        # shared durable reminder store (reference: TestingSiloHost's
+        # ReminderTableGrain / shared in-proc stores)
+        self.reminder_table = InMemoryReminderTable()
         self.storage_backing = MemoryStorage.shared_backing()
         self.silos: List[Silo] = []
         self._counter = 0
@@ -69,6 +73,7 @@ class TestingCluster:
             storage_providers={"Default": MemoryStorage(self.storage_backing)},
             fabric=self.fabric,
             membership_table=self.table,
+            reminder_table=self.reminder_table,
         )
         await silo.start()
         self.silos.append(silo)
