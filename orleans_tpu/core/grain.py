@@ -289,6 +289,25 @@ class Grain:
         provider = self.runtime.stream_provider(provider_name)
         return provider.get_stream(namespace, stream_id)
 
+    # -- stream runtime extensions (reference: StreamConsumerExtension /
+    # IStreamProducerExtension — every activation carries both) ------------
+
+    async def stream_deliver(self, subscription_id, stream_id, item, seq):
+        from orleans_tpu.streams.core import deliver_to_grain_instance
+        await deliver_to_grain_instance(self, subscription_id, stream_id,
+                                        item, seq)
+
+    async def stream_complete(self, subscription_id, stream_id, error):
+        from orleans_tpu.streams.core import complete_on_grain_instance
+        await complete_on_grain_instance(self, subscription_id, stream_id,
+                                         error)
+
+    async def stream_producer_update(self, stream_id, consumers):
+        cache = getattr(self, "_stream_producer_cache", None)
+        if cache is None:
+            cache = self._stream_producer_cache = {}
+        cache[stream_id] = consumers
+
     @property
     def logger(self):
         return self._activation.logger

@@ -170,16 +170,34 @@ class LocalGrainDirectory:
         return self.cache.get(grain_id)
 
     async def full_lookup(self, grain_id: GrainId) -> Optional[ActivationAddress]:
-        owner = self.owner_of(grain_id)
-        if owner == self.silo.address:
-            self.lookups_local += 1
-            return self.partition.lookup(grain_id)
-        self.lookups_remote += 1
-        addr = await self.silo.system_rpc(owner, "directory",
-                                          "remote_lookup", (grain_id,))
-        if addr is not None:
-            self.cache.put(grain_id, addr)
-        return addr
+        import asyncio
+
+        from orleans_tpu.runtime.runtime_client import (
+            RejectionError,
+            RequestTimeoutError,
+        )
+        # owner is re-evaluated per attempt: a lookup racing a membership
+        # change may first target a silo just declared dead; once the ring
+        # heals the next attempt lands on the live owner (reference:
+        # LocalGrainDirectory retry on ring change during lookup)
+        last_exc: Optional[Exception] = None
+        for attempt in range(5):
+            owner = self.owner_of(grain_id)
+            if owner == self.silo.address:
+                self.lookups_local += 1
+                return self.partition.lookup(grain_id)
+            self.lookups_remote += 1
+            try:
+                addr = await self.silo.system_rpc(owner, "directory",
+                                                  "remote_lookup", (grain_id,))
+            except (RejectionError, RequestTimeoutError) as exc:
+                last_exc = exc
+                await asyncio.sleep(0.02 * (attempt + 1))
+                continue
+            if addr is not None:
+                self.cache.put(grain_id, addr)
+            return addr
+        raise last_exc
 
     # -- invalidation -------------------------------------------------------
 

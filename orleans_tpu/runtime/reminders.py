@@ -435,12 +435,11 @@ class LocalReminderService:
                 if self.local.get(key) is None \
                         or self.local[key].task is not asyncio.current_task():
                     return
-                # confirm the row still exists with our etag (unregistered /
-                # re-registered reminders must stop firing here)
-                row = await self.table.read_row(entry.grain_id, entry.name)
-                if row is None or row.etag != entry.etag:
-                    self.local.pop(key, None)
-                    return
+                # note: no per-tick table read — in clustered mode that
+                # would be one RPC to the shared table grain per tick.
+                # Unregister cancels timers via the stop_reminder RPC, and
+                # the periodic refresh reconciles any straggler against the
+                # table at refresh cadence (reference behavior)
                 if not self._i_own(entry.grain_id):
                     # range moved away between sleeps
                     self.local.pop(key, None)
@@ -493,7 +492,17 @@ class LocalReminderService:
     def _schedule_refresh(self) -> None:
         if not self._running:
             return
-        asyncio.get_running_loop().create_task(self._refresh())
+
+        async def guarded() -> None:
+            try:
+                await self._refresh()
+            except Exception as exc:  # noqa: BLE001 — periodic refresh
+                self.logger.warn(      # will reconcile later
+                    f"ring-change reminder refresh failed: {exc!r}")
+
+        # keep a reference so the task isn't GC'd mid-flight
+        self._ring_refresh_task = \
+            asyncio.get_running_loop().create_task(guarded())
 
     async def _refresh_loop(self) -> None:
         while self._running:

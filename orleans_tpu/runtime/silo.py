@@ -214,11 +214,13 @@ class Silo:
         # keep mutating the shared durable table after "death"
         if self.reminder_service is not None:
             await self.reminder_service.stop()
+        # pulling agents likewise must stop on ANY shutdown, else a zombie
+        # agent keeps consuming shared queues after "death"
+        for provider in self.stream_providers.values():
+            stop = getattr(provider, "stop", None)
+            if stop is not None:
+                await stop()
         if graceful:
-            for provider in self.stream_providers.values():
-                stop = getattr(provider, "stop", None)
-                if stop is not None:
-                    await stop()
             await self.catalog.deactivate_all()
             if self.membership_oracle is not None:
                 await self.membership_oracle.leave()
@@ -238,6 +240,10 @@ class Silo:
         (reference: Silo.FastKill :776; TestingSiloHost.KillSilo)."""
         self.status = SiloStatus.DEAD
         self.catalog.stop_collector()
+        for provider in self.stream_providers.values():
+            k = getattr(provider, "kill", None)
+            if k is not None:
+                k()
         if self.reminder_service is not None:
             self.reminder_service.kill()
         if self.membership_oracle is not None:
@@ -350,6 +356,12 @@ class Silo:
         if provider is None:
             raise KeyError(f"stream provider {name!r} not configured")
         return provider
+
+    def add_stream_provider(self, name: str, provider) -> None:
+        """Register + wire a stream provider; call before start()
+        (reference: stream provider config blocks, Silo.cs:488-495)."""
+        provider.init(self, name)
+        self.stream_providers[name] = provider
 
     def attach_client(self) -> GrainFactory:
         """Bind the calling context to this silo as an in-process client

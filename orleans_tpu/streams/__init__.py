@@ -1,0 +1,37 @@
+"""Streams: the pub/sub programming model and its runtime.
+
+Parity: reference streams core (reference: src/Orleans/Streams/ — 65 files:
+IAsyncStream.cs:36, StreamImpl.cs:35, StreamConsumer.cs:32,
+StreamPubSubImpl.cs:31, ImplicitStreamSubscriberTable.cs:32) and streams
+runtime (reference: src/OrleansRuntime/Streams/ —
+PersistentStreamPullingManager.cs:35, PersistentStreamPullingAgent.cs:34,
+HashRingBasedStreamQueueMapper.cs:30, QueueBalancer/*).
+
+Two provider families, as in the reference:
+
+* SimpleMessageStreamProvider — direct grain-to-grain fan-out, no queue
+  (reference: SimpleMessageStreamProvider.cs:31).
+* PersistentStreamProvider — queue-backed: producers enqueue, per-queue
+  pulling agents on the queue's ring-owner silo deliver to subscribers
+  (reference: PersistentStreamProvider.cs:58).
+"""
+
+from orleans_tpu.streams.core import (
+    StreamId,
+    StreamSubscriptionHandle,
+    implicit_stream_subscription,
+)
+from orleans_tpu.streams.simple import SimpleMessageStreamProvider
+from orleans_tpu.streams.persistent import (
+    InMemoryQueueAdapter,
+    PersistentStreamProvider,
+)
+
+__all__ = [
+    "StreamId",
+    "StreamSubscriptionHandle",
+    "implicit_stream_subscription",
+    "SimpleMessageStreamProvider",
+    "PersistentStreamProvider",
+    "InMemoryQueueAdapter",
+]

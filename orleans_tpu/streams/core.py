@@ -1,0 +1,286 @@
+"""Stream identity, handles, and the consumer-side delivery extension.
+
+Parity: reference IAsyncStream<T>/StreamImpl (reference: IAsyncStream.cs:36,
+StreamImpl.cs:35), StreamSubscriptionHandle (StreamSubscriptionHandleImpl),
+the per-activation StreamConsumerExtension that receives deliveries
+(reference: StreamConsumerExtension.cs), and the implicit-subscription
+attribute table (reference: ImplicitStreamSubscriberTable.cs:32,
+[ImplicitStreamSubscription] attribute).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Union
+
+from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.hashing import jenkins_hash
+from orleans_tpu.ids import GrainId
+
+OnNext = Callable[[Any, int], Awaitable[None]]        # (item, seq)
+OnError = Callable[[Exception], Awaitable[None]]
+OnCompleted = Callable[[], Awaitable[None]]
+
+
+@dataclass(frozen=True)
+class StreamId:
+    """(reference: StreamId.cs — provider + namespace + guid key)"""
+
+    provider: str
+    namespace: str
+    key: Union[int, str]
+
+    def queue_hash(self) -> int:
+        return jenkins_hash(
+            f"{self.provider}/{self.namespace}/{self.key}".encode())
+
+    def pubsub_key(self) -> str:
+        """Key of the rendezvous grain for this stream
+        (reference: pub/sub rendezvous is itself a grain,
+        PubSubRendezvousGrain.cs:41)."""
+        return f"{self.provider}/{self.namespace}/{self.key}"
+
+
+@dataclass(frozen=True)
+class StreamSubscriptionHandle:
+    """(reference: StreamSubscriptionHandle<T>)"""
+
+    stream_id: StreamId
+    subscription_id: int
+    consumer: GrainId
+
+    async def unsubscribe(self) -> None:
+        from orleans_tpu.core.reference import current_runtime
+        provider = current_runtime().stream_provider(self.stream_id.provider)
+        await provider.unsubscribe(self)
+
+    async def resume(self, on_next: OnNext,
+                     on_error: Optional[OnError] = None,
+                     on_completed: Optional[OnCompleted] = None
+                     ) -> "StreamSubscriptionHandle":
+        """Re-attach callbacks after reactivation
+        (reference: StreamSubscriptionHandle.ResumeAsync)."""
+        ext = _consumer_extension()
+        ext.attach(self.subscription_id,
+                   _Callbacks(on_next, on_error, on_completed))
+        return self
+
+
+codec.register(StreamId)
+codec.register(StreamSubscriptionHandle)
+
+
+@dataclass
+class _Callbacks:
+    on_next: OnNext
+    on_error: Optional[OnError] = None
+    on_completed: Optional[OnCompleted] = None
+
+
+class StreamConsumerExtension:
+    """Per-activation registry of live subscription callbacks
+    (reference: StreamConsumerExtension.cs — the consumer-side invoker).
+
+    Lives on the grain *instance*, so it dies with the activation; durable
+    subscription state lives in the pub/sub grain, and a reactivated
+    consumer must resume its handles (reference semantics)."""
+
+    def __init__(self) -> None:
+        self.callbacks: Dict[int, _Callbacks] = {}
+
+    def attach(self, subscription_id: int, cbs: _Callbacks) -> None:
+        self.callbacks[subscription_id] = cbs
+
+    def detach(self, subscription_id: int) -> None:
+        self.callbacks.pop(subscription_id, None)
+
+
+def _consumer_extension() -> StreamConsumerExtension:
+    """The extension of the activation running the current turn."""
+    from orleans_tpu.core import context as ctx
+    act = ctx.current_activation()
+    if act is None:
+        raise RuntimeError(
+            "stream subscribe/resume must run inside a grain turn "
+            "(client-side consumers attach via the gateway observer path)")
+    inst = act.grain_instance
+    ext = getattr(inst, "_stream_consumer_ext", None)
+    if ext is None:
+        ext = StreamConsumerExtension()
+        inst._stream_consumer_ext = ext
+    return ext
+
+
+# ---------------------------------------------------------------------------
+# delivery entry points (grain-side; called by providers / pulling agents)
+# ---------------------------------------------------------------------------
+
+async def deliver_to_grain_instance(inst, subscription_id: int,
+                                    stream_id: StreamId, item: Any,
+                                    seq: int) -> None:
+    """Invoked inside the consumer's turn (the provider sends an RPC to
+    ``_stream_deliver`` on the consumer grain; the catalog has already
+    activated it).  Falls back to the implicit-subscription handler when no
+    explicit callback was resumed."""
+    ext = getattr(inst, "_stream_consumer_ext", None)
+    cbs = ext.callbacks.get(subscription_id) if ext is not None else None
+    if cbs is not None:
+        await cbs.on_next(item, seq)
+        return
+    handler = getattr(inst, "on_stream_item", None)
+    if handler is not None:
+        await handler(stream_id, item, seq)
+        return
+    # no local callback: either a stale fan-out racing an unsubscribe
+    # (producer cache updates are async pushes) — dropped silently — or a
+    # live durable subscription whose activation never resumed it, which is
+    # a fault the producer must see (reference: unresumed-subscription
+    # error on SMS delivery)
+    from orleans_tpu.core.factory import factory
+    from orleans_tpu.streams.pubsub import IPubSubRendezvous
+    pubsub = factory.get_grain(IPubSubRendezvous, stream_id.pubsub_key())
+    handles = await pubsub.consumer_handles_of(stream_id, inst.grain_id)
+    if any(h.subscription_id == subscription_id for h in handles):
+        raise RuntimeError(
+            f"subscription {subscription_id} not resumed on this "
+            f"activation and no on_stream_item handler (reference: "
+            f"unresumed-subscription delivery fault)")
+
+
+async def complete_on_grain_instance(inst, subscription_id: int,
+                                     stream_id: StreamId,
+                                     error: Optional[Exception]) -> None:
+    ext = getattr(inst, "_stream_consumer_ext", None)
+    cbs = ext.callbacks.get(subscription_id) if ext is not None else None
+    if cbs is None:
+        return
+    if error is not None:
+        if cbs.on_error is not None:
+            await cbs.on_error(error)
+    elif cbs.on_completed is not None:
+        await cbs.on_completed()
+
+
+# ---------------------------------------------------------------------------
+# implicit subscriptions (reference: ImplicitStreamSubscriberTable.cs:32)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _ImplicitEntry:
+    namespace: str
+    type_code: int
+    provider: Optional[str]  # None = any provider
+
+
+_IMPLICIT: List[_ImplicitEntry] = []
+
+
+def implicit_stream_subscription(namespace: str,
+                                 provider: Optional[str] = None):
+    """Class decorator: every stream in ``namespace`` implicitly has the
+    decorated grain class (same key as the stream) as a subscriber
+    (reference: [ImplicitStreamSubscription("ns")] attribute)."""
+
+    def apply(cls: type) -> type:
+        from orleans_tpu.ids import type_code_of
+        _IMPLICIT.append(_ImplicitEntry(
+            namespace=namespace, type_code=type_code_of(cls.__name__),
+            provider=provider))
+        existing = list(getattr(cls, "__implicit_stream_namespaces__", ()))
+        cls.__implicit_stream_namespaces__ = (*existing, namespace)
+        return cls
+
+    return apply
+
+
+def implicit_subscribers(stream_id: StreamId) -> List[GrainId]:
+    """Grain ids implicitly subscribed to this stream."""
+    out: List[GrainId] = []
+    for e in _IMPLICIT:
+        if e.namespace != stream_id.namespace:
+            continue
+        if e.provider is not None and e.provider != stream_id.provider:
+            continue
+        key = stream_id.key
+        if isinstance(key, int):
+            out.append(GrainId.from_int(e.type_code, key))
+        else:
+            out.append(GrainId.from_string(e.type_code, str(key)))
+    return out
+
+
+def implicit_subscription_id(stream_id: StreamId, grain_id: GrainId) -> int:
+    """Deterministic subscription id for implicit subscribers (stable across
+    activations and silos, no registration round-trip)."""
+    return jenkins_hash(
+        f"impl/{stream_id.pubsub_key()}/{grain_id}".encode()) | (1 << 62)
+
+
+def new_subscription_id() -> int:
+    return uuid.uuid4().int >> 66  # small positive int, codec-friendly
+
+
+# ---------------------------------------------------------------------------
+# the stream handle
+# ---------------------------------------------------------------------------
+
+class StreamImpl:
+    """The object grains hold: produce + subscribe on one logical stream
+    (reference: StreamImpl.cs:35 wrapping producer/consumer views)."""
+
+    def __init__(self, provider, stream_id: StreamId) -> None:
+        self._provider = provider
+        self.stream_id = stream_id
+
+    @property
+    def namespace(self) -> str:
+        return self.stream_id.namespace
+
+    @property
+    def key(self):
+        return self.stream_id.key
+
+    # -- producer view (reference: IAsyncObserver side of IAsyncStream) ----
+
+    async def on_next(self, item: Any) -> None:
+        await self._provider.produce(self.stream_id, [item])
+
+    async def on_next_batch(self, items: List[Any]) -> None:
+        await self._provider.produce(self.stream_id, list(items))
+
+    async def on_completed(self) -> None:
+        await self._provider.complete(self.stream_id, None)
+
+    async def on_error(self, error: Exception) -> None:
+        await self._provider.complete(self.stream_id, error)
+
+    # -- consumer view (reference: SubscribeAsync / GetAllSubscriptionHandles)
+
+    async def subscribe(self, on_next: OnNext,
+                        on_error: Optional[OnError] = None,
+                        on_completed: Optional[OnCompleted] = None
+                        ) -> StreamSubscriptionHandle:
+        from orleans_tpu.core import context as ctx
+        act = ctx.current_activation()
+        if act is None:
+            raise RuntimeError("subscribe must run inside a grain turn")
+        handle = StreamSubscriptionHandle(
+            stream_id=self.stream_id,
+            subscription_id=new_subscription_id(),
+            consumer=act.grain_id)
+        _consumer_extension().attach(
+            handle.subscription_id, _Callbacks(on_next, on_error, on_completed))
+        await self._provider.register_subscription(handle)
+        return handle
+
+    async def get_all_subscription_handles(self) -> List[StreamSubscriptionHandle]:
+        from orleans_tpu.core import context as ctx
+        act = ctx.current_activation()
+        if act is None:
+            raise RuntimeError("must run inside a grain turn")
+        return await self._provider.subscription_handles_of(
+            self.stream_id, act.grain_id)
+
+    def __repr__(self) -> str:
+        return f"Stream({self.stream_id.provider}:{self.namespace}/{self.key})"

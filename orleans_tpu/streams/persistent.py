@@ -1,0 +1,411 @@
+"""Persistent (queue-backed) streams: adapters, pulling agents, balancers.
+
+Parity: reference PersistentStreamProvider<TAdapterFactory> (reference:
+src/Orleans/Providers/Streams/PersistentStreams/
+PersistentStreamProvider.cs:58), the per-silo pulling side (reference:
+src/OrleansRuntime/Streams/PersistentStream/
+PersistentStreamPullingManager.cs:35 — one PullingAgent SystemTarget per
+queue, PersistentStreamPullingAgent.cs:34 timer-driven pull loop
+:335-370), queue→silo mapping (reference:
+HashRingBasedStreamQueueMapper.cs:30), queue balancers (reference:
+OrleansRuntime/Streams/QueueBalancer/* — ConsistentRingQueueBalancer,
+DeploymentBasedQueueBalancer), the bounded queue cache (reference:
+SimpleQueueCache.cs:59), and the in-memory queue backend standing in for
+the Azure queue adapter (reference: AzureQueueAdapter.cs:34).
+
+Producers enqueue (stream → queue by hash); the silo that owns a queue
+under the active balancer runs its pulling agent, which pulls batches,
+caches them, resolves the stream's subscriber set from pub/sub, delivers
+each event as a grain call, and advances the shared cursor — so queue
+ownership handoff on silo death resumes from the last delivered event.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from orleans_tpu.hashing import jenkins_hash
+from orleans_tpu.ids import GrainId
+from orleans_tpu.streams.core import StreamId
+from orleans_tpu.streams.pubsub import IPubSubRendezvous, PubSubStreamProviderMixin
+from orleans_tpu.streams.simple import IStreamConsumer
+from orleans_tpu.tracing import TraceLogger
+
+
+@dataclass
+class QueueMessage:
+    """One queued event (reference: IBatchContainer)."""
+
+    stream_id: StreamId
+    item: Any
+    seq: int
+    kind: str = "item"  # item | completed | error
+
+
+# ---------------------------------------------------------------------------
+# adapters (reference: IQueueAdapter / IQueueAdapterReceiver)
+# ---------------------------------------------------------------------------
+
+class QueueAdapterReceiver:
+    """Pull-side cursor over one queue (reference: IQueueAdapterReceiver)."""
+
+    async def get_queue_messages(self, max_count: int) -> List[QueueMessage]:
+        raise NotImplementedError
+
+    async def ack(self, up_to_seq: int) -> None:
+        raise NotImplementedError
+
+
+class QueueAdapter:
+    """(reference: IQueueAdapter — QueueMessageBatchAsync + CreateReceiver)"""
+
+    n_queues: int = 8
+
+    async def queue_message(self, queue_id: int, msg: QueueMessage) -> None:
+        raise NotImplementedError
+
+    def create_receiver(self, queue_id: int) -> QueueAdapterReceiver:
+        raise NotImplementedError
+
+
+class InMemoryQueueAdapter(QueueAdapter):
+    """Process-local queue backend; silos in one process share it via
+    ``shared_backing()`` the way the reference's test clusters share the
+    Azure storage emulator (reference: AzureQueueAdapter.cs:34 stand-in)."""
+
+    def __init__(self, n_queues: int = 8,
+                 backing: Optional[Dict] = None) -> None:
+        self.n_queues = n_queues
+        self._q = backing if backing is not None else {}
+
+    @staticmethod
+    def shared_backing() -> Dict:
+        return {}
+
+    def _slot(self, queue_id: int) -> Dict:
+        slot = self._q.get(queue_id)
+        if slot is None:
+            slot = self._q[queue_id] = {"events": [], "cursor": 0, "next_seq": 0}
+        return slot
+
+    async def queue_message(self, queue_id: int, msg: QueueMessage) -> None:
+        slot = self._slot(queue_id)
+        msg.seq = slot["next_seq"]
+        slot["next_seq"] += 1
+        slot["events"].append(msg)
+
+    def create_receiver(self, queue_id: int) -> "_InMemoryReceiver":
+        return _InMemoryReceiver(self._slot(queue_id))
+
+
+class _InMemoryReceiver(QueueAdapterReceiver):
+    def __init__(self, slot: Dict) -> None:
+        self._slot = slot
+
+    async def get_queue_messages(self, max_count: int) -> List[QueueMessage]:
+        events, cursor = self._slot["events"], self._slot["cursor"]
+        base_seq = events[0].seq if events else self._slot["next_seq"]
+        start = max(0, cursor - base_seq)
+        return events[start:start + max_count]
+
+    async def ack(self, up_to_seq: int) -> None:
+        """Advance the shared cursor; delivered events may be trimmed
+        (the durable-offset model: handoff resumes at cursor)."""
+        slot = self._slot
+        slot["cursor"] = max(slot["cursor"], up_to_seq + 1)
+        while slot["events"] and slot["events"][0].seq < slot["cursor"]:
+            slot["events"].pop(0)
+
+
+# ---------------------------------------------------------------------------
+# queue mapping + balancers
+# ---------------------------------------------------------------------------
+
+class HashRingStreamQueueMapper:
+    """stream → queue by hash (reference:
+    HashRingBasedStreamQueueMapper.cs:30)."""
+
+    def __init__(self, n_queues: int) -> None:
+        self.n_queues = n_queues
+
+    def queue_for(self, stream_id: StreamId) -> int:
+        return stream_id.queue_hash() % self.n_queues
+
+    def all_queues(self) -> List[int]:
+        return list(range(self.n_queues))
+
+
+class ConsistentRingQueueBalancer:
+    """A queue belongs to the silo owning its hash point on the consistent
+    ring (reference: ConsistentRingQueueBalancer)."""
+
+    def __init__(self, provider_name: str) -> None:
+        self.provider_name = provider_name
+
+    def _point(self, queue_id: int) -> int:
+        return jenkins_hash(f"{self.provider_name}/q{queue_id}".encode())
+
+    def my_queues(self, silo, mapper: HashRingStreamQueueMapper) -> List[int]:
+        return [q for q in mapper.all_queues()
+                if silo.ring.owns_hash(self._point(q))]
+
+
+class DeploymentBasedQueueBalancer:
+    """Queues split evenly across the active silo set by rank
+    (reference: DeploymentBasedQueueBalancer + BestFitBalancer)."""
+
+    def __init__(self, provider_name: str) -> None:
+        self.provider_name = provider_name
+
+    def my_queues(self, silo, mapper: HashRingStreamQueueMapper) -> List[int]:
+        silos = sorted(silo.active_silos(), key=lambda s: s.ring_hash())
+        if not silos:
+            return mapper.all_queues()
+        try:
+            rank = silos.index(silo.address)
+        except ValueError:
+            return []
+        return [q for q in mapper.all_queues() if q % len(silos) == rank]
+
+
+# ---------------------------------------------------------------------------
+# queue cache (reference: SimpleQueueCache.cs:59)
+# ---------------------------------------------------------------------------
+
+class SimpleQueueCache:
+    """Bounded per-queue buffer between the receiver and delivery
+    (reference: SimpleQueueCache.cs:59).  The agent pulls into the cache
+    (dedup by seq) and delivers from it, so an event whose delivery pass
+    failed stays buffered and is retried on the next loop instead of being
+    lost or re-pulled unboundedly."""
+
+    def __init__(self, size: int = 1024) -> None:
+        self.size = size
+        self._events: Deque[QueueMessage] = deque(maxlen=size)
+
+    def add(self, msgs: List[QueueMessage]) -> None:
+        newest = self.newest_seq
+        for m in msgs:
+            if newest is None or m.seq > newest:
+                self._events.append(m)
+                newest = m.seq
+
+    @property
+    def oldest_seq(self) -> Optional[int]:
+        return self._events[0].seq if self._events else None
+
+    @property
+    def newest_seq(self) -> Optional[int]:
+        return self._events[-1].seq if self._events else None
+
+    def window(self, from_seq: int) -> List[QueueMessage]:
+        return [m for m in self._events if m.seq >= from_seq]
+
+    def trim_to(self, seq: int) -> None:
+        """Drop delivered events (≤ seq)."""
+        while self._events and self._events[0].seq <= seq:
+            self._events.popleft()
+
+
+# ---------------------------------------------------------------------------
+# pulling agents (reference: PersistentStreamPullingAgent.cs:34)
+# ---------------------------------------------------------------------------
+
+class PullingAgent:
+    """One agent per owned queue: pull → cache → resolve subscribers →
+    deliver → ack (reference: PersistentStreamPullingAgent pull loop
+    :335-370)."""
+
+    def __init__(self, provider: "PersistentStreamProvider",
+                 queue_id: int) -> None:
+        self.provider = provider
+        self.queue_id = queue_id
+        self.receiver = provider.adapter.create_receiver(queue_id)
+        self.cache = SimpleQueueCache(provider.cache_size)
+        self.logger = TraceLogger(
+            f"streams.{provider.name}.{provider.silo.name}.q{queue_id}")
+        self.delivered = 0
+        self._task: Optional[asyncio.Task] = None
+        # stream → (consumer list, fetched_at) — TTL cache; agents are not
+        # grains, so pub/sub pushes can't reach them (reference agents ARE
+        # SystemTargets and get pushes; the TTL keeps the view fresh here)
+        self._consumer_cache: Dict[StreamId, Tuple[list, float]] = {}
+
+    def start(self) -> None:
+        import contextvars
+        self._task = asyncio.get_running_loop().create_task(
+            self._pull_loop(), context=contextvars.Context())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _pull_loop(self) -> None:
+        p = self.provider
+        delivered_up_to = -1
+        while True:
+            try:
+                msgs = await self.receiver.get_queue_messages(p.batch_size)
+                self.cache.add(msgs)  # dedup by seq
+                pending = self.cache.window(delivered_up_to + 1)
+                if pending:
+                    for m in pending:
+                        await self._deliver(m)
+                        await self.receiver.ack(m.seq)
+                        delivered_up_to = m.seq
+                        self.delivered += 1
+                    self.cache.trim_to(delivered_up_to)
+                    continue  # drain hot queue without sleeping
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001
+                # undelivered events stay cached; retried next loop
+                self.logger.warn(f"pull loop error: {exc!r}")
+            await asyncio.sleep(p.pull_period)
+
+    async def _consumers(self, stream_id: StreamId) -> list:
+        now = time.monotonic()
+        hit = self._consumer_cache.get(stream_id)
+        if hit is not None and now - hit[1] < self.provider.consumer_cache_ttl:
+            return hit[0]
+        from orleans_tpu.core.factory import factory
+        ref = factory.get_grain(IPubSubRendezvous, stream_id.pubsub_key())
+        consumers = await self._call_in_silo(ref.consumers, stream_id)
+        self._consumer_cache[stream_id] = (consumers, now)
+        return consumers
+
+    async def _call_in_silo(self, fn, *args):
+        from orleans_tpu.core.reference import _current_runtime, bind_runtime
+        token = bind_runtime(self.provider.silo.runtime_client)
+        try:
+            return await fn(*args)
+        finally:
+            _current_runtime.reset(token)
+
+    async def _deliver(self, msg: QueueMessage) -> None:
+        consumers = await self._consumers(msg.stream_id)
+        if not consumers:
+            return
+        from orleans_tpu.core.reference import GrainReference
+        iface_id = IStreamConsumer.__grain_interface_info__.interface_id
+        if msg.kind == "item":
+            sends = [self._call_in_silo(
+                GrainReference(c, iface_id).stream_deliver,
+                s, msg.stream_id, msg.item, msg.seq)
+                for s, c in consumers]
+        else:
+            error = msg.item if msg.kind == "error" else None
+            sends = [self._call_in_silo(
+                GrainReference(c, iface_id).stream_complete,
+                s, msg.stream_id, error)
+                for s, c in consumers]
+        results = await asyncio.gather(*sends, return_exceptions=True)
+        for r in results:
+            if isinstance(r, Exception):
+                self.logger.warn(
+                    f"delivery of seq={msg.seq} on {msg.stream_id} "
+                    f"failed: {r!r}")
+
+
+class PersistentStreamPullingManager:
+    """Owns this silo's agents; rebalances on ring/membership change
+    (reference: PersistentStreamPullingManager.cs:35 +
+    queue-balancer-driven agent start/stop)."""
+
+    def __init__(self, provider: "PersistentStreamProvider") -> None:
+        self.provider = provider
+        self.agents: Dict[int, PullingAgent] = {}
+        self._running = False
+
+    def start(self) -> None:
+        self._running = True
+        self.provider.silo.ring.subscribe(lambda *_: self.rebalance())
+        self.rebalance()
+
+    def stop(self) -> None:
+        self._running = False
+        for agent in self.agents.values():
+            agent.stop()
+        self.agents.clear()
+
+    def rebalance(self) -> None:
+        if not self._running:
+            return
+        owned = set(self.provider.balancer.my_queues(self.provider.silo,
+                                                     self.provider.mapper))
+        for q in list(self.agents):
+            if q not in owned:
+                self.agents.pop(q).stop()
+        for q in owned:
+            if q not in self.agents:
+                agent = PullingAgent(self.provider, q)
+                self.agents[q] = agent
+                agent.start()
+
+
+# ---------------------------------------------------------------------------
+# the provider
+# ---------------------------------------------------------------------------
+
+class PersistentStreamProvider(PubSubStreamProviderMixin):
+    """(reference: PersistentStreamProvider.cs:58)"""
+
+    def __init__(self, adapter: QueueAdapter,
+                 balancer_cls=ConsistentRingQueueBalancer,
+                 pull_period: float = 0.05,
+                 batch_size: int = 64,
+                 cache_size: int = 1024,
+                 consumer_cache_ttl: float = 1.0) -> None:
+        self.adapter = adapter
+        self.mapper = HashRingStreamQueueMapper(adapter.n_queues)
+        self.pull_period = pull_period
+        self.batch_size = batch_size
+        self.cache_size = cache_size
+        self.consumer_cache_ttl = consumer_cache_ttl
+        self._balancer_cls = balancer_cls
+        self.name = "persistent"
+        self.silo = None
+        self.balancer = None
+        self.manager: Optional[PersistentStreamPullingManager] = None
+
+    def init(self, silo, name: str) -> None:
+        self.silo = silo
+        self.name = name
+        self.balancer = self._balancer_cls(name)
+        self.manager = PersistentStreamPullingManager(self)
+
+    async def start(self) -> None:
+        self.manager.start()
+
+    async def stop(self) -> None:
+        self.manager.stop()
+
+    def kill(self) -> None:
+        """Synchronous teardown for the hard-kill path — a dead silo's
+        agents must never touch the shared queues again."""
+        if self.manager is not None:
+            self.manager.stop()
+
+    # get_stream / subscription plumbing come from PubSubStreamProviderMixin
+
+    # -- produce ------------------------------------------------------------
+
+    async def produce(self, stream_id: StreamId, items: List[Any]) -> None:
+        q = self.mapper.queue_for(stream_id)
+        for item in items:
+            await self.adapter.queue_message(
+                q, QueueMessage(stream_id=stream_id, item=item, seq=-1))
+
+    async def complete(self, stream_id: StreamId,
+                       error: Optional[Exception]) -> None:
+        q = self.mapper.queue_for(stream_id)
+        kind = "error" if error is not None else "completed"
+        await self.adapter.queue_message(
+            q, QueueMessage(stream_id=stream_id, item=error, seq=-1,
+                            kind=kind))
+

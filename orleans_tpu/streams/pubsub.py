@@ -1,0 +1,153 @@
+"""Pub/sub rendezvous: subscription state as a grain.
+
+Parity: reference PubSubRendezvousGrain (reference:
+src/OrleansRuntime/Streams/PubSub/PubSubRendezvousGrain.cs:41) and
+StreamPubSubImpl (reference: src/Orleans/Streams/PubSub/
+StreamPubSubImpl.cs:31): one rendezvous grain per stream holds the
+producer and consumer registrations; producers are notified of
+subscription changes so their cached consumer view stays current
+(reference: IStreamProducerExtension.AddSubscriber/RemoveSubscriber push).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from orleans_tpu.core.grain import Grain, grain_class, grain_interface
+from orleans_tpu.ids import GrainId
+from orleans_tpu.streams.core import (
+    StreamId,
+    StreamSubscriptionHandle,
+    implicit_subscribers,
+    implicit_subscription_id,
+)
+
+
+class PubSubStreamProviderMixin:
+    """Subscription plumbing shared by every pub/sub-backed stream
+    provider (reference: StreamPubSubImpl.cs:31 used by both SMS and
+    persistent providers)."""
+
+    name: str
+
+    def _pubsub(self, stream_id: StreamId):
+        from orleans_tpu.core.factory import factory
+        return factory.get_grain(IPubSubRendezvous, stream_id.pubsub_key())
+
+    def get_stream(self, namespace: str, key):
+        from orleans_tpu.streams.core import StreamImpl
+        return StreamImpl(self, StreamId(self.name, namespace, key))
+
+    async def register_subscription(self,
+                                    handle: StreamSubscriptionHandle) -> None:
+        await self._pubsub(handle.stream_id).register_consumer(handle)
+
+    async def unsubscribe(self, handle: StreamSubscriptionHandle) -> None:
+        await self._pubsub(handle.stream_id).unregister_consumer(handle)
+        from orleans_tpu.core import context as ctx
+        act = ctx.current_activation()
+        if act is not None and act.grain_instance is not None:
+            ext = getattr(act.grain_instance, "_stream_consumer_ext", None)
+            if ext is not None:
+                ext.detach(handle.subscription_id)
+
+    async def subscription_handles_of(self, stream_id: StreamId,
+                                      grain_id: GrainId) -> list:
+        return await self._pubsub(stream_id).consumer_handles_of(
+            stream_id, grain_id)
+
+
+@grain_interface
+class IPubSubRendezvous:
+    async def register_producer(self, stream_id, producer: GrainId) -> list: ...
+    async def unregister_producer(self, stream_id, producer: GrainId) -> None: ...
+    async def register_consumer(self, handle) -> None: ...
+    async def unregister_consumer(self, handle) -> None: ...
+    async def consumers(self, stream_id) -> list: ...
+    async def consumer_handles_of(self, stream_id, grain_id: GrainId) -> list: ...
+    async def producer_count(self, stream_id) -> int: ...
+    async def consumer_count(self, stream_id) -> int: ...
+
+
+@grain_class
+class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
+    """Holds (producers, consumers) for ONE stream — the grain's string key
+    is the stream's pubsub key, so pub/sub state shards across the cluster
+    with ordinary grain placement (reference: PubSubRendezvousGrain.cs:41).
+
+    State is in-memory like every non-persistent grain; the reference
+    optionally persists pub/sub state via a storage provider ("PubSubStore")
+    — resumable here by making this a StatefulGrain with that provider.
+    """
+
+    def __init__(self) -> None:
+        self.producers: Set[GrainId] = set()
+        # subscription_id → handle
+        self.consumer_subs: Dict[int, StreamSubscriptionHandle] = {}
+
+    # -- producers ----------------------------------------------------------
+
+    async def register_producer(self, stream_id: StreamId,
+                                producer: GrainId) -> list:
+        """Returns the current consumer list (explicit + implicit) so the
+        producer can seed its cache."""
+        self.producers.add(producer)
+        return self._consumer_list(stream_id)
+
+    async def unregister_producer(self, stream_id: StreamId,
+                                  producer: GrainId) -> None:
+        self.producers.discard(producer)
+
+    # -- consumers ----------------------------------------------------------
+
+    async def register_consumer(self, handle: StreamSubscriptionHandle) -> None:
+        self.consumer_subs[handle.subscription_id] = handle
+        await self._notify_producers(handle.stream_id)
+
+    async def unregister_consumer(self, handle: StreamSubscriptionHandle) -> None:
+        self.consumer_subs.pop(handle.subscription_id, None)
+        await self._notify_producers(handle.stream_id)
+
+    async def consumers(self, stream_id: StreamId) -> list:
+        return self._consumer_list(stream_id)
+
+    async def consumer_handles_of(self, stream_id: StreamId,
+                                  grain_id: GrainId) -> list:
+        return [h for h in self.consumer_subs.values()
+                if h.consumer == grain_id]
+
+    async def producer_count(self, stream_id: StreamId) -> int:
+        return len(self.producers)
+
+    async def consumer_count(self, stream_id: StreamId) -> int:
+        return len(self._consumer_list(stream_id))
+
+    # -- internals ----------------------------------------------------------
+
+    def _consumer_list(self, stream_id: StreamId
+                       ) -> List[Tuple[int, GrainId]]:
+        out = [(h.subscription_id, h.consumer)
+               for h in self.consumer_subs.values()]
+        explicit = {g for _, g in out}
+        for g in implicit_subscribers(stream_id):
+            if g not in explicit:
+                out.append((implicit_subscription_id(stream_id, g), g))
+        return out
+
+    async def _notify_producers(self, stream_id: StreamId) -> None:
+        """Push the updated consumer view to every registered producer
+        (reference: PubSubRendezvousGrain notifying IStreamProducerExtension)."""
+        consumers = self._consumer_list(stream_id)
+        dead: List[GrainId] = []
+        for producer in list(self.producers):
+            try:
+                from orleans_tpu.core.reference import GrainReference
+                from orleans_tpu.streams.simple import IStreamProducer
+                ref = GrainReference(
+                    producer,
+                    IStreamProducer.__grain_interface_info__.interface_id)
+                await ref.stream_producer_update(stream_id, consumers)
+            except Exception:  # noqa: BLE001 — unreachable producer drops out
+                dead.append(producer)
+        for p in dead:
+            self.producers.discard(p)

@@ -1,0 +1,131 @@
+"""SimpleMessageStreamProvider: direct grain-to-grain stream fan-out.
+
+Parity: reference SimpleMessageStreamProvider (reference:
+src/Orleans/Providers/Streams/SimpleMessageStream/
+SimpleMessageStreamProvider.cs:31): no queue — a producer pushes each item
+straight to every subscriber via RPC, with the consumer list cached on the
+producer and kept current by pub/sub push notifications
+(reference: SimpleMessageStreamProducer.cs + PubSubRendezvousGrain).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional, Tuple
+
+from orleans_tpu.core.grain import always_interleave, grain_interface, one_way
+from orleans_tpu.ids import GrainId
+from orleans_tpu.streams.core import StreamId
+from orleans_tpu.streams.pubsub import PubSubStreamProviderMixin
+from orleans_tpu.tracing import TraceLogger
+
+
+@grain_interface
+class IStreamConsumer:
+    """Consumer-side runtime extension every grain implements via the Grain
+    base class (reference: IStreamConsumerExtension)."""
+
+    @always_interleave
+    async def stream_deliver(self, subscription_id: int, stream_id,
+                             item, seq: int) -> None: ...
+
+    @always_interleave
+    async def stream_complete(self, subscription_id: int, stream_id,
+                              error) -> None: ...
+
+
+@grain_interface
+class IStreamProducer:
+    """Producer-side runtime extension (reference: IStreamProducerExtension
+    — AddSubscriber/RemoveSubscriber pushes)."""
+
+    @always_interleave
+    @one_way
+    async def stream_producer_update(self, stream_id, consumers) -> None: ...
+
+
+class SimpleMessageStreamProvider(PubSubStreamProviderMixin):
+    """(reference: SimpleMessageStreamProvider.cs:31)
+
+    ``fire_and_forget``: when False (reference default) a delivery error
+    propagates to the producer's ``on_next`` call; when True errors are
+    logged and swallowed (reference: FireAndForgetDelivery option).
+    """
+
+    def __init__(self, fire_and_forget: bool = False) -> None:
+        self.fire_and_forget = fire_and_forget
+        self.name = "sms"
+        self.silo = None
+        self.logger = TraceLogger("streams.sms")
+        # client-edge (non-grain) producer state: stream → (consumers, seq)
+        self._client_seq: Dict[StreamId, int] = {}
+
+    def init(self, silo, name: str) -> None:
+        self.silo = silo
+        self.name = name
+        self.logger = TraceLogger(f"streams.{name}.{silo.name}")
+
+    # get_stream / _pubsub / register_subscription / unsubscribe /
+    # subscription_handles_of come from PubSubStreamProviderMixin
+
+    # -- produce ------------------------------------------------------------
+
+    async def _consumers_and_seq(self, stream_id: StreamId, n_items: int
+                                 ) -> Tuple[List[Tuple[int, GrainId]], int]:
+        """Resolve the consumer view + allocate sequence numbers for this
+        produce call.  Grain producers cache the view on the instance,
+        refreshed by pub/sub pushes; client producers query per call."""
+        from orleans_tpu.core import context as ctx
+        act = ctx.current_activation()
+        if act is not None and act.grain_instance is not None:
+            inst = act.grain_instance
+            cache = getattr(inst, "_stream_producer_cache", None)
+            if cache is None:
+                cache = inst._stream_producer_cache = {}
+            if stream_id not in cache:
+                consumers = await self._pubsub(stream_id).register_producer(
+                    stream_id, act.grain_id)
+                # a push may have landed while registering; don't clobber it
+                cache.setdefault(stream_id, consumers)
+            seqs = getattr(inst, "_stream_seq", None)
+            if seqs is None:
+                seqs = inst._stream_seq = {}
+            first = seqs.get(stream_id, 0)
+            seqs[stream_id] = first + n_items
+            return cache[stream_id], first
+        consumers = await self._pubsub(stream_id).consumers(stream_id)
+        first = self._client_seq.get(stream_id, 0)
+        self._client_seq[stream_id] = first + n_items
+        return consumers, first
+
+    async def produce(self, stream_id: StreamId, items: List[Any]) -> None:
+        consumers, first = await self._consumers_and_seq(stream_id, len(items))
+        if not consumers:
+            return
+        from orleans_tpu.core.reference import GrainReference
+        iface_id = IStreamConsumer.__grain_interface_info__.interface_id
+        sends = []
+        for sub_id, consumer in consumers:
+            ref = GrainReference(consumer, iface_id)
+            for i, item in enumerate(items):
+                sends.append(ref.stream_deliver(sub_id, stream_id, item,
+                                                first + i))
+        results = await asyncio.gather(*sends, return_exceptions=True)
+        errors = [r for r in results if isinstance(r, Exception)]
+        if errors:
+            if self.fire_and_forget:
+                self.logger.warn(
+                    f"stream {stream_id} delivery errors (swallowed): "
+                    f"{errors[:3]!r}")
+            else:
+                raise errors[0]
+
+    async def complete(self, stream_id: StreamId,
+                       error: Optional[Exception]) -> None:
+        consumers, _ = await self._consumers_and_seq(stream_id, 0)
+        from orleans_tpu.core.reference import GrainReference
+        iface_id = IStreamConsumer.__grain_interface_info__.interface_id
+        await asyncio.gather(
+            *(GrainReference(c, iface_id).stream_complete(s, stream_id, error)
+              for s, c in consumers),
+            return_exceptions=True)

@@ -32,9 +32,12 @@ class TestingCluster:
 
     def __init__(self, n_silos: int = 2,
                  config_factory: Optional[Callable[[str], SiloConfig]] = None,
-                 wire_fidelity: bool = True) -> None:
+                 wire_fidelity: bool = True,
+                 silo_setup: Optional[Callable[[Silo], None]] = None) -> None:
         self.n_initial = n_silos
         self.config_factory = config_factory or self._default_config
+        # per-silo wiring hook (providers etc.) run before silo.start()
+        self.silo_setup = silo_setup
         self.fabric = InProcTransport(wire_fidelity=wire_fidelity)
         self.table = InMemoryMembershipTable()
         # shared durable reminder store (reference: TestingSiloHost's
@@ -75,6 +78,8 @@ class TestingCluster:
             membership_table=self.table,
             reminder_table=self.reminder_table,
         )
+        if self.silo_setup is not None:
+            self.silo_setup(silo)
         await silo.start()
         self.silos.append(silo)
         # let membership settle (gossip + view refresh)

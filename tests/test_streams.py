@@ -1,0 +1,349 @@
+"""Stream tests (reference analog: Tester/StreamingTests/* —
+SMSStreamingTests, PersistentStreamingTests, ImplicitSubscritionTests,
+DelayedQueueRebalancingTests)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from orleans_tpu import Grain, grain_interface
+from orleans_tpu.core.grain import grain_class
+from orleans_tpu.runtime.silo import Silo
+from orleans_tpu.streams import (
+    InMemoryQueueAdapter,
+    PersistentStreamProvider,
+    SimpleMessageStreamProvider,
+    implicit_stream_subscription,
+)
+from orleans_tpu.testing.cluster import TestingCluster
+
+
+@grain_interface
+class IStreamProducerGrain:
+    async def produce(self, provider: str, ns: str, key, items: list): ...
+    async def finish(self, provider: str, ns: str, key): ...
+
+
+@grain_class
+class StreamProducerGrain(Grain, IStreamProducerGrain):
+    async def produce(self, provider, ns, key, items):
+        stream = self.get_stream(provider, ns, key)
+        await stream.on_next_batch(items)
+
+    async def finish(self, provider, ns, key):
+        await self.get_stream(provider, ns, key).on_completed()
+
+
+@grain_interface
+class IStreamConsumerGrain:
+    async def join(self, provider: str, ns: str, key): ...
+    async def leave(self): ...
+    async def received(self) -> list: ...
+    async def completed(self) -> bool: ...
+
+
+@grain_class
+class StreamConsumerGrain(Grain, IStreamConsumerGrain):
+    def __init__(self) -> None:
+        self.items = []
+        self.done = False
+        self.handle = None
+
+    async def join(self, provider, ns, key):
+        stream = self.get_stream(provider, ns, key)
+
+        async def on_next(item, seq):
+            self.items.append((item, seq))
+
+        async def on_completed():
+            self.done = True
+
+        # resume an existing durable subscription if one survives in
+        # pub/sub (the reference's resume-on-activate pattern), else
+        # subscribe fresh
+        existing = await stream.get_all_subscription_handles()
+        if existing:
+            self.handle = await existing[0].resume(
+                on_next, on_completed=on_completed)
+        else:
+            self.handle = await stream.subscribe(on_next,
+                                                 on_completed=on_completed)
+
+    async def leave(self):
+        if self.handle is not None:
+            await self.handle.unsubscribe()
+            self.handle = None
+
+    async def received(self):
+        return list(self.items)
+
+    async def completed(self):
+        return self.done
+
+
+@grain_interface
+class IImplicitConsumerGrain:
+    async def seen(self) -> list: ...
+
+
+@implicit_stream_subscription("implicit-ns")
+@grain_class
+class ImplicitConsumerGrain(Grain, IImplicitConsumerGrain):
+    """(reference: [ImplicitStreamSubscription] grains)"""
+
+    def __init__(self) -> None:
+        self.items = []
+
+    async def on_stream_item(self, stream_id, item, seq):
+        self.items.append(item)
+
+    async def seen(self):
+        return list(self.items)
+
+
+async def _sms_silo():
+    silo = Silo(name="streams")
+    silo.add_stream_provider("sms", SimpleMessageStreamProvider())
+    await silo.start()
+    return silo
+
+
+def test_sms_fanout_and_unsubscribe(run):
+    async def go():
+        silo = await _sms_silo()
+        try:
+            f = silo.attach_client()
+            producer = f.get_grain(IStreamProducerGrain, 1)
+            c1 = f.get_grain(IStreamConsumerGrain, 1)
+            c2 = f.get_grain(IStreamConsumerGrain, 2)
+            await c1.join("sms", "chat", 7)
+            await c2.join("sms", "chat", 7)
+            await producer.produce("sms", "chat", 7, ["a", "b"])
+            assert [i for i, _ in await c1.received()] == ["a", "b"]
+            assert [i for i, _ in await c2.received()] == ["a", "b"]
+            # sequence numbers are the producer's monotone counter
+            assert [s for _, s in await c1.received()] == [0, 1]
+
+            await c2.leave()
+            await producer.produce("sms", "chat", 7, ["c"])
+            assert [i for i, _ in await c1.received()] == ["a", "b", "c"]
+            assert [i for i, _ in await c2.received()] == ["a", "b"]
+
+            await producer.finish("sms", "chat", 7)
+            assert await c1.completed() is True
+            assert await c2.completed() is False
+        finally:
+            await silo.stop(graceful=False)
+
+    run(go())
+
+
+def test_sms_late_subscriber_reaches_cached_producer(run):
+    """A consumer subscribing AFTER the producer's first produce must still
+    receive subsequent items: pub/sub pushes the updated consumer view to
+    registered producers (reference: IStreamProducerExtension.AddSubscriber
+    push keeping the producer cache current)."""
+
+    async def go():
+        silo = await _sms_silo()
+        try:
+            f = silo.attach_client()
+            producer = f.get_grain(IStreamProducerGrain, 9)
+            c1 = f.get_grain(IStreamConsumerGrain, 91)
+            await c1.join("sms", "chat", 70)
+            await producer.produce("sms", "chat", 70, ["first"])  # seeds cache
+            c2 = f.get_grain(IStreamConsumerGrain, 92)
+            await c2.join("sms", "chat", 70)
+            # pubsub's push to the producer is one-way; let it land
+            await asyncio.sleep(0.05)
+            await producer.produce("sms", "chat", 70, ["second"])
+            assert [i for i, _ in await c1.received()] == ["first", "second"]
+            assert [i for i, _ in await c2.received()] == ["second"]
+        finally:
+            await silo.stop(graceful=False)
+
+    run(go())
+
+
+def test_sms_client_producer(run):
+    """Clients (non-grain contexts) can produce to a stream."""
+
+    async def go():
+        silo = await _sms_silo()
+        try:
+            f = silo.attach_client()
+            c = f.get_grain(IStreamConsumerGrain, 10)
+            await c.join("sms", "chat", 99)
+            stream = silo.stream_provider("sms").get_stream("chat", 99)
+            await stream.on_next("hello")
+            assert [i for i, _ in await c.received()] == ["hello"]
+        finally:
+            await silo.stop(graceful=False)
+
+    run(go())
+
+
+def test_sms_implicit_subscription(run):
+    async def go():
+        silo = await _sms_silo()
+        try:
+            f = silo.attach_client()
+            producer = f.get_grain(IStreamProducerGrain, 2)
+            # stream key 42 → ImplicitConsumerGrain key 42, auto-subscribed
+            await producer.produce("sms", "implicit-ns", 42, ["x", "y"])
+            consumer = f.get_grain(IImplicitConsumerGrain, 42)
+            assert await consumer.seen() == ["x", "y"]
+        finally:
+            await silo.stop(graceful=False)
+
+    run(go())
+
+
+def test_persistent_stream_delivery(run):
+    async def go():
+        silo = Silo(name="pstreams")
+        silo.add_stream_provider("pq", PersistentStreamProvider(
+            InMemoryQueueAdapter(n_queues=4), pull_period=0.01,
+            consumer_cache_ttl=0.0))
+        await silo.start()
+        try:
+            f = silo.attach_client()
+            c = f.get_grain(IStreamConsumerGrain, 20)
+            await c.join("pq", "events", 5)
+            producer = f.get_grain(IStreamProducerGrain, 3)
+            await producer.produce("pq", "events", 5, [1, 2, 3])
+
+            async def until_delivered():
+                while len(await c.received()) < 3:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(until_delivered(), timeout=5.0)
+            items = await c.received()
+            assert [i for i, _ in items] == [1, 2, 3]
+            # queue-assigned seqs are monotone
+            seqs = [s for _, s in items]
+            assert seqs == sorted(seqs)
+
+            await producer.finish("pq", "events", 5)
+
+            async def until_done():
+                while not await c.completed():
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(until_done(), timeout=5.0)
+        finally:
+            await silo.stop(graceful=False)
+
+    run(go())
+
+
+def test_persistent_stream_multi_silo_and_rebalance(run):
+    """Queues spread across silos by the ring balancer; killing a silo
+    hands its queues (and their cursor) to survivors
+    (reference analog: DelayedQueueRebalancingTests)."""
+
+    async def go():
+        backing = InMemoryQueueAdapter.shared_backing()
+
+        def setup(silo):
+            silo.add_stream_provider("pq", PersistentStreamProvider(
+                InMemoryQueueAdapter(n_queues=8, backing=backing),
+                pull_period=0.01, consumer_cache_ttl=0.0))
+
+        cluster = TestingCluster(n_silos=3, silo_setup=setup)
+        await cluster.start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            # every queue owned by exactly one agent cluster-wide
+            owned = [q for s in cluster.silos
+                     for q in s.stream_provider("pq").manager.agents]
+            assert sorted(owned) == list(range(8)), owned
+            by_silo = {s.name: list(s.stream_provider("pq").manager.agents)
+                       for s in cluster.silos}
+            assert sum(1 for v in by_silo.values() if v) >= 2, by_silo
+
+            f = cluster.attach_client(0)
+            c = f.get_grain(IStreamConsumerGrain, 30)
+            await c.join("pq", "events", "k1")
+            producer = f.get_grain(IStreamProducerGrain, 4)
+            await producer.produce("pq", "events", "k1", list(range(5)))
+
+            async def until(n):
+                while len(await c.received()) < n:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(until(5), timeout=5.0)
+
+            # kill the silo that owns the stream's queue
+            provider0 = cluster.silos[0].stream_provider("pq")
+            qid = provider0.mapper.queue_for(
+                provider0.get_stream("events", "k1").stream_id)
+            owner = next(s for s in cluster.silos
+                         if qid in s.stream_provider("pq").manager.agents)
+            victim_hosts_consumer = owner is cluster.silos[0]
+            cluster.kill_silo(owner)
+            await cluster.wait_for_liveness_convergence()
+
+            if victim_hosts_consumer:
+                f = cluster.attach_client(0)
+                c = f.get_grain(IStreamConsumerGrain, 30)
+                await c.join("pq", "events", "k1")
+
+            # a survivor adopts the queue and resumes from the cursor
+            async def adopted():
+                while not any(qid in s.stream_provider("pq").manager.agents
+                              for s in cluster.silos):
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(adopted(), timeout=5.0)
+            before = len(await c.received())
+            await producer.produce("pq", "events", "k1", [100, 101])
+
+            async def more():
+                while len(await c.received()) < before + 2:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(more(), timeout=5.0)
+            items = [i for i, _ in await c.received()]
+            assert items[-2:] == [100, 101]
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_consumer_resumes_after_deactivation(run):
+    """Durable subscription state lives in pub/sub; a reactivated consumer
+    without a resumed handle surfaces the unresumed-delivery fault unless
+    it re-subscribes (reference: resume-on-activate pattern)."""
+
+    async def go():
+        silo = await _sms_silo()
+        try:
+            f = silo.attach_client()
+            c = f.get_grain(IStreamConsumerGrain, 40)
+            await c.join("sms", "chat", 123)
+            producer = f.get_grain(IStreamProducerGrain, 5)
+            await producer.produce("sms", "chat", 123, ["pre"])
+
+            # deactivate the consumer; its subscription survives in pubsub
+            act = silo.catalog.directory.by_grain[c.grain_id][0]
+            await silo.catalog._deactivate(act)
+
+            # delivery now faults (unresumed subscription, no implicit
+            # handler) and the producer — not fire-and-forget — sees it
+            try:
+                await producer.produce("sms", "chat", 123, ["lost"])
+                raise AssertionError("expected unresumed-delivery fault")
+            except Exception as exc:
+                assert "not resumed" in str(exc)
+
+            # the consumer re-subscribes (resume path) and flow continues
+            await c.join("sms", "chat", 123)
+            await producer.produce("sms", "chat", 123, ["post"])
+            items = [i for i, _ in await c.received()]
+            assert "post" in items
+        finally:
+            await silo.stop(graceful=False)
+
+    run(go())
