@@ -304,8 +304,15 @@ class Grain:
 
     async def stream_producer_update(self, stream_id, consumers):
         cache = getattr(self, "_stream_producer_cache", None)
-        if cache is None:
-            cache = self._stream_producer_cache = {}
+        if cache is None or stream_id not in cache:
+            # this activation never produced on the stream (e.g. a fresh
+            # activation after deactivation) — tell the rendezvous grain so
+            # it prunes the stale registration instead of keeping a
+            # registration that resurrects this grain on every pub/sub
+            # change (reference: GrainExtensionNotInstalledException)
+            from orleans_tpu.streams.core import ProducerNotRegisteredError
+            raise ProducerNotRegisteredError(
+                f"{self.grain_id} holds no producer state for {stream_id}")
         cache[stream_id] = consumers
 
     @property

@@ -1,0 +1,110 @@
+"""Limits + load shedding.
+
+Parity: reference LimitManager (reference: src/Orleans/Configuration/
+LimitManager.cs:34 — named LimitValue{soft,hard} lookups with defaults) and
+the overload-driven load shedding fed by silo metrics (reference:
+SiloPerformanceMetrics / NodeConfiguration LoadShedding settings, wired in
+Silo.cs:257; queue-length overload checks ActivationData.CheckOverloaded
+Catalog path :522 and GatewayTooBusy rejection).
+
+The host runtime consults ``LimitManager`` for mailbox depth and client
+connection limits; the tensor engine consults it for per-tick batch caps.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass(frozen=True)
+class LimitValue:
+    """(reference: LimitValue in LimitManager.cs)"""
+
+    name: str
+    soft_limit: int = 0
+    hard_limit: int = 0
+
+    @property
+    def is_defined(self) -> bool:
+        return self.soft_limit > 0 or self.hard_limit > 0
+
+
+class LimitExceededError(Exception):
+    """(reference: LimitExceededException)"""
+
+    def __init__(self, name: str, current: int, limit: LimitValue,
+                 context: str = ""):
+        super().__init__(
+            f"limit {name!r} exceeded: current={current} "
+            f"soft={limit.soft_limit} hard={limit.hard_limit} {context}")
+        self.limit_name = name
+        self.current = current
+        self.limit = limit
+
+
+# Well-known limit names (reference: LimitNames in the reference config)
+MAX_ENQUEUED_REQUESTS = "MaxEnqueuedRequests"
+MAX_ENQUEUED_REQUESTS_STATELESS_WORKER = "MaxEnqueuedRequests_StatelessWorker"
+MAX_PENDING_CLIENT_REQUESTS = "MaxPendingClientRequests"
+MAX_TICK_BATCH_MESSAGES = "MaxTickBatchMessages"  # tensor-plane analog
+
+
+class LimitManager:
+    """Named soft/hard limit registry (reference: LimitManager.cs:34)."""
+
+    def __init__(self, values: Optional[Dict[str, LimitValue]] = None) -> None:
+        self._values: Dict[str, LimitValue] = dict(values or {})
+
+    def add_limit(self, name: str, soft: int = 0, hard: int = 0) -> None:
+        self._values[name] = LimitValue(name, soft, hard)
+
+    def get_limit(self, name: str, default_soft: int = 0,
+                  default_hard: int = 0) -> LimitValue:
+        v = self._values.get(name)
+        if v is not None:
+            return v
+        return LimitValue(name, default_soft, default_hard)
+
+    def check(self, name: str, current: int, default_soft: int = 0,
+              default_hard: int = 0, context: str = "",
+              on_soft=None) -> None:
+        """Raise on hard-limit breach; invoke ``on_soft`` (e.g. a warning
+        logger) on soft-limit breach — the reference's pattern of
+        warn-at-soft / reject-at-hard (ActivationData.CheckOverloaded)."""
+        limit = self.get_limit(name, default_soft, default_hard)
+        if limit.hard_limit > 0 and current > limit.hard_limit:
+            raise LimitExceededError(name, current, limit, context)
+        if limit.soft_limit > 0 and current > limit.soft_limit \
+                and on_soft is not None:
+            on_soft(name, current, limit)
+
+
+class LoadSheddingGate:
+    """CPU-style overload gate (reference: LoadSheddingEnabled /
+    LoadSheddingLimit in NodeConfiguration, enforced at the gateway —
+    overloaded silos reject new client work with GatewayTooBusy).
+
+    The rebuild's load signal is queue pressure rather than Windows CPU
+    counters: callers report a utilization-like scalar (e.g. pending
+    messages / limit) and the gate trips above ``limit``.
+    """
+
+    def __init__(self, enabled: bool = False, limit: float = 0.95) -> None:
+        self.enabled = enabled
+        self.limit = limit
+        self.latest_load: float = 0.0
+        self.shed_count = 0
+
+    def report_load(self, load: float) -> None:
+        self.latest_load = load
+
+    @property
+    def is_overloaded(self) -> bool:
+        return self.enabled and self.latest_load > self.limit
+
+    def try_admit(self) -> bool:
+        if self.is_overloaded:
+            self.shed_count += 1
+            return False
+        return True

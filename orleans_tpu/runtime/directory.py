@@ -164,40 +164,45 @@ class LocalGrainDirectory:
     # -- lookup (reference: Catalog FastLookup :1213 / FullLookup :1224) ----
 
     def try_local_lookup(self, grain_id: GrainId) -> Optional[ActivationAddress]:
-        """Local partition, then cache — no remote traffic."""
+        """Local partition, then cache — no remote traffic.  Cache lines
+        pointing at silos not currently believed alive are dropped, not
+        returned (a membership change may race the death-cleanup sweep)."""
         if self.ring.owns_hash(grain_id.ring_hash()):
             return self.partition.lookup(grain_id)
-        return self.cache.get(grain_id)
+        addr = self.cache.get(grain_id)
+        if addr is not None and not self.silo.is_silo_alive(addr.silo):
+            self.cache.invalidate(grain_id)
+            return None
+        return addr
 
     async def full_lookup(self, grain_id: GrainId) -> Optional[ActivationAddress]:
-        import asyncio
-
         from orleans_tpu.runtime.runtime_client import (
             RejectionError,
             RequestTimeoutError,
         )
+        from orleans_tpu.utils import FixedBackoff, execute_with_retries
+
         # owner is re-evaluated per attempt: a lookup racing a membership
         # change may first target a silo just declared dead; once the ring
         # heals the next attempt lands on the live owner (reference:
         # LocalGrainDirectory retry on ring change during lookup)
-        last_exc: Optional[Exception] = None
-        for attempt in range(5):
+        async def attempt_lookup(attempt: int):
             owner = self.owner_of(grain_id)
             if owner == self.silo.address:
                 self.lookups_local += 1
                 return self.partition.lookup(grain_id)
             self.lookups_remote += 1
-            try:
-                addr = await self.silo.system_rpc(owner, "directory",
-                                                  "remote_lookup", (grain_id,))
-            except (RejectionError, RequestTimeoutError) as exc:
-                last_exc = exc
-                await asyncio.sleep(0.02 * (attempt + 1))
-                continue
+            addr = await self.silo.system_rpc(owner, "directory",
+                                              "remote_lookup", (grain_id,))
             if addr is not None:
                 self.cache.put(grain_id, addr)
             return addr
-        raise last_exc
+
+        return await execute_with_retries(
+            attempt_lookup, max_retries=4,
+            retry_filter=lambda exc, i: isinstance(
+                exc, (RejectionError, RequestTimeoutError)),
+            backoff=FixedBackoff(0.05))
 
     # -- invalidation -------------------------------------------------------
 

@@ -38,6 +38,12 @@ class RejectionError(Exception):
         self.rejection = rejection
         self.info = info
 
+    def __reduce__(self):
+        # default Exception reduce would replay __init__ with the single
+        # formatted message and fail — responses carrying this exception
+        # must survive the wire codec
+        return (RejectionError, (self.rejection, self.info))
+
 
 @dataclass
 class CallbackData:
@@ -175,11 +181,21 @@ class InsideRuntimeClient:
         if msg.response_kind == ResponseKind.REJECTION:
             if (msg.rejection_type == RejectionType.TRANSIENT
                     and self.resend_on_transient
+                    and cb.message.category == Category.APPLICATION
                     and cb.resend_count < self.max_resend_count):
+                # re-addressing is only meaningful for grain calls; a
+                # ping/system request addressed to a SPECIFIC silo must
+                # fail fast (a re-addressed probe could answer from the
+                # local oracle and fake the target alive)
                 # transparent resend with re-addressing
                 # (reference: CallbackData.DoResend / Message resend)
                 cb.resend_count += 1
                 cb.message.resend_count = cb.resend_count
+                if cb.message.target_grain is not None:
+                    # the route we just tried bounced — drop the cache line
+                    # or every resend re-resolves the same stale address
+                    self.silo.grain_directory.cache.invalidate(
+                        cb.message.target_grain)
                 cb.message.target_silo = None
                 cb.message.target_activation = None
                 self.silo.metrics.requests_resent += 1
@@ -209,17 +225,18 @@ class InsideRuntimeClient:
             cb.timeout_handle.cancel()
 
     def break_outstanding_messages_to_dead_silo(self, silo: SiloAddress) -> None:
-        """Fail pending callbacks targeted at a dead silo
-        (reference: InsideGrainClient.BreakOutstandingMessagesToDeadSilo :754)."""
-        broken = [mid for mid, cb in self.callbacks.items()
+        """Break pending callbacks targeted at a dead silo
+        (reference: InsideGrainClient.BreakOutstandingMessagesToDeadSilo :754).
+
+        Synthesized transient rejections go through receive_response so the
+        normal resend-with-re-addressing path gets a chance first; callers
+        only see an error once resends are exhausted."""
+        broken = [cb for cb in self.callbacks.values()
                   if cb.message.target_silo == silo]
-        for mid in broken:
-            cb = self.callbacks.pop(mid)
-            self._cancel_timer(cb)
-            if not cb.future.done():
-                cb.future.set_exception(RejectionError(
-                    RejectionType.TRANSIENT,
-                    f"target silo {silo} declared dead"))
+        for cb in broken:
+            self.receive_response(cb.message.create_rejection(
+                RejectionType.TRANSIENT,
+                f"target silo {silo} declared dead"))
 
     # ===================== invoke path =====================================
 

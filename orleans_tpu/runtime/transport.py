@@ -60,16 +60,62 @@ class InProcTransport:
             return
         deliver = self._inboxes.get(msg.target_silo)
         if deliver is None:
-            # closed socket analog: silently dropped; callers detect via
-            # timeouts + membership (reference: socket send failure →
-            # eventual probe failure)
+            # closed-socket analog: the connection refuses immediately, so
+            # requests bounce back as transient rejections — the caller's
+            # resend machinery re-addresses via the (by now healed)
+            # directory instead of hanging for the full response timeout
+            # (reference: socket send failure → rejection, not a black hole)
+            from orleans_tpu.runtime.messaging import Direction, RejectionType
+            back = self._inboxes.get(sender)
+            if back is not None and msg.direction == Direction.REQUEST:
+                rejection = msg.create_rejection(
+                    RejectionType.TRANSIENT,
+                    f"target silo {msg.target_silo} unreachable")
+                asyncio.get_running_loop().call_soon(back, rejection)
             return
         self.messages_carried += 1
         if self.wire_fidelity:
-            msg = codec.deserialize(codec.serialize(msg))
+            try:
+                msg = codec.deserialize(codec.serialize(msg))
+            except Exception as exc:  # noqa: BLE001
+                # a message that cannot cross the wire must NOT become a
+                # black hole (the caller would hang for the full response
+                # timeout) — degrade responses to a stringified error and
+                # bounce requests as rejections (reference: serialization
+                # failures surface as SerializationException responses)
+                degraded = _degrade_unserializable(msg, exc)
+                if degraded is None:
+                    from orleans_tpu.runtime.messaging import (
+                        Direction,
+                        RejectionType,
+                    )
+                    back = self._inboxes.get(sender)
+                    if back is not None and msg.direction == Direction.REQUEST:
+                        rejection = msg.create_rejection(
+                            RejectionType.UNRECOVERABLE,
+                            f"unserializable request: {exc!r}")
+                        asyncio.get_running_loop().call_soon(back, rejection)
+                    return
+                msg = codec.deserialize(codec.serialize(degraded))
         # schedule rather than call: preserves one-way send semantics and
         # avoids reentrant dispatcher stacks
         asyncio.get_running_loop().call_soon(deliver, msg)
+
+
+def _degrade_unserializable(msg: Message, exc: Exception) -> Optional[Message]:
+    """Build a wire-safe stand-in for a RESPONSE whose result failed to
+    serialize; returns None for non-responses (callers bounce those)."""
+    from orleans_tpu.runtime.messaging import Direction, ResponseKind
+    if msg.direction != Direction.RESPONSE:
+        return None
+    import dataclasses
+    return dataclasses.replace(
+        msg,
+        response_kind=ResponseKind.ERROR,
+        result=RuntimeError(
+            f"response not serializable ({exc!r}); original result/error: "
+            f"{msg.result!r}"),
+    )
 
 
 class BoundTransport:
@@ -173,7 +219,22 @@ class TcpTransport:
                 if wire.expiration is not None:
                     wire.expiration = max(0.0,
                                           wire.expiration - time.monotonic())
-                payload = codec.serialize(wire)
+                try:
+                    payload = codec.serialize(wire)
+                except Exception as exc:  # noqa: BLE001
+                    degraded = _degrade_unserializable(wire, exc)
+                    if degraded is None:
+                        from orleans_tpu.runtime.messaging import (
+                            Direction,
+                            RejectionType,
+                        )
+                        if msg.direction == Direction.REQUEST:
+                            self.silo.message_center.deliver_local(
+                                msg.create_rejection(
+                                    RejectionType.UNRECOVERABLE,
+                                    f"unserializable request: {exc!r}"))
+                        continue
+                    payload = codec.serialize(degraded)
                 writer.write(struct.pack("<II", self.MAGIC, len(payload))
                              + payload)
                 try:

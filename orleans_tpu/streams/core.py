@@ -23,6 +23,14 @@ OnError = Callable[[Exception], Awaitable[None]]
 OnCompleted = Callable[[], Awaitable[None]]
 
 
+class ProducerNotRegisteredError(Exception):
+    """Raised by a grain's stream_producer_update handler when the
+    activation holds no producer-side state for the stream — the fresh
+    activation of a grain that produced in a *previous* life (analog of
+    the reference's GrainExtensionNotInstalledException, which
+    PubSubRendezvousGrain catches to prune dead producers)."""
+
+
 @dataclass(frozen=True)
 class StreamId:
     """(reference: StreamId.cs — provider + namespace + guid key)"""

@@ -185,7 +185,15 @@ class SimpleQueueCache:
 
     def __init__(self, size: int = 1024) -> None:
         self.size = size
-        self._events: Deque[QueueMessage] = deque(maxlen=size)
+        # bounded by gating pulls on free_space, NOT a maxlen deque — a
+        # maxlen deque would silently evict the oldest *undelivered* events
+        # on overflow, and the seq-monotonic dedup in add() would then
+        # refuse to re-admit them (permanent loss)
+        self._events: Deque[QueueMessage] = deque()
+
+    @property
+    def free_space(self) -> int:
+        return max(0, self.size - len(self._events))
 
     def add(self, msgs: List[QueueMessage]) -> None:
         newest = self.newest_seq
@@ -248,17 +256,31 @@ class PullingAgent:
     async def _pull_loop(self) -> None:
         p = self.provider
         delivered_up_to = -1
+        attempts = 0  # failed delivery tries for the current retry head
         while True:
             try:
-                msgs = await self.receiver.get_queue_messages(p.batch_size)
-                self.cache.add(msgs)  # dedup by seq
-                pending = self.cache.window(delivered_up_to + 1)
-                if pending:
-                    for m in pending:
-                        await self._deliver(m)
-                        await self.receiver.ack(m.seq)
-                        delivered_up_to = m.seq
-                        self.delivered += 1
+                space = self.cache.free_space
+                if space > 0:  # cache full = backpressure: stop pulling
+                    msgs = await self.receiver.get_queue_messages(
+                        min(p.batch_size, space))
+                    self.cache.add(msgs)  # dedup by seq
+                progressed = False
+                for m in self.cache.window(delivered_up_to + 1):
+                    ok = await self._deliver(m)
+                    if not ok:
+                        attempts += 1
+                        if attempts < p.max_delivery_attempts:
+                            # stays cached and un-acked; retried next loop
+                            break
+                        self.logger.warn(
+                            f"dropping seq={m.seq} on {m.stream_id} after "
+                            f"{attempts} failed delivery attempts")
+                    attempts = 0
+                    await self.receiver.ack(m.seq)
+                    delivered_up_to = m.seq
+                    self.delivered += 1
+                    progressed = True
+                if progressed:
                     self.cache.trim_to(delivered_up_to)
                     continue  # drain hot queue without sleeping
             except asyncio.CancelledError:
@@ -287,10 +309,13 @@ class PullingAgent:
         finally:
             _current_runtime.reset(token)
 
-    async def _deliver(self, msg: QueueMessage) -> None:
+    async def _deliver(self, msg: QueueMessage) -> bool:
+        """Deliver one event to every subscriber.  Returns False when any
+        delivery failed, so the pull loop keeps the event cached/un-acked
+        and retries (at-least-once; poison cap = max_delivery_attempts)."""
         consumers = await self._consumers(msg.stream_id)
         if not consumers:
-            return
+            return True
         from orleans_tpu.core.reference import GrainReference
         iface_id = IStreamConsumer.__grain_interface_info__.interface_id
         if msg.kind == "item":
@@ -305,11 +330,18 @@ class PullingAgent:
                 s, msg.stream_id, error)
                 for s, c in consumers]
         results = await asyncio.gather(*sends, return_exceptions=True)
+        ok = True
         for r in results:
             if isinstance(r, Exception):
+                ok = False
                 self.logger.warn(
                     f"delivery of seq={msg.seq} on {msg.stream_id} "
                     f"failed: {r!r}")
+        if not ok:
+            # the cached subscriber view may be stale (e.g. consumer's silo
+            # died) — drop it so the retry re-resolves from pub/sub
+            self._consumer_cache.pop(msg.stream_id, None)
+        return ok
 
 
 class PersistentStreamPullingManager:
@@ -360,13 +392,15 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
                  pull_period: float = 0.05,
                  batch_size: int = 64,
                  cache_size: int = 1024,
-                 consumer_cache_ttl: float = 1.0) -> None:
+                 consumer_cache_ttl: float = 1.0,
+                 max_delivery_attempts: int = 3) -> None:
         self.adapter = adapter
         self.mapper = HashRingStreamQueueMapper(adapter.n_queues)
         self.pull_period = pull_period
         self.batch_size = batch_size
         self.cache_size = cache_size
         self.consumer_cache_ttl = consumer_cache_ttl
+        self.max_delivery_attempts = max_delivery_attempts
         self._balancer_cls = balancer_cls
         self.name = "persistent"
         self.silo = None

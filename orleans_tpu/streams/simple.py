@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Dict, List, Optional, Tuple
 
-from orleans_tpu.core.grain import always_interleave, grain_interface, one_way
+from orleans_tpu.core.grain import always_interleave, grain_interface
 from orleans_tpu.ids import GrainId
 from orleans_tpu.streams.core import StreamId
 from orleans_tpu.streams.pubsub import PubSubStreamProviderMixin
@@ -40,8 +40,11 @@ class IStreamProducer:
     — AddSubscriber/RemoveSubscriber pushes)."""
 
     @always_interleave
-    @one_way
     async def stream_producer_update(self, stream_id, consumers) -> None: ...
+    # NOT one-way: the rendezvous grain must see delivery failures
+    # (ProducerNotRegisteredError / dead silo) to prune dead producers
+    # (reference: PubSubRendezvousGrain catching
+    # GrainExtensionNotInstalledException)
 
 
 class SimpleMessageStreamProvider(PubSubStreamProviderMixin):
@@ -83,16 +86,21 @@ class SimpleMessageStreamProvider(PubSubStreamProviderMixin):
             if cache is None:
                 cache = inst._stream_producer_cache = {}
             if stream_id not in cache:
+                # mark BEFORE awaiting: a pub/sub push landing while
+                # register_producer is in flight must find the key (else the
+                # push handler reports ProducerNotRegistered and the
+                # rendezvous prunes the producer it just registered)
+                cache[stream_id] = None
                 consumers = await self._pubsub(stream_id).register_producer(
                     stream_id, act.grain_id)
-                # a push may have landed while registering; don't clobber it
-                cache.setdefault(stream_id, consumers)
+                if cache.get(stream_id) is None:  # no push won the race
+                    cache[stream_id] = consumers
             seqs = getattr(inst, "_stream_seq", None)
             if seqs is None:
                 seqs = inst._stream_seq = {}
             first = seqs.get(stream_id, 0)
             seqs[stream_id] = first + n_items
-            return cache[stream_id], first
+            return cache[stream_id] or [], first
         consumers = await self._pubsub(stream_id).consumers(stream_id)
         first = self._client_seq.get(stream_id, 0)
         self._client_seq[stream_id] = first + n_items
@@ -104,13 +112,18 @@ class SimpleMessageStreamProvider(PubSubStreamProviderMixin):
             return
         from orleans_tpu.core.reference import GrainReference
         iface_id = IStreamConsumer.__grain_interface_info__.interface_id
-        sends = []
-        for sub_id, consumer in consumers:
+
+        async def deliver_in_order(sub_id: int, consumer: GrainId) -> None:
+            # items to ONE consumer go sequentially — stream_deliver is
+            # @always_interleave, so concurrent sends could complete out of
+            # order at the consumer; consumers fan out in parallel
             ref = GrainReference(consumer, iface_id)
             for i, item in enumerate(items):
-                sends.append(ref.stream_deliver(sub_id, stream_id, item,
-                                                first + i))
-        results = await asyncio.gather(*sends, return_exceptions=True)
+                await ref.stream_deliver(sub_id, stream_id, item, first + i)
+
+        results = await asyncio.gather(
+            *(deliver_in_order(s, c) for s, c in consumers),
+            return_exceptions=True)
         errors = [r for r in results if isinstance(r, Exception)]
         if errors:
             if self.fire_and_forget:

@@ -1,0 +1,202 @@
+"""Telemetry consumer interfaces + registry.
+
+Parity: reference telemetry surface (reference: src/Orleans/Telemetry/
+ITelemetryConsumer.cs, IMetricTelemetryConsumer.cs,
+ITraceTelemetryConsumer.cs, IExceptionTelemetryConsumer.cs,
+IDependencyTelemetryConsumer.cs, IRequestTelemetryConsumer.cs,
+IEventTelemetryConsumer.cs, Severity.cs).  Consumers register on the
+process-wide ``TelemetryManager`` and receive fan-out from the stats
+registry (orleans_tpu/stats.py) and the trace logger
+(orleans_tpu/tracing.py).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Severity(enum.IntEnum):
+    """(reference: Severity.cs — Off..Verbose3)"""
+
+    OFF = 0
+    ERROR = 1
+    WARNING = 2
+    INFO = 3
+    VERBOSE = 4
+    VERBOSE2 = 5
+    VERBOSE3 = 6
+
+
+class TelemetryConsumer:
+    """Base marker (reference: ITelemetryConsumer.cs)."""
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class MetricTelemetryConsumer(TelemetryConsumer):
+    """(reference: IMetricTelemetryConsumer.cs)"""
+
+    def track_metric(self, name: str, value: float,
+                     properties: Optional[Dict[str, str]] = None) -> None:
+        raise NotImplementedError
+
+    def increment_metric(self, name: str, value: float = 1.0) -> None:
+        self.track_metric(name, value)
+
+    def decrement_metric(self, name: str, value: float = 1.0) -> None:
+        self.track_metric(name, -value)
+
+
+class TraceTelemetryConsumer(TelemetryConsumer):
+    """(reference: ITraceTelemetryConsumer.cs)"""
+
+    def track_trace(self, message: str, severity: Severity = Severity.INFO,
+                    properties: Optional[Dict[str, str]] = None) -> None:
+        raise NotImplementedError
+
+
+class ExceptionTelemetryConsumer(TelemetryConsumer):
+    """(reference: IExceptionTelemetryConsumer.cs)"""
+
+    def track_exception(self, exc: BaseException,
+                        properties: Optional[Dict[str, str]] = None,
+                        metrics: Optional[Dict[str, float]] = None) -> None:
+        raise NotImplementedError
+
+
+class DependencyTelemetryConsumer(TelemetryConsumer):
+    """External-call tracking, e.g. storage/table IO
+    (reference: IDependencyTelemetryConsumer.cs)."""
+
+    def track_dependency(self, name: str, command: str, start_time: float,
+                         duration: float, success: bool) -> None:
+        raise NotImplementedError
+
+
+class RequestTelemetryConsumer(TelemetryConsumer):
+    """Grain-request tracking (reference: IRequestTelemetryConsumer.cs)."""
+
+    def track_request(self, name: str, start_time: float, duration: float,
+                      response_code: str, success: bool) -> None:
+        raise NotImplementedError
+
+
+class EventTelemetryConsumer(TelemetryConsumer):
+    """(reference: IEventTelemetryConsumer.cs)"""
+
+    def track_event(self, name: str,
+                    properties: Optional[Dict[str, str]] = None,
+                    metrics: Optional[Dict[str, float]] = None) -> None:
+        raise NotImplementedError
+
+
+class TelemetryManager:
+    """Fan-out hub; silos and clients publish through one of these
+    (reference: the TelemetryConsumers list managed by TraceLogger +
+    LogManager in the reference tree)."""
+
+    def __init__(self) -> None:
+        self.consumers: List[TelemetryConsumer] = []
+
+    def add(self, consumer: TelemetryConsumer) -> None:
+        self.consumers.append(consumer)
+
+    def remove(self, consumer: TelemetryConsumer) -> None:
+        if consumer in self.consumers:
+            self.consumers.remove(consumer)
+
+    def _each(self, cls):
+        return [c for c in self.consumers if isinstance(c, cls)]
+
+    def track_metric(self, name: str, value: float,
+                     properties: Optional[Dict[str, str]] = None) -> None:
+        for c in self._each(MetricTelemetryConsumer):
+            c.track_metric(name, value, properties)
+
+    def track_trace(self, message: str, severity: Severity = Severity.INFO,
+                    properties: Optional[Dict[str, str]] = None) -> None:
+        for c in self._each(TraceTelemetryConsumer):
+            c.track_trace(message, severity, properties)
+
+    def track_exception(self, exc: BaseException,
+                        properties: Optional[Dict[str, str]] = None,
+                        metrics: Optional[Dict[str, float]] = None) -> None:
+        for c in self._each(ExceptionTelemetryConsumer):
+            c.track_exception(exc, properties, metrics)
+
+    def track_dependency(self, name: str, command: str, start_time: float,
+                         duration: float, success: bool) -> None:
+        for c in self._each(DependencyTelemetryConsumer):
+            c.track_dependency(name, command, start_time, duration, success)
+
+    def track_request(self, name: str, start_time: float, duration: float,
+                      response_code: str = "OK",
+                      success: bool = True) -> None:
+        for c in self._each(RequestTelemetryConsumer):
+            c.track_request(name, start_time, duration, response_code, success)
+
+    def track_event(self, name: str,
+                    properties: Optional[Dict[str, str]] = None,
+                    metrics: Optional[Dict[str, float]] = None) -> None:
+        for c in self._each(EventTelemetryConsumer):
+            c.track_event(name, properties, metrics)
+
+    def flush(self) -> None:
+        for c in self.consumers:
+            c.flush()
+
+    def close(self) -> None:
+        for c in self.consumers:
+            c.close()
+        self.consumers.clear()
+
+
+class InMemoryTelemetryConsumer(MetricTelemetryConsumer,
+                                TraceTelemetryConsumer,
+                                ExceptionTelemetryConsumer,
+                                RequestTelemetryConsumer,
+                                EventTelemetryConsumer,
+                                DependencyTelemetryConsumer):
+    """Captures everything — the test-facing consumer (the reference tests
+    against TraceTelemetryConsumer file/console sinks; in-process capture
+    is the idiomatic pytest analog)."""
+
+    def __init__(self) -> None:
+        self.metrics: List[tuple] = []
+        self.traces: List[tuple] = []
+        self.exceptions: List[tuple] = []
+        self.requests: List[tuple] = []
+        self.events: List[tuple] = []
+        self.dependencies: List[tuple] = []
+
+    def track_metric(self, name, value, properties=None) -> None:
+        self.metrics.append((name, value, properties, time.time()))
+
+    def track_trace(self, message, severity=Severity.INFO,
+                    properties=None) -> None:
+        self.traces.append((message, severity, properties))
+
+    def track_exception(self, exc, properties=None, metrics=None) -> None:
+        self.exceptions.append((exc, properties, metrics))
+
+    def track_request(self, name, start_time, duration, response_code,
+                      success) -> None:
+        self.requests.append((name, start_time, duration, response_code,
+                              success))
+
+    def track_event(self, name, properties=None, metrics=None) -> None:
+        self.events.append((name, properties, metrics))
+
+    def track_dependency(self, name, command, start_time, duration,
+                         success) -> None:
+        self.dependencies.append((name, command, start_time, duration,
+                                  success))
+
+
+default_manager = TelemetryManager()

@@ -121,14 +121,20 @@ class TestingCluster:
     # ================= convenience ========================================
 
     async def wait_for_liveness_convergence(self, timeout: float = 10.0) -> None:
-        """Wait until every silo's view agrees on the active set."""
+        """Wait until every live silo's view equals exactly the live set —
+        in particular, killed silos must have been DECLARED dead by every
+        survivor (merely agreeing while all still believe a corpse is
+        active is not convergence)."""
         deadline = asyncio.get_running_loop().time() + timeout
         while True:
-            views = [frozenset(s.active_silos()) for s in self.silos]
-            if len(set(views)) <= 1:
+            expected = frozenset(s.address for s in self.silos)
+            if all(frozenset(s.active_silos()) == expected
+                   for s in self.silos):
                 return
             if asyncio.get_running_loop().time() > deadline:
-                raise TimeoutError(f"liveness did not converge: {views}")
+                views = [frozenset(s.active_silos()) for s in self.silos]
+                raise TimeoutError(
+                    f"liveness did not converge: {views} != {expected}")
             await asyncio.sleep(0.05)
 
     def total_activations(self) -> int:

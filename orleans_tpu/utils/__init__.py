@@ -1,0 +1,25 @@
+"""Async + host-side utility layer (reference: src/Orleans/Async/*.cs)."""
+
+from orleans_tpu.utils.async_utils import (
+    INFINITE_RETRIES,
+    AsyncLock,
+    AsyncPipeline,
+    AsyncSerialExecutor,
+    BatchedContinuationQueue,
+    ExponentialBackoff,
+    FixedBackoff,
+    MultiCompletionSource,
+    execute_with_retries,
+)
+
+__all__ = [
+    "INFINITE_RETRIES",
+    "AsyncLock",
+    "AsyncPipeline",
+    "AsyncSerialExecutor",
+    "BatchedContinuationQueue",
+    "ExponentialBackoff",
+    "FixedBackoff",
+    "MultiCompletionSource",
+    "execute_with_retries",
+]

@@ -1,0 +1,238 @@
+"""Async utility suite (reference: TesterInternal AsyncSerialExecutorTests.cs
+and the AsyncExecutorWithRetries contracts)."""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.utils import (
+    INFINITE_RETRIES,
+    AsyncLock,
+    AsyncPipeline,
+    AsyncSerialExecutor,
+    BatchedContinuationQueue,
+    ExponentialBackoff,
+    FixedBackoff,
+    MultiCompletionSource,
+    execute_with_retries,
+)
+
+
+def test_retries_succeeds_after_failures(run):
+    calls = []
+
+    async def main():
+        async def flaky(attempt):
+            calls.append(attempt)
+            if attempt < 2:
+                raise IOError("transient")
+            return "ok"
+
+        return await execute_with_retries(flaky, max_retries=5,
+                                          backoff=FixedBackoff(0))
+
+    assert run(main()) == "ok"
+    assert calls == [0, 1, 2]
+
+
+def test_retries_exhausted_raises(run):
+    async def main():
+        async def always_fails(attempt):
+            raise IOError("perm")
+
+        await execute_with_retries(always_fails, max_retries=2,
+                                   backoff=FixedBackoff(0))
+
+    with pytest.raises(IOError):
+        run(main())
+
+
+def test_retry_filter_stops_early(run):
+    calls = []
+
+    async def main():
+        async def fails(attempt):
+            calls.append(attempt)
+            raise ValueError("fatal")
+
+        await execute_with_retries(
+            fails, max_retries=10,
+            retry_filter=lambda exc, i: not isinstance(exc, ValueError))
+
+    with pytest.raises(ValueError):
+        run(main())
+    assert calls == [0]
+
+
+def test_success_filter_retries_on_bad_result(run):
+    async def main():
+        async def counter(attempt):
+            return attempt
+
+        return await execute_with_retries(
+            counter, max_retries=10,
+            success_filter=lambda r, i: r >= 3)
+
+    assert run(main()) == 3
+
+
+def test_max_execution_time(run):
+    async def main():
+        async def slow(attempt):
+            await asyncio.sleep(0.02)
+            raise IOError("again")
+
+        await execute_with_retries(slow, max_retries=INFINITE_RETRIES,
+                                   max_execution_time=0.05,
+                                   backoff=FixedBackoff(0))
+
+    with pytest.raises((TimeoutError, IOError)):
+        run(main())
+
+
+def test_exponential_backoff_bounds():
+    b = ExponentialBackoff(min_delay=0.01, max_delay=1.0, step=2.0)
+    for i in range(20):
+        d = b.next(i)
+        assert 0.01 <= d <= 1.0
+
+
+def test_async_lock_mutual_exclusion(run):
+    async def main():
+        lock = AsyncLock()
+        inside = 0
+        max_inside = 0
+
+        async def worker():
+            nonlocal inside, max_inside
+            async with lock:
+                inside += 1
+                max_inside = max(max_inside, inside)
+                await asyncio.sleep(0.001)
+                inside -= 1
+
+        await asyncio.gather(*(worker() for _ in range(10)))
+        return max_inside
+
+    assert run(main()) == 1
+
+
+def test_serial_executor_no_interleaving(run):
+    """(reference: AsyncSerialExecutorTests — submitted closures never
+    interleave and run FIFO)"""
+
+    async def main():
+        ex = AsyncSerialExecutor()
+        order = []
+        running = 0
+        overlap = False
+
+        async def job(i):
+            nonlocal running, overlap
+            running += 1
+            if running > 1:
+                overlap = True
+            await asyncio.sleep(0.001)
+            order.append(i)
+            running -= 1
+            return i
+
+        results = await asyncio.gather(
+            *(ex.execute(lambda i=i: job(i)) for i in range(8)))
+        return overlap, order, results
+
+    overlap, order, results = run(main())
+    assert not overlap
+    assert order == list(range(8))
+    assert results == list(range(8))
+
+
+def test_serial_executor_propagates_exceptions(run):
+    async def main():
+        ex = AsyncSerialExecutor()
+
+        async def boom():
+            raise RuntimeError("x")
+
+        async def fine():
+            return 42
+
+        try:
+            await ex.execute(boom)
+        except RuntimeError:
+            pass
+        else:
+            raise AssertionError("expected RuntimeError")
+        return await ex.execute(fine)
+
+    assert run(main()) == 42
+
+
+def test_pipeline_enforces_capacity(run):
+    async def main():
+        pipe = AsyncPipeline(capacity=3)
+        in_flight = 0
+        peak = 0
+
+        async def work():
+            nonlocal in_flight, peak
+            in_flight += 1
+            peak = max(peak, in_flight)
+            await asyncio.sleep(0.002)
+            in_flight -= 1
+
+        for _ in range(12):
+            await pipe.add(work())
+        await pipe.wait()
+        return peak, pipe.count
+
+    peak, count = run(main())
+    assert peak <= 3
+    assert count == 0
+
+
+def test_pipeline_propagates_errors_on_wait(run):
+    async def main():
+        pipe = AsyncPipeline(capacity=2)
+
+        async def bad():
+            raise IOError("task failed")
+
+        await pipe.add(bad())
+        await pipe.wait()
+
+    with pytest.raises(IOError):
+        run(main())
+
+
+def test_multi_completion_source(run):
+    async def main():
+        mcs = MultiCompletionSource(3)
+        assert not mcs.task.done()
+        mcs.set_one_result()
+        mcs.set_one_result()
+        assert not mcs.task.done()
+        mcs.set_one_result()
+        await mcs.task
+        try:
+            mcs.set_one_result()
+        except RuntimeError:
+            return True
+        return False
+
+    assert run(main())
+
+
+def test_batched_continuation_queue_flushes_on_count_and_time(run):
+    async def main():
+        q = BatchedContinuationQueue(flush_count=4, flush_interval=0.01)
+        batches = []
+        q.on_flush(batches.append)
+        for i in range(4):
+            q.enqueue(i)
+        assert batches == [[0, 1, 2, 3]]  # count gate flushed synchronously
+        q.enqueue(99)
+        await asyncio.sleep(0.05)          # time gate
+        return batches
+
+    assert run(main()) == [[0, 1, 2, 3], [99]]

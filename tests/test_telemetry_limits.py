@@ -1,0 +1,70 @@
+"""Telemetry consumer fan-out + limits/load-shedding contracts
+(reference: src/Orleans/Telemetry/*, LimitManager.cs:34)."""
+
+import pytest
+
+from orleans_tpu.limits import (
+    MAX_ENQUEUED_REQUESTS,
+    LimitExceededError,
+    LimitManager,
+    LimitValue,
+    LoadSheddingGate,
+)
+from orleans_tpu.telemetry import (
+    InMemoryTelemetryConsumer,
+    Severity,
+    TelemetryManager,
+)
+
+
+def test_telemetry_fanout_by_kind():
+    mgr = TelemetryManager()
+    sink = InMemoryTelemetryConsumer()
+    mgr.add(sink)
+    mgr.track_metric("m", 1.5, {"k": "v"})
+    mgr.track_trace("hello", Severity.WARNING)
+    mgr.track_exception(ValueError("boom"))
+    mgr.track_request("IHello.say_hello", 0.0, 0.01)
+    mgr.track_event("activated", {"grain": "g"})
+    mgr.track_dependency("storage", "write", 0.0, 0.002, True)
+    assert sink.metrics[0][:2] == ("m", 1.5)
+    assert sink.traces == [("hello", Severity.WARNING, None)]
+    assert isinstance(sink.exceptions[0][0], ValueError)
+    assert sink.requests[0][0] == "IHello.say_hello"
+    assert sink.events[0][0] == "activated"
+    assert sink.dependencies[0][0] == "storage"
+    mgr.remove(sink)
+    mgr.track_metric("m2", 1.0)
+    assert len(sink.metrics) == 1
+
+
+def test_limit_manager_defaults_and_overrides():
+    lm = LimitManager()
+    d = lm.get_limit("Unknown", default_soft=10, default_hard=20)
+    assert d == LimitValue("Unknown", 10, 20)
+    lm.add_limit(MAX_ENQUEUED_REQUESTS, soft=2, hard=4)
+    got = lm.get_limit(MAX_ENQUEUED_REQUESTS)
+    assert got.soft_limit == 2 and got.hard_limit == 4 and got.is_defined
+
+
+def test_limit_check_soft_warns_hard_raises():
+    lm = LimitManager()
+    lm.add_limit("q", soft=2, hard=4)
+    warnings = []
+    lm.check("q", 3, on_soft=lambda n, c, l: warnings.append((n, c)))
+    assert warnings == [("q", 3)]
+    with pytest.raises(LimitExceededError):
+        lm.check("q", 5)
+    lm.check("q", 2)  # at soft limit: fine
+
+
+def test_load_shedding_gate():
+    gate = LoadSheddingGate(enabled=True, limit=0.9)
+    gate.report_load(0.5)
+    assert gate.try_admit()
+    gate.report_load(0.95)
+    assert not gate.try_admit()
+    assert gate.shed_count == 1
+    disabled = LoadSheddingGate(enabled=False)
+    disabled.report_load(2.0)
+    assert disabled.try_admit()
