@@ -1,0 +1,94 @@
+"""Event sourcing: journaled grains whose state is a fold over events.
+
+Parity: reference JournaledGrain / JournaledGrainState (reference:
+src/OrleansEventSourcing/JournaledGrain.cs:34 — RaiseStateEvent appends the
+event to the state and optionally commits via WriteStateAsync;
+JournaledGrainState.cs:35 — the persisted state IS the event list + version,
+and each event is applied by a per-event-type transition method).
+
+The persisted document is ``{"events": [...], "version": n}`` written
+through the grain's ordinary storage provider (so every provider —
+memory/file/sqlite/sharded — can back a journal).  The in-memory view is
+rebuilt on activation by replaying the journal through the grain's
+``apply_event`` (or per-type ``apply_<EventClassName>`` methods), which is
+exactly the reference's StateTransition dynamic dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from orleans_tpu.core.grain import StatefulGrain
+
+
+def journal_initial_state() -> Dict[str, Any]:
+    """Initial persisted shape (reference: JournaledGrainState.cs:35 —
+    Events list + Version)."""
+    return {"events": [], "version": 0}
+
+
+class JournaledGrain(StatefulGrain):
+    """Subclass, define ``apply_event(event)`` or ``apply_<EventType>``
+    methods that mutate the in-memory view, and call ``raise_event`` from
+    command methods (reference: JournaledGrain.RaiseStateEvent)."""
+
+    async def on_activate(self) -> None:
+        """Replay the journal into the in-memory view
+        (activation stage 2 loads ``state`` before this runs)."""
+        self.replay()
+
+    # -- event application --------------------------------------------------
+
+    def apply_event(self, event: Any) -> None:
+        """Default dynamic dispatch: apply_<EventClassName>(event)
+        (reference: JournaledGrainState.StateTransition looking up
+        ``Apply(<event type>)`` by reflection)."""
+        handler = getattr(self, f"apply_{type(event).__name__}", None)
+        if handler is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no apply_event override nor an "
+                f"apply_{type(event).__name__} method")
+        handler(event)
+
+    def replay(self) -> None:
+        """Rebuild the view from the journal: view = fold(apply, events)."""
+        for event in self.events:
+            self.apply_event(event)
+
+    # -- raising ------------------------------------------------------------
+
+    async def raise_event(self, event: Any, commit: bool = True) -> None:
+        """Apply + journal an event; ``commit`` persists immediately
+        (reference: RaiseStateEvent(event, commit))."""
+        if event is None:
+            raise ValueError("event must not be None")
+        self.apply_event(event)
+        self.state["events"].append(event)
+        self.state["version"] += 1
+        if commit:
+            await self.write_state()
+
+    async def commit(self) -> None:
+        """Persist events raised with commit=False."""
+        await self.write_state()
+
+    # -- accessors ----------------------------------------------------------
+
+    @property
+    def events(self) -> List[Any]:
+        return self.state["events"]
+
+    @property
+    def version(self) -> int:
+        return self.state["version"]
+
+
+def journaled_grain_class(cls=None, *, storage_provider: str = "Default"):
+    """Decorator: register a JournaledGrain with the journal's initial
+    state shape pre-wired (composes grain_class + journal_initial_state)."""
+    from orleans_tpu.core.grain import grain_class
+
+    def wrap(c):
+        return grain_class(c, storage_provider=storage_provider,
+                           initial_state=journal_initial_state)
+    return wrap if cls is None else wrap(cls)

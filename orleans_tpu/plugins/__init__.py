@@ -1,0 +1,31 @@
+"""Backend plugins: pluggable system-store implementations
+(reference: OrleansAzureUtils / OrleansSQLUtils / OrleansZooKeeperUtils —
+membership tables, reminder tables, gateway list providers, statistics
+publishers).  SQLite stands in for the SQL backends; the contracts are the
+same, so a different store is a connection swap."""
+
+from orleans_tpu.plugins.gateway_list import (
+    GatewayListProvider,
+    MembershipGatewayListProvider,
+    StaticGatewayListProvider,
+)
+from orleans_tpu.plugins.sqlite_tables import (
+    SqliteMembershipTable,
+    SqliteReminderTable,
+)
+from orleans_tpu.plugins.stats_publisher import (
+    LogStatisticsPublisher,
+    SqliteStatisticsPublisher,
+    StatisticsPublisher,
+)
+
+__all__ = [
+    "GatewayListProvider",
+    "LogStatisticsPublisher",
+    "MembershipGatewayListProvider",
+    "SqliteMembershipTable",
+    "SqliteReminderTable",
+    "SqliteStatisticsPublisher",
+    "StaticGatewayListProvider",
+    "StatisticsPublisher",
+]

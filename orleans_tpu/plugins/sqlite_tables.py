@@ -1,0 +1,184 @@
+"""SQL (sqlite) membership + reminder tables.
+
+Parity: reference SQL system stores (reference: src/OrleansSQLUtils/
+SqlMembershipTable.cs:34, SqlReminderTable.cs:31, and the
+CreateOrleansTables_SqlServer.sql DDL).  Contracts match the in-memory
+tables exactly (orleans_tpu/runtime/membership.py InMemoryMembershipTable;
+orleans_tpu/runtime/reminders.py InMemoryReminderTable), so the membership
+oracle and reminder service run unchanged over either backend — the same
+pluggability the reference gets from IMembershipTable/IReminderTable.
+
+CAS discipline: membership rows carry integer etags and the whole table a
+version (reference: TableVersion, IMembershipTable.cs:133); every
+insert/update is a compare-and-swap on both.  Reminder rows carry string
+etags; remove requires the current one.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.ids import GrainId
+from orleans_tpu.runtime.membership import CasConflictError, MembershipEntry
+from orleans_tpu.runtime.reminders import ReminderEntry, ReminderTable
+
+codec.register(MembershipEntry, name="orleans.MembershipEntry")
+
+_MEMBERSHIP_SCHEMA = """
+CREATE TABLE IF NOT EXISTS membership (
+    silo_key TEXT PRIMARY KEY,
+    etag     INTEGER NOT NULL,
+    entry    BLOB NOT NULL
+);
+CREATE TABLE IF NOT EXISTS membership_version (
+    id      INTEGER PRIMARY KEY CHECK (id = 0),
+    version INTEGER NOT NULL
+);
+INSERT OR IGNORE INTO membership_version (id, version) VALUES (0, 0);
+"""
+
+_REMINDER_SCHEMA = """
+CREATE TABLE IF NOT EXISTS reminders (
+    grain_key TEXT NOT NULL,
+    name      TEXT NOT NULL,
+    etag      TEXT NOT NULL,
+    entry     BLOB NOT NULL,
+    PRIMARY KEY (grain_key, name)
+);
+"""
+
+
+class SqliteMembershipTable:
+    """Drop-in for InMemoryMembershipTable over sqlite
+    (reference: SqlMembershipTable.cs:34)."""
+
+    def __init__(self, path: str = ":memory:") -> None:
+        self._conn = sqlite3.connect(path)
+        self._conn.executescript(_MEMBERSHIP_SCHEMA)
+        self._conn.commit()
+        self.write_count = 0
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def _version(self) -> int:
+        return self._conn.execute(
+            "SELECT version FROM membership_version WHERE id=0"
+        ).fetchone()[0]
+
+    def _bump_version(self, expected: int) -> None:
+        cur = self._conn.execute(
+            "UPDATE membership_version SET version=version+1 "
+            "WHERE id=0 AND version=?", (expected,))
+        if cur.rowcount == 0:
+            raise CasConflictError("table version moved")
+
+    async def read_all(self) -> Tuple[
+            Dict, int]:
+        rows = self._conn.execute(
+            "SELECT etag, entry FROM membership").fetchall()
+        snap = {}
+        for etag, blob in rows:
+            entry: MembershipEntry = codec.deserialize(blob)
+            snap[entry.silo] = (entry, etag)
+        return snap, self._version()
+
+    async def insert_row(self, entry: MembershipEntry,
+                         table_version: int) -> None:
+        self._bump_version(table_version)
+        try:
+            self._conn.execute(
+                "INSERT INTO membership (silo_key, etag, entry) "
+                "VALUES (?, 0, ?)",
+                (str(entry.silo), codec.serialize(entry)))
+        except sqlite3.IntegrityError:
+            self._conn.rollback()
+            raise CasConflictError("row exists")
+        self._conn.commit()
+        self.write_count += 1
+
+    async def update_row(self, entry: MembershipEntry, etag: int,
+                         table_version: int) -> None:
+        self._bump_version(table_version)
+        cur = self._conn.execute(
+            "UPDATE membership SET etag=?, entry=? "
+            "WHERE silo_key=? AND etag=?",
+            (etag + 1, codec.serialize(entry), str(entry.silo), etag))
+        if cur.rowcount == 0:
+            self._conn.rollback()
+            raise CasConflictError("row etag moved")
+        self._conn.commit()
+        self.write_count += 1
+
+    async def update_iam_alive(self, silo, when: float) -> None:
+        """Heartbeat column — no CAS (reference: UpdateIAmAlive)."""
+        row = self._conn.execute(
+            "SELECT etag, entry FROM membership WHERE silo_key=?",
+            (str(silo),)).fetchone()
+        if row is None:
+            return
+        etag, blob = row
+        entry: MembershipEntry = codec.deserialize(blob)
+        entry.iam_alive_time = when
+        self._conn.execute(
+            "UPDATE membership SET entry=? WHERE silo_key=?",
+            (codec.serialize(entry), str(silo)))
+        self._conn.commit()
+
+
+class SqliteReminderTable(ReminderTable):
+    """Drop-in for InMemoryReminderTable over sqlite
+    (reference: SqlReminderTable.cs:31)."""
+
+    def __init__(self, path: str = ":memory:") -> None:
+        self._conn = sqlite3.connect(path)
+        self._conn.executescript(_REMINDER_SCHEMA)
+        self._conn.commit()
+        self._etag_counter = 0
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def _next_etag(self) -> str:
+        self._etag_counter += 1
+        return f"sq{self._etag_counter}"
+
+    async def read_row(self, grain_id: GrainId,
+                       name: str) -> Optional[ReminderEntry]:
+        row = self._conn.execute(
+            "SELECT entry FROM reminders WHERE grain_key=? AND name=?",
+            (str(grain_id), name)).fetchone()
+        return codec.deserialize(row[0]) if row is not None else None
+
+    async def read_rows(self, grain_id: GrainId) -> List[ReminderEntry]:
+        rows = self._conn.execute(
+            "SELECT entry FROM reminders WHERE grain_key=?",
+            (str(grain_id),)).fetchall()
+        return [codec.deserialize(b) for (b,) in rows]
+
+    async def read_all(self) -> List[ReminderEntry]:
+        rows = self._conn.execute("SELECT entry FROM reminders").fetchall()
+        return [codec.deserialize(b) for (b,) in rows]
+
+    async def upsert_row(self, entry: ReminderEntry) -> str:
+        etag = self._next_etag()
+        stored = replace(entry, etag=etag)
+        self._conn.execute(
+            "INSERT INTO reminders (grain_key, name, etag, entry) "
+            "VALUES (?,?,?,?) "
+            "ON CONFLICT (grain_key, name) DO UPDATE SET etag=?, entry=?",
+            (str(entry.grain_id), entry.name, etag, codec.serialize(stored),
+             etag, codec.serialize(stored)))
+        self._conn.commit()
+        return etag
+
+    async def remove_row(self, grain_id: GrainId, name: str,
+                         etag: str) -> bool:
+        cur = self._conn.execute(
+            "DELETE FROM reminders WHERE grain_key=? AND name=? AND etag=?",
+            (str(grain_id), name, etag))
+        self._conn.commit()
+        return cur.rowcount > 0

@@ -1,0 +1,20 @@
+"""Storage provider implementations (reference: src/OrleansProviders/ +
+per-backend utils projects)."""
+
+from orleans_tpu.providers.file_storage import FileStorage
+from orleans_tpu.providers.memory_storage import (
+    ErrorInjectionStorage,
+    MemoryStorage,
+    MemoryStorageWithLatency,
+)
+from orleans_tpu.providers.sharded_storage import ShardedStorageProvider
+from orleans_tpu.providers.sqlite_storage import SqliteStorage
+
+__all__ = [
+    "ErrorInjectionStorage",
+    "FileStorage",
+    "MemoryStorage",
+    "MemoryStorageWithLatency",
+    "ShardedStorageProvider",
+    "SqliteStorage",
+]

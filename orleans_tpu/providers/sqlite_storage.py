@@ -1,0 +1,125 @@
+"""SQL (sqlite) table storage provider.
+
+Parity: reference SQL storage provider (reference: src/OrleansSQLUtils/
+Storage/Provider/SqlStorageProvider.cs:13 + the OrleansGrainState table DDL
+in CreateOrleansTables_SqlServer.sql) — grain state rows keyed by
+(grain type, grain id) with optimistic-concurrency etags.  SQLite stands in
+for SQL Server/MySQL; the schema and the etag CAS discipline are the same
+shape, so a real backend is a connection-string swap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sqlite3
+from typing import Any, Dict, Optional
+
+from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.ids import GrainId
+from orleans_tpu.runtime.storage import (
+    GrainState,
+    InconsistentStateError,
+    StorageProvider,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS grain_state (
+    grain_type TEXT NOT NULL,
+    grain_key  TEXT NOT NULL,
+    etag       INTEGER NOT NULL,
+    data       BLOB,
+    PRIMARY KEY (grain_type, grain_key)
+)
+"""
+
+
+class SqliteStorage(StorageProvider):
+    """``path=":memory:"`` gives a per-provider in-memory database (tests);
+    a file path gives durable storage shared across silo restarts."""
+
+    def __init__(self, path: str = ":memory:") -> None:
+        self.path = path
+        self._conn = sqlite3.connect(path)
+        self._conn.execute(_SCHEMA)
+        self._conn.commit()
+
+    async def close(self) -> None:
+        self._conn.close()
+
+    # sqlite calls are sub-ms; they run inline on the loop the same way the
+    # reference's ADO.NET calls run on the thread pool behind one await
+    async def read_state(self, grain_type: str, grain_id: GrainId,
+                         state: GrainState) -> None:
+        row = self._conn.execute(
+            "SELECT etag, data FROM grain_state "
+            "WHERE grain_type=? AND grain_key=?",
+            (grain_type, str(grain_id))).fetchone()
+        if row is None:
+            state.record_exists = False
+            state.etag = None
+            return
+        etag, blob = row
+        state.data = codec.deserialize(blob)
+        state.etag = str(etag)
+        state.record_exists = True
+
+    async def write_state(self, grain_type: str, grain_id: GrainId,
+                          state: GrainState) -> None:
+        key = (grain_type, str(grain_id))
+        blob = codec.serialize(state.data)
+        cur = self._conn.cursor()
+        if state.etag is None:
+            # insert-if-absent (CAS on non-existence)
+            try:
+                cur.execute(
+                    "INSERT INTO grain_state "
+                    "(grain_type, grain_key, etag, data) VALUES (?,?,1,?)",
+                    (*key, blob))
+            except sqlite3.IntegrityError:
+                row = cur.execute(
+                    "SELECT etag FROM grain_state "
+                    "WHERE grain_type=? AND grain_key=?", key).fetchone()
+                raise InconsistentStateError(
+                    str(row[0]) if row else None, None)
+            self._conn.commit()
+            state.etag = "1"
+        else:
+            cur.execute(
+                "UPDATE grain_state SET etag=etag+1, data=? "
+                "WHERE grain_type=? AND grain_key=? AND etag=?",
+                (blob, *key, int(state.etag)))
+            if cur.rowcount == 0:
+                row = cur.execute(
+                    "SELECT etag FROM grain_state "
+                    "WHERE grain_type=? AND grain_key=?", key).fetchone()
+                raise InconsistentStateError(
+                    str(row[0]) if row else None, state.etag)
+            self._conn.commit()
+            state.etag = str(int(state.etag) + 1)
+        state.record_exists = True
+
+    async def clear_state(self, grain_type: str, grain_id: GrainId,
+                          state: GrainState) -> None:
+        key = (grain_type, str(grain_id))
+        cur = self._conn.cursor()
+        if state.etag is None:
+            row = cur.execute(
+                "SELECT etag FROM grain_state "
+                "WHERE grain_type=? AND grain_key=?", key).fetchone()
+            if row is not None:
+                raise InconsistentStateError(str(row[0]), None)
+            return
+        cur.execute(
+            "DELETE FROM grain_state "
+            "WHERE grain_type=? AND grain_key=? AND etag=?",
+            (*key, int(state.etag)))
+        if cur.rowcount == 0:
+            row = cur.execute(
+                "SELECT etag FROM grain_state "
+                "WHERE grain_type=? AND grain_key=?", key).fetchone()
+            raise InconsistentStateError(
+                str(row[0]) if row else None, state.etag)
+        self._conn.commit()
+        state.etag = None
+        state.record_exists = False
+        state.data = None

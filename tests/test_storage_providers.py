@@ -1,0 +1,216 @@
+"""Storage provider contract suite run against every backend, plus
+sharded-composite routing and event-sourced grains (reference analog:
+Tester persistence provider tests + EventSourcingTests)."""
+
+import pytest
+
+from orleans_tpu.core.grain import Grain, grain_class, grain_interface
+from orleans_tpu.event_sourcing import JournaledGrain, journaled_grain_class
+from orleans_tpu.ids import GrainId
+from orleans_tpu.providers.file_storage import FileStorage
+from orleans_tpu.providers.memory_storage import MemoryStorage
+from orleans_tpu.providers.sharded_storage import ShardedStorageProvider
+from orleans_tpu.providers.sqlite_storage import SqliteStorage
+from orleans_tpu.runtime.silo import Silo
+from orleans_tpu.runtime.storage import GrainState, InconsistentStateError
+
+
+def _providers(tmp_path):
+    return {
+        "memory": MemoryStorage(),
+        "file": FileStorage(str(tmp_path / "files")),
+        "sqlite": SqliteStorage(),
+        "sharded": ShardedStorageProvider(
+            [MemoryStorage(), MemoryStorage(), SqliteStorage()]),
+    }
+
+
+@pytest.mark.parametrize("kind", ["memory", "file", "sqlite", "sharded"])
+def test_provider_contract(run, tmp_path, kind):
+    """Shared contract: read-missing, write-new, reread, etag conflict,
+    clear (the same suite shape as the reference's per-backend
+    MembershipTablePluginTests pattern applied to storage)."""
+
+    async def main():
+        provider = _providers(tmp_path)[kind]
+        gid = GrainId.from_int(0x1234, 42)
+        s = GrainState()
+
+        await provider.read_state("T", gid, s)
+        assert not s.record_exists and s.etag is None
+
+        s.data = {"n": 1, "items": [1, 2, 3]}
+        await provider.write_state("T", gid, s)
+        assert s.record_exists and s.etag is not None
+        etag1 = s.etag
+
+        s2 = GrainState()
+        await provider.read_state("T", gid, s2)
+        assert s2.record_exists and s2.data == {"n": 1, "items": [1, 2, 3]}
+        assert s2.etag == etag1
+
+        # stale-etag write must fail (etag discipline)
+        stale = GrainState(data={"n": 99}, etag=None)
+        with pytest.raises(InconsistentStateError):
+            await provider.write_state("T", gid, stale)
+
+        # fresh-etag write advances
+        s2.data = {"n": 2}
+        await provider.write_state("T", gid, s2)
+        assert s2.etag != etag1
+
+        # first writer after clear starts over
+        await provider.clear_state("T", gid, s2)
+        assert not s2.record_exists
+        s3 = GrainState()
+        await provider.read_state("T", gid, s3)
+        assert not s3.record_exists
+
+        # per-(type, id) isolation
+        other = GrainId.from_int(0x1234, 43)
+        so = GrainState(data="other")
+        await provider.write_state("T", other, so)
+        st = GrainState(data="typed")
+        await provider.write_state("U", gid, st)
+        back = GrainState()
+        await provider.read_state("U", gid, back)
+        assert back.data == "typed"
+        await provider.close()
+
+    run(main())
+
+
+def test_file_storage_survives_reopen(run, tmp_path):
+    async def main():
+        gid = GrainId.from_int(0x77, 7)
+        p1 = FileStorage(str(tmp_path / "dur"))
+        s = GrainState(data={"balance": 100})
+        await p1.write_state("Account", gid, s)
+        # new provider instance over the same directory = process restart
+        p2 = FileStorage(str(tmp_path / "dur"))
+        s2 = GrainState()
+        await p2.read_state("Account", gid, s2)
+        assert s2.record_exists and s2.data == {"balance": 100}
+
+    run(main())
+
+
+def test_sqlite_storage_survives_reopen(run, tmp_path):
+    async def main():
+        db = str(tmp_path / "state.db")
+        gid = GrainId.from_int(0x78, 8)
+        p1 = SqliteStorage(db)
+        s = GrainState(data=[1, 2, 3])
+        await p1.write_state("G", gid, s)
+        await p1.close()
+        p2 = SqliteStorage(db)
+        s2 = GrainState()
+        await p2.read_state("G", gid, s2)
+        assert s2.record_exists and s2.data == [1, 2, 3]
+        await p2.close()
+
+    run(main())
+
+
+def test_sharded_routes_consistently(run, tmp_path):
+    """The same grain always lands on the same child shard."""
+
+    async def main():
+        children = [MemoryStorage(), MemoryStorage()]
+        sharded = ShardedStorageProvider(children)
+        hits = []
+        for i in range(40):
+            gid = GrainId.from_int(0x55, i)
+            s = GrainState(data=i)
+            await sharded.write_state("G", gid, s)
+        for child in children:
+            hits.append(len(child._store))
+        assert sum(hits) == 40
+        assert all(h > 0 for h in hits)  # both shards used
+        # reads resolve through the same routing
+        for i in range(40):
+            gid = GrainId.from_int(0x55, i)
+            s = GrainState()
+            await sharded.read_state("G", gid, s)
+            assert s.data == i
+
+    run(main())
+
+
+def test_sharded_requires_two_children():
+    with pytest.raises(ValueError):
+        ShardedStorageProvider([MemoryStorage()])
+
+
+# ---------------------------------------------------------------------------
+# event sourcing (reference: JournaledGrain.cs:34)
+# ---------------------------------------------------------------------------
+
+class Deposited:
+    def __init__(self, amount):
+        self.amount = amount
+
+
+class Withdrawn:
+    def __init__(self, amount):
+        self.amount = amount
+
+
+@grain_interface
+class IJournaledAccount:
+    async def deposit(self, amount: float): ...
+    async def withdraw(self, amount: float): ...
+    async def balance(self) -> float: ...
+    async def history_len(self) -> int: ...
+
+
+@journaled_grain_class
+class JournaledAccount(JournaledGrain, IJournaledAccount):
+    def __init__(self):
+        self.view_balance = 0.0
+
+    def apply_Deposited(self, e):
+        self.view_balance += e.amount
+
+    def apply_Withdrawn(self, e):
+        self.view_balance -= e.amount
+
+    async def deposit(self, amount):
+        await self.raise_event(Deposited(amount))
+
+    async def withdraw(self, amount):
+        await self.raise_event(Withdrawn(amount), commit=False)
+        await self.commit()
+
+    async def balance(self):
+        return self.view_balance
+
+    async def history_len(self):
+        return len(self.events)
+
+
+def test_journaled_grain_folds_and_survives_deactivation(run):
+    async def main():
+        silo = Silo(name="es", storage_providers={"Default": MemoryStorage()})
+        await silo.start()
+        try:
+            f = silo.attach_client()
+            acct = f.get_grain(IJournaledAccount, 900)
+            await acct.deposit(100.0)
+            await acct.deposit(50.0)
+            await acct.withdraw(30.0)
+            assert await acct.balance() == 120.0
+            assert await acct.history_len() == 3
+
+            # deactivate, then reactivate: view rebuilt by replay
+            import asyncio
+            for act in silo.catalog.directory.all():
+                silo.catalog.schedule_deactivation(act)
+            await asyncio.sleep(0.05)
+            assert len(silo.catalog.directory) == 0
+            assert await acct.balance() == 120.0
+            assert await acct.history_len() == 3
+        finally:
+            await silo.stop(graceful=False)
+
+    run(main())
