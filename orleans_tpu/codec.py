@@ -572,9 +572,15 @@ class SerializationManager:
 
 
 def _dataclass_codec(cls: Type) -> Tuple[_Serializer, _Deserializer]:
-    fields = [f.name for f in dataclasses.fields(cls)]
+    dc_fields = dataclasses.fields(cls)
+    fields = [f.name for f in dc_fields]
 
     def ser(mgr: SerializationManager, obj: Any, w: Writer, ctx: dict) -> None:
+        # field-count prefix so records persisted before a field was
+        # appended (or by an older-version silo sharing a system table)
+        # still deserialize: extra stored fields are consumed generically,
+        # missing trailing fields fall back to dataclass defaults
+        w.varint(len(fields))
         for fname in fields:
             mgr._write(getattr(obj, fname), w, ctx)
 
@@ -585,8 +591,20 @@ def _dataclass_codec(cls: Type) -> Tuple[_Serializer, _Deserializer]:
         register = ctx.pop("register_ref", None)
         if register is not None:
             register(obj)
-        for fname in fields:
+        stored = r.varint()
+        for fname in fields[:stored]:
             object.__setattr__(obj, fname, mgr._read(r, ctx))
+        for _ in range(max(0, stored - len(fields))):
+            mgr._read(r, ctx)  # field this version doesn't know — skip
+        for f in dc_fields[stored:]:
+            if f.default is not dataclasses.MISSING:
+                object.__setattr__(obj, f.name, f.default)
+            elif f.default_factory is not dataclasses.MISSING:  # type: ignore
+                object.__setattr__(obj, f.name, f.default_factory())
+            else:
+                raise SerializationError(
+                    f"{cls.__name__}.{f.name} missing from stored record "
+                    "and has no default")
         post = getattr(obj, "__post_init__", None)
         if post is not None:
             import inspect

@@ -78,6 +78,10 @@ class TensorEngineConfig:
 @dataclass
 class SiloConfig:
     name: str = "silo"
+    # run a client gateway on this silo (reference: NodeConfiguration
+    # ProxyGatewayEndpoint — silos without one don't accept clients and
+    # are not advertised by gateway list providers)
+    gateway_enabled: bool = True
     liveness: LivenessConfig = field(default_factory=LivenessConfig)
     directory: DirectoryConfig = field(default_factory=DirectoryConfig)
     collection: CollectionConfig = field(default_factory=CollectionConfig)

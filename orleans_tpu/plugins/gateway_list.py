@@ -1,0 +1,64 @@
+"""Gateway list providers: how an out-of-cluster client discovers live
+gateway silos.
+
+Parity: reference IGatewayListProvider (reference:
+src/Orleans/Messaging/IGatewayListProvider.cs) and its implementations —
+a static config list (reference: ClientConfiguration gateway list), and a
+membership-table-backed provider (reference:
+src/OrleansAzureUtils/AzureGatewayListProvider.cs:35,
+src/OrleansSQLUtils/SqlMembershipTable.cs gateway query) where live
+gateways are the ACTIVE rows of the membership table.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from orleans_tpu.ids import SiloAddress
+from orleans_tpu.runtime.membership import MembershipEntry, SiloStatus
+
+
+class GatewayListProvider:
+    """Contract (reference: IGatewayListProvider.cs — GetGateways +
+    MaxStaleness + IsUpdatable)."""
+
+    #: seconds a cached copy of the list may be served before re-reading
+    max_staleness: float = 1.0
+    #: False for fixed lists (clients need not poll)
+    is_updatable: bool = True
+
+    async def get_gateways(self) -> List[SiloAddress]:
+        raise NotImplementedError
+
+
+class StaticGatewayListProvider(GatewayListProvider):
+    """Fixed gateway list from config (reference: ClientConfiguration's
+    <Gateway Address=.../> elements)."""
+
+    is_updatable = False
+
+    def __init__(self, gateways: Sequence[SiloAddress]) -> None:
+        self._gateways = list(gateways)
+
+    async def get_gateways(self) -> List[SiloAddress]:
+        return list(self._gateways)
+
+
+class MembershipGatewayListProvider(GatewayListProvider):
+    """Live gateways = ACTIVE membership rows that advertise a proxy port
+    (reference: AzureGatewayListProvider.cs:35 — the membership table doubles
+    as the gateway registry; rows with ProxyPort != 0 are gateways)."""
+
+    def __init__(self, membership_table, max_staleness: float = 1.0) -> None:
+        self._table = membership_table
+        self.max_staleness = max_staleness
+
+    async def get_gateways(self) -> List[SiloAddress]:
+        snapshot, _version = await self._table.read_all()
+        out: List[SiloAddress] = []
+        for silo, (entry, _etag) in snapshot.items():
+            assert isinstance(entry, MembershipEntry)
+            if entry.status == SiloStatus.ACTIVE \
+                    and getattr(entry, "proxy_port", 0):
+                out.append(silo)
+        return out

@@ -17,6 +17,7 @@ etags; remove requires the current one.
 from __future__ import annotations
 
 import sqlite3
+import uuid
 from dataclasses import replace
 from typing import Dict, List, Optional, Tuple
 
@@ -137,14 +138,15 @@ class SqliteReminderTable(ReminderTable):
         self._conn = sqlite3.connect(path)
         self._conn.executescript(_REMINDER_SCHEMA)
         self._conn.commit()
-        self._etag_counter = 0
 
     def close(self) -> None:
         self._conn.close()
 
     def _next_etag(self) -> str:
-        self._etag_counter += 1
-        return f"sq{self._etag_counter}"
+        # uuid, not a counter: a counter resets on process restart, so a
+        # stale etag held from a previous process could wrongly match a
+        # newer row and defeat the CAS discipline
+        return uuid.uuid4().hex
 
     async def read_row(self, grain_id: GrainId,
                        name: str) -> Optional[ReminderEntry]:

@@ -1,0 +1,93 @@
+"""Statistics publishers: periodic export of the silo metrics snapshot.
+
+Parity: reference statistics publication backends (reference:
+src/OrleansSQLUtils/SqlStatisticsPublisher.cs; Azure analogs
+StatsTableDataManager / SiloMetricsTableDataManager; periodic driver
+LogStatistics.cs:33,52).  A publisher receives the flattened
+``SiloMetrics.snapshot()`` dict at each reporting interval.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import time
+from typing import Dict, List, Tuple
+
+from orleans_tpu.tracing import TraceLogger
+
+
+class StatisticsPublisher:
+    """Contract (reference: IStatisticsPublisher / ISiloMetricsDataPublisher
+    — Init + ReportStats/ReportMetrics)."""
+
+    async def init(self, silo_name: str) -> None:  # noqa: B027 — optional
+        pass
+
+    async def report(self, silo_name: str,
+                     stats: Dict[str, float]) -> None:
+        raise NotImplementedError
+
+    async def close(self) -> None:  # noqa: B027 — optional hook
+        pass
+
+
+class LogStatisticsPublisher(StatisticsPublisher):
+    """Dump the snapshot to the trace log (reference: LogStatistics.cs:52
+    'DumpCounters' periodic log dump)."""
+
+    def __init__(self, logger: TraceLogger | None = None) -> None:
+        self.logger = logger or TraceLogger("stats")
+
+    async def report(self, silo_name: str,
+                     stats: Dict[str, float]) -> None:
+        self.logger.info(f"stats {silo_name}: "
+                         + json.dumps(stats, sort_keys=True, default=float))
+
+
+class SqliteStatisticsPublisher(StatisticsPublisher):
+    """Append snapshots to a sqlite table — the SQL stats backend analog
+    (reference: SqlStatisticsPublisher.cs, CreateOrleansTables DDL's
+    OrleansStatisticsTable)."""
+
+    _SCHEMA = """
+    CREATE TABLE IF NOT EXISTS silo_statistics (
+        id        INTEGER PRIMARY KEY AUTOINCREMENT,
+        time      REAL NOT NULL,
+        silo_name TEXT NOT NULL,
+        stat_name TEXT NOT NULL,
+        value     REAL NOT NULL
+    );
+    """
+
+    def __init__(self, path: str = ":memory:") -> None:
+        self._conn = sqlite3.connect(path)
+        self._conn.executescript(self._SCHEMA)
+        self._conn.commit()
+
+    async def report(self, silo_name: str,
+                     stats: Dict[str, float]) -> None:
+        now = time.time()
+        self._conn.executemany(
+            "INSERT INTO silo_statistics (time, silo_name, stat_name, value) "
+            "VALUES (?,?,?,?)",
+            [(now, silo_name, k, float(v)) for k, v in stats.items()
+             if isinstance(v, (int, float))])
+        self._conn.commit()
+
+    def rows(self, silo_name: str | None = None
+             ) -> List[Tuple[float, str, str, float]]:
+        """Read back published rows (test/ops surface)."""
+        if silo_name is None:
+            cur = self._conn.execute(
+                "SELECT time, silo_name, stat_name, value "
+                "FROM silo_statistics ORDER BY id")
+        else:
+            cur = self._conn.execute(
+                "SELECT time, silo_name, stat_name, value "
+                "FROM silo_statistics WHERE silo_name=? ORDER BY id",
+                (silo_name,))
+        return cur.fetchall()
+
+    async def close(self) -> None:
+        self._conn.close()

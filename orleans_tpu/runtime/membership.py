@@ -49,6 +49,10 @@ class MembershipEntry:
     suspect_times: List[Tuple[SiloAddress, float]] = field(default_factory=list)
     iam_alive_time: float = 0.0
     start_time: float = 0.0
+    # nonzero when this silo runs a client gateway — the membership table
+    # doubles as the gateway registry (reference: MembershipEntry.ProxyPort,
+    # consumed by AzureGatewayListProvider.cs:35)
+    proxy_port: int = 0
 
     def fresh_votes(self, now: float, expiration: float
                     ) -> List[Tuple[SiloAddress, float]]:
@@ -244,9 +248,16 @@ class MembershipOracle:
             existing = snapshot.get(self.silo.address)
             try:
                 if existing is None:
+                    has_gateway = "gateway" in getattr(
+                        self.silo, "system_targets", {})
+                    # real listen port when there is one; 1 is the
+                    # "in-process gateway" sentinel for port-0 test silos
+                    # (the filter only needs nonzero = is-a-gateway)
                     await self.table.insert_row(MembershipEntry(
                         silo=self.silo.address, status=status,
-                        iam_alive_time=now, start_time=now), version)
+                        iam_alive_time=now, start_time=now,
+                        proxy_port=(self.silo.address.port or 1)
+                        if has_gateway else 0), version)
                 else:
                     entry, etag = existing
                     entry.status = status
@@ -284,9 +295,13 @@ class MembershipOracle:
 
     async def _probe_one(self, target: SiloAddress) -> None:
         try:
-            await self.silo.system_rpc(target, "membership", "ping",
-                                       (self.silo.address,),
-                                       timeout=self.config.probe_timeout)
+            alive = await self.silo.system_rpc(
+                target, "membership", "ping", (self.silo.address,),
+                timeout=self.config.probe_timeout)
+            # ping answers False when the target is not ACTIVE (e.g. already
+            # shutting down) — a reply alone is not proof of liveness
+            if not alive:
+                raise RuntimeError(f"{target} answered not-active")
             self._missed_probes[target] = 0
         except Exception:
             missed = self._missed_probes.get(target, 0) + 1

@@ -134,8 +134,9 @@ class Silo:
         self.system_targets: Dict[str, Any] = {}
         self.register_system_target("directory",
                                     RemoteGrainDirectory(self.grain_directory))
-        from orleans_tpu.runtime.gateway import Gateway
-        self.register_system_target("gateway", Gateway(self))
+        if self.config.gateway_enabled:
+            from orleans_tpu.runtime.gateway import Gateway
+            self.register_system_target("gateway", Gateway(self))
         self.register_system_target("catalog", _CatalogTarget(self))
 
         # identity for calls made from non-grain contexts attached to this

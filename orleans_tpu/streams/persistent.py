@@ -257,6 +257,7 @@ class PullingAgent:
         p = self.provider
         delivered_up_to = -1
         attempts = 0  # failed delivery tries for the current retry head
+        retry_at = 0.0  # backoff gate for the retry head
         while True:
             try:
                 space = self.cache.free_space
@@ -266,11 +267,21 @@ class PullingAgent:
                     self.cache.add(msgs)  # dedup by seq
                 progressed = False
                 for m in self.cache.window(delivered_up_to + 1):
+                    if attempts and time.monotonic() < retry_at:
+                        break  # backing off before redelivering the head
                     ok = await self._deliver(m)
                     if not ok:
                         attempts += 1
                         if attempts < p.max_delivery_attempts:
-                            # stays cached and un-acked; retried next loop
+                            # stays cached and un-acked; exponential backoff
+                            # so the total retry window outlasts
+                            # directory/membership healing after a silo
+                            # death — retrying only every pull_period would
+                            # hit the poison cap in ~0.1s and drop events
+                            # during ordinary failover
+                            retry_at = time.monotonic() + min(
+                                p.retry_backoff_initial * (2 ** (attempts - 1)),
+                                p.retry_backoff_max)
                             break
                         self.logger.warn(
                             f"dropping seq={m.seq} on {m.stream_id} after "
@@ -393,7 +404,9 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
                  batch_size: int = 64,
                  cache_size: int = 1024,
                  consumer_cache_ttl: float = 1.0,
-                 max_delivery_attempts: int = 3) -> None:
+                 max_delivery_attempts: int = 8,
+                 retry_backoff_initial: float = 0.1,
+                 retry_backoff_max: float = 2.0) -> None:
         self.adapter = adapter
         self.mapper = HashRingStreamQueueMapper(adapter.n_queues)
         self.pull_period = pull_period
@@ -401,6 +414,8 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
         self.cache_size = cache_size
         self.consumer_cache_ttl = consumer_cache_ttl
         self.max_delivery_attempts = max_delivery_attempts
+        self.retry_backoff_initial = retry_backoff_initial
+        self.retry_backoff_max = retry_backoff_max
         self._balancer_cls = balancer_cls
         self.name = "persistent"
         self.silo = None

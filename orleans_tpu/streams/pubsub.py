@@ -69,21 +69,59 @@ class IPubSubRendezvous:
     async def consumer_count(self, stream_id) -> int: ...
 
 
+#: name of the storage provider backing pub/sub state when configured
+#: (reference: PubSubRendezvousGrain's [StorageProvider(ProviderName=
+#: "PubSubStore")] — without it, subscriptions die with the silo hosting
+#: the rendezvous grain and failover redeliveries resolve an empty
+#: consumer list)
+PUBSUB_STORE = "PubSubStore"
+
+
 @grain_class
 class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
     """Holds (producers, consumers) for ONE stream — the grain's string key
     is the stream's pubsub key, so pub/sub state shards across the cluster
     with ordinary grain placement (reference: PubSubRendezvousGrain.cs:41).
 
-    State is in-memory like every non-persistent grain; the reference
-    optionally persists pub/sub state via a storage provider ("PubSubStore")
-    — resumable here by making this a StatefulGrain with that provider.
+    When the hosting silo configures a ``PubSubStore`` storage provider,
+    subscription state is written through it on every change and re-read
+    when the grain re-activates after its silo dies — so queue-backed
+    stream redelivery after failover still finds the consumer set
+    (reference: PubSubRendezvousGrain.cs State + WriteStateAsync calls).
+    Without the provider, state is in-memory (reference default).
     """
 
     def __init__(self) -> None:
         self.producers: Set[GrainId] = set()
         # subscription_id → handle
         self.consumer_subs: Dict[int, StreamSubscriptionHandle] = {}
+        self._bridge = None
+
+    # -- persistence (reference: PubSubRendezvousGrain.cs State) ------------
+
+    async def on_activate(self) -> None:
+        silo = getattr(self._activation.runtime, "silo", None)
+        provider = None
+        if silo is not None:
+            provider = silo.storage_providers.get(PUBSUB_STORE)
+        if provider is None:
+            return
+        from orleans_tpu.runtime.storage import GrainStateStorageBridge
+        self._bridge = GrainStateStorageBridge(
+            grain_type=type(self).__name__, grain_id=self.grain_id,
+            provider=provider)
+        await self._bridge.read_state()
+        saved = self._bridge.state
+        if saved:
+            self.producers = set(saved.get("producers", ()))
+            self.consumer_subs = dict(saved.get("consumer_subs", {}))
+
+    async def _save(self) -> None:
+        if self._bridge is None:
+            return
+        self._bridge.state = {"producers": set(self.producers),
+                              "consumer_subs": dict(self.consumer_subs)}
+        await self._bridge.write_state()
 
     # -- producers ----------------------------------------------------------
 
@@ -91,21 +129,28 @@ class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
                                 producer: GrainId) -> list:
         """Returns the current consumer list (explicit + implicit) so the
         producer can seed its cache."""
-        self.producers.add(producer)
+        if producer not in self.producers:
+            self.producers.add(producer)
+            await self._save()
         return self._consumer_list(stream_id)
 
     async def unregister_producer(self, stream_id: StreamId,
                                   producer: GrainId) -> None:
-        self.producers.discard(producer)
+        if producer in self.producers:
+            self.producers.discard(producer)
+            await self._save()
 
     # -- consumers ----------------------------------------------------------
 
     async def register_consumer(self, handle: StreamSubscriptionHandle) -> None:
         self.consumer_subs[handle.subscription_id] = handle
+        await self._save()
         await self._notify_producers(handle.stream_id)
 
     async def unregister_consumer(self, handle: StreamSubscriptionHandle) -> None:
-        self.consumer_subs.pop(handle.subscription_id, None)
+        if self.consumer_subs.pop(handle.subscription_id, None) is None:
+            return  # duplicate/late unsubscribe — no write, no fan-out
+        await self._save()
         await self._notify_producers(handle.stream_id)
 
     async def consumers(self, stream_id: StreamId) -> list:
@@ -151,3 +196,5 @@ class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
                 dead.append(producer)
         for p in dead:
             self.producers.discard(p)
+        if dead:
+            await self._save()

@@ -91,8 +91,17 @@ class SimpleMessageStreamProvider(PubSubStreamProviderMixin):
                 # push handler reports ProducerNotRegistered and the
                 # rendezvous prunes the producer it just registered)
                 cache[stream_id] = None
-                consumers = await self._pubsub(stream_id).register_producer(
-                    stream_id, act.grain_id)
+                try:
+                    consumers = await self._pubsub(stream_id).register_producer(
+                        stream_id, act.grain_id)
+                except BaseException:
+                    # registration failed (timeout/rejection): drop the
+                    # pre-mark sentinel so the next produce retries — a
+                    # lingering None would make every later produce skip
+                    # registration and deliver to nobody
+                    if cache.get(stream_id, 0) is None:
+                        cache.pop(stream_id, None)
+                    raise
                 if cache.get(stream_id) is None:  # no push won the race
                     cache[stream_id] = consumers
             seqs = getattr(inst, "_stream_seq", None)

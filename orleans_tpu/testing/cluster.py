@@ -44,6 +44,10 @@ class TestingCluster:
         # ReminderTableGrain / shared in-proc stores)
         self.reminder_table = InMemoryReminderTable()
         self.storage_backing = MemoryStorage.shared_backing()
+        # durable pub/sub state so stream subscriptions survive the death
+        # of the silo hosting a rendezvous grain (reference: the test
+        # clusters' "PubSubStore" provider block)
+        self.pubsub_backing = MemoryStorage.shared_backing()
         self.silos: List[Silo] = []
         self._counter = 0
 
@@ -73,7 +77,10 @@ class TestingCluster:
             name = f"silo{self._counter}"
         silo = Silo(
             config=self.config_factory(name),
-            storage_providers={"Default": MemoryStorage(self.storage_backing)},
+            storage_providers={
+                "Default": MemoryStorage(self.storage_backing),
+                "PubSubStore": MemoryStorage(self.pubsub_backing),
+            },
             fabric=self.fabric,
             membership_table=self.table,
             reminder_table=self.reminder_table,

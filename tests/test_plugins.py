@@ -1,0 +1,154 @@
+"""Backend plugin tests: sqlite system tables, gateway list providers,
+statistics publishers (reference analog: TesterInternal/MembershipTests/
+MembershipTablePluginTests.cs — same contract suite run per backend)."""
+
+from __future__ import annotations
+
+import orleans_tpu.plugins as plugins
+from orleans_tpu.ids import GrainId, SiloAddress
+from orleans_tpu.plugins import (
+    LogStatisticsPublisher,
+    MembershipGatewayListProvider,
+    SqliteMembershipTable,
+    SqliteReminderTable,
+    SqliteStatisticsPublisher,
+    StaticGatewayListProvider,
+)
+from orleans_tpu.runtime.membership import (
+    CasConflictError,
+    InMemoryMembershipTable,
+    MembershipEntry,
+    SiloStatus,
+)
+from orleans_tpu.runtime.reminders import ReminderEntry
+
+
+def test_plugins_package_exports():
+    for name in plugins.__all__:
+        assert getattr(plugins, name) is not None
+
+
+def _silo(n: int) -> SiloAddress:
+    return SiloAddress.new_local(host=f"s{n}", port=n)
+
+
+def _membership_contract(run, table):
+    async def go():
+        snap, version = await table.read_all()
+        assert snap == {} and version == 0
+        a = MembershipEntry(silo=_silo(1), status=SiloStatus.ACTIVE,
+                            iam_alive_time=1.0, start_time=1.0, proxy_port=7)
+        await table.insert_row(a, version)
+        snap, version = await table.read_all()
+        (entry, etag), = [snap[a.silo]]
+        assert entry.status == SiloStatus.ACTIVE and entry.proxy_port == 7
+
+        # stale table version → CAS conflict
+        b = MembershipEntry(silo=_silo(2), status=SiloStatus.JOINING)
+        try:
+            await table.insert_row(b, version - 1)
+            raise AssertionError("stale-version insert must fail")
+        except CasConflictError:
+            pass
+        await table.insert_row(b, version)
+
+        # row CAS: update with stale etag fails
+        snap, version = await table.read_all()
+        entry, etag = snap[a.silo]
+        entry.status = SiloStatus.DEAD
+        await table.update_row(entry, etag, version)
+        snap, version2 = await table.read_all()
+        try:
+            await table.update_row(entry, etag, version2)
+            raise AssertionError("stale-etag update must fail")
+        except CasConflictError:
+            pass
+
+        # heartbeat is CAS-free and persists
+        await table.update_iam_alive(b.silo, 42.0)
+        snap, _ = await table.read_all()
+        assert snap[b.silo][0].iam_alive_time == 42.0
+
+    run(go())
+
+
+def test_sqlite_membership_table_contract(run):
+    _membership_contract(run, SqliteMembershipTable())
+
+
+def test_in_memory_membership_table_contract(run):
+    _membership_contract(run, InMemoryMembershipTable())
+
+
+def test_sqlite_reminder_table_contract(run, tmp_path):
+    async def go():
+        path = str(tmp_path / "reminders.db")
+        table = SqliteReminderTable(path)
+        gid = GrainId.from_int(1234, 42)
+        assert await table.read_row(gid, "r1") is None
+        etag = await table.upsert_row(
+            ReminderEntry(grain_id=gid, name="r1", start_at=1.0, period=2.0))
+        row = await table.read_row(gid, "r1")
+        assert row.etag == etag and row.period == 2.0
+        etag2 = await table.upsert_row(
+            ReminderEntry(grain_id=gid, name="r1", start_at=1.0, period=3.0))
+        assert etag2 != etag
+        assert not await table.remove_row(gid, "r1", etag)
+
+        # etags survive a process restart without repeating: a fresh table
+        # over the same file mints etags that cannot collide with old ones
+        table.close()
+        table = SqliteReminderTable(path)
+        etag3 = await table.upsert_row(
+            ReminderEntry(grain_id=gid, name="r2", start_at=0.0, period=1.0))
+        assert etag3 not in (etag, etag2)
+        assert not await table.remove_row(gid, "r1", etag)  # stale stays stale
+        assert await table.remove_row(gid, "r1", etag2)
+        assert [r.name for r in await table.read_rows(gid)] == ["r2"]
+        table.close()
+
+    run(go())
+
+
+def test_static_gateway_list_provider(run):
+    async def go():
+        gws = [_silo(1), _silo(2)]
+        provider = StaticGatewayListProvider(gws)
+        assert await provider.get_gateways() == gws
+        assert not provider.is_updatable
+
+    run(go())
+
+
+def test_membership_gateway_list_provider(run):
+    async def go():
+        live_gw, plain, dead_gw = _silo(1), _silo(2), _silo(3)
+        table = SqliteMembershipTable()
+        _, version = await table.read_all()
+        await table.insert_row(MembershipEntry(
+            silo=live_gw, status=SiloStatus.ACTIVE, proxy_port=101), version)
+        _, version = await table.read_all()
+        await table.insert_row(MembershipEntry(  # no gateway
+            silo=plain, status=SiloStatus.ACTIVE, proxy_port=0), version)
+        _, version = await table.read_all()
+        await table.insert_row(MembershipEntry(  # dead gateway
+            silo=dead_gw, status=SiloStatus.DEAD, proxy_port=103), version)
+        provider = MembershipGatewayListProvider(table)
+        assert await provider.get_gateways() == [live_gw]
+
+    run(go())
+
+
+def test_stats_publishers(run):
+    async def go():
+        sink = SqliteStatisticsPublisher()
+        await sink.report("silo1", {"messages_sent": 5, "p99": 0.25})
+        await sink.report("silo2", {"messages_sent": 2})
+        names = {(silo, stat) for _, silo, stat, _ in sink.rows()}
+        assert ("silo1", "messages_sent") in names
+        assert ("silo2", "messages_sent") in names
+        assert [v for _, s, k, v in sink.rows("silo1") if k == "p99"] == [0.25]
+        await sink.close()
+        await LogStatisticsPublisher().report("silo1", {"x": 1})
+
+    run(go())

@@ -312,6 +312,67 @@ def test_persistent_stream_multi_silo_and_rebalance(run):
     run(go())
 
 
+def test_pubsub_state_survives_rendezvous_silo_death(run):
+    """The rendezvous grain's subscription state is written through the
+    PubSubStore provider, so when the silo hosting it is hard-killed the
+    re-activated rendezvous still knows the consumers and queued events
+    keep flowing (reference: PubSubRendezvousGrain's persisted State via
+    the PubSubStore storage provider)."""
+
+    async def go():
+        from orleans_tpu.core.factory import factory
+        from orleans_tpu.streams.pubsub import IPubSubRendezvous
+
+        backing = InMemoryQueueAdapter.shared_backing()
+
+        def setup(silo):
+            silo.add_stream_provider("pq", PersistentStreamProvider(
+                InMemoryQueueAdapter(n_queues=8, backing=backing),
+                pull_period=0.01, consumer_cache_ttl=0.0))
+
+        cluster = TestingCluster(n_silos=3, silo_setup=setup)
+        await cluster.start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            f = cluster.attach_client(0)
+            c = f.get_grain(IStreamConsumerGrain, 31)
+            await c.join("pq", "events", "k2")
+            producer = f.get_grain(IStreamProducerGrain, 6)
+            await producer.produce("pq", "events", "k2", ["a"])
+
+            async def until(n):
+                while len(await c.received()) < n:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(until(1), timeout=5.0)
+
+            # find and kill the silo hosting the rendezvous grain
+            stream_id = cluster.silos[0].stream_provider(
+                "pq").get_stream("events", "k2").stream_id
+            pubsub_id = factory.get_grain(
+                IPubSubRendezvous, stream_id.pubsub_key()).grain_id
+            host = next(s for s in cluster.silos
+                        if s.catalog.directory.by_grain.get(pubsub_id))
+            consumer_died = bool(
+                host.catalog.directory.by_grain.get(c.grain_id))
+            cluster.kill_silo(host)
+            await cluster.wait_for_liveness_convergence()
+            if consumer_died:
+                f = cluster.attach_client(0)
+                c = f.get_grain(IStreamConsumerGrain, 31)
+                await c.join("pq", "events", "k2")
+
+            before = len(await c.received())
+            await producer.produce("pq", "events", "k2", ["b", "c"])
+            await asyncio.wait_for(until(before + 2), timeout=10.0)
+            items = [i for i, _ in await c.received()]
+            assert items[-2:] == ["b", "c"]
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
 def test_consumer_resumes_after_deactivation(run):
     """Durable subscription state lives in pub/sub; a reactivated consumer
     without a resumed handle surfaces the unresumed-delivery fault unless
