@@ -68,6 +68,12 @@ class TensorEngineConfig:
     enabled: bool = True
     tick_interval: float = 0.001          # min seconds between ticks
     max_rounds_per_tick: int = 4          # intra-tick call-chain rounds
+    # tensor-path activation collection (reference: ActivationCollector
+    # quantum + age limit): rows idle > collection_idle_ticks are evicted
+    # (written back when a store is attached) every collection_every_ticks.
+    # 0 disables automatic sweeps (collect_idle() remains callable).
+    collection_idle_ticks: int = 0
+    collection_every_ticks: int = 64
     bucket_sizes: tuple = (256, 4096, 65536, 1 << 20)  # padded batch buckets
     mesh_axis: str = "grains"
     # max parked optimistic miss-checks before a forced (synchronizing)

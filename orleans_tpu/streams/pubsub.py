@@ -119,9 +119,27 @@ class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
     async def _save(self) -> None:
         if self._bridge is None:
             return
+        from orleans_tpu.runtime.storage import InconsistentStateError
         self._bridge.state = {"producers": set(self.producers),
                               "consumer_subs": dict(self.consumer_subs)}
-        await self._bridge.write_state()
+        try:
+            await self._bridge.write_state()
+        except InconsistentStateError:
+            # another activation of this rendezvous won a write race
+            # (transient duplicate during failover).  Re-read to refresh
+            # the etag, then retry once with our view — without this the
+            # stale etag makes every later save fail for the activation's
+            # lifetime.  A second conflict means the duplicate is live and
+            # racing: step aside like the reference (deactivate so the
+            # directory converges on one activation).
+            data = self._bridge.state
+            await self._bridge.read_state()
+            self._bridge.state = data
+            try:
+                await self._bridge.write_state()
+            except InconsistentStateError:
+                self.deactivate_on_idle()
+                raise
 
     # -- producers ----------------------------------------------------------
 

@@ -27,8 +27,18 @@ from orleans_tpu.tensor.vector_grain import (
     vector_grain,
 )
 from orleans_tpu.tensor.engine import TensorEngine
+from orleans_tpu.tensor.persistence import (
+    FileVectorStore,
+    MemoryVectorStore,
+    StorageProviderVectorStore,
+    VectorStore,
+)
 
 __all__ = [
+    "FileVectorStore",
+    "MemoryVectorStore",
+    "StorageProviderVectorStore",
+    "VectorStore",
     "Batch",
     "Emit",
     "VectorGrain",

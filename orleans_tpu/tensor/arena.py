@@ -30,6 +30,11 @@ class ArenaFullError(RuntimeError):
     pass
 
 
+@jax.jit
+def _touch_kernel(last_use_dev, rows, tick):
+    return last_use_dev.at[rows].max(tick, mode="drop")
+
+
 def _hash_keys_u64(keys: np.ndarray) -> np.ndarray:
     """Vectorized splitmix64 matching hashing.stable_hash_u64, so host row
     assignment and any device-side bucketing agree."""
@@ -44,8 +49,15 @@ def _hash_keys_u64(keys: np.ndarray) -> np.ndarray:
 class GrainArena:
 
     def __init__(self, info: VectorGrainInfo, capacity: int = 1024,
-                 n_shards: int = 1, sharding: Optional[Any] = None) -> None:
+                 n_shards: int = 1, sharding: Optional[Any] = None,
+                 store: Optional[Any] = None) -> None:
         self.info = info
+        # VectorStore (tensor/persistence.py): activation reads persisted
+        # rows (stage-2 analog, reference: Catalog.cs:731), eviction and
+        # checkpoint write them back
+        self.store = store
+        self.evicted_count = 0
+        self.restored_count = 0
         self.n_shards = max(1, n_shards)
         # capacity must divide evenly into shard blocks
         per_shard = max(1, -(-capacity // self.n_shards))
@@ -66,7 +78,13 @@ class GrainArena:
         self._sorted_rows = np.empty(0, dtype=np.int32)
         self._dirty = False
         self.live_count = 0
+        # host-side last use: updated by host-key resolution
         self.last_use_tick = np.zeros(self.capacity, dtype=np.int64)
+        # device-side last use: updated by the engine for device-routed
+        # batches (injector fast path, emit hits) with a scatter-max —
+        # those never cross to the host, so a host-only clock would see
+        # hot rows as idle and evict live state.  Collection merges both.
+        self.last_use_dev = self._dev_zeros_i32(self.capacity)
 
         # device-side directory mirror (int32 keys only — see device_resolve):
         # lets emit routing resolve key→row without any host round-trip,
@@ -82,6 +100,23 @@ class GrainArena:
         if self.sharding is not None:
             col = jax.device_put(col, self.sharding)
         return col
+
+    def _dev_zeros_i32(self, capacity: int) -> jnp.ndarray:
+        z = jnp.zeros(capacity, dtype=jnp.int32)
+        if self.sharding is not None:
+            z = jax.device_put(z, self.sharding)
+        return z
+
+    def touch_rows_dev(self, rows: jnp.ndarray, tick: int) -> None:
+        """Record device-routed traffic for collection (scatter-max, stays
+        on device; padding rows -1 dropped)."""
+        self.last_use_dev = _touch_kernel(self.last_use_dev, rows,
+                                          jnp.int32(tick))
+
+    def effective_last_use(self) -> np.ndarray:
+        """Merge the host and device use clocks (collection-time only)."""
+        return np.maximum(self.last_use_tick,
+                          np.asarray(self.last_use_dev, dtype=np.int64))
 
     def _init_state_columns(self, capacity: int) -> None:
         self.state = {name: self._make_column(f, capacity)
@@ -185,6 +220,26 @@ class GrainArena:
             self._shard_next[s] += len(ks)
         self.live_count += len(keys)
         self._dirty = True
+        if self.store is not None:
+            self._load_persisted(keys)
+
+    def _load_persisted(self, keys: np.ndarray) -> None:
+        """Activation stage 2, batched: scatter persisted rows (previously
+        evicted or checkpointed) into the freshly allocated slots
+        (reference: Catalog.SetupActivationState :731)."""
+        stored = self.store.read_many(self.info.name, keys.tolist())
+        if not stored:
+            return
+        found = np.array(sorted(stored), dtype=np.int64)
+        rows, ok = self.lookup_rows(found)
+        assert ok.all()
+        dst = jnp.asarray(rows, dtype=jnp.int32)
+        for name, f in self.info.state_fields.items():
+            vals = np.stack([np.asarray(stored[int(k)][name], dtype=f.dtype)
+                             for k in found])
+            self.state[name] = self.state[name].at[dst].set(
+                jnp.asarray(vals))
+        self.restored_count += len(found)
 
     # -- growth -------------------------------------------------------------
 
@@ -211,6 +266,8 @@ class GrainArena:
             col = self._make_column(f, new_capacity)
             col = col.at[dst].set(self.state[name][idx])
             new_state[name] = col
+        self.last_use_dev = self._dev_zeros_i32(new_capacity).at[dst].set(
+            self.last_use_dev[idx])
 
         self.state = new_state
         self.shard_capacity = new_per
@@ -225,6 +282,155 @@ class GrainArena:
         per_shard_target = -(-n // self.n_shards)
         while self.shard_capacity < per_shard_target * 2:
             self._grow()
+
+    # -- collection (reference: ActivationCollector.cs:37) -------------------
+
+    def rows_to_host(self, rows: np.ndarray) -> Dict[str, np.ndarray]:
+        """Gather the given rows' state columns to host, one d2h per field."""
+        idx = jnp.asarray(rows, dtype=jnp.int32)
+        return {name: np.asarray(col[idx])
+                for name, col in self.state.items()}
+
+    def collect(self, older_than_tick: int, write_back: bool = True) -> int:
+        """Deactivate rows idle since before ``older_than_tick`` and compact
+        the freed space — the tensor-path activation collector: the
+        reference buckets activations by last-use quantum and deactivates
+        whole buckets (reference: ActivationCollector.cs:37, age-based
+        DeactivateActivations Catalog.cs:836); here the bucket test is one
+        vectorized compare over ``last_use_tick``.
+
+        With a store and ``write_back``, victim rows are written through
+        the storage bridge first, so a later message to an evicted grain
+        re-activates it with its state (the deactivate→storage→reactivate
+        cycle of the reference).  Returns the number of rows evicted."""
+        live = self._key_of_row >= 0
+        victims = np.nonzero(
+            live & (self.effective_last_use() < older_than_tick))[0]
+        if len(victims) == 0:
+            return 0
+        keys = self._key_of_row[victims]
+        if write_back and self.store is not None:
+            host = self.rows_to_host(victims)
+            rows_list = [{n: host[n][i] for n in host}
+                         for i in range(len(victims))]
+            self.store.write_many(self.info.name, keys.tolist(), rows_list)
+        self._key_of_row[victims] = -1
+        self.live_count -= len(victims)
+        self.evicted_count += len(victims)
+        self._dirty = True
+        self._compact()
+        return len(victims)
+
+    def _compact(self) -> None:
+        """Repack each shard block so live rows are contiguous from the
+        block base (freed slots return to the allocator's bump pointer).
+        Rows move → generation bump; holders re-resolve."""
+        old_rows = np.nonzero(self._key_of_row >= 0)[0]
+        shards = old_rows // self.shard_capacity
+        new_rows = np.empty_like(old_rows)
+        next_free = np.zeros(self.n_shards, dtype=np.int64)
+        for s in range(self.n_shards):
+            in_s = shards == s
+            k = int(in_s.sum())
+            base = s * self.shard_capacity
+            new_rows[in_s] = base + np.arange(k)
+            next_free[s] = k
+
+        keys = self._key_of_row[old_rows]
+        last_use = self.last_use_tick[old_rows]
+        self._key_of_row.fill(-1)
+        self._key_of_row[new_rows] = keys
+        self.last_use_tick.fill(0)
+        self.last_use_tick[new_rows] = last_use
+        self._shard_next = next_free
+
+        idx = jnp.asarray(old_rows, dtype=jnp.int32)
+        dst = jnp.asarray(new_rows, dtype=jnp.int32)
+        for name, f in self.info.state_fields.items():
+            col = self._make_column(f, self.capacity)
+            self.state[name] = col.at[dst].set(self.state[name][idx])
+        self.last_use_dev = self._dev_zeros_i32(self.capacity).at[dst].set(
+            self.last_use_dev[idx])
+        self._dirty = True
+        self.generation += 1
+
+    # -- elasticity (reference: GrainDirectoryHandoffManager.cs:141) ---------
+
+    def reshard(self, n_shards: int, sharding: Optional[Any] = None) -> None:
+        """Re-lay the arena over a different shard count/mesh — the
+        tensor-path directory handoff: on membership/mesh change the
+        reference merges the dead silo's directory partition into its ring
+        successors (reference: GrainDirectoryHandoffManager.cs:141,
+        ProcessSiloRemoveEvent); here every row's owner is recomputed from
+        the same stable key hash and the state gathers to its new block in
+        one scatter per column."""
+        live_rows = np.nonzero(self._key_of_row >= 0)[0]
+        keys = self._key_of_row[live_rows]
+        last_use = self.effective_last_use()[live_rows]
+        host_state = self.rows_to_host(live_rows) if len(live_rows) else {}
+
+        self.n_shards = max(1, n_shards)
+        self.sharding = sharding
+        per_shard = max(1, -(-max(self.capacity, len(keys) * 2)
+                             // self.n_shards))
+        self.shard_capacity = per_shard
+        self.capacity = per_shard * self.n_shards
+        self._key_of_row = np.full(self.capacity, -1, dtype=np.int64)
+        self._shard_next = np.zeros(self.n_shards, dtype=np.int64)
+        self.last_use_tick = np.zeros(self.capacity, dtype=np.int64)
+        self.live_count = 0
+        self._dirty = True
+        self._dev_index_stale = True
+        self._dev_sorted_keys = None
+        self._dev_sorted_rows = None
+        self._init_state_columns(self.capacity)
+        self.last_use_dev = self._dev_zeros_i32(self.capacity)
+
+        if len(keys):
+            store = self.store
+            self.store = None  # re-placement is a move, not a re-activation
+            try:
+                self._activate_keys(keys)
+            finally:
+                self.store = store
+            rows, ok = self.lookup_rows(keys)
+            assert ok.all()
+            dst = jnp.asarray(rows, dtype=jnp.int32)
+            for name, f in self.info.state_fields.items():
+                self.state[name] = self.state[name].at[dst].set(
+                    jnp.asarray(host_state[name]))
+            self.last_use_tick[rows] = last_use
+        self.generation += 1
+
+    # -- checkpoint (tick-consistent full-arena write) -----------------------
+
+    def checkpoint(self) -> int:
+        """Write every live row through the store — with the engine
+        quiesced this is a tick-consistent snapshot of the whole arena,
+        stronger than the reference's per-grain-only writes (SURVEY §5
+        'checkpoint/resume') while keeping per-grain record granularity."""
+        if self.store is None:
+            raise RuntimeError(f"arena {self.info.name} has no store")
+        live_rows = np.nonzero(self._key_of_row >= 0)[0]
+        if len(live_rows) == 0:
+            return 0
+        keys = self._key_of_row[live_rows]
+        host = self.rows_to_host(live_rows)
+        rows_list = [{n: host[n][i] for n in host}
+                     for i in range(len(live_rows))]
+        self.store.write_many(self.info.name, keys.tolist(), rows_list)
+        return len(live_rows)
+
+    def restore_from_store(self) -> int:
+        """Activate (and load) every key the store holds for this type —
+        resume after a process restart."""
+        if self.store is None:
+            raise RuntimeError(f"arena {self.info.name} has no store")
+        keys = self.store.list_keys(self.info.name)
+        fresh = keys[~self.lookup_rows(keys)[1]] if len(keys) else keys
+        if len(fresh):
+            self._activate_keys(fresh)
+        return len(fresh)
 
     # -- host access (debug / persistence / host-path interop) --------------
 

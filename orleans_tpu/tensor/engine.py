@@ -121,21 +121,16 @@ class TensorEngine:
 
     def __init__(self, silo=None, config: Optional[TensorEngineConfig] = None,
                  mesh: Optional[jax.sharding.Mesh] = None,
-                 initial_capacity: int = 1024) -> None:
+                 initial_capacity: int = 1024,
+                 store: Optional[Any] = None) -> None:
         self.silo = silo
         self.config = config or TensorEngineConfig()
         self.mesh = mesh
         self.initial_capacity = initial_capacity
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-            self.n_shards = mesh.devices.size
-            self.sharding = NamedSharding(mesh,
-                                          PartitionSpec(self.config.mesh_axis))
-            self.replicated = NamedSharding(mesh, PartitionSpec())
-        else:
-            self.n_shards = 1
-            self.sharding = None
-            self.replicated = None
+        # VectorStore backing every arena (tensor/persistence.py):
+        # activation reads, eviction write-back, checkpoints
+        self.store = store
+        self._apply_mesh(mesh)
 
         self.arenas: Dict[str, GrainArena] = {}
         self.queues: Dict[Tuple[str, str], List[PendingBatch]] = defaultdict(list)
@@ -152,6 +147,19 @@ class TensorEngine:
         self._running = False
         self._wake: Optional[asyncio.Event] = None
 
+    def _apply_mesh(self, mesh: Optional[jax.sharding.Mesh]) -> None:
+        self.mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self.n_shards = mesh.devices.size
+            self.sharding = NamedSharding(mesh,
+                                          PartitionSpec(self.config.mesh_axis))
+            self.replicated = NamedSharding(mesh, PartitionSpec())
+        else:
+            self.n_shards = 1
+            self.sharding = None
+            self.replicated = None
+
     # ================= arenas =============================================
 
     def arena_for(self, type_name: str) -> GrainArena:
@@ -161,9 +169,52 @@ class TensorEngine:
             if info is None:
                 raise KeyError(f"{type_name!r} is not a @vector_grain type")
             arena = GrainArena(info, capacity=self.initial_capacity,
-                               n_shards=self.n_shards, sharding=self.sharding)
+                               n_shards=self.n_shards, sharding=self.sharding,
+                               store=self.store)
             self.arenas[type_name] = arena
         return arena
+
+    # ================= collection / elasticity / checkpoint ===============
+
+    def collect_idle(self, max_idle_ticks: int,
+                     write_back: bool = True) -> int:
+        """Deactivate rows idle for > max_idle_ticks across all arenas
+        (the age-based collector sweep, reference:
+        ActivationCollector.cs:37).  Runs between ticks."""
+        cutoff = self.tick_number - max_idle_ticks
+        return sum(a.collect(cutoff, write_back=write_back)
+                   for a in self.arenas.values())
+
+    async def reshard(self, mesh: Optional[jax.sharding.Mesh]) -> None:
+        """Re-lay every arena over a new mesh — the data-plane elasticity
+        event (a device/"silo" joining or leaving).  Quiesces in-flight
+        work first so the move is tick-consistent, then rebuilds each
+        arena's blocks by the stable key hash (reference analog: directory
+        handoff on membership change,
+        GrainDirectoryHandoffManager.cs:141)."""
+        await self.flush()
+        self._apply_mesh(mesh)
+        for arena in self.arenas.values():
+            arena.reshard(self.n_shards, self.sharding)
+        # sharded array shapes changed: compiled steps specialize on shard
+        # layout, so drop them and let jit re-trace on next use
+        self._step_cache.clear()
+
+    async def checkpoint(self) -> int:
+        """Tick-consistent snapshot: quiesce, then write every live row of
+        every arena through the store.  Returns rows written."""
+        await self.flush()
+        return sum(a.checkpoint() for a in self.arenas.values())
+
+    def restore(self, type_names: Optional[List[str]] = None) -> int:
+        """Re-activate all stored rows (process-restart resume).  With no
+        argument every registered @vector_grain type is tried — arenas are
+        created lazily, so the engine's own arena dict is empty right after
+        a restart and must not be the default."""
+        from orleans_tpu.tensor.vector_grain import all_vector_types
+        names = type_names if type_names is not None \
+            else list(all_vector_types())
+        return sum(self.arena_for(n).restore_from_store() for n in names)
 
     # ================= submission (the client/batch edge) =================
 
@@ -285,6 +336,10 @@ class TensorEngine:
         t0 = time.perf_counter()
         self.tick_number += 1
         self.ticks_run += 1
+        if (self.config.collection_idle_ticks
+                and self.config.collection_every_ticks > 0
+                and self.tick_number % self.config.collection_every_ticks == 0):
+            self.collect_idle(self.config.collection_idle_ticks)
         if len(self._pending_checks) >= self.config.miss_check_cap:
             # bound device memory pinned by parked optimistic checks
             self._drain_checks()
@@ -429,6 +484,11 @@ class TensorEngine:
                              else len(rows))
         new_state, results, emits = step(arena.state, rows, args, mask)
         arena.state = new_state
+        if not isinstance(rows, np.ndarray):
+            # device-routed batches (injector fast path, emit hits) never
+            # cross to the host, so record their traffic on the device-side
+            # use clock — otherwise collection would evict hot rows
+            arena.touch_rows_dev(rows, self.tick_number)
         self._route_emits(emits)
         if want_results:
             self._deliver_results(batches, results)
@@ -504,6 +564,8 @@ class TensorEngine:
                              if self.tick_seconds > 0 else 0.0),
             "activation_passes": self.activation_passes,
             "arenas": {name: a.live_count for name, a in self.arenas.items()},
+            "evicted": sum(a.evicted_count for a in self.arenas.values()),
+            "restored": sum(a.restored_count for a in self.arenas.values()),
         }
 
 

@@ -1,0 +1,219 @@
+"""Vector-grain persistence: the tensor-path storage bridge.
+
+The host path persists one grain at a time through async storage providers
+(orleans_tpu/runtime/storage.py — reference: GrainStateStorageBridge.cs,
+Catalog.SetupActivationState Catalog.cs:731).  The tensor path moves
+thousands of rows per operation (eviction sweeps, checkpoints, activation
+floods), so its bridge is a *bulk, synchronous* contract — ``VectorStore``
+— that the arena can call from inside a tick: read a batch of rows at
+activation (stage-2 analog), write a batch at eviction/checkpoint
+(WriteStateAsync analog), with per-grain record granularity preserved so
+state written by the tensor path is readable grain-by-grain.
+
+``StorageProviderVectorStore`` adapts any host-path ``StorageProvider``
+whose coroutines complete without real awaits (memory/file/sqlite — all
+bundled providers) so both paths share one store; natively-async backends
+implement ``VectorStore`` directly.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from orleans_tpu.ids import GrainId, type_code_of
+
+
+class VectorStore:
+    """Bulk per-row storage contract for vector-grain arenas.
+
+    Rows are keyed by ``(type_name, primary_key_int)``; each record is a
+    ``{field_name: np.ndarray}`` dict (one arena row).  All methods are
+    synchronous — they run inside the tick machine.
+    """
+
+    def read_many(self, type_name: str, keys: Iterable[int]
+                  ) -> Dict[int, Dict[str, np.ndarray]]:
+        """Return stored rows for the subset of ``keys`` that exist."""
+        raise NotImplementedError
+
+    def write_many(self, type_name: str, keys: Iterable[int],
+                   rows: List[Dict[str, np.ndarray]]) -> None:
+        raise NotImplementedError
+
+    def delete_many(self, type_name: str, keys: Iterable[int]) -> None:
+        raise NotImplementedError
+
+    def list_keys(self, type_name: str) -> np.ndarray:
+        """All stored keys for a type (checkpoint restore enumerates this)."""
+        raise NotImplementedError
+
+
+class MemoryVectorStore(VectorStore):
+    """In-process store; pass a shared ``backing`` so several engines (or a
+    restarted one) see the same rows — the tensor-path analog of the test
+    clusters' shared MemoryStorage backing."""
+
+    def __init__(self, backing: Optional[Dict] = None) -> None:
+        self._store: Dict[tuple, Dict[str, np.ndarray]] = \
+            backing if backing is not None else {}
+
+    @staticmethod
+    def shared_backing() -> Dict:
+        return {}
+
+    def read_many(self, type_name, keys):
+        out = {}
+        for k in keys:
+            row = self._store.get((type_name, int(k)))
+            if row is not None:
+                out[int(k)] = {n: v.copy() for n, v in row.items()}
+        return out
+
+    def write_many(self, type_name, keys, rows):
+        for k, row in zip(keys, rows):
+            self._store[(type_name, int(k))] = \
+                {n: np.asarray(v).copy() for n, v in row.items()}
+
+    def delete_many(self, type_name, keys):
+        for k in keys:
+            self._store.pop((type_name, int(k)), None)
+
+    def list_keys(self, type_name):
+        return np.array(sorted(k for t, k in self._store if t == type_name),
+                        dtype=np.int64)
+
+
+class FileVectorStore(VectorStore):
+    """One ``.npz`` per row under ``root/<type>/<key>.npz`` — the simple
+    durable backend (checkpoints survive the process)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+
+    def _dir(self, type_name: str) -> str:
+        d = os.path.join(self.root, type_name)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def read_many(self, type_name, keys):
+        d = self._dir(type_name)
+        out = {}
+        for k in keys:
+            path = os.path.join(d, f"{int(k)}.npz")
+            if os.path.exists(path):
+                with np.load(path) as z:
+                    out[int(k)] = {n: z[n] for n in z.files}
+        return out
+
+    def write_many(self, type_name, keys, rows):
+        d = self._dir(type_name)
+        for k, row in zip(keys, rows):
+            tmp = os.path.join(d, f".{int(k)}.tmp.npz")  # savez appends .npz
+            np.savez(tmp, **{n: np.asarray(v) for n, v in row.items()})
+            os.replace(tmp, os.path.join(d, f"{int(k)}.npz"))
+
+    def delete_many(self, type_name, keys):
+        d = self._dir(type_name)
+        for k in keys:
+            try:
+                os.remove(os.path.join(d, f"{int(k)}.npz"))
+            except FileNotFoundError:
+                pass
+
+    def list_keys(self, type_name):
+        d = self._dir(type_name)
+        keys = [int(m.group(1)) for f in os.listdir(d)
+                if (m := re.fullmatch(r"(-?\d+)\.npz", f))]
+        return np.array(sorted(keys), dtype=np.int64)
+
+
+def _drive(coro) -> Any:
+    """Run a coroutine that must complete without a real await — the
+    bundled storage providers do synchronous work in async clothing."""
+    try:
+        coro.send(None)
+    except StopIteration as stop:
+        return stop.value
+    coro.close()
+    raise RuntimeError(
+        "storage provider awaited real I/O inside the tick machine; "
+        "implement VectorStore natively for async backends")
+
+
+class StorageProviderVectorStore(VectorStore):
+    """Adapter: per-grain records through a host-path StorageProvider, so
+    tensor-path state shares the provider (and its namespace) with host
+    grains — the 'per-grain write semantics' half of the checkpoint story
+    (reference: Catalog.cs:731 read / Grain.WriteStateAsync write)."""
+
+    def __init__(self, provider) -> None:
+        self.provider = provider
+        # etags per (type, key): the CAS discipline providers enforce
+        self._etags: Dict[tuple, Optional[str]] = {}
+        self._known: Dict[str, set] = {}
+
+    def _grain_id(self, type_name: str, key: int) -> GrainId:
+        return GrainId.from_int(type_code_of(type_name), int(key))
+
+    def read_many(self, type_name, keys):
+        from orleans_tpu.runtime.storage import GrainState
+        out = {}
+        for k in keys:
+            state = GrainState()
+            _drive(self.provider.read_state(
+                type_name, self._grain_id(type_name, k), state))
+            self._etags[(type_name, int(k))] = state.etag
+            if state.record_exists and state.data is not None:
+                out[int(k)] = {n: np.asarray(v)
+                               for n, v in state.data.items()}
+        return out
+
+    def write_many(self, type_name, keys, rows):
+        from orleans_tpu.runtime.storage import GrainState
+        known = self._known.setdefault(type_name, set())
+        for k, row in zip(keys, rows):
+            ek = (type_name, int(k))
+            if ek not in self._etags:
+                # unseen by this bridge — fetch the current etag first
+                probe = GrainState()
+                _drive(self.provider.read_state(
+                    type_name, self._grain_id(type_name, k), probe))
+                self._etags[ek] = probe.etag
+            state = GrainState(
+                data={n: np.asarray(v) for n, v in row.items()},
+                etag=self._etags[ek], record_exists=True)
+            _drive(self.provider.write_state(
+                type_name, self._grain_id(type_name, k), state))
+            self._etags[ek] = state.etag
+            known.add(int(k))
+
+    def delete_many(self, type_name, keys):
+        from orleans_tpu.runtime.storage import GrainState
+        known = self._known.setdefault(type_name, set())
+        for k in keys:
+            ek = (type_name, int(k))
+            state = GrainState(etag=self._etags.get(ek), record_exists=True)
+            try:
+                _drive(self.provider.clear_state(
+                    type_name, self._grain_id(type_name, k), state))
+            except Exception:
+                pass
+            self._etags.pop(ek, None)
+            known.discard(int(k))
+
+    def list_keys(self, type_name):
+        # providers have no enumeration in their contract (reference:
+        # IStorageProvider has none either), so only keys THIS bridge
+        # wrote are known.  After a process restart that set is empty —
+        # refuse rather than silently restore nothing; restart-restore
+        # needs a store with real enumeration (e.g. FileVectorStore).
+        if type_name not in self._known:
+            raise NotImplementedError(
+                "StorageProviderVectorStore cannot enumerate keys it did "
+                "not write (the provider contract has no list operation); "
+                "use a VectorStore with enumeration for restart-restore")
+        return np.array(sorted(self._known[type_name]), dtype=np.int64)

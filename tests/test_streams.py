@@ -280,14 +280,17 @@ def test_persistent_stream_multi_silo_and_rebalance(run):
                 provider0.get_stream("events", "k1").stream_id)
             owner = next(s for s in cluster.silos
                          if qid in s.stream_provider("pq").manager.agents)
-            victim_hosts_consumer = owner is cluster.silos[0]
             cluster.kill_silo(owner)
             await cluster.wait_for_liveness_convergence()
 
-            if victim_hosts_consumer:
-                f = cluster.attach_client(0)
-                c = f.get_grain(IStreamConsumerGrain, 30)
-                await c.join("pq", "events", "k1")
+            # re-join from a surviving client: if the consumer activation
+            # died with the silo, join() resumes the durable subscription
+            # on the new activation (the reference's resume-on-activate
+            # pattern); if it didn't die, join() finds the handle already
+            # resumed and is a no-op re-resume
+            f = cluster.attach_client(0)
+            c = f.get_grain(IStreamConsumerGrain, 30)
+            await c.join("pq", "events", "k1")
 
             # a survivor adopts the queue and resumes from the cursor
             async def adopted():
@@ -353,14 +356,12 @@ def test_pubsub_state_survives_rendezvous_silo_death(run):
                 IPubSubRendezvous, stream_id.pubsub_key()).grain_id
             host = next(s for s in cluster.silos
                         if s.catalog.directory.by_grain.get(pubsub_id))
-            consumer_died = bool(
-                host.catalog.directory.by_grain.get(c.grain_id))
             cluster.kill_silo(host)
             await cluster.wait_for_liveness_convergence()
-            if consumer_died:
-                f = cluster.attach_client(0)
-                c = f.get_grain(IStreamConsumerGrain, 31)
-                await c.join("pq", "events", "k2")
+            # resume-on-activate (no-op if the consumer survived the kill)
+            f = cluster.attach_client(0)
+            c = f.get_grain(IStreamConsumerGrain, 31)
+            await c.join("pq", "events", "k2")
 
             before = len(await c.received())
             await producer.produce("pq", "events", "k2", ["b", "c"])
