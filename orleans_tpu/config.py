@@ -68,6 +68,16 @@ class TensorEngineConfig:
     enabled: bool = True
     tick_interval: float = 0.001          # min seconds between ticks
     max_rounds_per_tick: int = 4          # intra-tick call-chain rounds
+    # adaptive tick sizing (SURVEY §7 hard-part 5): when a latency budget
+    # is set, the engine's loop adjusts the accumulation interval between
+    # ticks so that queue-wait + tick-service time stays inside the budget
+    # (shrinks the batch when ticks run long, grows it back for throughput
+    # when there is headroom).  0 disables adaptation (fixed tick_interval).
+    target_tick_latency: float = 0.0
+    tick_interval_min: float = 0.0002
+    tick_interval_max: float = 0.05
+    # ring buffer of recent per-tick durations backing latency percentiles
+    latency_window: int = 1024
     # tensor-path activation collection (reference: ActivationCollector
     # quantum + age limit): rows idle > collection_idle_ticks are evicted
     # (written back when a store is attached) every collection_every_ticks.

@@ -30,6 +30,17 @@ from orleans_tpu.ids import ActivationAddress, ActivationId, GrainId, SiloAddres
 from orleans_tpu.runtime.messaging import Message, RejectionType
 
 
+def _observe_turn(t: "asyncio.Task") -> None:
+    """Mark a finished turn task's exception as retrieved.
+
+    Failures already reach the caller through the response message; this
+    only silences asyncio's "exception was never retrieved" reporting.  A
+    non-graceful silo stop cancels in-flight turns, and ``Task.exception()``
+    raises on a cancelled task, so that case must be skipped."""
+    if not t.cancelled():
+        t.exception()
+
+
 class ActivationState(Enum):
     """(reference: ActivationState.cs)"""
 
@@ -165,7 +176,7 @@ class ActivationData:
         self.running[msg.id] = msg
         loop = asyncio.get_running_loop()
         task = loop.create_task(self._run_turn(msg, invoke))
-        task.add_done_callback(lambda t: t.exception())  # observed via response
+        task.add_done_callback(_observe_turn)  # outcome travels via response
 
     async def _run_turn(self, msg: Message,
                         invoke: Callable[[Message], Awaitable[None]]) -> None:

@@ -127,14 +127,19 @@ class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
         except InconsistentStateError:
             # another activation of this rendezvous won a write race
             # (transient duplicate during failover).  Re-read to refresh
-            # the etag, then retry once with our view — without this the
-            # stale etag makes every later save fail for the activation's
-            # lifetime.  A second conflict means the duplicate is live and
-            # racing: step aside like the reference (deactivate so the
-            # directory converges on one activation).
-            data = self._bridge.state
+            # the etag and MERGE the winner's registrations with ours —
+            # retrying with only our view would erase whatever the other
+            # activation durably registered (silently undelivered streams).
+            # A second conflict means the duplicate is live and racing:
+            # step aside like the reference (deactivate so the directory
+            # converges on one activation).
             await self._bridge.read_state()
-            self._bridge.state = data
+            theirs = self._bridge.state or {}
+            self.producers |= set(theirs.get("producers", ()))
+            self.consumer_subs = {**dict(theirs.get("consumer_subs", {})),
+                                  **self.consumer_subs}
+            self._bridge.state = {"producers": set(self.producers),
+                                  "consumer_subs": dict(self.consumer_subs)}
             try:
                 await self._bridge.write_state()
             except InconsistentStateError:

@@ -32,6 +32,10 @@ class ArenaFullError(RuntimeError):
 
 @jax.jit
 def _touch_kernel(last_use_dev, rows, tick):
+    # mode="drop" only drops OUT-OF-RANGE indices; -1 (unresolved miss)
+    # would wrap to the last row and pin it hot forever, so remap negatives
+    # past capacity where the scatter really does drop them
+    rows = jnp.where(rows < 0, last_use_dev.shape[0], rows)
     return last_use_dev.at[rows].max(tick, mode="drop")
 
 
@@ -84,6 +88,9 @@ class GrainArena:
         # batches (injector fast path, emit hits) with a scatter-max —
         # those never cross to the host, so a host-only clock would see
         # hot rows as idle and evict live state.  Collection merges both.
+        # int32 because device int64 needs jax x64 mode; the clock is a
+        # tick counter, so the bound is 2**31 ticks (~25 days at 1ms/tick)
+        # per engine lifetime, far beyond a process run between restarts.
         self.last_use_dev = self._dev_zeros_i32(self.capacity)
 
         # device-side directory mirror (int32 keys only — see device_resolve):

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from dataclasses import dataclass, field as dc_field
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -140,6 +140,10 @@ class TensorEngine:
         self.messages_processed = 0
         self.tick_seconds = 0.0
         self.activation_passes = 0
+        # recent per-tick durations → honest latency percentiles; the
+        # adaptive controller (SURVEY §7 hard-part 5) reads the same data
+        self.tick_durations: deque = deque(maxlen=self.config.latency_window)
+        self._adaptive_interval = self.config.tick_interval
 
         self._step_cache: Dict[Tuple[str, str, int], Callable] = {}
         self._pending_checks: List[_MissCheck] = []
@@ -310,8 +314,9 @@ class TensorEngine:
             while self._running:
                 while self._running and any(self.queues.values()):
                     self.run_tick()
-                    # yield so producers can batch up the next tick
-                    await asyncio.sleep(self.config.tick_interval)
+                    # yield so producers can batch up the next tick; the
+                    # accumulation interval is the latency/throughput knob
+                    await asyncio.sleep(self.tick_interval())
                 if not self._drain_checks():
                     break
 
@@ -353,7 +358,53 @@ class TensorEngine:
                 self._run_group(type_name, method, batches)
             rounds += 1
             self.rounds_run += 1
-        self.tick_seconds += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.tick_seconds += dt
+        self.tick_durations.append(dt)
+        self._adapt(dt)
+
+    def tick_interval(self) -> float:
+        """Seconds to accumulate messages before the next tick."""
+        if self.config.target_tick_latency <= 0:
+            return self.config.tick_interval
+        return self._adaptive_interval
+
+    def _adapt(self, tick_duration: float) -> None:
+        """Adaptive tick sizing: a message's turn latency is bounded by
+        accumulation wait + tick service time, so steer the accumulation
+        interval to keep that sum inside ``target_tick_latency``.  Longer
+        intervals build bigger batches (throughput); the controller grows
+        the interval only while the budget has headroom and cuts it
+        multiplicatively when a tick overruns."""
+        budget = self.config.target_tick_latency
+        if budget <= 0:
+            return
+        cfg = self.config
+        if tick_duration + self._adaptive_interval > budget:
+            self._adaptive_interval = max(cfg.tick_interval_min,
+                                          self._adaptive_interval * 0.5)
+        else:
+            headroom = budget - tick_duration
+            self._adaptive_interval = max(
+                cfg.tick_interval_min,
+                min(cfg.tick_interval_max, headroom * 0.5,
+                    self._adaptive_interval * 1.1 + 1e-5))
+
+    def latency_stats(self) -> Dict[str, float]:
+        """True percentiles over the recent per-tick duration window (NOT
+        a mean — the north-star metric's p99 is a real p99 here)."""
+        if not self.tick_durations:
+            return {"n": 0, "p50": 0.0, "p90": 0.0, "p99": 0.0,
+                    "mean": 0.0, "max": 0.0}
+        d = np.asarray(self.tick_durations)
+        return {
+            "n": int(d.size),
+            "p50": float(np.percentile(d, 50)),
+            "p90": float(np.percentile(d, 90)),
+            "p99": float(np.percentile(d, 99)),
+            "mean": float(d.mean()),
+            "max": float(d.max()),
+        }
 
     # -- destination resolution --------------------------------------------
 
@@ -563,6 +614,7 @@ class TensorEngine:
             "msgs_per_sec": (self.messages_processed / self.tick_seconds
                              if self.tick_seconds > 0 else 0.0),
             "activation_passes": self.activation_passes,
+            "tick_latency": self.latency_stats(),
             "arenas": {name: a.live_count for name, a in self.arenas.items()},
             "evicted": sum(a.evicted_count for a in self.arenas.values()),
             "restored": sum(a.restored_count for a in self.arenas.values()),

@@ -162,3 +162,85 @@ def test_multi_round_tick_caps_and_spills(run):
         assert stats["messages"] == 2 * n
 
     run(main())
+
+
+def test_latency_stats_are_true_percentiles(run):
+    """snapshot()['tick_latency'] reports real percentiles over per-tick
+    durations, not a mean (VERDICT r1: the published p99 was a mean)."""
+
+    async def main():
+        engine = TensorEngine()
+        stats = await run_presence_load(engine, n_players=500, n_games=5,
+                                        n_ticks=8, measure_latency=True)
+        assert "tick_p99_seconds" in stats
+        assert stats["tick_p99_seconds"] >= stats["tick_p50_seconds"] > 0
+        lat = engine.latency_stats()
+        assert lat["n"] >= 8
+        assert lat["max"] >= lat["p99"] >= lat["p50"] > 0
+        assert lat["p99"] <= lat["max"]
+
+    run(main())
+
+
+def test_adaptive_tick_interval_controller():
+    """With a latency budget set, overruns shrink the accumulation interval
+    multiplicatively and headroom grows it back, clamped to the bounds
+    (SURVEY §7 hard-part 5: adaptive tick sizing)."""
+    engine = TensorEngine()
+    cfg = engine.config
+    cfg.target_tick_latency = 0.010
+    cfg.tick_interval_min = 0.0002
+    cfg.tick_interval_max = 0.05
+    engine._adaptive_interval = 0.004
+
+    # tick far over budget: interval halves
+    engine._adapt(tick_duration=0.050)
+    assert engine._adaptive_interval == 0.002
+    # repeated overruns clamp at the floor
+    for _ in range(20):
+        engine._adapt(tick_duration=0.050)
+    assert engine._adaptive_interval == cfg.tick_interval_min
+    assert engine.tick_interval() == cfg.tick_interval_min
+
+    # fast ticks: interval recovers but never exceeds half the headroom
+    for _ in range(200):
+        engine._adapt(tick_duration=0.001)
+    assert engine._adaptive_interval <= (cfg.target_tick_latency - 0.001) / 2
+    assert engine._adaptive_interval > cfg.tick_interval_min
+
+    # no budget -> fixed interval
+    cfg.target_tick_latency = 0.0
+    assert engine.tick_interval() == cfg.tick_interval
+
+
+def test_turn_observer_tolerates_cancellation(run):
+    """Non-graceful stop cancels in-flight turns; the done-callback must
+    not re-raise CancelledError (VERDICT r1: bench teardown spewed
+    unhandled CancelledError tracebacks)."""
+
+    async def main():
+        from orleans_tpu.runtime.activation import _observe_turn
+
+        async def hang():
+            await asyncio.sleep(30)
+
+        task = asyncio.get_running_loop().create_task(hang())
+        await asyncio.sleep(0)
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        _observe_turn(task)  # must not raise
+
+        async def boom():
+            raise RuntimeError("x")
+
+        task2 = asyncio.get_running_loop().create_task(boom())
+        try:
+            await task2
+        except RuntimeError:
+            pass
+        _observe_turn(task2)  # marks retrieved, must not raise
+
+    run(main())
