@@ -116,7 +116,18 @@ class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
             self.producers = set(saved.get("producers", ()))
             self.consumer_subs = dict(saved.get("consumer_subs", {}))
 
-    async def _save(self) -> None:
+    async def _save(self, delta=None) -> None:
+        """Write the in-memory view through the bridge.
+
+        ``delta`` is the mutation that just happened, as ``(kind, value)``
+        — on an etag conflict (another activation of this rendezvous won a
+        write race during failover) the winner's durable state is adopted
+        as the base and ONLY the delta is replayed on it.  Replaying the
+        whole local view would erase the winner's registrations; merging
+        by union would resurrect whatever this operation just removed.
+        A second conflict means the duplicate is live and racing: step
+        aside like the reference (deactivate so the directory converges
+        on one activation)."""
         if self._bridge is None:
             return
         from orleans_tpu.runtime.storage import InconsistentStateError
@@ -125,19 +136,11 @@ class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
         try:
             await self._bridge.write_state()
         except InconsistentStateError:
-            # another activation of this rendezvous won a write race
-            # (transient duplicate during failover).  Re-read to refresh
-            # the etag and MERGE the winner's registrations with ours —
-            # retrying with only our view would erase whatever the other
-            # activation durably registered (silently undelivered streams).
-            # A second conflict means the duplicate is live and racing:
-            # step aside like the reference (deactivate so the directory
-            # converges on one activation).
             await self._bridge.read_state()
             theirs = self._bridge.state or {}
-            self.producers |= set(theirs.get("producers", ()))
-            self.consumer_subs = {**dict(theirs.get("consumer_subs", {})),
-                                  **self.consumer_subs}
+            self.producers = set(theirs.get("producers", ()))
+            self.consumer_subs = dict(theirs.get("consumer_subs", {}))
+            self._apply_delta(delta)
             self._bridge.state = {"producers": set(self.producers),
                                   "consumer_subs": dict(self.consumer_subs)}
             try:
@@ -145,6 +148,19 @@ class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
             except InconsistentStateError:
                 self.deactivate_on_idle()
                 raise
+
+    def _apply_delta(self, delta) -> None:
+        if delta is None:
+            return
+        kind, value = delta
+        if kind == "add_producer":
+            self.producers.add(value)
+        elif kind == "remove_producer":
+            self.producers.discard(value)
+        elif kind == "add_consumer":
+            self.consumer_subs[value.subscription_id] = value
+        elif kind == "remove_consumer":
+            self.consumer_subs.pop(value.subscription_id, None)
 
     # -- producers ----------------------------------------------------------
 
@@ -154,26 +170,26 @@ class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
         producer can seed its cache."""
         if producer not in self.producers:
             self.producers.add(producer)
-            await self._save()
+            await self._save(("add_producer", producer))
         return self._consumer_list(stream_id)
 
     async def unregister_producer(self, stream_id: StreamId,
                                   producer: GrainId) -> None:
         if producer in self.producers:
             self.producers.discard(producer)
-            await self._save()
+            await self._save(("remove_producer", producer))
 
     # -- consumers ----------------------------------------------------------
 
     async def register_consumer(self, handle: StreamSubscriptionHandle) -> None:
         self.consumer_subs[handle.subscription_id] = handle
-        await self._save()
+        await self._save(("add_consumer", handle))
         await self._notify_producers(handle.stream_id)
 
     async def unregister_consumer(self, handle: StreamSubscriptionHandle) -> None:
         if self.consumer_subs.pop(handle.subscription_id, None) is None:
             return  # duplicate/late unsubscribe — no write, no fan-out
-        await self._save()
+        await self._save(("remove_consumer", handle))
         await self._notify_producers(handle.stream_id)
 
     async def consumers(self, stream_id: StreamId) -> list:

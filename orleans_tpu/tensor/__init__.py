@@ -27,6 +27,7 @@ from orleans_tpu.tensor.vector_grain import (
     vector_grain,
 )
 from orleans_tpu.tensor.engine import TensorEngine
+from orleans_tpu.tensor.fanout import DeviceFanout, FanoutOverflowError
 from orleans_tpu.tensor.persistence import (
     FileVectorStore,
     MemoryVectorStore,
@@ -49,4 +50,6 @@ __all__ = [
     "scatter_rows",
     "vector_grain",
     "TensorEngine",
+    "DeviceFanout",
+    "FanoutOverflowError",
 ]

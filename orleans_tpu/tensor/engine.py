@@ -46,13 +46,13 @@ from orleans_tpu.core.grain import MethodInfo
 from orleans_tpu.ids import GrainId
 from orleans_tpu.tensor.arena import GrainArena
 from orleans_tpu.tensor.vector_grain import (
+    KEY_SENTINEL,
     Batch,
     Emit,
     VectorGrainInfo,
     vector_type,
 )
 
-KEY_SENTINEL = np.int32(2**31 - 1)  # device-path keys must be < this
 MISS_BUF = 8192                     # unique unseen keys activated per pass
 
 
@@ -60,9 +60,12 @@ MISS_BUF = 8192                     # unique unseen keys activated per pass
 class PendingBatch:
     """One queued slab of messages for a (type, method).
 
-    Exactly one of (rows, keys_host, keys_dev) identifies destinations:
-    ``rows`` = already resolved (injector fast path), ``keys_host`` = host
-    resolution at dequeue, ``keys_dev`` = device resolution (emits).
+    Destination resolution precedence: ``rows`` when its ``generation``
+    still matches the arena (injector fast path), else ``keys_host``
+    (host resolution at dequeue), else ``keys_dev`` (device resolution —
+    emits).  An injector batch carries all three: rows for the fast path,
+    keys_host for re-resolution after repack, keys_dev so registered
+    fan-outs expand with zero per-inject transfer.
     """
 
     args: Any                                  # pytree [m, ...] np or device
@@ -72,6 +75,10 @@ class PendingBatch:
     mask: Optional[jnp.ndarray] = None         # bool[m] device (None = all)
     future: Optional[asyncio.Future] = None    # resolves to results[m]
     generation: int = -1                       # arena generation rows assume
+    # miss-check redeliveries set this: the original pass already expanded
+    # the whole batch through any registered fan-out (expansion is
+    # key-based, not row-based), so expanding again would double-deliver
+    no_fanout: bool = False
 
     def __len__(self) -> int:
         for c in (self.rows, self.keys_host, self.keys_dev):
@@ -147,6 +154,9 @@ class TensorEngine:
 
         self._step_cache: Dict[Tuple[str, str, int], Callable] = {}
         self._pending_checks: List[_MissCheck] = []
+        # (src_type, src_method) → (DeviceFanout, dst_type, dst_method):
+        # one-to-many subscription expansion on the device (tensor/fanout.py)
+        self._fanouts: Dict[Tuple[str, str], Tuple[Any, str, str]] = {}
         self._task: Optional[asyncio.Task] = None
         self._running = False
         self._wake: Optional[asyncio.Event] = None
@@ -248,6 +258,42 @@ class TensorEngine:
         self._wake_up()
         return future
 
+    def register_fanout(self, src_interface, src_method: str, fanout,
+                        dst_interface, dst_method: str) -> None:
+        """Every message delivered to (src_interface, src_method) also
+        expands through ``fanout`` (a DeviceFanout subscription graph) into
+        messages for (dst_interface, dst_method) — the batched analog of a
+        grain forwarding each message to its subscriber set (reference:
+        ChirperAccount.PublishMessage → Followers loop,
+        ChirperAccount.cs:129-156; ObserverSubscriptionManager.Notify).
+        Expansion runs on device and the expanded batch routes through the
+        normal emit path next round (same tick)."""
+        self._fanouts[(self._type_name(src_interface), src_method)] = (
+            fanout, self._type_name(dst_interface), dst_method)
+
+    def _run_fanout(self, type_name: str, method: str,
+                    batches: List[PendingBatch]) -> None:
+        fan = self._fanouts.get((type_name, method))
+        if fan is None:
+            return
+        fanout, dst_type, dst_method = fan
+        for b in batches:
+            if b.no_fanout:
+                continue
+            if b.keys_dev is not None:
+                skeys = b.keys_dev
+            elif b.keys_host is not None:
+                if (b.keys_host >= KEY_SENTINEL).any() or \
+                        (b.keys_host < 0).any():
+                    raise OverflowError(
+                        "fanout src keys must be in [0, 2**31-1)")
+                skeys = jnp.asarray(b.keys_host.astype(np.int32))
+            else:
+                continue  # row-only batch with no kept keys: nothing to map
+            dst, gargs, valid = fanout.expand(skeys, b.args, b.mask)
+            self.queues[(dst_type, dst_method)].append(
+                PendingBatch(args=gargs, keys_dev=dst, mask=valid))
+
     def make_injector(self, interface, method: str,
                       keys: np.ndarray) -> "BatchInjector":
         """Pre-resolve a stable destination set once; subsequent injections
@@ -334,6 +380,10 @@ class TensorEngine:
             await self.drain_queues()
             if not self._drain_checks():
                 break
+        # quiescence point: surface any fan-out budget overruns (the hot
+        # path parks totals on device instead of synchronizing per round)
+        for fanout, _, _ in self._fanouts.values():
+            fanout.overflow_check()
 
     # ================= tick execution =====================================
 
@@ -355,6 +405,7 @@ class TensorEngine:
                 break
             self.queues = defaultdict(list)
             for (type_name, method), batches in pending.items():
+                self._run_fanout(type_name, method, batches)
                 self._run_group(type_name, method, batches)
             rounds += 1
             self.rounds_run += 1
@@ -462,7 +513,8 @@ class TensorEngine:
             # re-deliver only the dropped messages; convergence across
             # cycles even when unique misses exceed MISS_BUF
             self.queues[(c.type_name, c.method)].append(PendingBatch(
-                args=c.args, keys_dev=c.keys, mask=missing))
+                args=c.args, keys_dev=c.keys, mask=missing,
+                no_fanout=True))
             requeued = True
         return requeued
 
@@ -635,6 +687,11 @@ class BatchInjector:
         self.method = method
         self.keys = keys
         self._arena = engine.arena_for(type_name)
+        # device mirror of the key set: lets registered fan-outs expand
+        # injected batches with zero per-inject host→device transfer
+        self._keys_dev = jnp.asarray(keys.astype(np.int32)) \
+            if len(keys) and keys.max() < KEY_SENTINEL and keys.min() >= 0 \
+            else None
         self._refresh()
         self.n = len(keys)
 
@@ -653,7 +710,8 @@ class BatchInjector:
             if want_results else None
         self.engine.queues[(self.type_name, self.method)].append(
             PendingBatch(args=args, rows=self.rows, future=future,
-                         keys_host=self.keys, generation=self.generation))
+                         keys_host=self.keys, keys_dev=self._keys_dev,
+                         generation=self.generation))
         self.engine._wake_up()
         return future
 

@@ -1,0 +1,208 @@
+"""DeviceFanout: ragged one-to-many message expansion on device.
+
+The reference's fan-out pattern — one grain holding a variable-size
+subscriber set and forwarding each message to every subscriber
+(reference: Samples/Chirper/ChirperGrains/ChirperAccount.cs:129-156
+PublishMessage → Followers loop; ObserverSubscriptionManager.Notify;
+streams' StreamConsumerCollection) — is per-message pointer chasing in
+C#.  On TPU the same pattern must become a static-shape gather: the
+subscription graph lives as a CSR edge table in device memory, and a
+whole batch of published messages expands into one flat (dst_key, args)
+tensor in a single jitted kernel.
+
+Raggedness with static shapes: per-message out-degrees are cumsum'd into
+offsets, and each of ``budget`` output slots binary-searches which source
+message it belongs to (`searchsorted` over the offsets — the standard XLA
+ragged-expansion idiom).  Slots past the real total are masked and carry
+``KEY_SENTINEL`` keys, which the engine's resolve kernel already drops.
+
+Mutation (follow/unfollow) is host-side control-plane; the device CSR is
+a mirror rebuilt lazily on first expand after a change — the same
+truth-on-host / mirror-on-device discipline as the arena's directory
+index (arena.py device_index).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.tensor.vector_grain import KEY_SENTINEL
+
+
+@jax.jit
+def _expand_kernel(csr_keys, csr_offsets, csr_dst, src_keys, valid):
+    """Expand [m] source messages into [budget] destination slots.
+
+    Returns (dst_keys int32[budget], src_index int32[budget],
+    out_valid bool[budget], total int32) where ``src_index[j]`` is the
+    source message each slot's args are gathered from and ``total`` is
+    the true (unpadded) number of expanded messages — if it exceeds
+    ``budget`` the surplus was dropped and the caller must re-publish
+    with a larger budget."""
+    n = csr_keys.shape[0]
+    budget = _budget_of(csr_dst)  # static: taken from a closure-free helper
+    idx = jnp.clip(jnp.searchsorted(csr_keys, src_keys), 0, n - 1)
+    hit = valid & (csr_keys[idx] == src_keys)
+    deg = jnp.where(hit, csr_offsets[idx + 1] - csr_offsets[idx], 0)
+    start = jnp.where(hit, csr_offsets[idx], 0)
+    offs = jnp.cumsum(deg)                      # inclusive: msgs ≤ i
+    total = offs[-1] if offs.shape[0] else jnp.int32(0)
+    j = jnp.arange(budget, dtype=jnp.int32)
+    src_index = jnp.searchsorted(offs, j, side="right").astype(jnp.int32)
+    src_c = jnp.clip(src_index, 0, jnp.maximum(src_keys.shape[0] - 1, 0))
+    before = jnp.where(src_c > 0, offs[src_c - 1], 0)
+    e = start[src_c] + (j - before)
+    out_valid = j < total
+    dst = jnp.where(out_valid,
+                    csr_dst[jnp.clip(e, 0, jnp.maximum(budget - 1, 0))],
+                    KEY_SENTINEL)
+    return dst, src_c, out_valid, total
+
+
+def _budget_of(csr_dst):
+    return csr_dst.shape[0]
+
+
+class FanoutOverflowError(RuntimeError):
+    """More expanded messages than the configured budget in one round."""
+
+
+class DeviceFanout:
+    """A mutable src→{dst...} subscription graph with device expansion.
+
+    ``budget`` caps BOTH the stored edge count and the per-round expansion
+    width (one publish round can at most touch every edge once, so a
+    single cap covers both)."""
+
+    def __init__(self, budget: int = 1 << 20) -> None:
+        self.budget = int(budget)
+        self._adj: Dict[int, List[int]] = {}
+        self.edge_count = 0
+        self._dirty = True
+        self._csr_keys: Optional[jnp.ndarray] = None
+        self._csr_offsets: Optional[jnp.ndarray] = None
+        self._csr_dst: Optional[jnp.ndarray] = None
+        # device totals parked by expand(); drained by overflow_check()
+        self._pending_totals: List[Any] = []
+
+    # -- control plane (host) ----------------------------------------------
+
+    def follow(self, src: int, dst: int) -> None:
+        """Subscribe ``dst`` to ``src``'s messages (reference:
+        ChirperAccount.AddFollower)."""
+        lst = self._adj.setdefault(int(src), [])
+        if int(dst) not in lst:
+            lst.append(int(dst))
+            self.edge_count += 1
+            self._dirty = True
+
+    def unfollow(self, src: int, dst: int) -> None:
+        lst = self._adj.get(int(src))
+        if lst and int(dst) in lst:
+            lst.remove(int(dst))
+            self.edge_count -= 1
+            self._dirty = True
+
+    def followers_of(self, src: int) -> List[int]:
+        return list(self._adj.get(int(src), ()))
+
+    def add_edges(self, src_keys: np.ndarray, dst_keys: np.ndarray) -> None:
+        """Bulk graph load (the sample's NetworkLoader analog)."""
+        for s, d in zip(np.asarray(src_keys).tolist(),
+                        np.asarray(dst_keys).tolist()):
+            self.follow(s, d)
+
+    # -- device mirror -------------------------------------------------------
+
+    def _rebuild(self) -> None:
+        if self.edge_count > self.budget:
+            raise FanoutOverflowError(
+                f"{self.edge_count} edges exceed fanout budget {self.budget}")
+        srcs = sorted(k for k, v in self._adj.items() if v)
+        keys = np.fromiter(srcs, dtype=np.int64, count=len(srcs))
+        if (keys >= np.int64(KEY_SENTINEL)).any() or (keys < 0).any():
+            raise OverflowError("fanout src keys must be in [0, 2**31-1)")
+        # expansion width: how many output slots one expand round gets.
+        # Sized to the live edge count (lane-aligned), NOT the storage
+        # budget — a static graph then pads < 256 dead lanes per round
+        # instead of (budget - edges).  The budget stays the hard cap so
+        # a round with duplicate src keys that needs more than `width`
+        # slots surfaces as FanoutOverflowError, not silent truncation.
+        width = min(self.budget,
+                    max(256, -(-max(1, self.edge_count) // 256) * 256))
+        if not srcs:
+            # sentinel row so the kernel never gathers from an empty array;
+            # KEY_SENTINEL can't match a valid src key (they are < it)
+            self._csr_keys = jnp.asarray(np.array([KEY_SENTINEL], np.int32))
+            self._csr_offsets = jnp.asarray(np.zeros(2, np.int32))
+            self._csr_dst = jnp.asarray(
+                np.full(width, KEY_SENTINEL, np.int32))
+            self._dirty = False
+            return
+        offsets = np.zeros(len(srcs) + 1, dtype=np.int32)
+        dst_np = np.full(width, KEY_SENTINEL, dtype=np.int32)
+        pos = 0
+        for i, s in enumerate(srcs):
+            d = self._adj[s]
+            dst_np[pos:pos + len(d)] = d
+            pos += len(d)
+            offsets[i + 1] = pos
+        self._csr_keys = jnp.asarray(keys.astype(np.int32))
+        self._csr_offsets = jnp.asarray(offsets)
+        self._csr_dst = jnp.asarray(dst_np)
+        self._dirty = False
+
+    # -- data plane ----------------------------------------------------------
+
+    def expand(self, src_keys: jnp.ndarray, args: Any,
+               mask: Optional[jnp.ndarray] = None
+               ) -> Tuple[jnp.ndarray, Any, jnp.ndarray]:
+        """(src message keys [m], args pytree [m,...]) → (dst keys
+        [budget], gathered args [budget,...] + ``src_key``, valid mask).
+
+        Scalar arg leaves broadcast (same convention as the engine's
+        kernels).  The true expansion total stays on device; call
+        ``overflow_check()`` at a quiescence point to detect budget
+        overruns without synchronizing the hot path."""
+        if self._dirty:
+            self._rebuild()
+        if mask is None:
+            mask = _ones_mask(src_keys.shape[0])
+        dst, src_index, out_valid, total = _expand_kernel(
+            self._csr_keys, self._csr_offsets, self._csr_dst,
+            src_keys, mask)
+        self._pending_totals.append(total)
+        gathered = jax.tree_util.tree_map(
+            lambda a: a if jnp.ndim(a) == 0 else jnp.asarray(a)[src_index],
+            args)
+        if isinstance(gathered, dict) and "src_key" not in gathered:
+            gathered = {**gathered, "src_key": src_keys[src_index]}
+        return dst, gathered, out_valid
+
+    def overflow_check(self) -> int:
+        """Synchronize parked totals; raises FanoutOverflowError if any
+        round expanded past the output width (messages were dropped)."""
+        totals, self._pending_totals = self._pending_totals, []
+        worst = max((int(t) for t in totals), default=0)
+        width = self._csr_dst.shape[0] if self._csr_dst is not None else 0
+        if width and worst > width:
+            raise FanoutOverflowError(
+                f"expansion needed {worst} slots, width {width} "
+                f"(budget {self.budget})")
+        return worst
+
+
+# cached all-true masks, one eager device array per distinct batch size
+_mask_cache: Dict[int, jnp.ndarray] = {}
+
+
+def _ones_mask(n: int) -> jnp.ndarray:
+    m = _mask_cache.get(n)
+    if m is None:
+        m = jnp.asarray(np.ones(n, dtype=bool))
+        _mask_cache[n] = m
+    return m

@@ -53,6 +53,11 @@ from orleans_tpu.core.grain import (
 from orleans_tpu.hashing import jenkins_hash
 from orleans_tpu.ids import type_code_of
 
+# Device-path key sentinel: resolve kernels treat any key >= this as
+# invalid/padding and drop it.  Single definition — the engine's resolve
+# kernel and the fan-out's padding must agree on it.
+KEY_SENTINEL = np.int32(2**31 - 1)
+
 
 @dataclass(frozen=True)
 class StateField:

@@ -1,0 +1,164 @@
+"""Chirper sample — power-law follower fan-out (the ragged-scatter
+benchmark workload).
+
+Parity: reference Samples/Chirper — ChirperAccount publishes a chirp and
+forwards it to every follower, each of whom records it in a bounded
+received-messages cache (reference:
+Samples/Chirper/ChirperGrains/ChirperAccount.cs:129-156 PublishMessage →
+Followers loop; NewChirp :261; AddFollower :235).  The follower network
+(the sample's NetworkGenerator/NetworkLoader) is power-law: a few
+celebrity accounts with huge follower counts, a long tail with few.
+
+TPU-native shape: the follow graph is a device-resident CSR edge table
+(``DeviceFanout``); a tick's publishes expand into one flat
+(follower_key, chirp) tensor in a single jitted gather — the batched
+equivalent of the per-follower RPC loop — and followers absorb the
+fan-IN with segment reductions.  Power-law raggedness stresses exactly
+what Presence's uniform fan-in does not: per-message emit widths that
+vary by orders of magnitude.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.core.grain import batched_method
+from orleans_tpu.tensor import (
+    Batch,
+    DeviceFanout,
+    VectorGrain,
+    field,
+    seg_max,
+    seg_sum,
+    vector_grain,
+)
+
+
+@vector_grain
+class ChirperAccount(VectorGrain):
+    """Per-account state (reference: ChirperAccount.cs:40 — the publish
+    and receive sides of one account grain)."""
+
+    published = field(jnp.int32, 0)       # chirps this account published
+    received = field(jnp.int32, 0)        # chirps received from followees
+    last_chirp = field(jnp.int32, -1)     # newest chirp id seen
+    checksum = field(jnp.float32, 0.0)    # delivery checksum (test oracle)
+
+    @batched_method
+    @staticmethod
+    def publish(state, batch: Batch, n_rows: int):
+        """Record the publish.  Follower fan-out happens through the
+        engine-registered DeviceFanout (reference: PublishMessage's
+        Followers loop, ChirperAccount.cs:145-156)."""
+        rows = batch.rows
+        ones = jnp.asarray(batch.mask, jnp.int32)
+        return {
+            **state,
+            "published": state["published"] + seg_sum(ones, rows, n_rows),
+        }
+
+    @batched_method
+    @staticmethod
+    def new_chirp(state, batch: Batch, n_rows: int):
+        """Absorb the fan-in from followed accounts (reference:
+        ChirperAccount.NewChirp :261 — enqueue into the bounded
+        RecentReceivedMessages cache)."""
+        rows, args = batch.rows, batch.args
+        ones = jnp.asarray(batch.mask, jnp.int32)
+        chirp = jnp.asarray(args["chirp_id"], jnp.int32)
+        return {
+            **state,
+            "received": state["received"] + seg_sum(ones, rows, n_rows),
+            "last_chirp": jnp.maximum(state["last_chirp"],
+                                      seg_max(jnp.where(batch.mask, chirp,
+                                                        -1),
+                                              rows, n_rows)),
+            "checksum": state["checksum"]
+            + seg_sum(jnp.where(batch.mask,
+                                jnp.asarray(args["src_key"],
+                                            jnp.float32) % 97.0,
+                      0.0), rows, n_rows),
+        }
+
+
+def build_follow_graph(n_accounts: int, mean_followers: float = 20.0,
+                       zipf_a: float = 1.6, seed: int = 0,
+                       budget: Optional[int] = None) -> DeviceFanout:
+    """Power-law follower network (the NetworkGenerator analog): account
+    popularity ~ Zipf, so follower counts span orders of magnitude."""
+    rng = np.random.default_rng(seed)
+    # popularity weights ~ k^-a over a random permutation of accounts
+    ranks = rng.permutation(n_accounts) + 1
+    weights = ranks.astype(np.float64) ** (-zipf_a)
+    weights /= weights.sum()
+    n_edges = int(n_accounts * mean_followers)
+    publishers = rng.choice(n_accounts, size=n_edges, p=weights)
+    followers = rng.integers(0, n_accounts, size=n_edges)
+    # drop self-follows and duplicate edges
+    keep = publishers != followers
+    edges = np.unique(
+        np.stack([publishers[keep], followers[keep]], axis=1), axis=0)
+    fanout = DeviceFanout(budget=budget or max(1 << 12, 2 * len(edges)))
+    fanout.add_edges(edges[:, 0], edges[:, 1])
+    return fanout
+
+
+async def run_chirper_load(engine, n_accounts: int = 100_000,
+                           mean_followers: float = 20.0,
+                           n_ticks: int = 10, seed: int = 0,
+                           fanout: Optional[DeviceFanout] = None,
+                           measure_latency: bool = False
+                           ) -> Dict[str, float]:
+    """Every account publishes one chirp per tick; each chirp is delivered
+    to all followers through the device fan-out.  Message accounting
+    matches the reference's Chirper load: one publish RPC + one NewChirp
+    per follower edge."""
+    import jax as _jax
+
+    if fanout is None:
+        fanout = build_follow_graph(n_accounts, mean_followers, seed=seed)
+    engine.register_fanout("ChirperAccount", "publish", fanout,
+                           "ChirperAccount", "new_chirp")
+    engine.arena_for("ChirperAccount").reserve(n_accounts)
+
+    accounts = np.arange(n_accounts, dtype=np.int64)
+    injector = engine.make_injector("ChirperAccount", "publish", accounts)
+    chirp_ids = jnp.asarray(np.arange(n_accounts, dtype=np.int32))
+
+    arena = engine.arena_for("ChirperAccount")
+    tick_durations = []
+
+    t0 = time.perf_counter()
+    for t in range(n_ticks):
+        tick_t0 = time.perf_counter()
+        injector.inject({"chirp_id": chirp_ids + np.int32(t * n_accounts)})
+        if measure_latency:
+            await engine.flush()
+            _jax.block_until_ready(arena.state["received"])
+            tick_durations.append(time.perf_counter() - tick_t0)
+        else:
+            await engine.drain_queues()
+    await engine.flush()
+    _jax.block_until_ready(arena.state["received"])
+    elapsed = time.perf_counter() - t0
+
+    # one publish per account per tick + one delivery per follow edge
+    messages = (n_accounts + fanout.edge_count) * n_ticks
+    stats: Dict[str, float] = {
+        "accounts": n_accounts,
+        "edges": fanout.edge_count,
+        "ticks": n_ticks,
+        "seconds": elapsed,
+        "messages": messages,
+        "messages_per_sec": messages / elapsed,
+        "mean_tick_seconds": elapsed / n_ticks,
+    }
+    if tick_durations:
+        d = np.asarray(tick_durations)
+        stats["tick_p50_seconds"] = float(np.percentile(d, 50))
+        stats["tick_p99_seconds"] = float(np.percentile(d, 99))
+    return stats

@@ -1,0 +1,162 @@
+"""TwitterSentiment sample — batched per-hashtag sentiment scoring.
+
+Parity: reference Samples/TwitterSentiment — a [StatelessWorker]
+TweetDispatcherGrain fans each tweet's hashtags out to per-hashtag
+grains, which accumulate positive/negative/total counts and notify a
+singleton CounterGrain the first time each hashtag activates (reference:
+Samples/TwitterSentiment/TwitterGrains/TweetDispatcherGrain.cs:45
+AddScore fan-out; HashtagGrain.cs — AddScore :70, first-activation
+counter :55; CounterGrain.cs — IncrementCounter with write-every-100).
+
+TPU-native shape: the dispatcher tier IS the batch — a tick's tweets
+flatten host-side into one (hashtag_key, score) tensor (the stateless
+worker had no state to vectorize); hashtag rows absorb the fan-in with
+sign-split segment sums on the VPU; and the "first activation" signal
+becomes a one-element emit carrying the count of newly-touched rows —
+a whole tick's activations reach the counter as ONE message, which is
+the batched version of the reference's write-batching optimisation.
+Hashtag strings hash into the int31 device key space (device routing is
+int32-keyed; see tensor/arena.py device_resolve).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.core.grain import batched_method
+from orleans_tpu.hashing import jenkins_hash
+from orleans_tpu.tensor import (
+    Batch,
+    Emit,
+    VectorGrain,
+    field,
+    scatter_rows,
+    seg_sum,
+    vector_grain,
+)
+
+COUNTER_KEY = 0  # singleton counter grain key (reference: GetGrain<ICounter>(0))
+
+
+def hashtag_key(tag: str) -> int:
+    """Map a hashtag string into the int31 device-routable key space."""
+    return jenkins_hash(tag.lower().encode()) & 0x7FFFFFFE
+
+
+@vector_grain
+class HashtagGrain(VectorGrain):
+    """Per-hashtag sentiment totals (reference: HashtagGrain.cs:49
+    TotalsState — Positive/Negative/Total/BeenCounted)."""
+
+    total = field(jnp.int32, 0)
+    positive = field(jnp.int32, 0)
+    negative = field(jnp.int32, 0)
+    counted = field(jnp.int32, 0)         # 0 until first touch
+    last_score = field(jnp.int32, 0)
+
+    @batched_method
+    @staticmethod
+    def add_score(state, batch: Batch, n_rows: int):
+        rows, args = batch.rows, batch.args
+        score = jnp.asarray(args["score"], jnp.int32)
+        ones = jnp.asarray(batch.mask, jnp.int32)
+        touched = seg_sum(ones, rows, n_rows) > 0
+        newly = touched & (state["counted"] == 0)
+        state = {
+            **state,
+            "total": state["total"] + seg_sum(ones, rows, n_rows),
+            "positive": state["positive"] + seg_sum(
+                jnp.asarray(batch.mask & (score > 0), jnp.int32),
+                rows, n_rows),
+            "negative": state["negative"] + seg_sum(
+                jnp.asarray(batch.mask & (score < 0), jnp.int32),
+                rows, n_rows),
+            "counted": jnp.asarray(touched, jnp.int32) | state["counted"],
+            "last_score": scatter_rows(state["last_score"], rows, score),
+        }
+        # the whole tick's first activations reach the counter as ONE
+        # message (reference: HashtagGrain.OnActivateAsync → counter
+        # IncrementCounter per grain, batched here by construction)
+        emit = Emit(
+            interface="TweetCounterGrain", method="increment",
+            keys=jnp.asarray([COUNTER_KEY], jnp.int32),
+            args={"n": jnp.sum(jnp.asarray(newly, jnp.int32))[None]})
+        return state, None, (emit,)
+
+
+@vector_grain
+class TweetCounterGrain(VectorGrain):
+    """Singleton activation counter (reference: CounterGrain.cs:46)."""
+
+    hashtags = field(jnp.int32, 0)
+
+    @batched_method
+    @staticmethod
+    def increment(state, batch: Batch, n_rows: int):
+        n = jnp.where(batch.mask, jnp.asarray(batch.args["n"], jnp.int32), 0)
+        return {
+            **state,
+            "hashtags": state["hashtags"] + seg_sum(n, batch.rows, n_rows),
+        }
+
+
+def flatten_tweets(tweets: Sequence[Dict]) -> Dict[str, np.ndarray]:
+    """Dispatcher tier (reference: TweetDispatcherGrain.AddScore :45):
+    flatten a batch of tweets into one (hashtag_key, score) tensor."""
+    keys: List[int] = []
+    scores: List[int] = []
+    for tw in tweets:
+        for tag in tw["hashtags"]:
+            keys.append(hashtag_key(tag))
+            scores.append(int(tw["score"]))
+    return {"keys": np.asarray(keys, dtype=np.int64),
+            "scores": np.asarray(scores, dtype=np.int32)}
+
+
+async def run_twitter_load(engine, n_tweets_per_tick: int = 50_000,
+                           n_hashtags: int = 5_000, tags_per_tweet: int = 2,
+                           n_ticks: int = 10, zipf_a: float = 1.4,
+                           seed: int = 0) -> Dict[str, float]:
+    """Synthetic firehose: hashtag popularity ~ Zipf (a few trending tags
+    absorb most of the traffic — the hot-row stress), sentiment scores in
+    {-1, 0, +1}."""
+    import jax as _jax
+
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_hashtags + 1, dtype=np.float64)
+    weights = ranks ** (-zipf_a)
+    weights /= weights.sum()
+    tag_keys = (np.arange(n_hashtags, dtype=np.int64) * 2654435761) \
+        % 0x7FFFFFFE  # pre-hashed tag key space
+
+    engine.arena_for("HashtagGrain").reserve(n_hashtags)
+    engine.arena_for("TweetCounterGrain").reserve(1)
+
+    m = n_tweets_per_tick * tags_per_tweet
+    t0 = time.perf_counter()
+    for t in range(n_ticks):
+        tag_idx = rng.choice(n_hashtags, size=m, p=weights)
+        engine.send_batch("HashtagGrain", "add_score", tag_keys[tag_idx], {
+            "score": rng.integers(-1, 2, size=m).astype(np.int32),
+        })
+        await engine.drain_queues()
+    await engine.flush()
+    arena = engine.arena_for("HashtagGrain")
+    _jax.block_until_ready(arena.state["total"])
+    elapsed = time.perf_counter() - t0
+
+    # per reference accounting: one AddScore per (tweet, hashtag) + one
+    # dispatcher RPC per tweet
+    messages = (m + n_tweets_per_tick) * n_ticks
+    return {
+        "tweets": n_tweets_per_tick * n_ticks,
+        "hashtags": n_hashtags,
+        "ticks": n_ticks,
+        "seconds": elapsed,
+        "messages": messages,
+        "messages_per_sec": messages / elapsed,
+    }

@@ -1,0 +1,350 @@
+"""Benchmark-workload samples: Chirper, GPSTracker, TwitterSentiment.
+
+These are the three BASELINE.json configs beyond HelloWorld/Presence.
+Each test checks the vector-grain implementation against an exact
+host-side (numpy/dict) oracle of the reference semantics:
+Chirper's follower fan-out (ChirperAccount.cs:129-156), GPSTracker's
+movement gate + speed (DeviceGrain.cs:37), TwitterSentiment's
+per-hashtag scoring + first-activation counting (HashtagGrain.cs:70).
+"""
+
+import numpy as np
+import pytest
+
+from orleans_tpu.tensor import DeviceFanout, FanoutOverflowError, TensorEngine
+from orleans_tpu.tensor.fanout import KEY_SENTINEL
+
+from samples.chirper import (
+    ChirperAccount,
+    build_follow_graph,
+    run_chirper_load,
+)
+from samples.gpstracker import (
+    N_NOTIFIERS,
+    DeviceGrain,
+    PushNotifierGrain,
+    run_gps_load,
+)
+from samples.twitter_sentiment import (
+    TweetCounterGrain,
+    HashtagGrain,
+    flatten_tweets,
+    hashtag_key,
+    run_twitter_load,
+)
+
+
+# ---------------------------------------------------------------------------
+# DeviceFanout (the ragged-expansion primitive)
+# ---------------------------------------------------------------------------
+
+def test_fanout_expansion_matches_adjacency():
+    import jax.numpy as jnp
+
+    fan = DeviceFanout(budget=64)
+    adj = {1: [10, 11, 12], 2: [20], 5: [50, 51]}
+    for s, ds in adj.items():
+        for d in ds:
+            fan.follow(s, d)
+
+    src = jnp.asarray(np.array([2, 1, 7, 5], np.int32))  # 7 has no followers
+    args = {"v": jnp.asarray(np.array([200, 100, 700, 500], np.int32))}
+    dst, gargs, valid = fan.expand(src, args)
+    dst, v, sk, valid = (np.asarray(dst), np.asarray(gargs["v"]),
+                         np.asarray(gargs["src_key"]), np.asarray(valid))
+    got = sorted(zip(dst[valid].tolist(), v[valid].tolist(),
+                     sk[valid].tolist()))
+    want = sorted([(20, 200, 2), (10, 100, 1), (11, 100, 1), (12, 100, 1),
+                   (50, 500, 5), (51, 500, 5)])
+    assert got == want
+    assert (dst[~valid] == KEY_SENTINEL).all()
+    assert fan.overflow_check() == 6
+
+
+def test_fanout_mutation_and_empty_graph():
+    import jax.numpy as jnp
+
+    fan = DeviceFanout(budget=16)
+    src = jnp.asarray(np.array([3], np.int32))
+    dst, _, valid = fan.expand(src, {"v": jnp.zeros(1)})
+    assert not np.asarray(valid).any()          # empty graph: no expansion
+
+    fan.follow(3, 9)
+    dst, _, valid = fan.expand(src, {"v": jnp.zeros(1)})
+    assert np.asarray(dst)[np.asarray(valid)].tolist() == [9]
+
+    fan.unfollow(3, 9)                          # mirror rebuilds lazily
+    dst, _, valid = fan.expand(src, {"v": jnp.zeros(1)})
+    assert not np.asarray(valid).any()
+
+
+def test_fanout_overflow_detected():
+    import jax.numpy as jnp
+
+    fan = DeviceFanout(budget=4)
+    for d in range(3):
+        fan.follow(1, 100 + d)
+    # two publishes from key 1 in one round: 6 expansions > budget 4
+    src = jnp.asarray(np.array([1, 1], np.int32))
+    fan.expand(src, {"v": jnp.zeros(2)})
+    with pytest.raises(FanoutOverflowError):
+        fan.overflow_check()
+
+
+# ---------------------------------------------------------------------------
+# Chirper
+# ---------------------------------------------------------------------------
+
+def test_chirper_exact_small_graph(run):
+    """5 accounts, known graph: received counts / checksums must equal the
+    sequential per-follower delivery of the reference."""
+
+    async def main():
+        engine = TensorEngine()
+        fan = DeviceFanout(budget=64)
+        adj = {0: [1, 2, 3], 1: [2], 3: [0, 4]}
+        for s, ds in adj.items():
+            for d in ds:
+                fan.follow(s, d)
+
+        stats = await run_chirper_load(engine, n_accounts=5, n_ticks=3,
+                                       fanout=fan)
+        arena = engine.arena_for("ChirperAccount")
+        received = np.asarray(arena.state["received"])
+        rows = arena.resolve_rows(np.arange(5, dtype=np.int64))
+
+        # oracle: per-account fan-in = number of accounts following them
+        followers_of = {k: 0 for k in range(5)}
+        for s, ds in adj.items():
+            for d in ds:
+                followers_of[d] += 1
+        for acct in range(5):
+            assert received[rows[acct]] == 3 * followers_of[acct], acct
+        published = np.asarray(arena.state["published"])
+        assert all(published[rows[a]] == 3 for a in range(5))
+        assert stats["messages"] == 3 * (5 + 6)
+
+    run(main())
+
+
+def test_chirper_power_law_load(run):
+    """Power-law graph at small scale: total deliveries equal edge count
+    per tick and the expansion is exact per account."""
+
+    async def main():
+        engine = TensorEngine()
+        fan = build_follow_graph(200, mean_followers=8.0, seed=3)
+        await run_chirper_load(engine, n_accounts=200, n_ticks=2, fanout=fan)
+        arena = engine.arena_for("ChirperAccount")
+        received = np.asarray(arena.state["received"])
+        rows = arena.resolve_rows(np.arange(200, dtype=np.int64))
+        followers_of = np.zeros(200, np.int64)
+        for s in range(200):
+            for d in fan.followers_of(s):
+                followers_of[d] += 1
+        np.testing.assert_array_equal(received[rows], 2 * followers_of)
+        # power-law sanity: the most-followed account dominates the median
+        deg = np.asarray([len(fan.followers_of(s)) for s in range(200)])
+        assert deg.max() >= 10 * max(1, int(np.median(deg)))
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# GPSTracker
+# ---------------------------------------------------------------------------
+
+def test_gps_movement_gate_and_speed(run):
+    """Only moved devices notify; speed matches the equirectangular
+    formula (reference: DeviceGrain.GetSpeed)."""
+
+    async def main():
+        import jax.numpy as jnp
+
+        engine = TensorEngine()
+        engine.arena_for("DeviceGrain").reserve(4)
+        engine.arena_for("PushNotifierGrain").reserve(N_NOTIFIERS)
+        devices = np.arange(4, dtype=np.int64)
+        inj = engine.make_injector("DeviceGrain", "process_message", devices)
+
+        lat0 = np.array([47.60, 47.61, 47.62, 47.63], np.float32)
+        lon0 = np.full(4, -122.1, np.float32)
+        base = {"lon": jnp.asarray(lon0),
+                "device": jnp.asarray(devices.astype(np.int32))}
+        inj.inject({**base, "lat": jnp.asarray(lat0),
+                    "ts": jnp.full(4, 1.0, jnp.float32)})
+        await engine.flush()
+
+        # second fix: only devices 0 and 2 move (0.001 deg north over 10s)
+        lat1 = lat0 + np.array([1e-3, 0, 1e-3, 0], np.float32)
+        inj.inject({**base, "lat": jnp.asarray(lat1),
+                    "ts": jnp.full(4, 11.0, jnp.float32)})
+        await engine.flush()
+
+        dev_arena = engine.arena_for("DeviceGrain")
+        rows = dev_arena.resolve_rows(devices)
+        moves = np.asarray(dev_arena.state["moves"])[rows]
+        np.testing.assert_array_equal(moves, [2, 1, 2, 1])  # first fix counts
+
+        # expected speed: dist = dlat(rad) * R over 10s
+        expected = np.deg2rad(1e-3) * 6371000.0 / 10.0
+        speed = np.asarray(dev_arena.state["speed"])[rows]
+        # float32 keeps ~1e-6 deg resolution at lat 47 — 1e-3 rtol covers it
+        np.testing.assert_allclose(speed[[0, 2]], expected, rtol=1e-3)
+        np.testing.assert_allclose(speed[[1, 3]], 0.0)
+
+        notif = engine.arena_for("PushNotifierGrain")
+        total_forwarded = int(np.asarray(notif.state["forwarded"]).sum())
+        assert total_forwarded == 4 + 2  # all first fixes + two moves
+
+    run(main())
+
+
+def test_gps_load_driver(run):
+    async def main():
+        engine = TensorEngine()
+        stats = await run_gps_load(engine, n_devices=500, n_ticks=4,
+                                   move_fraction=0.5, seed=1)
+        notif = engine.arena_for("PushNotifierGrain")
+        forwarded = int(np.asarray(notif.state["forwarded"]).sum())
+        assert forwarded == stats["notified"]
+        assert stats["messages"] == 500 * 4 + forwarded
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# TwitterSentiment
+# ---------------------------------------------------------------------------
+
+def test_twitter_scoring_exact(run):
+    """Sign-split totals and the first-activation counter match the
+    reference semantics exactly."""
+
+    async def main():
+        engine = TensorEngine()
+        engine.arena_for("HashtagGrain").reserve(16)
+        engine.arena_for("TweetCounterGrain").reserve(1)
+
+        tweets = [
+            {"hashtags": ["jax", "tpu"], "score": 1},
+            {"hashtags": ["jax"], "score": -1},
+            {"hashtags": ["tpu"], "score": 0},
+            {"hashtags": ["jax", "xla"], "score": 1},
+        ]
+        flat = flatten_tweets(tweets)
+        engine.send_batch("HashtagGrain", "add_score", flat["keys"],
+                          {"score": flat["scores"]})
+        await engine.flush()
+
+        arena = engine.arena_for("HashtagGrain")
+        rows = arena.resolve_rows(np.asarray(
+            [hashtag_key(t) for t in ("jax", "tpu", "xla")], np.int64))
+        total = np.asarray(arena.state["total"])[rows]
+        pos = np.asarray(arena.state["positive"])[rows]
+        neg = np.asarray(arena.state["negative"])[rows]
+        np.testing.assert_array_equal(total, [3, 2, 1])
+        np.testing.assert_array_equal(pos, [2, 1, 1])
+        np.testing.assert_array_equal(neg, [1, 0, 0])
+
+        counter = engine.arena_for("TweetCounterGrain")
+        crow = counter.resolve_rows(np.array([0], np.int64))
+        assert int(np.asarray(counter.state["hashtags"])[crow][0]) == 3
+
+        # second wave: old tags don't re-count, a new one does
+        engine.send_batch("HashtagGrain", "add_score",
+                          np.asarray([hashtag_key("jax"),
+                                      hashtag_key("new")], np.int64),
+                          {"score": np.asarray([1, -1], np.int32)})
+        await engine.flush()
+        assert int(np.asarray(counter.state["hashtags"])[crow][0]) == 4
+
+    run(main())
+
+
+def test_twitter_load_driver(run):
+    async def main():
+        engine = TensorEngine()
+        stats = await run_twitter_load(engine, n_tweets_per_tick=1000,
+                                       n_hashtags=50, tags_per_tweet=2,
+                                       n_ticks=3)
+        arena = engine.arena_for("HashtagGrain")
+        total = int(np.asarray(arena.state["total"]).sum())
+        assert total == 1000 * 2 * 3
+        counter = engine.arena_for("TweetCounterGrain")
+        crow = counter.resolve_rows(np.array([0], np.int64))
+        counted = int(np.asarray(counter.state["hashtags"])[crow][0])
+        assert 0 < counted <= 50
+        assert stats["messages"] == (2000 + 1000) * 3
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Chirper host path (per-message actor parity surface)
+# ---------------------------------------------------------------------------
+
+def test_chirper_host_path(run):
+    """Follow → publish → per-follower delivery over the asyncio host
+    path (reference: ChirperAccount.cs full RPC loop)."""
+
+    async def main():
+        from orleans_tpu.runtime.silo import Silo
+        from samples.chirper_host import IHostChirperAccount
+
+        silo = Silo(name="chirper-host")
+        await silo.start()
+        try:
+            factory = silo.attach_client()
+            a, b, c = (factory.get_grain(IHostChirperAccount, i)
+                       for i in (1001, 1002, 1003))
+            await b.follow(1001)
+            await c.follow(1001)
+            await c.follow(1002)
+            await a.publish(7)
+            await b.publish(8)
+            # one-way new_chirp deliveries drain on the loop
+            import asyncio as _a
+            await _a.sleep(0.05)
+            assert await b.received_count() == 1
+            assert await c.received_count() == 2
+            got = await c.recent_chirps()
+            assert sorted(got) == [(7, 1001), (8, 1002)]
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_fanout_no_duplicate_delivery_on_miss_redelivery(run):
+    """Publishing from NOT-yet-activated keys via the optimistic device
+    path must deliver each chirp to each follower exactly once: the
+    miss-check redelivery (which re-runs the publish state update) must
+    not re-expand the fan-out."""
+
+    async def main():
+        import jax.numpy as jnp
+
+        engine = TensorEngine()
+        fan = DeviceFanout(budget=64)
+        fan.follow(1, 10)
+        fan.follow(1, 11)
+        fan.follow(2, 10)
+        engine.register_fanout("ChirperAccount", "publish", fan,
+                               "ChirperAccount", "new_chirp")
+        # no reserve/injector: publisher keys are unseen -> optimistic
+        # resolution parks a miss-check and redelivers
+        engine.send_batch(
+            "ChirperAccount", "publish",
+            jnp.asarray(np.array([1, 2], np.int32)),
+            {"chirp_id": jnp.asarray(np.array([100, 200], np.int32))})
+        await engine.flush()
+
+        arena = engine.arena_for("ChirperAccount")
+        rows = arena.resolve_rows(np.array([1, 2, 10, 11], np.int64))
+        received = np.asarray(arena.state["received"])[rows]
+        published = np.asarray(arena.state["published"])[rows]
+        np.testing.assert_array_equal(received, [0, 0, 2, 1])
+        np.testing.assert_array_equal(published, [1, 1, 0, 0])
+
+    run(main())

@@ -409,3 +409,64 @@ def test_consumer_resumes_after_deactivation(run):
             await silo.stop(graceful=False)
 
     run(go())
+
+
+def test_pubsub_conflict_replays_delta_only(run):
+    """On an etag write conflict the rendezvous adopts the winner's state
+    and replays only its own delta — additions survive, and removals are
+    not resurrected by the loser's stale view."""
+
+    async def main():
+        from orleans_tpu.streams.pubsub import PubSubRendezvousGrain
+
+        class FakeBridge:
+            def __init__(self):
+                self.durable = {"producers": {"P-other"},
+                                "consumer_subs": {7: _handle(7)}}
+                self.state = None
+                self.fail_next = False
+
+            async def read_state(self):
+                self.state = {k: (set(v) if isinstance(v, set) else dict(v))
+                              for k, v in self.durable.items()}
+
+            async def write_state(self):
+                from orleans_tpu.runtime.storage import InconsistentStateError
+                if self.fail_next:
+                    self.fail_next = False
+                    raise InconsistentStateError("etag", None)
+                self.durable = {"producers": set(self.state["producers"]),
+                                "consumer_subs":
+                                    dict(self.state["consumer_subs"])}
+
+        def _handle(sub_id):
+            class H:
+                subscription_id = sub_id
+                consumer = f"C{sub_id}"
+                stream_id = None
+            return H()
+
+        g = PubSubRendezvousGrain.__new__(PubSubRendezvousGrain)
+        g.producers = {"P-other", "P-mine"}
+        g.consumer_subs = {7: _handle(7)}
+        g._bridge = FakeBridge()
+
+        # removal delta under conflict: 7 must stay removed even though
+        # the winner's durable state still contains it
+        g.consumer_subs.pop(7)
+        g._bridge.fail_next = True
+        await g._save(("remove_consumer", _handle(7)))
+        assert 7 not in g._bridge.durable["consumer_subs"]
+        # and the winner's producer set was preserved (not overwritten by
+        # our stale view): P-mine was never durably written before the
+        # conflict, so only the delta semantics keep the winner's P-other
+        assert "P-other" in g._bridge.durable["producers"]
+
+        # addition delta under conflict survives alongside winner's data
+        g._bridge.durable["consumer_subs"] = {9: _handle(9)}
+        g.consumer_subs[8] = _handle(8)
+        g._bridge.fail_next = True
+        await g._save(("add_consumer", _handle(8)))
+        assert set(g._bridge.durable["consumer_subs"]) == {8, 9}
+
+    run(main())
