@@ -1,0 +1,133 @@
+"""Standalone silo host: ``python -m orleans_tpu.host --config silo.json``.
+
+Parity: reference OrleansHost — a console/service process that loads
+config, constructs one Silo, starts it, and blocks until shutdown
+(reference: src/OrleansHost/Program.cs:29 Main → WindowsServerHost.cs:36
+Init/Run; SiloHost.cs LoadOrleansConfig/StartOrleansSilo).
+
+A real multi-process cluster on one machine::
+
+    python -m orleans_tpu.host --config a.json &
+    python -m orleans_tpu.host --config b.json &
+
+where both configs point at the same sqlite membership/reminder paths —
+the sqlite tables are the cross-process CAS store (the reference's
+SQL/Azure table role) and silo↔silo traffic rides TcpTransport (DCN).
+
+Config file (JSON; every key optional)::
+
+    {
+      "name": "silo-a",
+      "host": "127.0.0.1",          # routable endpoint peers dial
+      "port": 0,                    # 0 = OS-assigned
+      "membership_db": "cluster.db",  # shared sqlite path (omit = solo)
+      "reminder_db": "cluster.db",
+      "storage": {"Default": {"kind": "file", "root": "./state"}},
+      "silo": { ... SiloConfig.from_dict overrides ... }
+    }
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+from typing import Any, Dict, Optional
+
+from orleans_tpu.config import SiloConfig
+from orleans_tpu.runtime.silo import Silo
+from orleans_tpu.runtime.transport import TcpFabric
+
+
+def build_storage_providers(spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Named provider blocks → instances (reference: <Provider Type=...
+    Name=...> blocks instantiated by ProviderLoader)."""
+    from orleans_tpu.providers.file_storage import FileStorage
+    from orleans_tpu.providers.memory_storage import MemoryStorage
+    from orleans_tpu.providers.sqlite_storage import SqliteStorage
+
+    kinds = {
+        "memory": lambda c: MemoryStorage(),
+        "file": lambda c: FileStorage(root=c.get("root", "./grain-state")),
+        "sqlite": lambda c: SqliteStorage(path=c.get("path", ":memory:")),
+    }
+    out = {}
+    for name, cfg in (spec or {}).items():
+        kind = cfg.get("kind", "memory")
+        if kind not in kinds:
+            raise ValueError(f"unknown storage kind {kind!r} for {name!r}")
+        out[name] = kinds[kind](cfg)
+    return out
+
+
+def build_silo(config: Dict[str, Any],
+               fabric: Optional[TcpFabric] = None) -> Silo:
+    """Construct (but do not start) a silo from a host config dict."""
+    silo_cfg = SiloConfig.from_dict({"name": config.get("name", "silo"),
+                                     **config.get("silo", {})})
+    host = config.get("host", "127.0.0.1")
+    fabric = fabric or TcpFabric(host=host)
+    port = int(config.get("port", 0)) or fabric.reserve()
+
+    membership_table = None
+    if config.get("membership_db"):
+        from orleans_tpu.plugins.sqlite_tables import SqliteMembershipTable
+        membership_table = SqliteMembershipTable(config["membership_db"])
+    reminder_table = None
+    if config.get("reminder_db"):
+        from orleans_tpu.plugins.sqlite_tables import SqliteReminderTable
+        reminder_table = SqliteReminderTable(config["reminder_db"])
+
+    return Silo(
+        config=silo_cfg,
+        storage_providers=build_storage_providers(config.get("storage", {})),
+        fabric=fabric,
+        membership_table=membership_table,
+        reminder_table=reminder_table,
+        host=host, port=port,
+    )
+
+
+async def run_host(config: Dict[str, Any],
+                   shutdown: Optional[asyncio.Event] = None) -> None:
+    """Start a silo and serve until ``shutdown`` is set (or SIGINT/SIGTERM
+    arrives) — reference: WindowsServerHost.Run's wait loop."""
+    silo = build_silo(config)
+    shutdown = shutdown or asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, shutdown.set)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-main thread / platform without signal support
+    await silo.start()
+    print(f"silo {silo.name} active at {silo.address.host}:"
+          f"{silo.address.port}", flush=True)
+    try:
+        await shutdown.wait()
+    finally:
+        await silo.stop()
+        print(f"silo {silo.name} stopped", flush=True)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="python -m orleans_tpu.host",
+        description="Run one silo from a JSON config (reference: "
+                    "OrleansHost.exe <deployment.xml>)")
+    parser.add_argument("--config", help="path to JSON host config")
+    parser.add_argument("--name", default=None, help="override silo name")
+    args = parser.parse_args(argv)
+
+    config: Dict[str, Any] = {}
+    if args.config:
+        with open(args.config) as f:
+            config = json.load(f)
+    if args.name:
+        config["name"] = args.name
+    asyncio.run(run_host(config))
+
+
+if __name__ == "__main__":
+    main()

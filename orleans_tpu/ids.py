@@ -202,6 +202,17 @@ class SiloAddress:
     def new_local(cls, host: str = "local", port: int = 0) -> "SiloAddress":
         return cls(host, port, next(cls._counter))
 
+    @classmethod
+    def new_endpoint(cls, host: str, port: int) -> "SiloAddress":
+        """Routable-endpoint identity for multi-PROCESS silos: the
+        generation must be unique across processes (a per-process counter
+        restarts at 1, so a restarted silo at the same endpoint would be
+        indistinguishable from its corpse).  The reference uses the silo
+        start timestamp for exactly this (reference: SiloAddress.cs
+        Generation = timestamp epoch)."""
+        import time
+        return cls(host, port, int(time.time() * 1000) & 0x7FFFFFFF)
+
     def ring_hash(self) -> int:
         """Uniform hash for the silo's point on the consistent ring
         (reference: SiloAddress.GetConsistentHashCode)."""

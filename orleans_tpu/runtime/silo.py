@@ -93,11 +93,18 @@ class Silo:
                  name: str = "silo", port: int = 0,
                  storage_providers: Optional[Dict[str, StorageProvider]] = None,
                  fabric=None, membership_table=None,
-                 reminder_table=None,
+                 reminder_table=None, host: Optional[str] = None,
                  ) -> None:
         self.config = config or SiloConfig(name=name)
         self.name = self.config.name if config else name
-        self.address = SiloAddress.new_local(host=self.name, port=port)
+        # host defaults to the silo NAME (an in-proc label); TCP fabrics
+        # pass a routable host because SiloAddress.host:port IS the
+        # endpoint peers dial (reference: SiloAddress is IP:port+gen).
+        # Routable endpoints get time-based generations so incarnations
+        # stay distinct ACROSS processes (new_endpoint docstring).
+        self.address = (SiloAddress.new_endpoint(host, port)
+                        if host is not None
+                        else SiloAddress.new_local(host=self.name, port=port))
         self.status = SiloStatus.CREATED
         self.logger = TraceLogger(f"silo.{self.name}")
         self.metrics = SiloMetrics()
@@ -188,7 +195,10 @@ class Silo:
     async def start(self) -> None:
         self.status = SiloStatus.JOINING
         if self._fabric is not None:
-            self._bound_transport = self._fabric.attach(self)
+            bound = self._fabric.attach(self)
+            if asyncio.iscoroutine(bound):  # TCP fabrics bind sockets
+                bound = await bound
+            self._bound_transport = bound
             self.message_center.transport = self._bound_transport
         for name, provider in self.storage_providers.items():
             await provider.init(name, {})
@@ -277,6 +287,11 @@ class Silo:
     def _on_ring_changed(self) -> None:
         if self.status != SiloStatus.ACTIVE:
             return
+        # drop transport sender queues for dead endpoints (queued requests
+        # bounce as transient rejections; reference: SiloDeadOracle)
+        prune = getattr(self._bound_transport, "prune_dead", None)
+        if prune is not None:
+            prune(self.active_silos())
         self.grain_directory.schedule_heal()
         gateway = self.system_targets.get("gateway")
         if gateway is not None and gateway._clients:

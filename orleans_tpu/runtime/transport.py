@@ -148,19 +148,35 @@ class TcpTransport:
     """
 
     MAGIC = 0x4F54  # "OT"
+    MAX_QUEUED_PER_DEST = 10_000  # (reference: queue-length overload limits)
+    CONNECT_RETRIES = 3
+    CONNECT_BACKOFF = 0.05
 
-    def __init__(self, silo, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, silo, host: str = "127.0.0.1", port: int = 0,
+                 sock=None) -> None:
         self.silo = silo
         self.host = host
         self.port = port
+        self._sock = sock  # pre-bound listening socket (port reservation)
         self._server: Optional[asyncio.AbstractServer] = None
         self._queues: Dict[SiloAddress, asyncio.Queue] = {}
         self._senders: Dict[SiloAddress, asyncio.Task] = {}
         self._endpoints: Dict[SiloAddress, tuple] = {}
+        # accepted inbound connections: a hard kill must sever these too —
+        # server.close() only stops NEW accepts, and a "dead" silo that
+        # keeps reading from old sockets is a zombie peers never detect
+        self._accepted: set = set()
+        # fault injection parity with InProcTransport
+        self.drop_predicate: Optional[Callable[[Message], bool]] = None
+        self._closing = False
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(self._on_conn, self.host,
-                                                  self.port)
+        if self._sock is not None:
+            self._server = await asyncio.start_server(self._on_conn,
+                                                      sock=self._sock)
+        else:
+            self._server = await asyncio.start_server(self._on_conn,
+                                                      self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
 
     def register_endpoint(self, silo: SiloAddress, host: str, port: int) -> None:
@@ -169,6 +185,7 @@ class TcpTransport:
     async def _on_conn(self, reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
         import time
+        self._accepted.add(writer)
         try:
             while True:
                 header = await reader.readexactly(8)
@@ -184,17 +201,70 @@ class TcpTransport:
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
+            self._accepted.discard(writer)
             writer.close()
 
     def send(self, msg: Message) -> None:
+        if self.drop_predicate is not None and self.drop_predicate(msg):
+            return
         target = msg.target_silo
         queue = self._queues.get(target)
         if queue is None:
-            queue = asyncio.Queue()
+            queue = asyncio.Queue(maxsize=self.MAX_QUEUED_PER_DEST)
             self._queues[target] = queue
             self._senders[target] = asyncio.get_running_loop().create_task(
                 self._sender_loop(target, queue))
-        queue.put_nowait(msg)
+        try:
+            queue.put_nowait(msg)
+        except asyncio.QueueFull:
+            # overload: bounce rather than buffer unboundedly (reference:
+            # queue-length warnings + overload rejection, SURVEY §5)
+            self._bounce(msg, "send queue full")
+
+    def _bounce(self, msg: Message, reason: str) -> None:
+        """Requests come back as transient rejections — like InProc's
+        closed-socket analog — so the caller's resend machinery
+        re-addresses instead of hanging for the full response timeout.
+        Undeliverable RESPONSES are logged (the remote caller's own
+        timeout/dead-silo break covers it — reference behavior), never
+        dropped without a trace."""
+        from orleans_tpu.runtime.messaging import Direction, RejectionType
+        if self._closing:
+            return  # own silo dying: nothing meaningful to bounce into
+        if msg.direction == Direction.REQUEST:
+            self.silo.message_center.deliver_local(msg.create_rejection(
+                RejectionType.TRANSIENT,
+                f"target silo {msg.target_silo} unreachable: {reason}"))
+        else:
+            self.silo.logger.warning(
+                f"dropping undeliverable {msg.direction.name} to "
+                f"{msg.target_silo}: {reason}")
+
+    def prune_dead(self, live) -> None:
+        """Drop sender tasks/queues for destinations no longer in the live
+        set (membership declared them dead); queued requests bounce.
+        Keyed by FULL address — a restarted silo at the same endpoint is a
+        different incarnation whose corpse's queue must still die.
+        (reference: MessageCenter.SiloDeadOracle breaking sends)"""
+        live_set = set(live)
+        for target in list(self._queues):
+            if target in live_set:
+                continue
+            queue = self._queues.pop(target)
+            task = self._senders.pop(target, None)
+            if task is not None:
+                task.cancel()
+            while not queue.empty():
+                self._bounce(queue.get_nowait(), "silo declared dead")
+
+    async def _connect(self, endpoint) -> Optional[asyncio.StreamWriter]:
+        for attempt in range(self.CONNECT_RETRIES):
+            try:
+                _, writer = await asyncio.open_connection(*endpoint)
+                return writer
+            except OSError:
+                await asyncio.sleep(self.CONNECT_BACKOFF * (attempt + 1))
+        return None
 
     async def _sender_loop(self, target: SiloAddress,
                            queue: asyncio.Queue) -> None:
@@ -202,19 +272,23 @@ class TcpTransport:
         import dataclasses
         import time
         writer: Optional[asyncio.StreamWriter] = None
+        msg: Optional[Message] = None
         try:
             while True:
+                msg = None
                 msg = await queue.get()
                 if msg is None:
                     break
                 if writer is None or writer.is_closing():
                     endpoint = self._endpoints.get(
                         target, (target.host, target.port))
-                    try:
-                        _, writer = await asyncio.open_connection(*endpoint)
-                    except OSError:
-                        writer = None
-                        continue  # closed-socket analog; membership notices
+                    writer = await self._connect(endpoint)
+                    if writer is None:
+                        # NOT a silent drop: bounce so callers resend via
+                        # the (healing) directory; membership probes will
+                        # declare the peer dead and prune this queue
+                        self._bounce(msg, "connect failed")
+                        continue
                 wire = dataclasses.replace(msg)
                 if wire.expiration is not None:
                     wire.expiration = max(0.0,
@@ -240,18 +314,108 @@ class TcpTransport:
                 try:
                     await writer.drain()
                 except ConnectionError:
+                    # peer died under an established connection: the frame
+                    # may or may not have landed — bounce so the caller's
+                    # resend machinery decides (at-least-once, like the
+                    # reference's resend-on-failure), never a silent drop
                     writer = None
+                    self._bounce(msg, "connection lost")
         except asyncio.CancelledError:
-            pass
+            # prune cancelled us mid-message (connect backoff / drain):
+            # the in-hand message must bounce like the queued ones
+            if msg is not None:
+                self._bounce(msg, "silo declared dead")
         finally:
             if writer is not None:
                 writer.close()
 
-    async def close(self) -> None:
+    def close_nowait(self) -> None:
+        """Synchronous teardown (hard-kill path): cancel senders, stop
+        accepting.  No drain — the point of a kill is that peers must
+        detect the corpse."""
+        self._closing = True
         for task in self._senders.values():
             task.cancel()
         self._senders.clear()
         self._queues.clear()
+        for w in list(self._accepted):
+            w.close()
+        self._accepted.clear()
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            self._server = None
+
+    async def close(self) -> None:
+        self.close_nowait()
+
+
+class TcpFabric:
+    """A fabric (Silo-attachable like InProcTransport) whose silos talk
+    over real TCP sockets — used by TestingCluster(transport="tcp") so the
+    multi-silo suite exercises the actual DCN path: framing, TTL rebase,
+    connect failures, sender queues (reference: the AppDomain cluster still
+    used real sockets between silos, TestingSiloHost.cs:58).
+
+    Port discipline: a silo's SiloAddress must carry its REAL port before
+    membership ever sees it, but the OS assigns ephemeral ports only at
+    bind time — so ``reserve()`` binds a listening socket first and the
+    Silo is constructed with that port (the reference solves this by
+    configuring explicit ports per silo).
+    """
+
+    def __init__(self, host: str = "127.0.0.1") -> None:
+        self.host = host
+        self._reserved: Dict[int, Any] = {}   # port → bound socket
+        self.transports: Dict[SiloAddress, TcpTransport] = {}
+        self.drop_predicate: Optional[Callable[[Message], bool]] = None
+        self.messages_carried = 0  # diagnostic parity with InProcTransport
+
+    def reserve(self) -> int:
+        """Bind an ephemeral listening socket now; returns its port."""
+        import socket
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.host, 0))
+        sock.setblocking(False)
+        port = sock.getsockname()[1]
+        self._reserved[port] = sock
+        return port
+
+    async def attach(self, silo) -> "TcpBoundTransport":
+        sock = self._reserved.pop(silo.address.port, None)
+        transport = TcpTransport(silo, host=self.host,
+                                 port=silo.address.port, sock=sock)
+        transport.drop_predicate = self._drop_and_count
+        await transport.start()
+        self.transports[silo.address] = transport
+        return TcpBoundTransport(self, silo.address, transport)
+
+    def _drop_and_count(self, msg: Message) -> bool:
+        if self.drop_predicate is not None and self.drop_predicate(msg):
+            return True
+        self.messages_carried += 1
+        return False
+
+    def detach(self, address: SiloAddress) -> None:
+        transport = self.transports.pop(address, None)
+        if transport is not None:
+            transport.close_nowait()
+
+
+class TcpBoundTransport:
+    """A silo's handle on a TcpFabric (same surface as BoundTransport)."""
+
+    def __init__(self, fabric: TcpFabric, address: SiloAddress,
+                 transport: TcpTransport) -> None:
+        self.fabric = fabric
+        self.address = address
+        self.transport = transport
+
+    def send(self, msg: Message) -> None:
+        self.transport.send(msg)
+
+    def prune_dead(self, live) -> None:
+        self.transport.prune_dead(live)
+
+    def close(self) -> None:
+        self.fabric.detach(self.address)

@@ -157,6 +157,8 @@ class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
             self.producers.add(value)
         elif kind == "remove_producer":
             self.producers.discard(value)
+        elif kind == "remove_producers":
+            self.producers -= value
         elif kind == "add_consumer":
             self.consumer_subs[value.subscription_id] = value
         elif kind == "remove_consumer":
@@ -236,4 +238,4 @@ class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
         for p in dead:
             self.producers.discard(p)
         if dead:
-            await self._save()
+            await self._save(("remove_producers", set(dead)))

@@ -716,13 +716,17 @@ class BatchInjector:
         return future
 
 
-# module-level caches for tiny helper arrays (one eager creation per size)
+# module-level caches for tiny helper arrays (one eager creation per size);
+# bounded so churning batch sizes cannot grow device memory forever
 _mask_cache: Dict[int, jnp.ndarray] = {}
+_MASK_CACHE_MAX = 256
 
 
 def _mask_for(n: int) -> jnp.ndarray:
     m = _mask_cache.get(n)
     if m is None:
+        if len(_mask_cache) >= _MASK_CACHE_MAX:
+            _mask_cache.clear()
         m = jnp.asarray(np.ones(n, dtype=bool))
         _mask_cache[n] = m
     return m

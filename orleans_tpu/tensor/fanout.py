@@ -175,7 +175,10 @@ class DeviceFanout:
         dst, src_index, out_valid, total = _expand_kernel(
             self._csr_keys, self._csr_offsets, self._csr_dst,
             src_keys, mask)
-        self._pending_totals.append(total)
+        # pair the total with THIS round's width — a rebuild before the
+        # next overflow_check may change the width, and comparing old
+        # totals against a new width would mask (or invent) overflows
+        self._pending_totals.append((total, self._csr_dst.shape[0]))
         gathered = jax.tree_util.tree_map(
             lambda a: a if jnp.ndim(a) == 0 else jnp.asarray(a)[src_index],
             args)
@@ -185,24 +188,31 @@ class DeviceFanout:
 
     def overflow_check(self) -> int:
         """Synchronize parked totals; raises FanoutOverflowError if any
-        round expanded past the output width (messages were dropped)."""
+        round expanded past its round's output width (messages were
+        dropped)."""
         totals, self._pending_totals = self._pending_totals, []
-        worst = max((int(t) for t in totals), default=0)
-        width = self._csr_dst.shape[0] if self._csr_dst is not None else 0
-        if width and worst > width:
-            raise FanoutOverflowError(
-                f"expansion needed {worst} slots, width {width} "
-                f"(budget {self.budget})")
+        worst = 0
+        for total, width in totals:
+            t = int(total)
+            worst = max(worst, t)
+            if t > width:
+                raise FanoutOverflowError(
+                    f"expansion needed {t} slots, width {width} "
+                    f"(budget {self.budget})")
         return worst
 
 
-# cached all-true masks, one eager device array per distinct batch size
+# cached all-true masks, one eager device array per distinct batch size;
+# bounded — workloads with churning batch sizes must not grow this forever
 _mask_cache: Dict[int, jnp.ndarray] = {}
+_MASK_CACHE_MAX = 256
 
 
 def _ones_mask(n: int) -> jnp.ndarray:
     m = _mask_cache.get(n)
     if m is None:
+        if len(_mask_cache) >= _MASK_CACHE_MAX:
+            _mask_cache.clear()
         m = jnp.asarray(np.ones(n, dtype=bool))
         _mask_cache[n] = m
     return m

@@ -33,12 +33,23 @@ class TestingCluster:
     def __init__(self, n_silos: int = 2,
                  config_factory: Optional[Callable[[str], SiloConfig]] = None,
                  wire_fidelity: bool = True,
-                 silo_setup: Optional[Callable[[Silo], None]] = None) -> None:
+                 silo_setup: Optional[Callable[[Silo], None]] = None,
+                 transport: str = "inproc") -> None:
         self.n_initial = n_silos
         self.config_factory = config_factory or self._default_config
         # per-silo wiring hook (providers etc.) run before silo.start()
         self.silo_setup = silo_setup
-        self.fabric = InProcTransport(wire_fidelity=wire_fidelity)
+        # "inproc": wire-fidelity in-memory fabric (fast default);
+        # "tcp": real sockets between silos on this loop — the DCN path
+        # (framing, TTL rebase, connect failure, queue bounds) under the
+        # same kill/restart suite (reference: the AppDomain test cluster
+        # still spoke real TCP between silos)
+        self.transport = transport
+        if transport == "tcp":
+            from orleans_tpu.runtime.transport import TcpFabric
+            self.fabric = TcpFabric()
+        else:
+            self.fabric = InProcTransport(wire_fidelity=wire_fidelity)
         self.table = InMemoryMembershipTable()
         # shared durable reminder store (reference: TestingSiloHost's
         # ReminderTableGrain / shared in-proc stores)
@@ -75,6 +86,9 @@ class TestingCluster:
         if name is None:
             self._counter += 1
             name = f"silo{self._counter}"
+        host, port = None, 0
+        if self.transport == "tcp":
+            host, port = self.fabric.host, self.fabric.reserve()
         silo = Silo(
             config=self.config_factory(name),
             storage_providers={
@@ -84,6 +98,7 @@ class TestingCluster:
             fabric=self.fabric,
             membership_table=self.table,
             reminder_table=self.reminder_table,
+            host=host, port=port,
         )
         if self.silo_setup is not None:
             self.silo_setup(silo)

@@ -16,8 +16,8 @@ import asyncio
 from collections import deque
 from typing import Deque, List
 
-from orleans_tpu import Grain, grain_interface, one_way
-from orleans_tpu.core.grain import grain_class
+from orleans_tpu import Grain, grain_interface
+from orleans_tpu.core.grain import grain_class, reentrant
 
 RECEIVED_CACHE_SIZE = 100  # reference: ChirperAccount ReceivedMessagesCacheSize
 
@@ -27,14 +27,22 @@ class IHostChirperAccount:
     async def follow(self, publisher: int): ...
     async def add_follower(self, follower: int): ...
     async def publish(self, chirp_id: int): ...
-    @one_way
+    # NOT one-way: publish awaits every delivery, matching the reference's
+    # Task.WhenAll over subscriber NewChirp calls (ChirperAccount.cs:156) —
+    # and keeping the bench baseline honest (one-way would stop the clock
+    # before any delivery executed)
     async def new_chirp(self, chirp_id: int, author: int): ...
     async def received_count(self) -> int: ...
     async def recent_chirps(self) -> list: ...
 
 
 @grain_class
+@reentrant
 class HostChirperAccountGrain(Grain, IHostChirperAccount):
+    """Reentrant: publish awaits every follower's new_chirp, and follow
+    graphs have cycles — without interleaving, two accounts publishing to
+    each other would deadlock their turns (the classic awaited-fan-out
+    cycle; the reference mitigates the same hazard with [Reentrant])."""
     def __init__(self) -> None:
         self.followers: List[int] = []
         self.following: List[int] = []

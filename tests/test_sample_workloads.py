@@ -303,9 +303,7 @@ def test_chirper_host_path(run):
             await c.follow(1002)
             await a.publish(7)
             await b.publish(8)
-            # one-way new_chirp deliveries drain on the loop
-            import asyncio as _a
-            await _a.sleep(0.05)
+            # publish awaits all deliveries (reference WhenAll parity)
             assert await b.received_count() == 1
             assert await c.received_count() == 2
             got = await c.recent_chirps()
