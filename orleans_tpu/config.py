@@ -94,6 +94,15 @@ class TensorEngineConfig:
 @dataclass
 class SiloConfig:
     name: str = "silo"
+    # DeploymentLoadPublisher cadence (reference: GlobalConfiguration
+    # DeploymentLoadPublisherRefreshTime); 0 disables the broadcast
+    load_publish_period: float = 1.0
+    # watchdog health-check cadence (reference: Watchdog.cs
+    # healthCheckPeriod); 0 disables the watchdog
+    watchdog_period: float = 5.0
+    # False = transient observer member (admin CLI): joins membership but
+    # takes no grain placements and no ring ranges
+    host_grains: bool = True
     # run a client gateway on this silo (reference: NodeConfiguration
     # ProxyGatewayEndpoint — silos without one don't accept clients and
     # are not advertised by gateway list providers)

@@ -22,6 +22,10 @@ Config file (JSON; every key optional)::
       "port": 0,                    # 0 = OS-assigned
       "membership_db": "cluster.db",  # shared sqlite path (omit = solo)
       "reminder_db": "cluster.db",
+      "imports": ["myapp.grains"],  # app modules to import (registers
+                                    # grain classes — the assembly-load
+                                    # analog; also needed by the admin
+                                    # CLI for lookup/unregister keys)
       "storage": {"Default": {"kind": "file", "root": "./state"}},
       "silo": { ... SiloConfig.from_dict overrides ... }
     }
@@ -64,6 +68,11 @@ def build_storage_providers(spec: Dict[str, Any]) -> Dict[str, Any]:
 def build_silo(config: Dict[str, Any],
                fabric: Optional[TcpFabric] = None) -> Silo:
     """Construct (but do not start) a silo from a host config dict."""
+    import importlib
+    for mod in config.get("imports", ()):
+        # application grain modules register their classes on import
+        # (reference: SiloAssemblyLoader directory scan, Silo.cs:433)
+        importlib.import_module(mod)
     silo_cfg = SiloConfig.from_dict({"name": config.get("name", "silo"),
                                      **config.get("silo", {})})
     host = config.get("host", "127.0.0.1")

@@ -209,9 +209,12 @@ class SiloAddress:
         restarts at 1, so a restarted silo at the same endpoint would be
         indistinguishable from its corpse).  The reference uses the silo
         start timestamp for exactly this (reference: SiloAddress.cs
-        Generation = timestamp epoch)."""
+        Generation = timestamp epoch).  Full millisecond timestamp: the
+        wire codec varint-encodes it, and truncating to 31 bits would
+        wrap every ~25 days, breaking the 'newer incarnation has larger
+        generation' ordering that corpse cleanup relies on."""
         import time
-        return cls(host, port, int(time.time() * 1000) & 0x7FFFFFFF)
+        return cls(host, port, int(time.time() * 1000))
 
     def ring_hash(self) -> int:
         """Uniform hash for the silo's point on the consistent ring

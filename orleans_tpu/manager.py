@@ -1,0 +1,115 @@
+"""Cluster admin CLI: ``python -m orleans_tpu.manager <command>``.
+
+Parity: reference OrleansManager — a console tool speaking to the cluster
+through the management grain: grainstats, collect, lookup, unregister
+(reference: src/OrleansManager/Program.cs — command dispatch; the grain
+calls land on ManagementGrain.cs:38 → per-silo SiloControl.cs:33).
+
+Cluster attachment: the CLI joins the cluster the way a host process does
+— same JSON config (``--config``, see orleans_tpu/host.py) pointing at
+the shared sqlite membership table — as a transient, non-hosting member
+(gateway/reminders/tensor disabled), runs the command through the
+management grain, and leaves gracefully.
+
+Commands::
+
+    hosts                      list silos and their status
+    stats                      per-silo runtime statistics
+    grainstats                 per-type activation counts (host + tensor)
+    activations                total activation count
+    collect [age_limit]        force idle-activation collection
+    tensor-collect [ticks]     force vector-grain row collection
+    lookup <type> <key>        directory lookup for one grain
+    unregister <type> <key>    force-remove a directory registration
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+from typing import Any, Dict
+
+from orleans_tpu.core.grain import grain_id_for
+
+
+def _management_ref(silo):
+    from orleans_tpu.runtime.management import IManagementGrain
+    return silo.attach_client().get_grain(IManagementGrain, 0)
+
+
+async def run_command(config: Dict[str, Any], command: str,
+                      args: list) -> Any:
+    """Join, run one admin command, leave.  Returns the printable result."""
+    from orleans_tpu.host import build_silo
+
+    config = dict(config)
+    config.setdefault("name", "manager-cli")
+    # transient admin member: observes and manages, hosts nothing extra
+    silo_overrides = dict(config.get("silo", {}))
+    silo_overrides.setdefault("gateway_enabled", False)
+    silo_overrides.setdefault("host_grains", False)
+    silo_overrides.setdefault("reminders", {"enabled": False})
+    silo_overrides.setdefault("tensor", {"enabled": False})
+    config["silo"] = silo_overrides
+
+    silo = build_silo(config)
+    await silo.start()
+    try:
+        mgmt = _management_ref(silo)
+        if command == "hosts":
+            return await mgmt.get_hosts(False)
+        if command == "stats":
+            return [vars(s) if hasattr(s, "__dict__") else s
+                    for s in await mgmt.get_runtime_statistics()]
+        if command == "grainstats":
+            return [f"{s.plane}:{s.grain_type}@{s.silo}"
+                    f" = {s.activation_count}"
+                    for s in await mgmt.get_simple_grain_statistics()]
+        if command == "activations":
+            return await mgmt.get_total_activation_count()
+        if command == "collect":
+            age = float(args[0]) if args else 0.0
+            return await mgmt.force_activation_collection(age)
+        if command == "tensor-collect":
+            ticks = int(args[0]) if args else 0
+            return await mgmt.force_tensor_collection(ticks)
+        if command in ("lookup", "unregister"):
+            if len(args) < 2:
+                raise SystemExit(f"{command} needs: <interface> <key>")
+            gid = grain_id_for(args[0], int(args[1]))
+            if command == "lookup":
+                return await mgmt.lookup(gid)
+            return await mgmt.unregister(gid)
+        raise SystemExit(f"unknown command {command!r}")
+    finally:
+        await silo.stop()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="python -m orleans_tpu.manager",
+        description="Cluster admin CLI (reference: OrleansManager)")
+    parser.add_argument("--config", help="host JSON config "
+                        "(shared membership_db locates the cluster)")
+    parser.add_argument("command", help="hosts | stats | grainstats | "
+                        "activations | collect | tensor-collect | "
+                        "lookup | unregister")
+    parser.add_argument("args", nargs="*")
+    ns = parser.parse_args(argv)
+
+    config: Dict[str, Any] = {}
+    if ns.config:
+        with open(ns.config) as f:
+            config = json.load(f)
+
+    result = asyncio.run(run_command(config, ns.command, ns.args))
+    if isinstance(result, (list, tuple)):
+        for row in result:
+            print(row)
+    else:
+        print(result)
+
+
+if __name__ == "__main__":
+    main()

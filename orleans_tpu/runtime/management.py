@@ -1,0 +1,216 @@
+"""Admin/management surface: per-silo control target + cluster-wide
+management grain.
+
+Parity: reference SiloControl (a system target on every silo exposing
+runtime stats, grain statistics, forced collection, directory ops —
+reference: src/OrleansRuntime/Silo/SiloControl.cs:33) and ManagementGrain
+(a normal grain that fans admin operations out to the SiloControl of each
+selected silo — reference: src/OrleansRuntime/Core/ManagementGrain.cs:38).
+The OrleansManager CLI drives this surface (orleans_tpu/manager.py;
+reference: src/OrleansManager/Program.cs — grainstats, collect,
+unregister, lookup).
+
+TPU angle: grain statistics and forced collection cover BOTH planes —
+host activations (catalog) and vector-grain arena rows (tensor engine),
+so one admin surface manages the whole framework.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from orleans_tpu import Grain, grain_interface
+from orleans_tpu.core.grain import grain_class
+from orleans_tpu.ids import GrainId, SiloAddress
+
+
+@dataclass
+class SimpleGrainStatistic:
+    """(reference: SimpleGrainStatistic — type/silo/activation count)"""
+
+    grain_type: str
+    silo: SiloAddress
+    activation_count: int
+    plane: str = "host"  # "host" (catalog) | "tensor" (arena rows)
+
+
+@dataclass
+class DetailedGrainReport:
+    """(reference: DetailedGrainReport.cs)"""
+
+    grain_id: GrainId
+    silo: SiloAddress
+    local_activations: List[str]
+    directory_entry: Optional[str]
+    is_directory_owner: bool
+
+
+class SiloControl:
+    """Per-silo admin system target (reference: SiloControl.cs:33)."""
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+
+    async def ping(self, message: str = "") -> str:
+        """(reference: SiloControl.Ping :46)"""
+        return f"pong from {self.silo.address}"
+
+    async def get_runtime_statistics(self):
+        """(reference: GetRuntimeStatistics :101)"""
+        from orleans_tpu.runtime.load_publisher import collect_silo_statistics
+        return collect_silo_statistics(self.silo)
+
+    async def get_activation_count(self) -> int:
+        """(reference: GetActivationCount :134)"""
+        return len(self.silo.catalog.directory)
+
+    async def get_simple_grain_statistics(self) -> List[SimpleGrainStatistic]:
+        """Per-type activation counts on this silo, both planes
+        (reference: GetSimpleGrainStatistics :113)."""
+        counts: Dict[str, int] = {}
+        for act in self.silo.catalog.directory.all():
+            counts[act.class_info.cls.__name__] = \
+                counts.get(act.class_info.cls.__name__, 0) + 1
+        stats = [SimpleGrainStatistic(t, self.silo.address, n)
+                 for t, n in sorted(counts.items())]
+        if self.silo.tensor_engine is not None:
+            stats.extend(
+                SimpleGrainStatistic(name, self.silo.address, a.live_count,
+                                     plane="tensor")
+                for name, a in sorted(self.silo.tensor_engine.arenas.items()))
+        return stats
+
+    async def force_activation_collection(self,
+                                          age_limit: float = 0.0) -> int:
+        """Collect idle host activations now; age_limit 0 = collect all
+        idle (reference: ForceActivationCollection :89)."""
+        return self.silo.catalog.collect_idle_activations(
+            age_limit if age_limit > 0 else 0.0)
+
+    async def force_tensor_collection(self, idle_ticks: int = 0) -> int:
+        """Collect idle vector-grain rows now (the tensor-plane analog of
+        forced collection)."""
+        engine = self.silo.tensor_engine
+        if engine is None:
+            return 0
+        return engine.collect_idle(idle_ticks)
+
+    async def get_detailed_grain_report(self, grain_id: GrainId
+                                        ) -> DetailedGrainReport:
+        """(reference: GetDetailedGrainReport :120)"""
+        directory = self.silo.grain_directory
+        entry = directory.partition.lookup(grain_id)
+        return DetailedGrainReport(
+            grain_id=grain_id,
+            silo=self.silo.address,
+            local_activations=[
+                str(a.address)
+                for a in self.silo.catalog.directory.activations_of(grain_id)],
+            directory_entry=str(entry) if entry is not None else None,
+            is_directory_owner=directory.owner_of(grain_id)
+            == self.silo.address,
+        )
+
+    async def set_log_level(self, logger_name: str, level: int) -> bool:
+        """(reference: SetLogLevel :69)"""
+        import logging
+        logging.getLogger(logger_name).setLevel(level)
+        return True
+
+    async def directory_lookup(self, grain_id: GrainId) -> Optional[str]:
+        addr = await self.silo.grain_directory.full_lookup(grain_id)
+        return str(addr) if addr is not None else None
+
+    async def directory_unregister(self, grain_id: GrainId) -> bool:
+        """Force-remove a directory registration (the OrleansManager
+        'unregister' repair command — reference: Program.cs unregister)."""
+        addr = self.silo.grain_directory.try_local_lookup(grain_id)
+        if addr is None:
+            addr = await self.silo.grain_directory.full_lookup(grain_id)
+        if addr is None:
+            return False
+        await self.silo.grain_directory.unregister(addr)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# ManagementGrain: cluster-wide fan-out (reference: ManagementGrain.cs:38)
+# ---------------------------------------------------------------------------
+
+@grain_interface
+class IManagementGrain:
+    async def get_hosts(self, only_active: bool = True) -> dict: ...
+    async def get_total_activation_count(self) -> int: ...
+    async def get_simple_grain_statistics(self) -> list: ...
+    async def force_activation_collection(self, age_limit: float = 0.0) -> int: ...
+    async def force_tensor_collection(self, idle_ticks: int = 0) -> int: ...
+    async def get_runtime_statistics(self) -> list: ...
+    async def lookup(self, grain_id: GrainId) -> Optional[str]: ...
+    async def unregister(self, grain_id: GrainId) -> bool: ...
+
+
+@grain_class
+class ManagementGrain(Grain, IManagementGrain):
+    """Fan-out over every active silo's SiloControl
+    (reference: ManagementGrain.cs:38 — GetSiloAddresses + per-silo
+    ISiloControl calls gathered)."""
+
+    @property
+    def _silo(self):
+        return self._activation.runtime.silo
+
+    def _active(self) -> List[SiloAddress]:
+        return list(self._silo.active_silos())
+
+    async def _fanout(self, method: str, *args) -> List[Any]:
+        import asyncio
+        silo = self._silo
+        results = await asyncio.gather(
+            *(silo.system_rpc(target, "silo_control", method, args)
+              for target in self._active()),
+            return_exceptions=True)
+        return [r for r in results if not isinstance(r, Exception)]
+
+    async def get_hosts(self, only_active: bool = True) -> dict:
+        """(reference: ManagementGrain.GetHosts)"""
+        oracle = self._silo.membership_oracle
+        if oracle is None:
+            return {str(self._silo.address): "ACTIVE"}
+        view = dict(oracle.view)
+        # the oracle's table view may omit the local silo (it trusts its
+        # own status field, like GetApproximateSiloStatuses includeMyself)
+        view.setdefault(self._silo.address, oracle.my_status)
+        return {str(s): status.name
+                for s, status in view.items()
+                if not only_active or status.name == "ACTIVE"}
+
+    async def get_total_activation_count(self) -> int:
+        return sum(await self._fanout("get_activation_count"))
+
+    async def get_simple_grain_statistics(self) -> list:
+        out: List[SimpleGrainStatistic] = []
+        for chunk in await self._fanout("get_simple_grain_statistics"):
+            out.extend(chunk)
+        return out
+
+    async def force_activation_collection(self,
+                                          age_limit: float = 0.0) -> int:
+        return sum(await self._fanout("force_activation_collection",
+                                      age_limit))
+
+    async def force_tensor_collection(self, idle_ticks: int = 0) -> int:
+        return sum(await self._fanout("force_tensor_collection", idle_ticks))
+
+    async def get_runtime_statistics(self) -> list:
+        return await self._fanout("get_runtime_statistics")
+
+    async def lookup(self, grain_id: GrainId) -> Optional[str]:
+        return await self._silo.system_rpc(
+            self._silo.grain_directory.owner_of(grain_id), "silo_control",
+            "directory_lookup", (grain_id,))
+
+    async def unregister(self, grain_id: GrainId) -> bool:
+        return await self._silo.system_rpc(
+            self._silo.grain_directory.owner_of(grain_id), "silo_control",
+            "directory_unregister", (grain_id,))

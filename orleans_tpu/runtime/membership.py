@@ -53,6 +53,10 @@ class MembershipEntry:
     # doubles as the gateway registry (reference: MembershipEntry.ProxyPort,
     # consumed by AzureGatewayListProvider.cs:35)
     proxy_port: int = 0
+    # False for transient/observer members (the admin CLI): they carry NO
+    # grain placements and NO ring ranges — the nearest reference analog
+    # is a client, which never joins membership at all
+    can_host: bool = True
 
     def fresh_votes(self, now: float, expiration: float
                     ) -> List[Tuple[SiloAddress, float]]:
@@ -127,6 +131,8 @@ class MembershipOracle:
         self.my_status = SiloStatus.JOINING
         # local view, refreshed from the table
         self.view: Dict[SiloAddress, SiloStatus] = {}
+        # silo → can_host flag from its membership entry
+        self.hosting: Dict[SiloAddress, bool] = {}
         self._known_dead: set = set()
         self._missed_probes: Dict[SiloAddress, int] = {}
         self._tasks: List[asyncio.Task] = []
@@ -185,6 +191,13 @@ class MembershipOracle:
             out.append(self.silo.address)
         return out
 
+    def hosting_silos(self):
+        """Active members eligible for grain placement (excludes
+        transient observer members like the admin CLI)."""
+        return [s for s in self.active_silos()
+                if self.hosting.get(s, True)
+                and (s != self.silo.address or self.silo.config.host_grains)]
+
     def is_alive(self, silo: SiloAddress) -> bool:
         if silo == self.silo.address:
             return self.my_status == SiloStatus.ACTIVE
@@ -196,6 +209,7 @@ class MembershipOracle:
         the table, not trusting payload')."""
         snapshot, _version = await self.table.read_all()
         new_view: Dict[SiloAddress, SiloStatus] = {}
+        new_hosting: Dict[SiloAddress, bool] = {}
         for addr, (entry, _etag) in snapshot.items():
             if addr == self.silo.address:
                 # self-death check: if peers declared me dead I must stop
@@ -211,10 +225,15 @@ class MembershipOracle:
                     return
                 continue
             new_view[addr] = entry.status
+            new_hosting[addr] = getattr(entry, "can_host", True)
         old_view = self.view
         self.view = new_view
+        self.hosting = new_hosting
         for addr, status in new_view.items():
-            if status == SiloStatus.ACTIVE and old_view.get(addr) != status:
+            if status == SiloStatus.ACTIVE and old_view.get(addr) != status \
+                    and new_hosting.get(addr, True):
+                # non-hosting members never take ring ranges (directory,
+                # reminders, stream queues stay on real hosts)
                 self.silo.ring.add_silo(addr)
             if status == SiloStatus.DEAD and addr not in self._known_dead:
                 self._known_dead.add(addr)
@@ -257,7 +276,8 @@ class MembershipOracle:
                         silo=self.silo.address, status=status,
                         iam_alive_time=now, start_time=now,
                         proxy_port=(self.silo.address.port or 1)
-                        if has_gateway else 0), version)
+                        if has_gateway else 0,
+                        can_host=self.silo.config.host_grains), version)
                 else:
                     entry, etag = existing
                     entry.status = status
@@ -266,6 +286,14 @@ class MembershipOracle:
             except CasConflictError:
                 await asyncio.sleep(0)
         raise CasConflictError(f"could not write {status} for {self.silo.address}")
+
+    def check_health(self) -> bool:
+        """Watchdog participant (reference: MembershipOracle as
+        IHealthCheckParticipant): healthy while running means every
+        protocol loop task is still alive."""
+        if not self._running:
+            return True
+        return all(not t.done() for t in self._tasks)
 
     # ================= probing ============================================
 

@@ -64,23 +64,28 @@ class PlacementDirectorsManager:
 
     def _choose_silo(self, strategy: PlacementStrategy,
                      grain_id: GrainId) -> SiloAddress:
-        members = self.silo.active_silos()
+        members = self.silo.hosting_silos()
         if not members:
             return self.silo.address
+        # "local" is only a valid answer when this silo hosts grains —
+        # on a non-hosting observer (admin CLI) fall back to a stable
+        # member choice instead
+        local = self.silo.address if self.silo.address in members \
+            else members[grain_id.ring_hash() % len(members)]
         if isinstance(strategy, HashBasedPlacement):
             owner = self.silo.grain_directory.owner_of(grain_id)
-            return owner if owner in members else self.silo.address
+            return owner if owner in members else local
         if isinstance(strategy, RandomPlacement):
             return self._rng.choice(members)
         if isinstance(strategy, PreferLocalPlacement):
-            return self.silo.address
+            return local
         if isinstance(strategy, ActivationCountBasedPlacement):
             # power-of-k-choices (reference:
             # ActivationCountPlacementDirector.SelectSiloPowerOfK :117)
             k = min(strategy.choose_out_of, len(members))
             candidates = self._rng.sample(members, k)
             return min(candidates, key=lambda s: self._load_of(s))
-        return self.silo.address
+        return local
 
     def _load_of(self, silo: SiloAddress) -> int:
         if silo == self.silo.address:

@@ -384,6 +384,14 @@ class LocalReminderService:
 
     # -- system-target RPCs -------------------------------------------------
 
+    def check_health(self) -> bool:
+        """Watchdog participant: the table-refresh loop must be alive
+        while the service runs."""
+        if not self._running:
+            return True
+        return (self._refresh_task is not None
+                and not self._refresh_task.done())
+
     async def start_reminder(self, grain_id: GrainId, name: str,
                              start_at: float, period: float,
                              etag: str) -> None:

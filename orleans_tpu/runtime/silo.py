@@ -112,6 +112,11 @@ class Silo:
         # construction order mirrors reference Silo ctor :151-337
         self.ring = VirtualBucketsRing(
             self.address, self.config.directory.buckets_per_silo)
+        if not self.config.host_grains:
+            # non-hosting observer (admin CLI): takes NO ring ranges — its
+            # own ring holds only the real hosts it learns via membership,
+            # so directory/placement ownership never lands here
+            self.ring.remove_silo(self.address)
         self.message_center = MessageCenter(self.address)
         self.message_center.metrics = self.metrics
         self.grain_directory = LocalGrainDirectory(self)
@@ -145,6 +150,8 @@ class Silo:
             from orleans_tpu.runtime.gateway import Gateway
             self.register_system_target("gateway", Gateway(self))
         self.register_system_target("catalog", _CatalogTarget(self))
+        from orleans_tpu.runtime.management import SiloControl
+        self.register_system_target("silo_control", SiloControl(self))
 
         # identity for calls made from non-grain contexts attached to this
         # silo (tests, hosted client) — reference: client GrainId
@@ -177,6 +184,21 @@ class Silo:
             self.reminder_service = LocalReminderService(
                 self, reminder_table,
                 refresh_period=self.config.reminders.refresh_period)
+        # watchdog (reference: Watchdog.cs:32, wired at Silo.cs:261,366)
+        self.watchdog = None
+        if self.config.watchdog_period > 0:
+            from orleans_tpu.runtime.watchdog import Watchdog
+            self.watchdog = Watchdog(self, self.config.watchdog_period)
+
+        # deployment load broadcast → power-of-k placement (reference:
+        # DeploymentLoadPublisher.cs:39); only meaningful in a cluster
+        self.load_publisher = None
+        if fabric is not None and self.config.load_publish_period > 0:
+            from orleans_tpu.runtime.load_publisher import (
+                DeploymentLoadPublisher,
+            )
+            self.load_publisher = DeploymentLoadPublisher(
+                self, self.config.load_publish_period)
         self._stop_callbacks: List[Callable[[], Any]] = []
 
         # elasticity: membership-driven ring changes re-assert directory
@@ -213,12 +235,23 @@ class Silo:
                 await start()
         if self.tensor_engine is not None:
             self.tensor_engine.start()
+        if self.load_publisher is not None:
+            self.load_publisher.start()
+        if self.watchdog is not None:
+            self.watchdog.register(self.membership_oracle)
+            self.watchdog.register(self.reminder_service)
+            self.watchdog.register(self.tensor_engine)
+            self.watchdog.start()
         self.status = SiloStatus.ACTIVE
         self.logger.info(f"silo {self.address} active")
 
     async def stop(self, graceful: bool = True) -> None:
         """(reference: Silo.Terminate :642-770 graceful / FastKill :776)"""
         self.status = SiloStatus.SHUTTING_DOWN if graceful else SiloStatus.STOPPING
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.load_publisher is not None:
+            self.load_publisher.stop()
         if self.tensor_engine is not None:
             await self.tensor_engine.stop(drain=graceful)
         # reminder timers must die on ANY stop — a zombie service would
@@ -243,6 +276,12 @@ class Silo:
         for provider in self.storage_providers.values():
             await provider.close()
         if self._bound_transport is not None:
+            if graceful:
+                # flush outbound sender queues so in-flight responses
+                # reach their callers before the sockets die
+                drain = getattr(self._bound_transport, "drain", None)
+                if drain is not None:
+                    await drain()
             self._bound_transport.close()
         self.status = SiloStatus.DEAD
 
@@ -250,6 +289,10 @@ class Silo:
         """Hard kill for tests: no deactivations, no handoff
         (reference: Silo.FastKill :776; TestingSiloHost.KillSilo)."""
         self.status = SiloStatus.DEAD
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.load_publisher is not None:
+            self.load_publisher.stop()
         self.catalog.stop_collector()
         for provider in self.stream_providers.values():
             k = getattr(provider, "kill", None)
@@ -272,6 +315,13 @@ class Silo:
             return self.membership_oracle.active_silos()
         return self.ring.members
 
+    def hosting_silos(self) -> List[SiloAddress]:
+        """Placement-eligible members (excludes non-hosting observers
+        like the admin CLI; see SiloConfig.host_grains)."""
+        if self.membership_oracle is not None:
+            return self.membership_oracle.hosting_silos()
+        return self.ring.members
+
     def is_silo_alive(self, addr: SiloAddress) -> bool:
         if self.membership_oracle is not None:
             return self.membership_oracle.is_alive(addr)
@@ -292,6 +342,11 @@ class Silo:
         prune = getattr(self._bound_transport, "prune_dead", None)
         if prune is not None:
             prune(self.active_silos())
+        if self.load_publisher is not None:
+            live = set(self.active_silos())
+            for s in list(self.load_publisher.periodic_stats):
+                if s not in live:
+                    self.load_publisher.forget(s)
         self.grain_directory.schedule_heal()
         gateway = self.system_targets.get("gateway")
         if gateway is not None and gateway._clients:

@@ -236,7 +236,7 @@ class TcpTransport:
                 RejectionType.TRANSIENT,
                 f"target silo {msg.target_silo} unreachable: {reason}"))
         else:
-            self.silo.logger.warning(
+            self.silo.logger.warn(
                 f"dropping undeliverable {msg.direction.name} to "
                 f"{msg.target_silo}: {reason}")
 
@@ -329,6 +329,17 @@ class TcpTransport:
             if writer is not None:
                 writer.close()
 
+    async def drain(self, timeout: float = 2.0) -> None:
+        """Graceful-stop half: wait (bounded) for per-destination sender
+        queues to flush so in-flight RESPONSES reach their callers before
+        the sockets die (reference: graceful Silo.Terminate stops the
+        message center only after outbound queues drain)."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while any(not q.empty() for q in self._queues.values()):
+            if asyncio.get_event_loop().time() > deadline:
+                break
+            await asyncio.sleep(0.01)
+
     def close_nowait(self) -> None:
         """Synchronous teardown (hard-kill path): cancel senders, stop
         accepting.  No drain — the point of a kill is that peers must
@@ -416,6 +427,9 @@ class TcpBoundTransport:
 
     def prune_dead(self, live) -> None:
         self.transport.prune_dead(live)
+
+    async def drain(self, timeout: float = 2.0) -> None:
+        await self.transport.drain(timeout)
 
     def close(self) -> None:
         self.fabric.detach(self.address)

@@ -1,0 +1,89 @@
+"""Silo watchdog: health-check participants + event-loop stall detection.
+
+Parity: reference Watchdog — a dedicated thread that periodically (a) asks
+each IHealthCheckParticipant whether it is healthy and (b) measures how
+late its own timer fired, flagging GC pauses / thread starvation
+(reference: src/OrleansRuntime/Silo/Watchdog.cs:32 — CheckYourOwnHealth
+clock-drift check, participants wired at Silo.cs:261,366;
+IHealthCheckParticipant.cs).
+
+Runtime mapping: the silo is one asyncio event loop, so the reference's
+"GC pause" failure mode becomes *event-loop stall* — a turn or callback
+hogging the loop delays every timer.  The watchdog measures its own wake
+drift exactly like the reference measures timer drift, and anything
+beyond the threshold is reported.  Participants are duck-typed: any
+component with ``check_health() -> bool`` registers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, List, Optional
+
+
+class Watchdog:
+    """(reference: Watchdog.cs:32)"""
+
+    def __init__(self, silo, period: float = 5.0,
+                 stall_threshold: float = 1.0) -> None:
+        self.silo = silo
+        self.period = period
+        self.stall_threshold = stall_threshold
+        self.participants: List[Any] = []
+        self.failed_checks = 0
+        self.loop_stalls = 0
+        self.last_check_time: Optional[float] = None
+        self._task: Optional[asyncio.Task] = None
+        self._running = False
+        self.logger = silo.logger.child("watchdog")
+
+    def register(self, participant: Any) -> None:
+        """(reference: Silo wiring IHealthCheckParticipants :366)"""
+        if participant is not None and hasattr(participant, "check_health"):
+            self.participants.append(participant)
+
+    def start(self) -> None:
+        self._running = True
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        try:
+            while self._running:
+                expected = time.monotonic() + self.period
+                await asyncio.sleep(self.period)
+                drift = time.monotonic() - expected
+                if drift > self.stall_threshold:
+                    # the loop could not run us on time: something hogged
+                    # it (reference: CheckYourOwnHealth clock-drift warn)
+                    self.loop_stalls += 1
+                    self.logger.warn(
+                        f"event loop stalled {drift:.3f}s past the "
+                        f"{self.period}s watchdog period", code=3001)
+                self.check_participants()
+        except asyncio.CancelledError:
+            pass
+
+    def check_participants(self) -> int:
+        """Run every participant's health check; returns failures this
+        round (reference: Watchdog.WatchdogThreadProc participant loop)."""
+        failures = 0
+        now = time.monotonic()
+        for p in self.participants:
+            try:
+                healthy = p.check_health()
+            except Exception:  # noqa: BLE001 — a throwing check IS a failure
+                healthy = False
+            if not healthy:
+                failures += 1
+                self.failed_checks += 1
+                self.logger.warn(
+                    f"health check failed: {type(p).__name__}", code=3002)
+        self.last_check_time = now
+        return failures

@@ -50,6 +50,7 @@ from orleans_tpu.tensor.vector_grain import (
     Batch,
     Emit,
     VectorGrainInfo,
+    ones_mask as _mask_for,
     vector_type,
 )
 
@@ -352,6 +353,13 @@ class TensorEngine:
     def _wake_up(self) -> None:
         if self._wake is not None:
             self._wake.set()
+
+    def check_health(self) -> bool:
+        """Watchdog participant: the tick loop must be alive while the
+        engine runs (a dead loop silently strands every queued batch)."""
+        if not self._running:
+            return True
+        return self._task is not None and not self._task.done()
 
     async def _loop(self) -> None:
         while self._running:
@@ -716,20 +724,6 @@ class BatchInjector:
         return future
 
 
-# module-level caches for tiny helper arrays (one eager creation per size);
-# bounded so churning batch sizes cannot grow device memory forever
-_mask_cache: Dict[int, jnp.ndarray] = {}
-_MASK_CACHE_MAX = 256
-
-
-def _mask_for(n: int) -> jnp.ndarray:
-    m = _mask_cache.get(n)
-    if m is None:
-        if len(_mask_cache) >= _MASK_CACHE_MAX:
-            _mask_cache.clear()
-        m = jnp.asarray(np.ones(n, dtype=bool))
-        _mask_cache[n] = m
-    return m
 
 
 def _pad_np(a: np.ndarray, n: int) -> np.ndarray:

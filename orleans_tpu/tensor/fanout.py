@@ -30,7 +30,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from orleans_tpu.tensor.vector_grain import KEY_SENTINEL
+from orleans_tpu.tensor.vector_grain import (
+    KEY_SENTINEL,
+    ones_mask as _ones_mask,
+)
 
 
 @jax.jit
@@ -202,17 +205,3 @@ class DeviceFanout:
         return worst
 
 
-# cached all-true masks, one eager device array per distinct batch size;
-# bounded — workloads with churning batch sizes must not grow this forever
-_mask_cache: Dict[int, jnp.ndarray] = {}
-_MASK_CACHE_MAX = 256
-
-
-def _ones_mask(n: int) -> jnp.ndarray:
-    m = _mask_cache.get(n)
-    if m is None:
-        if len(_mask_cache) >= _MASK_CACHE_MAX:
-            _mask_cache.clear()
-        m = jnp.asarray(np.ones(n, dtype=bool))
-        _mask_cache[n] = m
-    return m

@@ -58,6 +58,22 @@ from orleans_tpu.ids import type_code_of
 # kernel and the fan-out's padding must agree on it.
 KEY_SENTINEL = np.int32(2**31 - 1)
 
+# cached all-true masks, one eager device array per distinct batch size;
+# bounded so churning batch sizes cannot grow device memory forever.
+# Shared by the engine's padding path and the fan-out's default mask.
+_mask_cache: Dict[int, Any] = {}
+_MASK_CACHE_MAX = 256
+
+
+def ones_mask(n: int):
+    m = _mask_cache.get(n)
+    if m is None:
+        if len(_mask_cache) >= _MASK_CACHE_MAX:
+            _mask_cache.clear()
+        m = jnp.asarray(np.ones(n, dtype=bool))
+        _mask_cache[n] = m
+    return m
+
 
 @dataclass(frozen=True)
 class StateField:
