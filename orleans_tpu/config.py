@@ -103,6 +103,9 @@ class SiloConfig:
     # False = transient observer member (admin CLI): joins membership but
     # takes no grain placements and no ring ranges
     host_grains: bool = True
+    # cadence of statistics publication to registered publishers
+    # (reference: StatisticsCollectionLevel / LogStatistics period)
+    statistics_report_period: float = 30.0
     # run a client gateway on this silo (reference: NodeConfiguration
     # ProxyGatewayEndpoint — silos without one don't accept clients and
     # are not advertised by gateway list providers)

@@ -251,6 +251,17 @@ class Grain:
 
     # -- services -----------------------------------------------------------
 
+    def service(self, name: str):
+        """Resolve a host-registered service by name — the DI analog
+        (reference: startup IServiceProvider built by
+        ConfigureStartupBuilder.cs:40; grains resolve injected services).
+        Services are registered by the silo's startup hook
+        (SiloConfig/host-config ``startup``) or ``silo.services[...]``."""
+        services = getattr(self._activation.runtime.silo, "services", {})
+        if name not in services:
+            raise KeyError(f"no service {name!r} registered on this silo")
+        return services[name]
+
     def get_grain(self, interface, key):
         """Typed reference to another grain (reference: GrainFactory via
         Grain.GrainFactory)."""

@@ -27,6 +27,17 @@ Config file (JSON; every key optional)::
                                     # analog; also needed by the admin
                                     # CLI for lookup/unregister keys)
       "storage": {"Default": {"kind": "file", "root": "./state"}},
+      "providers": [            # generic named provider blocks
+        {"kind": "storage", "type": "sqlite", "name": "Audit",
+         "path": "audit.db"},
+        {"kind": "stream", "type": "simple", "name": "SMS"},
+        {"kind": "bootstrap", "type": "myapp.boot:Warmup", "name": "warm"},
+        {"kind": "statistics", "type":
+         "orleans_tpu.plugins.stats_publisher:LogStatisticsPublisher",
+         "name": "log"}
+      ],
+      "startup": "myapp.startup:configure",  # DI hook: fn(silo) registers
+                                             # silo.services entries
       "silo": { ... SiloConfig.from_dict overrides ... }
     }
 """
@@ -45,23 +56,18 @@ from orleans_tpu.runtime.transport import TcpFabric
 
 
 def build_storage_providers(spec: Dict[str, Any]) -> Dict[str, Any]:
-    """Named provider blocks → instances (reference: <Provider Type=...
-    Name=...> blocks instantiated by ProviderLoader)."""
-    from orleans_tpu.providers.file_storage import FileStorage
-    from orleans_tpu.providers.memory_storage import MemoryStorage
-    from orleans_tpu.providers.sqlite_storage import SqliteStorage
+    """Shorthand ``storage`` blocks → instances.  One registry: delegates
+    to the ProviderLoader's storage factories so the shorthand and the
+    generic ``providers`` blocks accept exactly the same types
+    (reference: <Provider Type=... Name=...> via ProviderLoader)."""
+    from orleans_tpu.providers.loader import ProviderLoader, _resolve_type
 
-    kinds = {
-        "memory": lambda c: MemoryStorage(),
-        "file": lambda c: FileStorage(root=c.get("root", "./grain-state")),
-        "sqlite": lambda c: SqliteStorage(path=c.get("path", ":memory:")),
-    }
+    registry = ProviderLoader().registry
     out = {}
     for name, cfg in (spec or {}).items():
         kind = cfg.get("kind", "memory")
-        if kind not in kinds:
-            raise ValueError(f"unknown storage kind {kind!r} for {name!r}")
-        out[name] = kinds[kind](cfg)
+        props = {k: v for k, v in cfg.items() if k != "kind"}
+        out[name] = _resolve_type("storage", kind, registry)(props)
     return out
 
 
@@ -83,12 +89,18 @@ def build_silo(config: Dict[str, Any],
     if config.get("membership_db"):
         from orleans_tpu.plugins.sqlite_tables import SqliteMembershipTable
         membership_table = SqliteMembershipTable(config["membership_db"])
+    elif config.get("membership_file"):
+        from orleans_tpu.plugins.file_tables import FileMembershipTable
+        membership_table = FileMembershipTable(config["membership_file"])
     reminder_table = None
     if config.get("reminder_db"):
         from orleans_tpu.plugins.sqlite_tables import SqliteReminderTable
         reminder_table = SqliteReminderTable(config["reminder_db"])
+    elif config.get("reminder_file"):
+        from orleans_tpu.plugins.file_tables import FileReminderTable
+        reminder_table = FileReminderTable(config["reminder_file"])
 
-    return Silo(
+    silo = Silo(
         config=silo_cfg,
         storage_providers=build_storage_providers(config.get("storage", {})),
         fabric=fabric,
@@ -96,6 +108,20 @@ def build_silo(config: Dict[str, Any],
         reminder_table=reminder_table,
         host=host, port=port,
     )
+    # generic named provider blocks (reference: ProviderLoader over
+    # <Provider Type=... Name=...> config)
+    if config.get("providers"):
+        from orleans_tpu.providers.loader import ProviderLoader
+        ProviderLoader().load(silo, config["providers"])
+    # DI/startup hook (reference: ConfigureStartupBuilder.cs:40): the
+    # named function receives the silo and registers silo.services
+    if config.get("startup"):
+        mod_name, _, attr = config["startup"].replace(":", ".").rpartition(".")
+        fn = getattr(importlib.import_module(mod_name), attr)
+        result = fn(silo)
+        if isinstance(result, dict):
+            silo.services.update(result)
+    return silo
 
 
 async def run_host(config: Dict[str, Any],

@@ -77,7 +77,11 @@ async def run_command(config: Dict[str, Any], command: str,
         if command in ("lookup", "unregister"):
             if len(args) < 2:
                 raise SystemExit(f"{command} needs: <interface> <key>")
-            gid = grain_id_for(args[0], int(args[1]))
+            try:
+                key = int(args[1])
+            except ValueError:
+                key = args[1]  # string/GUID-keyed grains
+            gid = grain_id_for(args[0], key)
             if command == "lookup":
                 return await mgmt.lookup(gid)
             return await mgmt.unregister(gid)

@@ -9,6 +9,10 @@ from orleans_tpu.plugins.gateway_list import (
     MembershipGatewayListProvider,
     StaticGatewayListProvider,
 )
+from orleans_tpu.plugins.file_tables import (
+    FileMembershipTable,
+    FileReminderTable,
+)
 from orleans_tpu.plugins.sqlite_tables import (
     SqliteMembershipTable,
     SqliteReminderTable,
@@ -20,6 +24,8 @@ from orleans_tpu.plugins.stats_publisher import (
 )
 
 __all__ = [
+    "FileMembershipTable",
+    "FileReminderTable",
     "GatewayListProvider",
     "LogStatisticsPublisher",
     "MembershipGatewayListProvider",

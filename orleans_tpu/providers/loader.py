@@ -1,0 +1,153 @@
+"""Provider framework: named provider config blocks → live instances.
+
+Parity: the reference instantiates every pluggable backend from named
+``<Provider Type="..." Name="..." .../>`` config blocks via a reflective
+loader, grouped by kind (storage / stream / bootstrap / statistics), and
+runs bootstrap providers at silo startup (reference:
+src/Orleans/Providers/ProviderLoader.cs; ProviderConfiguration.cs;
+BootstrapProviderManager.cs; StatisticsProviderManager.cs; started at
+Silo.cs:478-495,542-552).
+
+Python mapping: "Type" is a registry short-name for built-ins or a
+dotted ``module:Class`` path for user providers (the assembly-scan
+analog); "Name" is the registration key; remaining properties become the
+provider's config dict passed to ``init``.
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class ProviderConfiguration:
+    """One named provider block (reference: ProviderConfiguration.cs)."""
+
+    kind: str          # storage | stream | bootstrap | statistics
+    type: str          # registry short-name or "module:Class"
+    name: str          # registration key (e.g. "Default", "PubSubStore")
+    properties: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ProviderConfiguration":
+        props = {k: v for k, v in d.items()
+                 if k not in ("kind", "type", "name", "properties")}
+        return cls(kind=d["kind"], type=d["type"],
+                   name=d.get("name", "Default"),
+                   properties={**props, **d.get("properties", {})})
+
+
+class BootstrapProvider:
+    """Contract (reference: IBootstrapProvider — Init runs app startup
+    logic inside the silo once the runtime is up)."""
+
+    name: str = "?"
+
+    async def init(self, name: str, silo, config: Dict[str, Any]) -> None:
+        self.name = name
+
+    async def close(self) -> None:  # noqa: B027 — optional hook
+        pass
+
+
+def _builtin_factories() -> Dict[str, Dict[str, Callable[..., Any]]]:
+    from orleans_tpu.providers.file_storage import FileStorage
+    from orleans_tpu.providers.memory_storage import (
+        MemoryStorage,
+        MemoryStorageWithLatency,
+    )
+    from orleans_tpu.providers.sqlite_storage import SqliteStorage
+    from orleans_tpu.providers.sharded_storage import ShardedStorageProvider
+
+    def sharded(config: Dict[str, Any]):
+        n = int(config.get("shards", 2))
+        return ShardedStorageProvider([MemoryStorage() for _ in range(n)])
+
+    storage = {
+        "memory": lambda c: MemoryStorage(),
+        "memory_with_latency": lambda c: MemoryStorageWithLatency(
+            latency=float(c.get("latency", 0.05))),
+        "file": lambda c: FileStorage(root=c.get("root", "./grain-state")),
+        "sqlite": lambda c: SqliteStorage(path=c.get("path", ":memory:")),
+        "sharded": sharded,
+    }
+
+    def simple_stream(config: Dict[str, Any]):
+        from orleans_tpu.streams.simple import SimpleMessageStreamProvider
+        return SimpleMessageStreamProvider()
+
+    def persistent_stream(config: Dict[str, Any]):
+        from orleans_tpu.streams.persistent import (
+            InMemoryQueueAdapter,
+            PersistentStreamProvider,
+        )
+        return PersistentStreamProvider(
+            InMemoryQueueAdapter(n_queues=int(config.get("queues", 4))),
+            pull_period=float(config.get("pull_period", 0.05)))
+
+    streams = {
+        "simple": simple_stream,
+        "persistent": persistent_stream,
+    }
+    return {"storage": storage, "stream": streams,
+            "bootstrap": {}, "statistics": {}}
+
+
+def _resolve_type(kind: str, type_name: str,
+                  registry: Dict[str, Dict[str, Callable[..., Any]]]
+                  ) -> Callable[..., Any]:
+    factory = registry.get(kind, {}).get(type_name)
+    if factory is not None:
+        return factory
+    if ":" in type_name or "." in type_name:
+        # dotted user type — the reflective-load analog
+        mod_name, _, attr = type_name.replace(":", ".").rpartition(".")
+        cls = getattr(importlib.import_module(mod_name), attr)
+        return lambda c: cls(**c) if _wants_kwargs(cls) else cls()
+    raise KeyError(f"unknown {kind} provider type {type_name!r}")
+
+
+def _wants_kwargs(cls) -> bool:
+    import inspect
+    try:
+        params = inspect.signature(cls).parameters
+    except (TypeError, ValueError):
+        return False
+    return any(p.kind in (p.KEYWORD_ONLY, p.POSITIONAL_OR_KEYWORD)
+               for p in params.values())
+
+
+class ProviderLoader:
+    """Instantiate + register provider blocks on a silo
+    (reference: ProviderLoader.LoadProviders + per-kind managers)."""
+
+    def __init__(self) -> None:
+        self.registry = _builtin_factories()
+
+    def register_type(self, kind: str, type_name: str,
+                      factory: Callable[[Dict[str, Any]], Any]) -> None:
+        self.registry.setdefault(kind, {})[type_name] = factory
+
+    def load(self, silo, configs: List[Any]) -> None:
+        """Wire every block onto the (not-yet-started) silo.  Bootstrap
+        and statistics providers are stashed for the silo's start
+        sequence (reference: bootstrap providers run AFTER the app
+        runtime is live, Silo.cs:542-552)."""
+        for raw in configs:
+            cfg = raw if isinstance(raw, ProviderConfiguration) \
+                else ProviderConfiguration.from_dict(raw)
+            factory = _resolve_type(cfg.kind, cfg.type, self.registry)
+            instance = factory(dict(cfg.properties))
+            if cfg.kind == "storage":
+                silo.add_storage_provider(cfg.name, instance)
+            elif cfg.kind == "stream":
+                silo.add_stream_provider(cfg.name, instance)
+            elif cfg.kind == "bootstrap":
+                silo.bootstrap_providers[cfg.name] = \
+                    (instance, dict(cfg.properties))
+            elif cfg.kind == "statistics":
+                silo.statistics_publishers[cfg.name] = instance
+            else:
+                raise ValueError(f"unknown provider kind {cfg.kind!r}")

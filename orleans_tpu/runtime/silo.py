@@ -141,6 +141,17 @@ class Silo:
         self.storage_providers: Dict[str, StorageProvider] = \
             dict(storage_providers or {})
         self.stream_providers: Dict[str, Any] = {}
+        # bootstrap providers run once the runtime is live (reference:
+        # BootstrapProviderManager, Silo.cs:542-552); name → (instance,
+        # config).  Statistics publishers get the periodic metrics
+        # snapshot (reference: StatisticsProviderManager + LogStatistics)
+        self.bootstrap_providers: Dict[str, tuple] = {}
+        self.statistics_publishers: Dict[str, Any] = {}
+        self._stats_report_task: Optional[asyncio.Task] = None
+        # DI analog: named services registered by the startup hook and
+        # resolved by grains via Grain.service() (reference:
+        # ConfigureStartupBuilder.cs:40)
+        self.services: Dict[str, Any] = {}
 
         # system targets (reference: Silo.CreateSystemTargets :339)
         self.system_targets: Dict[str, Any] = {}
@@ -237,6 +248,15 @@ class Silo:
             self.tensor_engine.start()
         if self.load_publisher is not None:
             self.load_publisher.start()
+        # bootstrap providers: app startup logic inside the live silo
+        # (reference: Silo.cs:542-552 — after stream providers start)
+        for name, (provider, cfg) in self.bootstrap_providers.items():
+            await provider.init(name, self, cfg)
+        if self.statistics_publishers:
+            for name, pub in self.statistics_publishers.items():
+                await pub.init(self.name)
+            self._stats_report_task = asyncio.get_running_loop().create_task(
+                self._stats_report_loop())
         if self.watchdog is not None:
             self.watchdog.register(self.membership_oracle)
             self.watchdog.register(self.reminder_service)
@@ -273,6 +293,21 @@ class Silo:
             res = cb()
             if asyncio.iscoroutine(res):
                 await res
+        if self._stats_report_task is not None:
+            self._stats_report_task.cancel()
+            self._stats_report_task = None
+        for name, pub in self.statistics_publishers.items():
+            try:
+                await pub.report(self.name, self.metrics.snapshot())
+                await pub.close()
+            except Exception:  # noqa: BLE001 — stats must not block stop
+                pass
+        for _, (provider, _cfg) in self.bootstrap_providers.items():
+            try:
+                await provider.close()
+            except Exception:  # noqa: BLE001 — close must not block stop
+                self.logger.warn("bootstrap provider close failed",
+                                 code=2802)
         for provider in self.storage_providers.values():
             await provider.close()
         if self._bound_transport is not None:
@@ -293,6 +328,9 @@ class Silo:
             self.watchdog.stop()
         if self.load_publisher is not None:
             self.load_publisher.stop()
+        if self._stats_report_task is not None:
+            self._stats_report_task.cancel()
+            self._stats_report_task = None
         self.catalog.stop_collector()
         for provider in self.stream_providers.values():
             k = getattr(provider, "kill", None)
@@ -307,6 +345,22 @@ class Silo:
 
     def on_stop(self, cb: Callable[[], Any]) -> None:
         self._stop_callbacks.append(cb)
+
+    async def _stats_report_loop(self) -> None:
+        """Periodic metrics publication (reference: LogStatistics.cs:33
+        periodic dump driving the table/SQL publishers)."""
+        try:
+            while True:
+                await asyncio.sleep(self.config.statistics_report_period)
+                snapshot = self.metrics.snapshot()
+                for pub in self.statistics_publishers.values():
+                    try:
+                        await pub.report(self.name, snapshot)
+                    except Exception:  # noqa: BLE001 — keep reporting
+                        self.logger.warn("statistics publisher failed",
+                                         code=2801)
+        except asyncio.CancelledError:
+            pass
 
     # ================= membership view =====================================
 

@@ -162,7 +162,9 @@ class DeploymentBasedQueueBalancer:
         self.provider_name = provider_name
 
     def my_queues(self, silo, mapper: HashRingStreamQueueMapper) -> List[int]:
-        silos = sorted(silo.active_silos(), key=lambda s: s.ring_hash())
+        # hosting members only: a non-hosting observer (admin CLI) runs no
+        # pulling agents, so counting it would strand its rank's queues
+        silos = sorted(silo.hosting_silos(), key=lambda s: s.ring_hash())
         if not silos:
             return mapper.all_queues()
         try:

@@ -1,0 +1,33 @@
+"""Startup-hook fixture for the host DI tests (imported by name via the
+host config's ``startup`` key — reference: user Startup class loaded by
+ConfigureStartupBuilder.cs:40)."""
+
+
+class FakeMailer:
+    def __init__(self) -> None:
+        self.sent = []
+
+    def send(self, to: str, body: str) -> None:
+        self.sent.append((to, body))
+
+
+def configure(silo):
+    """Register services; returned dict merges into silo.services."""
+    return {"mailer": FakeMailer(), "region": "test-region"}
+
+
+class RecordingBootstrap:
+    """Bootstrap provider fixture (reference: IBootstrapProvider)."""
+
+    initialized = []
+
+    def __init__(self) -> None:
+        self.name = "?"
+
+    async def init(self, name, silo, config):
+        self.name = name
+        RecordingBootstrap.initialized.append((name, silo.name,
+                                               dict(config)))
+
+    async def close(self):
+        pass

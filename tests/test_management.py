@@ -64,12 +64,13 @@ def test_management_lookup_and_unregister(run):
 
             gid = grain_id_for(ICounterGrain, 5555)
             found = await mgmt.lookup(gid)
-            assert found is not None and "5555" not in "", found
+            assert found is not None and "silo" in found, found
 
             assert await mgmt.unregister(gid) is True
-            # directory entry is gone; a fresh call re-activates cleanly
-            assert await mgmt.lookup(gid) is None or True
-            assert await ref.add(1) in (1, 2)  # fresh activation restarts
+            # the directory entry is actually gone
+            assert await mgmt.lookup(gid) is None
+            # and a fresh call re-activates cleanly
+            assert await ref.add(1) >= 1
         finally:
             await cluster.stop()
 

@@ -1,0 +1,219 @@
+"""Provider framework: named-config loader, bootstrap providers, DI
+startup hook, and the file-based table backend family.
+
+Reference analogs: ProviderLoader.cs (named <Provider> blocks),
+BootstrapProviderManager.cs, ConfigureStartupBuilder.cs:40 (DI), and the
+interchangeable table backends (AzureBasedMembershipTable.cs:37 /
+SqlMembershipTable.cs:34 — here: file-locked JSON vs sqlite).
+"""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu import Grain, grain_interface
+from orleans_tpu.core.grain import grain_class
+from orleans_tpu.ids import GrainId
+from orleans_tpu.plugins.file_tables import (
+    FileMembershipTable,
+    FileReminderTable,
+)
+from orleans_tpu.providers.loader import ProviderConfiguration, ProviderLoader
+from orleans_tpu.runtime.reminders import ReminderEntry
+from orleans_tpu.runtime.silo import Silo
+
+from tests.test_plugins import _membership_contract
+
+
+# ---------------------------------------------------------------------------
+# file table backends run the SAME contract suite as sqlite/in-memory
+# ---------------------------------------------------------------------------
+
+def test_file_membership_table_contract(run, tmp_path):
+    _membership_contract(run, FileMembershipTable(
+        str(tmp_path / "members.json")))
+
+
+def test_file_membership_table_survives_reopen(run, tmp_path):
+    """A second table object over the same path (≈ another process) sees
+    the rows and respects the CAS state."""
+
+    async def go():
+        from orleans_tpu.runtime.membership import (
+            CasConflictError,
+            MembershipEntry,
+            SiloStatus,
+        )
+        from orleans_tpu.ids import SiloAddress
+
+        path = str(tmp_path / "shared.json")
+        t1 = FileMembershipTable(path)
+        _, v = await t1.read_all()
+        entry = MembershipEntry(silo=SiloAddress("h", 1, 1),
+                                status=SiloStatus.ACTIVE)
+        await t1.insert_row(entry, v)
+
+        t2 = FileMembershipTable(path)  # fresh handle, same file
+        snap, v2 = await t2.read_all()
+        assert snap[entry.silo][0].status == SiloStatus.ACTIVE
+        with pytest.raises(CasConflictError):
+            await t2.insert_row(entry, v2)  # row exists
+        entry.status = SiloStatus.DEAD
+        await t2.update_row(entry, snap[entry.silo][1], v2)
+        snap1, _ = await t1.read_all()
+        assert snap1[entry.silo][0].status == SiloStatus.DEAD
+
+    run(go())
+
+
+def test_file_reminder_table_contract(run, tmp_path):
+    async def go():
+        path = str(tmp_path / "reminders.json")
+        table = FileReminderTable(path)
+        gid = GrainId.from_int(1234, 77)
+        assert await table.read_row(gid, "r1") is None
+        etag = await table.upsert_row(
+            ReminderEntry(grain_id=gid, name="r1", start_at=1.0, period=2.0))
+        row = await table.read_row(gid, "r1")
+        assert row.etag == etag and row.period == 2.0
+        etag2 = await table.upsert_row(
+            ReminderEntry(grain_id=gid, name="r1", start_at=1.0, period=3.0))
+        assert etag2 != etag
+        assert not await table.remove_row(gid, "r1", etag)  # stale
+        # reopen ≈ restart: etags are uuids, stale stays stale
+        table2 = FileReminderTable(path)
+        assert not await table2.remove_row(gid, "r1", etag)
+        assert await table2.remove_row(gid, "r1", etag2)
+        assert await table2.read_rows(gid) == []
+
+    run(go())
+
+
+def test_file_table_backed_cluster(run, tmp_path):
+    """Two host-style silos cluster through the FILE membership table over
+    TCP — the second backend family passes the same liveness path sqlite
+    does."""
+
+    async def main():
+        from orleans_tpu.host import build_silo
+        from tests.fixture_grains import ICounterGrain  # noqa: F401
+
+        cfg = {"host": "127.0.0.1",
+               "membership_file": str(tmp_path / "cluster.json"),
+               "reminder_file": str(tmp_path / "reminders.json"),
+               "storage": {"Default": {"kind": "memory"}},
+               "silo": {"liveness": {
+                   "probe_period": 0.1, "probe_timeout": 0.1,
+                   "num_missed_probes_limit": 2,
+                   "table_refresh_timeout": 0.2,
+                   "iam_alive_table_publish": 0.5}}}
+        a = build_silo({**cfg, "name": "file-a"})
+        b = build_silo({**cfg, "name": "file-b"})
+        await a.start()
+        await b.start()
+        try:
+            deadline = asyncio.get_running_loop().time() + 10
+            while not (len(a.active_silos()) == 2
+                       and len(b.active_silos()) == 2):
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            factory = a.attach_client()
+            from tests.fixture_grains import ICounterGrain
+            results = await asyncio.gather(
+                *(factory.get_grain(ICounterGrain, 9100 + i).add(1)
+                  for i in range(8)))
+            assert results == [1] * 8
+        finally:
+            await b.stop()
+            await a.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# provider loader + bootstrap + statistics + DI startup
+# ---------------------------------------------------------------------------
+
+def test_provider_loader_blocks(run, tmp_path):
+    """Named blocks of every kind instantiate and register; bootstrap
+    providers run at silo start with their config; statistics publishers
+    report; dotted user types load (the reflective-load analog)."""
+
+    async def main():
+        from tests.fixture_startup import RecordingBootstrap
+
+        RecordingBootstrap.initialized.clear()
+        silo = Silo(name="provider-silo")
+        loader = ProviderLoader()
+        loader.load(silo, [
+            {"kind": "storage", "type": "memory", "name": "Default"},
+            {"kind": "storage", "type": "file", "name": "Files",
+             "root": str(tmp_path / "files")},
+            {"kind": "stream", "type": "simple", "name": "SMS"},
+            {"kind": "bootstrap",
+             "type": "tests.fixture_startup:RecordingBootstrap",
+             "name": "warmup", "properties": {"level": 3}},
+            {"kind": "statistics",
+             "type": "orleans_tpu.plugins.stats_publisher:"
+                     "LogStatisticsPublisher", "name": "log"},
+        ])
+        assert set(silo.storage_providers) == {"Default", "Files"}
+        assert "SMS" in silo.stream_providers
+        assert "warmup" in silo.bootstrap_providers
+        assert "log" in silo.statistics_publishers
+
+        await silo.start()
+        try:
+            assert RecordingBootstrap.initialized == [
+                ("warmup", "provider-silo", {"level": 3})]
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_provider_configuration_from_dict():
+    cfg = ProviderConfiguration.from_dict(
+        {"kind": "storage", "type": "sqlite", "name": "S",
+         "path": "x.db", "properties": {"extra": 1}})
+    assert cfg.properties == {"path": "x.db", "extra": 1}
+    assert (cfg.kind, cfg.type, cfg.name) == ("storage", "sqlite", "S")
+
+
+@grain_interface
+class IServiceUser:
+    async def mail(self, to: str) -> int: ...
+
+
+@grain_class
+class ServiceUserGrain(Grain, IServiceUser):
+    async def mail(self, to: str) -> int:
+        mailer = self.service("mailer")
+        mailer.send(to, "hello")
+        return len(mailer.sent)
+
+
+def test_startup_hook_registers_services(run, tmp_path):
+    """The host config's startup hook populates silo.services and grains
+    resolve them via Grain.service() (the DI analog)."""
+
+    async def main():
+        from orleans_tpu.host import build_silo
+
+        silo = build_silo({
+            "name": "di-host", "host": "127.0.0.1",
+            "storage": {"Default": {"kind": "memory"}},
+            "startup": "tests.fixture_startup:configure",
+        })
+        await silo.start()
+        try:
+            assert silo.services["region"] == "test-region"
+            factory = silo.attach_client()
+            ref = factory.get_grain(IServiceUser, 1)
+            assert await ref.mail("a@b") == 1
+            assert await ref.mail("c@d") == 2
+            assert silo.services["mailer"].sent[0] == ("a@b", "hello")
+        finally:
+            await silo.stop()
+
+    run(main())
