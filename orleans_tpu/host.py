@@ -116,18 +116,27 @@ def build_silo(config: Dict[str, Any],
     # DI/startup hook (reference: ConfigureStartupBuilder.cs:40): the
     # named function receives the silo and registers silo.services
     if config.get("startup"):
-        mod_name, _, attr = config["startup"].replace(":", ".").rpartition(".")
-        fn = getattr(importlib.import_module(mod_name), attr)
-        result = fn(silo)
+        from orleans_tpu.providers.loader import load_attr
+        result = load_attr(config["startup"])(silo)
         if isinstance(result, dict):
             silo.services.update(result)
     return silo
 
 
 async def run_host(config: Dict[str, Any],
-                   shutdown: Optional[asyncio.Event] = None) -> None:
+                   shutdown: Optional[asyncio.Event] = None,
+                   config_path: Optional[str] = None,
+                   reload_poll: float = 2.0,
+                   on_started=None) -> None:
     """Start a silo and serve until ``shutdown`` is set (or SIGINT/SIGTERM
-    arrives) — reference: WindowsServerHost.Run's wait loop."""
+    arrives) — reference: WindowsServerHost.Run's wait loop.
+
+    When ``config_path`` is given the file is polled for changes and the
+    ``silo`` section is live-applied via Silo.update_config (reference:
+    live-reload OnConfigChange hooks; identity/topology keys require a
+    restart and are ignored)."""
+    import os
+
     silo = build_silo(config)
     shutdown = shutdown or asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -139,9 +148,38 @@ async def run_host(config: Dict[str, Any],
     await silo.start()
     print(f"silo {silo.name} active at {silo.address.host}:"
           f"{silo.address.port}", flush=True)
+    if on_started is not None:
+        on_started(silo)  # embedding/test hook: observe the live silo
+
+    async def watch_config() -> None:
+        mtime: Optional[float] = None
+        while True:
+            try:
+                m = os.path.getmtime(config_path)
+                if mtime is None:
+                    mtime = m
+                elif m != mtime:
+                    mtime = m
+                    with open(config_path) as f:
+                        fresh = json.load(f)
+                    silo.update_config(fresh.get("silo") or {})
+                    print(f"silo {silo.name}: config reloaded", flush=True)
+            except (OSError, json.JSONDecodeError):
+                pass  # transient editor states; keep watching
+            except Exception as exc:  # noqa: BLE001 — a bad edit must not
+                # silently kill the watcher (future edits still apply)
+                print(f"silo {silo.name}: config reload rejected: {exc}",
+                      flush=True)
+            await asyncio.sleep(reload_poll)
+
+    watcher = None
+    if config_path is not None:
+        watcher = loop.create_task(watch_config())
     try:
         await shutdown.wait()
     finally:
+        if watcher is not None:
+            watcher.cancel()
         await silo.stop()
         print(f"silo {silo.name} stopped", flush=True)
 
@@ -161,7 +199,7 @@ def main(argv=None) -> None:
             config = json.load(f)
     if args.name:
         config["name"] = args.name
-    asyncio.run(run_host(config))
+    asyncio.run(run_host(config, config_path=args.config))
 
 
 if __name__ == "__main__":

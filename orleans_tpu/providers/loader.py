@@ -95,6 +95,21 @@ def _builtin_factories() -> Dict[str, Dict[str, Callable[..., Any]]]:
             "bootstrap": {}, "statistics": {}}
 
 
+def load_attr(path: str):
+    """Resolve a ``module:Attr`` / ``module.Attr`` path — the single
+    reflective-load helper (used for provider types and startup hooks)."""
+    mod_name, _, attr = path.replace(":", ".").rpartition(".")
+    if not mod_name:
+        raise ValueError(f"not a dotted path: {path!r}")
+    module = importlib.import_module(mod_name)
+    try:
+        return getattr(module, attr)
+    except AttributeError:
+        raise AttributeError(
+            f"module {mod_name!r} has no attribute {attr!r} "
+            f"(from path {path!r})") from None
+
+
 def _resolve_type(kind: str, type_name: str,
                   registry: Dict[str, Dict[str, Callable[..., Any]]]
                   ) -> Callable[..., Any]:
@@ -103,8 +118,7 @@ def _resolve_type(kind: str, type_name: str,
         return factory
     if ":" in type_name or "." in type_name:
         # dotted user type — the reflective-load analog
-        mod_name, _, attr = type_name.replace(":", ".").rpartition(".")
-        cls = getattr(importlib.import_module(mod_name), attr)
+        cls = load_attr(type_name)
         return lambda c: cls(**c) if _wants_kwargs(cls) else cls()
     raise KeyError(f"unknown {kind} provider type {type_name!r}")
 

@@ -152,6 +152,8 @@ class Silo:
         # resolved by grains via Grain.service() (reference:
         # ConfigureStartupBuilder.cs:40)
         self.services: Dict[str, Any] = {}
+        # live-reload subscribers (reference: OnConfigChange hooks)
+        self._config_listeners: List[Callable[[SiloConfig], Any]] = []
 
         # system targets (reference: Silo.CreateSystemTargets :339)
         self.system_targets: Dict[str, Any] = {}
@@ -299,9 +301,12 @@ class Silo:
         for name, pub in self.statistics_publishers.items():
             try:
                 await pub.report(self.name, self.metrics.snapshot())
-                await pub.close()
             except Exception:  # noqa: BLE001 — stats must not block stop
                 pass
+            try:
+                await pub.close()
+            except Exception:  # noqa: BLE001 — a failed final report must
+                pass           # not leak the publisher's resources
         for _, (provider, _cfg) in self.bootstrap_providers.items():
             try:
                 await provider.close()
@@ -345,6 +350,61 @@ class Silo:
 
     def on_stop(self, cb: Callable[[], Any]) -> None:
         self._stop_callbacks.append(cb)
+
+    # ================= live config reload ==================================
+
+    def on_config_change(self, cb: Callable[[SiloConfig], Any]) -> None:
+        """Subscribe to live config updates (reference: OnConfigChange
+        hooks, Silo.cs:179,184,257; InsideGrainClient.cs:83)."""
+        self._config_listeners.append(cb)
+
+    def update_config(self, changes: Dict[str, Any]) -> None:
+        """Apply a partial config dict (SiloConfig.from_dict shape) to the
+        RUNNING silo: mutate the live dataclasses, re-push the values
+        components copied at construction, notify subscribers.  Identity
+        and topology fields (name/host/port/host_grains) are not
+        reloadable — same as the reference."""
+        import dataclasses as _dc
+        if not isinstance(changes, dict):
+            raise TypeError(f"config changes must be a dict, "
+                            f"got {type(changes).__name__}")
+        for section, value in changes.items():
+            if section in ("name", "host", "port", "host_grains"):
+                continue  # identity/topology: restart-only
+            current = getattr(self.config, section, None)
+            if _dc.is_dataclass(current):
+                if not isinstance(value, dict):
+                    # never replace a section object with a scalar — that
+                    # would corrupt the RUNNING silo's config
+                    raise TypeError(
+                        f"config section {section!r} needs a dict, "
+                        f"got {type(value).__name__}")
+                for k, v in value.items():
+                    if hasattr(current, k):
+                        setattr(current, k, v)
+            elif hasattr(self.config, section):
+                setattr(self.config, section, value)
+        # re-push values that components copied out of the config at
+        # construction time (everything else reads the live dataclass)
+        m = self.config.messaging
+        self.runtime_client.response_timeout = m.response_timeout
+        self.runtime_client.max_resend_count = m.max_resend_count
+        self.dispatcher.perform_deadlock_detection = m.deadlock_detection
+        self.max_forward_count = m.max_forward_count
+        self.catalog.age_limit = self.config.collection.default_age_limit
+        self.grain_directory.cache.max_size = self.config.directory.cache_size
+        if self.watchdog is not None and self.config.watchdog_period > 0:
+            self.watchdog.period = self.config.watchdog_period
+        if self.load_publisher is not None \
+                and self.config.load_publish_period > 0:
+            self.load_publisher.publish_period = \
+                self.config.load_publish_period
+        for cb in self._config_listeners:
+            res = cb(self.config)
+            if asyncio.iscoroutine(res):
+                # async listeners run as tasks (update_config is sync —
+                # same convenience on_stop gives its callbacks)
+                asyncio.get_running_loop().create_task(res)
 
     async def _stats_report_loop(self) -> None:
         """Periodic metrics publication (reference: LogStatistics.cs:33

@@ -217,3 +217,80 @@ def test_startup_hook_registers_services(run, tmp_path):
             await silo.stop()
 
     run(main())
+
+
+def test_live_config_reload(run):
+    """update_config applies partial overrides to the RUNNING silo —
+    nested sections mutate the live dataclasses, component-copied values
+    are re-pushed, and subscribers fire (reference: OnConfigChange)."""
+
+    async def main():
+        silo = Silo(name="reload-silo")
+        await silo.start()
+        try:
+            seen = []
+            silo.on_config_change(lambda cfg: seen.append(
+                cfg.messaging.response_timeout))
+
+            assert silo.runtime_client.response_timeout == 30.0
+            silo.update_config({
+                "messaging": {"response_timeout": 7.5,
+                              "deadlock_detection": False},
+                "collection": {"default_age_limit": 123.0},
+                "watchdog_period": 9.0,
+                "name": "must-not-change",  # identity: ignored
+            })
+            assert silo.config.messaging.response_timeout == 7.5
+            assert silo.runtime_client.response_timeout == 7.5
+            assert silo.dispatcher.perform_deadlock_detection is False
+            assert silo.catalog.age_limit == 123.0
+            assert silo.watchdog.period == 9.0
+            assert silo.name == "reload-silo"
+            assert seen == [7.5]
+        finally:
+            await silo.stop()
+
+    run(main())
+
+
+def test_host_config_file_watch(run, tmp_path):
+    """run_host live-applies silo-section edits to the config file."""
+
+    async def main():
+        import json
+
+        from orleans_tpu.host import run_host
+
+        path = tmp_path / "watched.json"
+        path.write_text(json.dumps({
+            "name": "watched", "host": "127.0.0.1",
+            "silo": {"messaging": {"response_timeout": 30.0}}}))
+        ev = asyncio.Event()
+        captured = []
+        task = asyncio.get_running_loop().create_task(
+            run_host(json.loads(path.read_text()), shutdown=ev,
+                     config_path=str(path), reload_poll=0.05,
+                     on_started=captured.append))
+        await asyncio.sleep(0.3)
+        silo = captured[0]
+        assert silo.runtime_client.response_timeout == 30.0
+
+        # a malformed edit is rejected without killing the watcher ...
+        path.write_text(json.dumps({
+            "name": "watched", "host": "127.0.0.1", "silo": {"messaging": 5}}))
+        await asyncio.sleep(0.3)
+        assert silo.runtime_client.response_timeout == 30.0
+
+        # ... and the next good edit still applies
+        path.write_text(json.dumps({
+            "name": "watched", "host": "127.0.0.1",
+            "silo": {"messaging": {"response_timeout": 4.0}}}))
+        deadline = asyncio.get_running_loop().time() + 5
+        while silo.runtime_client.response_timeout != 4.0:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert silo.config.messaging.response_timeout == 4.0
+        ev.set()
+        await asyncio.wait_for(task, timeout=10.0)
+
+    run(main())

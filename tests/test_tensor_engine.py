@@ -244,3 +244,33 @@ def test_turn_observer_tolerates_cancellation(run):
         _observe_turn(task2)  # marks retrieved, must not raise
 
     run(main())
+
+
+def test_wide_keys_use_host_path_and_device_routing_refuses(run):
+    """Documented v1 constraint (README 'Device routing keys'): the device
+    directory mirror is int32-keyed.  Wide (hashed/string) keys still work
+    through host-side resolution; asking for the device index with wide
+    keys raises a clear OverflowError instead of corrupting routes."""
+
+    async def main():
+        import pytest
+
+        engine = TensorEngine()
+        arena = engine.arena_for("AccumGrain")
+        wide = np.array([2**40 + 1, 2**40 + 2], dtype=np.int64)
+
+        # host path: resolution, dispatch and results all work
+        fut = engine.send_batch("AccumGrain", "add", wide,
+                                {"v": np.float32([1.0, 2.0])},
+                                want_results=True)
+        await engine.flush()
+        res = await fut
+        np.testing.assert_allclose(res["echo"], [2.0, 4.0])
+        rows = arena.resolve_rows(wide)
+        assert arena.live_count >= 2 and rows[0] != rows[1]
+
+        # device mirror refuses wide keys loudly
+        with pytest.raises(OverflowError, match="int32"):
+            arena.device_index()
+
+    run(main())
