@@ -34,6 +34,12 @@ from orleans_tpu.runtime.messaging import (
     RejectionType,
     ResponseKind,
 )
+from orleans_tpu.runtime.gateway import (
+    _rebase_expiration_inbound,
+    _with_ttl,
+    read_gateway_frame,
+    write_gateway_frame,
+)
 from orleans_tpu.runtime.runtime_client import (
     CallbackData,
     RejectionError,
@@ -56,15 +62,27 @@ class GrainClient:
 
     # ================= connection =========================================
 
-    async def connect(self, *gateway_silos) -> "GrainClient":
-        """Connect through one or more gateway silos (reference:
-        GatewayManager's live-gateway pool :41)."""
-        for silo in gateway_silos:
-            gateway = silo.system_targets.get("gateway")
-            if gateway is None:
-                raise RuntimeError(f"silo {silo.name} has no gateway")
-            await gateway.connect_client(self.client_id, self._on_message)
-            self._gateways.append(gateway)
+    async def connect(self, *gateways) -> "GrainClient":
+        """Connect through one or more gateways (reference:
+        GatewayManager's live-gateway pool :41).  Each entry is either a
+        Silo object (in-process edge) or a ``(host, port)`` /
+        ``"host:port"`` endpoint of a gateway silo's client port (TCP
+        edge — the reference's GatewayConnection sockets)."""
+        for gw in gateways:
+            if isinstance(gw, (tuple, list)):
+                handle = await TcpGatewayHandle.open(
+                    gw[0], int(gw[1]), self.client_id, self._on_message)
+            elif isinstance(gw, str):
+                host, _, port = gw.rpartition(":")
+                handle = await TcpGatewayHandle.open(
+                    host, int(port), self.client_id, self._on_message)
+            else:
+                gateway = gw.system_targets.get("gateway")
+                if gateway is None:
+                    raise RuntimeError(f"silo {gw.name} has no gateway")
+                await gateway.connect_client(self.client_id, self._on_message)
+                handle = gateway
+            self._gateways.append(handle)
         self._gw_cycle = itertools.cycle(self._gateways)
         self._connected = True
         bind_runtime(self)
@@ -201,3 +219,96 @@ class GrainClient:
         self._observers.pop(ref.grain_id, None)
         for gateway in self._gateways:
             await gateway.disconnect_client(ref.grain_id)
+
+
+class TcpGatewayHandle:
+    """Client side of one gateway socket (reference:
+    GatewayConnection + the proxied handshake,
+    ProxiedMessageCenter.cs:82).  Duck-types the in-process Gateway
+    surface the client uses: alive / submit / register_observer /
+    disconnect_client."""
+
+    def __init__(self, host: str, port: int, client_id: GrainId,
+                 on_message) -> None:
+        self.host, self.port = host, port
+        self.client_id = client_id
+        self._on_message = on_message
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pump: Optional[asyncio.Task] = None
+        # control replies ("welcome"/"ok") resolve in arrival order
+        self._control_waiters: "asyncio.Queue[asyncio.Future]" = None
+
+    @classmethod
+    async def open(cls, host: str, port: int, client_id: GrainId,
+                   on_message) -> "TcpGatewayHandle":
+        self = cls(host, port, client_id, on_message)
+        self._reader, self._writer = await asyncio.open_connection(host, port)
+        self._control_waiters = asyncio.Queue()
+        write_gateway_frame(self._writer, {"op": "hello",
+                                           "client_id": client_id})
+        await self._writer.drain()
+        welcome = await read_gateway_frame(self._reader)
+        if not (isinstance(welcome, dict) and welcome.get("op") == "welcome"):
+            raise ConnectionError(f"gateway handshake failed: {welcome!r}")
+        self._pump = asyncio.get_running_loop().create_task(self._run_pump())
+        return self
+
+    @property
+    def alive(self) -> bool:
+        return self._writer is not None and not self._writer.is_closing()
+
+    async def _run_pump(self) -> None:
+        """(reference: OutsideRuntimeClient.RunClientMessagePump :315)"""
+        try:
+            while True:
+                frame = await read_gateway_frame(self._reader)
+                if isinstance(frame, Message):
+                    self._on_message(_rebase_expiration_inbound(frame))
+                else:  # control reply
+                    waiter = self._control_waiters.get_nowait() \
+                        if not self._control_waiters.empty() else None
+                    if waiter is not None and not waiter.done():
+                        waiter.set_result(frame)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None  # alive -> False; pool skips us
+
+    def submit(self, msg: Message) -> None:
+        if not self.alive:
+            raise ConnectionError(f"gateway {self.host}:{self.port} is down")
+        write_gateway_frame(self._writer, _with_ttl(msg))
+
+    async def _control(self, record: dict) -> dict:
+        if not self.alive:
+            raise ConnectionError(
+                f"gateway {self.host}:{self.port} is down")
+        waiter: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._control_waiters.put(waiter)
+        write_gateway_frame(self._writer, record)
+        await self._writer.drain()
+        return await asyncio.wait_for(waiter, timeout=10.0)
+
+    async def register_observer(self, client_id: GrainId,
+                                observer_id: GrainId) -> None:
+        await self._control({"op": "observer", "observer_id": observer_id})
+
+    async def disconnect_client(self, grain_id: GrainId) -> None:
+        if not self.alive:
+            return
+        if grain_id == self.client_id:
+            write_gateway_frame(self._writer, {"op": "bye"})
+            try:
+                await self._writer.drain()
+            except ConnectionError:
+                pass
+            if self._pump is not None:
+                self._pump.cancel()
+            self._writer.close()
+            self._writer = None
+        else:
+            write_gateway_frame(self._writer,
+                                {"op": "unregister", "grain_id": grain_id})
+            await self._writer.drain()

@@ -62,3 +62,15 @@ class MembershipGatewayListProvider(GatewayListProvider):
                     and getattr(entry, "proxy_port", 0):
                 out.append(silo)
         return out
+
+    async def get_gateway_endpoints(self) -> List[tuple]:
+        """(host, client_port) pairs a TCP client can dial — the
+        advertised ProxyPort, not the silo-to-silo port (reference: the
+        gateway URI list AzureGatewayListProvider builds from ProxyPort)."""
+        snapshot, _version = await self._table.read_all()
+        out: List[tuple] = []
+        for silo, (entry, _etag) in snapshot.items():
+            if entry.status == SiloStatus.ACTIVE \
+                    and getattr(entry, "proxy_port", 0) > 1:
+                out.append((silo.host, entry.proxy_port))
+        return out

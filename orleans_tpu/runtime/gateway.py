@@ -6,16 +6,33 @@ RecordOpenedSocket :109, reply routing via TryDeliverToProxy,
 MessageCenter.cs:55) and the ClientObserverRegistrar system target that
 registers client ids in the grain directory so any silo can route
 observer calls (reference: ClientObserverRegistrar.cs:35).
+
+Two client edges share one Gateway object:
+
+* in-process — the client hands a deliver callable straight to
+  ``connect_client`` (the test/embedded mode);
+* TCP — ``GatewayAcceptor`` listens on a dedicated client port (the
+  reference's ProxyGatewayEndpoint, distinct from the silo-to-silo
+  port; accept side GatewayAcceptor.cs:32): a connection opens with a
+  codec-framed HELLO control record carrying the client id, after which
+  Message frames flow both ways on the same socket.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Callable, Dict, Optional
+import dataclasses
+import struct
+import time
+from typing import Any, Callable, Dict, Optional
 
 from orleans_tpu.codec import default_manager as codec
 from orleans_tpu.ids import ActivationAddress, ActivationId, GrainId
 from orleans_tpu.runtime.messaging import Message
+
+#: gateway wire framing: 4-byte magic + 4-byte length, codec payload.
+#: Payloads are either a Message or a control dict {"op": ...}.
+GATEWAY_MAGIC = 0x4F43  # "OC" — distinct from silo-to-silo frames
 
 
 class Gateway:
@@ -25,6 +42,8 @@ class Gateway:
         self.silo = silo
         # client grain id → deliver callable (the 'socket' to the client)
         self._clients: Dict[GrainId, Callable[[Message], None]] = {}
+        # ids whose connection is a REAL socket (fidelity roundtrip skipped)
+        self._wired: set = set()
         self.wire_fidelity = True
 
     @property
@@ -35,12 +54,19 @@ class Gateway:
     # -- connection management (reference: Gateway.RecordOpenedSocket :109)
 
     async def connect_client(self, client_id: GrainId,
-                             deliver: Callable[[Message], None]) -> None:
+                             deliver: Callable[[Message], None],
+                             wired: bool = False) -> None:
+        """``wired=True`` marks a connection whose messages cross a REAL
+        socket (GatewayAcceptor) — the wire-fidelity codec roundtrip that
+        emulates a socket for in-proc clients is skipped for those."""
         self._clients[client_id] = deliver
+        if wired:
+            self._wired.add(client_id)
         await self._register_client_route(client_id)
 
     async def disconnect_client(self, client_id: GrainId) -> None:
         self._clients.pop(client_id, None)
+        self._wired.discard(client_id)
         addr = ActivationAddress(self.silo.address, client_id,
                                  ActivationId(0, 0))
         try:
@@ -56,6 +82,8 @@ class Gateway:
         if deliver is None:
             raise KeyError(f"client {client_id} not connected to this gateway")
         self._clients[observer_id] = deliver
+        if client_id in self._wired:
+            self._wired.add(observer_id)
         await self._register_client_route(observer_id)
 
     async def _register_client_route(self, grain_id: GrainId) -> None:
@@ -75,10 +103,12 @@ class Gateway:
 
     # -- inbound from clients ----------------------------------------------
 
-    def submit(self, msg: Message) -> None:
+    def submit(self, msg: Message, already_wired: bool = False) -> None:
         """A client pushed a message into the cluster through this silo
-        (reference: GatewayAcceptor receive → MessageCenter inbound)."""
-        if self.wire_fidelity:
+        (reference: GatewayAcceptor receive → MessageCenter inbound).
+        ``already_wired`` skips the fidelity roundtrip for messages that
+        arrived over a real socket (they were just deserialized)."""
+        if self.wire_fidelity and not already_wired:
             msg = codec.deserialize(codec.serialize(msg))
         if msg.target_silo is None:
             # gateway addresses the message like any in-silo send
@@ -95,6 +125,118 @@ class Gateway:
                 f"gateway: no client connection for {msg.target_grain}; "
                 f"dropping {msg}")
             return
-        if self.wire_fidelity:
+        if self.wire_fidelity and msg.target_grain not in self._wired:
             msg = codec.deserialize(codec.serialize(msg))
         asyncio.get_running_loop().call_soon(deliver, msg)
+
+
+# ---------------------------------------------------------------------------
+# TCP client edge (reference: GatewayAcceptor.cs:32 + proxied handshake,
+# IncomingMessageAcceptor.cs:133)
+# ---------------------------------------------------------------------------
+
+def write_gateway_frame(writer: asyncio.StreamWriter, payload: Any) -> None:
+    blob = codec.serialize(payload)
+    writer.write(struct.pack("<II", GATEWAY_MAGIC, len(blob)) + blob)
+
+
+async def read_gateway_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(8)
+    magic, length = struct.unpack("<II", header)
+    if magic != GATEWAY_MAGIC:
+        raise ValueError(f"bad gateway frame magic {magic:#x}")
+    return codec.deserialize(await reader.readexactly(length))
+
+
+def _rebase_expiration_inbound(msg: Message) -> Message:
+    if isinstance(msg, Message) and msg.expiration is not None:
+        # wire carries remaining TTL → rebase on this host's clock
+        # (same discipline as TcpTransport silo frames)
+        msg.expiration = time.monotonic() + msg.expiration
+    return msg
+
+
+def _with_ttl(msg: Message) -> Message:
+    if msg.expiration is None:
+        return msg
+    return dataclasses.replace(
+        msg, expiration=max(0.0, msg.expiration - time.monotonic()))
+
+
+class GatewayAcceptor:
+    """Dedicated client-facing listener on a gateway silo
+    (reference: ProxyGatewayEndpoint + GatewayAcceptor.cs:32)."""
+
+    def __init__(self, silo, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.silo = silo
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        for w in list(self._conns):
+            w.close()
+        self._conns.clear()
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        gateway: Gateway = self.silo.system_targets.get("gateway")
+        self._conns.add(writer)
+        registered: list = []  # client + observer ids bound to this socket
+        try:
+            hello = await read_gateway_frame(reader)
+            if not (isinstance(hello, dict) and hello.get("op") == "hello"):
+                raise ValueError("gateway connection must open with HELLO")
+            client_id: GrainId = hello["client_id"]
+
+            def deliver(msg: Message) -> None:
+                if writer.is_closing():
+                    return
+                write_gateway_frame(writer, _with_ttl(msg))
+
+            await gateway.connect_client(client_id, deliver, wired=True)
+            registered.append(client_id)
+            write_gateway_frame(writer, {"op": "welcome",
+                                         "silo": str(self.silo.address)})
+
+            while True:
+                frame = await read_gateway_frame(reader)
+                if isinstance(frame, Message):
+                    gateway.submit(_rebase_expiration_inbound(frame),
+                                   already_wired=True)
+                elif isinstance(frame, dict):
+                    op = frame.get("op")
+                    if op == "observer":
+                        await gateway.register_observer(client_id,
+                                                        frame["observer_id"])
+                        registered.append(frame["observer_id"])
+                        write_gateway_frame(writer, {"op": "ok",
+                                                     "for": "observer"})
+                    elif op == "unregister":
+                        await gateway.disconnect_client(frame["grain_id"])
+                        if frame["grain_id"] in registered:
+                            registered.remove(frame["grain_id"])
+                    elif op == "bye":
+                        break
+                    else:
+                        raise ValueError(f"unknown gateway op {op!r}")
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass  # client vanished: clean up below (reference:
+            #       Gateway.RecordClosedSocket)
+        finally:
+            self._conns.discard(writer)
+            writer.close()
+            for grain_id in registered:
+                try:
+                    await gateway.disconnect_client(grain_id)
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass

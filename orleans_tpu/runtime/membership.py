@@ -275,7 +275,8 @@ class MembershipOracle:
                     await self.table.insert_row(MembershipEntry(
                         silo=self.silo.address, status=status,
                         iam_alive_time=now, start_time=now,
-                        proxy_port=(self.silo.address.port or 1)
+                        proxy_port=(getattr(self.silo, "gateway_port", 0)
+                                    or self.silo.address.port or 1)
                         if has_gateway else 0,
                         can_host=self.silo.config.host_grains), version)
                 else:

@@ -174,6 +174,8 @@ class Silo:
         # the ring is the membership view)
         self._fabric = fabric
         self._bound_transport = None
+        self.gateway_acceptor = None
+        self.gateway_port = 0  # client-facing port (0 = in-proc only)
         self.membership_oracle = None
         if membership_table is not None:
             from orleans_tpu.runtime.membership import MembershipOracle
@@ -235,6 +237,17 @@ class Silo:
                 bound = await bound
             self._bound_transport = bound
             self.message_center.transport = self._bound_transport
+        # TCP client edge: gateway silos with a routable endpoint listen
+        # for clients on a dedicated port (reference: ProxyGatewayEndpoint,
+        # GatewayAcceptor.cs:32); the port is advertised via membership
+        if (self.config.gateway_enabled
+                and getattr(self._bound_transport, "transport", None)
+                is not None):
+            from orleans_tpu.runtime.gateway import GatewayAcceptor
+            self.gateway_acceptor = GatewayAcceptor(self,
+                                                    host=self.address.host)
+            await self.gateway_acceptor.start()
+            self.gateway_port = self.gateway_acceptor.port
         for name, provider in self.storage_providers.items():
             await provider.init(name, {})
         self.catalog.start_collector(self.config.collection.collection_quantum)
@@ -315,6 +328,8 @@ class Silo:
                                  code=2802)
         for provider in self.storage_providers.values():
             await provider.close()
+        if self.gateway_acceptor is not None:
+            self.gateway_acceptor.close()
         if self._bound_transport is not None:
             if graceful:
                 # flush outbound sender queues so in-flight responses
@@ -345,6 +360,8 @@ class Silo:
             self.reminder_service.kill()
         if self.membership_oracle is not None:
             self.membership_oracle.kill()
+        if self.gateway_acceptor is not None:
+            self.gateway_acceptor.close()
         if self._bound_transport is not None:
             self._bound_transport.close()
 
@@ -400,11 +417,15 @@ class Silo:
             self.load_publisher.publish_period = \
                 self.config.load_publish_period
         for cb in self._config_listeners:
-            res = cb(self.config)
-            if asyncio.iscoroutine(res):
-                # async listeners run as tasks (update_config is sync —
-                # same convenience on_stop gives its callbacks)
-                asyncio.get_running_loop().create_task(res)
+            try:
+                res = cb(self.config)
+                if asyncio.iscoroutine(res):
+                    # async listeners run as tasks (update_config is sync
+                    # — same convenience on_stop gives its callbacks)
+                    asyncio.get_running_loop().create_task(res)
+            except Exception:  # noqa: BLE001 — one bad listener must not
+                # starve the rest or mislabel an APPLIED reload as rejected
+                self.logger.warn("config-change listener failed", code=2803)
 
     async def _stats_report_loop(self) -> None:
         """Periodic metrics publication (reference: LogStatistics.cs:33

@@ -1,0 +1,187 @@
+"""Out-of-cluster clients over real TCP gateway sockets.
+
+VERDICT-era gap: clients could only attach in-process.  Here GrainClient
+dials a gateway silo's dedicated client port (the ProxyGatewayEndpoint
+analog), handshakes, and runs RPC + observers over the socket — the
+reference's GatewayConnection/ProxiedMessageCenter path (reference:
+Gateway.cs:37, GatewayAcceptor.cs:32, ProxiedMessageCenter.cs:82,
+GatewayManager.cs:41).
+"""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.client import GrainClient
+from orleans_tpu.testing import TestingCluster
+
+from tests.fixture_grains import ICounterGrain, IFailingGrain
+
+
+def _gateway_endpoint(silo):
+    return (silo.address.host, silo.gateway_port)
+
+
+def test_tcp_client_rpc_roundtrip(run):
+    """Requests, responses, errors and one-ways over the client socket."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2, transport="tcp").start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            assert cluster.silos[0].gateway_port > 0
+            client = await GrainClient().connect(
+                _gateway_endpoint(cluster.silos[0]))
+            try:
+                ref = client.get_grain(ICounterGrain, 8800)
+                assert await ref.add(5) == 5
+                assert await ref.add(2) == 7
+
+                # errors propagate over the socket
+                bad = client.get_grain(IFailingGrain, 8801)
+                with pytest.raises(ValueError, match="kaboom"):
+                    await bad.boom()
+
+                # grains placed on the NON-gateway-connected silo still
+                # answer (gateway routes into the cluster)
+                refs = [client.get_grain(ICounterGrain, 8810 + i)
+                        for i in range(10)]
+                results = await asyncio.gather(*(r.add(1) for r in refs))
+                assert results == [1] * 10
+                placed = [len(s.catalog.directory) for s in cluster.silos]
+                assert all(p > 0 for p in placed), placed
+            finally:
+                await client.close()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_tcp_client_gateway_pool_failover(run):
+    """Two gateway sockets; killing one leaves the pool serving through
+    the survivor (reference: GatewayManager.GetLiveGateways skips dead
+    gateways)."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=3, transport="tcp").start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            client = await GrainClient().connect(
+                _gateway_endpoint(cluster.silos[0]),
+                _gateway_endpoint(cluster.silos[1]))
+            try:
+                refs = [client.get_grain(ICounterGrain, 8900 + i)
+                        for i in range(6)]
+                await asyncio.gather(*(r.add(1) for r in refs))
+
+                victim = cluster.silos[0]
+                cluster.kill_silo(victim)
+                await cluster.wait_for_liveness_convergence(timeout=15.0)
+                # the dead gateway's handle reports not-alive soon after
+                deadline = asyncio.get_running_loop().time() + 5
+                while all(g.alive for g in client._gateways):
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.05)
+
+                results = await asyncio.gather(
+                    *(r.add(1) for r in refs), return_exceptions=True)
+                ok = [r for r in results if isinstance(r, int)]
+                assert len(ok) == 6, results
+            finally:
+                await client.close()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_tcp_client_observers(run):
+    """Observer objects on the client receive grain-initiated calls over
+    the socket (reference: CreateObjectReference + Gateway reply path)."""
+
+    async def main():
+        from orleans_tpu import Grain, grain_interface, one_way
+        from orleans_tpu.core.grain import grain_class
+
+        @grain_interface
+        class ITcpNotifier:
+            @one_way
+            async def notify(self, value: int): ...
+
+        @grain_interface
+        class ITcpPublisher:
+            async def subscribe(self, observer) -> None: ...
+            async def publish(self, value: int) -> None: ...
+
+        @grain_class
+        class TcpPublisherGrain(Grain, ITcpPublisher):
+            def __init__(self):
+                self.observers = []
+
+            async def subscribe(self, observer):
+                self.observers.append(observer)
+
+            async def publish(self, value):
+                for obs in self.observers:
+                    await obs.notify(value)
+
+        cluster = await TestingCluster(n_silos=2, transport="tcp").start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            client = await GrainClient().connect(
+                _gateway_endpoint(cluster.silos[0]))
+            try:
+                got = []
+
+                class Obs:
+                    async def notify(self, value):
+                        got.append(value)
+
+                obs_ref = await client.create_object_reference(
+                    ITcpNotifier, Obs())
+                pub = client.get_grain(ITcpPublisher, 42)
+                await pub.subscribe(obs_ref)
+                await pub.publish(11)
+                await pub.publish(22)
+                deadline = asyncio.get_running_loop().time() + 5
+                while len(got) < 2:
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.02)
+                assert got == [11, 22]
+            finally:
+                await client.close()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_gateway_endpoints_advertised_in_membership(run):
+    """The membership table advertises the CLIENT port (not the
+    silo-to-silo port), so list providers hand clients dialable
+    endpoints (reference: ProxyPort in the membership row)."""
+
+    async def main():
+        from orleans_tpu.plugins.gateway_list import (
+            MembershipGatewayListProvider,
+        )
+
+        cluster = await TestingCluster(n_silos=2, transport="tcp").start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            provider = MembershipGatewayListProvider(cluster.table)
+            eps = await provider.get_gateway_endpoints()
+            expected = {(s.address.host, s.gateway_port)
+                        for s in cluster.silos}
+            assert set(eps) == expected
+            # and a client can connect via a discovered endpoint
+            client = await GrainClient().connect(eps[0])
+            try:
+                assert await client.get_grain(ICounterGrain, 8950).add(1) == 1
+            finally:
+                await client.close()
+        finally:
+            await cluster.stop()
+
+    run(main())
