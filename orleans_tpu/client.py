@@ -210,9 +210,19 @@ class GrainClient:
         (reference: GrainFactory.CreateObjectReference / IGrainObserver)."""
         iface = get_interface(interface)
         observer_id = GrainId.client(uuid.uuid4())
-        self._observers[observer_id] = obj
+        registered = 0
         for gateway in self._gateways:
-            await gateway.register_observer(self.client_id, observer_id)
+            if not gateway.alive:
+                continue  # pool semantics: dead gateways are skipped
+            try:
+                await gateway.register_observer(self.client_id, observer_id)
+                registered += 1
+            except ConnectionError:
+                continue
+        if registered == 0:
+            raise RuntimeError("no live gateways to register observer "
+                               "(reference: GatewayManager empty live list)")
+        self._observers[observer_id] = obj
         return GrainReference(observer_id, iface.interface_id)
 
     async def delete_object_reference(self, ref: GrainReference) -> None:
@@ -275,6 +285,14 @@ class TcpGatewayHandle:
             if self._writer is not None:
                 self._writer.close()
                 self._writer = None  # alive -> False; pool skips us
+            # fail in-flight control calls NOW instead of letting them
+            # sit out their timeout against a dead socket
+            while self._control_waiters is not None \
+                    and not self._control_waiters.empty():
+                waiter = self._control_waiters.get_nowait()
+                if not waiter.done():
+                    waiter.set_exception(ConnectionError(
+                        f"gateway {self.host}:{self.port} disconnected"))
 
     def submit(self, msg: Message) -> None:
         if not self.alive:

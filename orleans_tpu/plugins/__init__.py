@@ -13,6 +13,10 @@ from orleans_tpu.plugins.file_tables import (
     FileMembershipTable,
     FileReminderTable,
 )
+from orleans_tpu.plugins.sqlite_queue import (
+    SqliteQueueAdapter,
+    SqliteQueueReceiver,
+)
 from orleans_tpu.plugins.sqlite_tables import (
     SqliteMembershipTable,
     SqliteReminderTable,
@@ -30,6 +34,8 @@ __all__ = [
     "LogStatisticsPublisher",
     "MembershipGatewayListProvider",
     "SqliteMembershipTable",
+    "SqliteQueueAdapter",
+    "SqliteQueueReceiver",
     "SqliteReminderTable",
     "SqliteStatisticsPublisher",
     "StaticGatewayListProvider",

@@ -1,0 +1,152 @@
+"""Durable queue adapter for persistent streams, backed by sqlite.
+
+Parity: the reference's production persistent-stream backend is a
+durable external queue service — AzureQueueAdapter writes each event
+batch to an Azure Storage queue and receivers pull/delete by receipt
+(reference: src/OrleansAzureUtils/Providers/Streams/AzureQueue/
+AzureQueueAdapter.cs:34, AzureQueueAdapterReceiver).  This adapter plays
+that role with sqlite on a shared path: events survive process restarts,
+multiple processes can produce/consume the same queues, and the pulling
+agents' at-least-once + ack/trim discipline is identical to the
+in-memory adapter's (streams/persistent.py) — so the whole persistent-
+stream suite runs unchanged on a durable store.
+
+Concurrency discipline: sequence allocation is a read-modify-write, so
+every mutation runs under ``BEGIN IMMEDIATE`` (sqlite's write lock —
+the cross-process serialization the reference gets from the queue
+service), and all sqlite work runs in a worker thread via
+``asyncio.to_thread`` so disk commits never stall the silo's event loop.
+
+Delivery cursor: one durable row per queue records the ack offset (the
+analog of queue-message deletion after processing); events at or below
+it are trimmed on ack.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sqlite3
+import threading
+from typing import List
+
+from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.streams.persistent import (
+    QueueAdapter,
+    QueueAdapterReceiver,
+    QueueMessage,
+)
+
+
+class SqliteQueueAdapter(QueueAdapter):
+    """(reference: AzureQueueAdapter.cs:34 — durable queue per queue id)"""
+
+    _SCHEMA = """
+    CREATE TABLE IF NOT EXISTS stream_events (
+        queue_id  INTEGER NOT NULL,
+        seq       INTEGER NOT NULL,
+        payload   BLOB    NOT NULL,
+        PRIMARY KEY (queue_id, seq)
+    );
+    CREATE TABLE IF NOT EXISTS stream_cursors (
+        queue_id  INTEGER PRIMARY KEY,
+        cursor    INTEGER NOT NULL,
+        next_seq  INTEGER NOT NULL
+    );
+    """
+
+    def __init__(self, path: str = ":memory:", n_queues: int = 8) -> None:
+        self.path = path
+        self.n_queues = n_queues
+        # manual transactions (BEGIN IMMEDIATE) + worker-thread execution
+        self._conn = sqlite3.connect(path, isolation_level=None,
+                                     check_same_thread=False)
+        self._conn.execute("PRAGMA busy_timeout=5000")
+        self._lock = threading.Lock()  # serialize our own threads
+        with self._lock:
+            self._conn.executescript(self._SCHEMA)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- synchronous cores (run via asyncio.to_thread) ----------------------
+
+    def _enqueue_sync(self, queue_id: int, msg: QueueMessage) -> int:
+        with self._lock:
+            # IMMEDIATE takes the write lock BEFORE the read, so two
+            # producer processes cannot both read the same next_seq
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                self._conn.execute(
+                    "INSERT OR IGNORE INTO stream_cursors (queue_id, "
+                    "cursor, next_seq) VALUES (?, 0, 0)", (queue_id,))
+                (next_seq,) = self._conn.execute(
+                    "SELECT next_seq FROM stream_cursors WHERE queue_id=?",
+                    (queue_id,)).fetchone()
+                msg.seq = next_seq
+                self._conn.execute(
+                    "INSERT INTO stream_events (queue_id, seq, payload) "
+                    "VALUES (?,?,?)",
+                    (queue_id, next_seq, codec.serialize(msg)))
+                self._conn.execute(
+                    "UPDATE stream_cursors SET next_seq=? WHERE queue_id=?",
+                    (next_seq + 1, queue_id))
+                self._conn.execute("COMMIT")
+                return next_seq
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+
+    def _pull_sync(self, queue_id: int, max_count: int) -> List[QueueMessage]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT cursor FROM stream_cursors WHERE queue_id=?",
+                (queue_id,)).fetchone()
+            cursor = row[0] if row is not None else 0
+            rows = self._conn.execute(
+                "SELECT payload FROM stream_events WHERE queue_id=? AND "
+                "seq>=? ORDER BY seq LIMIT ?",
+                (queue_id, cursor, max_count)).fetchall()
+        return [codec.deserialize(b) for (b,) in rows]
+
+    def _ack_sync(self, queue_id: int, up_to_seq: int) -> None:
+        with self._lock:
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                self._conn.execute(
+                    "UPDATE stream_cursors SET cursor=MAX(cursor, ?) "
+                    "WHERE queue_id=?", (up_to_seq + 1, queue_id))
+                self._conn.execute(
+                    "DELETE FROM stream_events WHERE queue_id=? AND seq<"
+                    "(SELECT cursor FROM stream_cursors WHERE queue_id=?)",
+                    (queue_id, queue_id))
+                self._conn.execute("COMMIT")
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+
+    # -- adapter contract ----------------------------------------------------
+
+    async def queue_message(self, queue_id: int, msg: QueueMessage) -> None:
+        msg.seq = await asyncio.to_thread(self._enqueue_sync, queue_id, msg)
+
+    def create_receiver(self, queue_id: int) -> "SqliteQueueReceiver":
+        return SqliteQueueReceiver(self, queue_id)
+
+
+class SqliteQueueReceiver(QueueAdapterReceiver):
+    """(reference: AzureQueueAdapterReceiver — pull, then delete-on-ack)"""
+
+    def __init__(self, adapter: SqliteQueueAdapter, queue_id: int) -> None:
+        self.adapter = adapter
+        self.queue_id = queue_id
+
+    async def get_queue_messages(self, max_count: int) -> List[QueueMessage]:
+        return await asyncio.to_thread(self.adapter._pull_sync,
+                                       self.queue_id, max_count)
+
+    async def ack(self, up_to_seq: int) -> None:
+        """Durable delivery offset + trim (the delete-after-processing
+        of the reference's queue receipts)."""
+        await asyncio.to_thread(self.adapter._ack_sync, self.queue_id,
+                                up_to_seq)

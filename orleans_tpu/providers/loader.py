@@ -87,9 +87,18 @@ def _builtin_factories() -> Dict[str, Dict[str, Callable[..., Any]]]:
             InMemoryQueueAdapter(n_queues=int(config.get("queues", 4))),
             pull_period=float(config.get("pull_period", 0.05)))
 
+    def persistent_sqlite_stream(config):
+        from orleans_tpu.plugins.sqlite_queue import SqliteQueueAdapter
+        from orleans_tpu.streams.persistent import PersistentStreamProvider
+        return PersistentStreamProvider(
+            SqliteQueueAdapter(path=config.get("path", ":memory:"),
+                               n_queues=int(config.get("queues", 4))),
+            pull_period=float(config.get("pull_period", 0.05)))
+
     streams = {
         "simple": simple_stream,
         "persistent": persistent_stream,
+        "persistent_sqlite": persistent_sqlite_stream,
     }
     return {"storage": storage, "stream": streams,
             "bootstrap": {}, "statistics": {}}

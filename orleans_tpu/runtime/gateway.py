@@ -222,9 +222,12 @@ class GatewayAcceptor:
                         write_gateway_frame(writer, {"op": "ok",
                                                      "for": "observer"})
                     elif op == "unregister":
-                        await gateway.disconnect_client(frame["grain_id"])
+                        # only ids THIS connection registered — otherwise
+                        # one client could sever another's routes
                         if frame["grain_id"] in registered:
                             registered.remove(frame["grain_id"])
+                            await gateway.disconnect_client(
+                                frame["grain_id"])
                     elif op == "bye":
                         break
                     else:

@@ -435,12 +435,20 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
 
     async def stop(self) -> None:
         self.manager.stop()
+        # durable adapters own real resources (sqlite connections, file
+        # handles) — release them with the provider
+        close = getattr(self.adapter, "close", None)
+        if close is not None:
+            close()
 
     def kill(self) -> None:
         """Synchronous teardown for the hard-kill path — a dead silo's
         agents must never touch the shared queues again."""
         if self.manager is not None:
             self.manager.stop()
+        close = getattr(self.adapter, "close", None)
+        if close is not None:
+            close()
 
     # get_stream / subscription plumbing come from PubSubStreamProviderMixin
 

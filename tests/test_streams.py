@@ -470,3 +470,85 @@ def test_pubsub_conflict_replays_delta_only(run):
         assert set(g._bridge.durable["consumer_subs"]) == {8, 9}
 
     run(main())
+
+
+def test_persistent_stream_over_sqlite_queue(run, tmp_path):
+    """The durable queue adapter (AzureQueueAdapter analog) runs the same
+    delivery discipline as in-memory, and events survive a 'process
+    restart' — a fresh adapter over the same db resumes undelivered
+    events from the durable cursor."""
+
+    async def go():
+        from orleans_tpu.plugins.sqlite_queue import SqliteQueueAdapter
+        from orleans_tpu.providers.memory_storage import MemoryStorage
+        from orleans_tpu.streams.pubsub import PUBSUB_STORE
+
+        db = str(tmp_path / "queues.db")
+        # durable subscriptions: without a PubSubStore, a subscription
+        # dies with its silo and a restarted agent correctly acks events
+        # into the void (reference: PubSubStore provider block)
+        pubsub_backing = MemoryStorage.shared_backing()
+        silo = Silo(name="pstreams-sqlite", storage_providers={
+            PUBSUB_STORE: MemoryStorage(pubsub_backing)})
+        silo.add_stream_provider("pq", PersistentStreamProvider(
+            SqliteQueueAdapter(path=db, n_queues=4), pull_period=0.01,
+            consumer_cache_ttl=0.0))
+        await silo.start()
+        try:
+            f = silo.attach_client()
+            c = f.get_grain(IStreamConsumerGrain, 60)
+            await c.join("pq", "devents", 9)
+            producer = f.get_grain(IStreamProducerGrain, 61)
+            await producer.produce("pq", "devents", 9, ["a", "b", "c"])
+
+            async def until(n):
+                while len(await c.received()) < n:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(until(3), timeout=5.0)
+            items = await c.received()
+            assert [i for i, _ in items] == ["a", "b", "c"]
+        finally:
+            await silo.stop(graceful=False)
+
+        # restart simulation: write events with one adapter+no consumer,
+        # then a FRESH adapter over the same file delivers them
+        adapter = SqliteQueueAdapter(path=db, n_queues=4)
+        from orleans_tpu.streams.core import StreamId
+        sid = StreamId(provider="pq", namespace="devents", key=9)
+        from orleans_tpu.streams.persistent import (
+            HashRingStreamQueueMapper,
+            QueueMessage,
+        )
+        q = HashRingStreamQueueMapper(4).queue_for(sid)
+        await adapter.queue_message(q, QueueMessage(stream_id=sid,
+                                                   item="post-crash", seq=0))
+        adapter.close()
+
+        silo2 = Silo(name="pstreams-sqlite-2", storage_providers={
+            PUBSUB_STORE: MemoryStorage(pubsub_backing)})
+        silo2.add_stream_provider("pq", PersistentStreamProvider(
+            SqliteQueueAdapter(path=db, n_queues=4), pull_period=0.01,
+            consumer_cache_ttl=0.0))
+        await silo2.start()
+        try:
+            f2 = silo2.attach_client()
+            c2 = f2.get_grain(IStreamConsumerGrain, 60)
+            # the subscription is durable in the PubSubStore; the fresh
+            # activation RESUMES it (join takes the resume path via
+            # get_all_subscription_handles — the reference's
+            # resume-on-activate pattern; an unresumed handle faults)
+            await c2.join("pq", "devents", 9)
+
+            async def until2():
+                items = await c2.received()
+                return any(i == "post-crash" for i, _ in items)
+
+            deadline = asyncio.get_running_loop().time() + 5
+            while not await until2():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.02)
+        finally:
+            await silo2.stop(graceful=False)
+
+    run(go())
