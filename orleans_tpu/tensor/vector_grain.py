@@ -71,6 +71,8 @@ def ones_mask(n: int):
         if len(_mask_cache) >= _MASK_CACHE_MAX:
             _mask_cache.clear()
         m = jnp.asarray(np.ones(n, dtype=bool))
+        if isinstance(m, jax.core.Tracer):
+            return m  # under an abstract trace: trace-local, don't cache
         _mask_cache[n] = m
     return m
 
