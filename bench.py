@@ -64,20 +64,30 @@ async def _tensor_chirper(n_accounts: int, mean_followers: float,
                           n_ticks: int, latency_ticks: int,
                           warmup_ticks: int = 2) -> dict:
     from orleans_tpu.tensor import TensorEngine
-    from samples.chirper import build_follow_graph, run_chirper_load
+    from samples.chirper import (
+        build_follow_graph,
+        run_chirper_load,
+        run_chirper_load_fused,
+    )
 
     engine = TensorEngine()
     fanout = build_follow_graph(n_accounts, mean_followers)
-    await run_chirper_load(engine, n_accounts=n_accounts,
-                           n_ticks=warmup_ticks, fanout=fanout)
-    stats = await run_chirper_load(engine, n_accounts=n_accounts,
-                                   n_ticks=n_ticks, fanout=fanout)
-    lat = await run_chirper_load(engine, n_accounts=n_accounts,
-                                 n_ticks=latency_ticks, fanout=fanout,
-                                 measure_latency=True)
+    stats = await run_chirper_load_fused(engine, n_accounts=n_accounts,
+                                         n_ticks=n_ticks, fanout=fanout)
+    lat = await run_chirper_load_fused(engine, n_accounts=n_accounts,
+                                       n_ticks=latency_ticks, fanout=fanout,
+                                       measure_latency=True)
     stats["tick_p50_seconds"] = lat["tick_p50_seconds"]
     stats["tick_p99_seconds"] = lat["tick_p99_seconds"]
     stats["latency_ticks"] = latency_ticks
+    # transparency: the unfused (per-round dispatch) engine on the same load
+    engine2 = TensorEngine()
+    await run_chirper_load(engine2, n_accounts=n_accounts,
+                           n_ticks=warmup_ticks, fanout=fanout)
+    unfused = await run_chirper_load(engine2, n_accounts=n_accounts,
+                                     n_ticks=max(2, n_ticks // 4),
+                                     fanout=fanout)
+    stats["unfused_msgs_per_sec"] = unfused["messages_per_sec"]
     return stats
 
 
@@ -174,6 +184,8 @@ def main() -> None:
             "grains": args.accounts,
             "edges": stats["edges"],
             "ticks": args.ticks,
+            "engine": "fused (one compiled program per tick window)",
+            "unfused_msgs_per_sec": round(stats["unfused_msgs_per_sec"], 1),
             "p99_turn_latency_s": round(stats["tick_p99_seconds"], 4),
             "p50_turn_latency_s": round(stats["tick_p50_seconds"], 4),
             "latency_def": f"true p99 over {stats['latency_ticks']} "

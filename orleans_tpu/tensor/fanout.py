@@ -70,6 +70,17 @@ def _budget_of(csr_dst):
     return csr_dst.shape[0]
 
 
+def _group_ranges(sorted_vals: np.ndarray):
+    """Yield (value, start, end) for each run of equal values."""
+    if len(sorted_vals) == 0:
+        return
+    boundaries = np.flatnonzero(np.diff(sorted_vals)) + 1
+    starts = np.concatenate([[0], boundaries])
+    ends = np.concatenate([boundaries, [len(sorted_vals)]])
+    for s, e in zip(starts.tolist(), ends.tolist()):
+        yield sorted_vals[s], s, e
+
+
 class FanoutOverflowError(RuntimeError):
     """More expanded messages than the configured budget in one round."""
 
@@ -114,10 +125,26 @@ class DeviceFanout:
         return list(self._adj.get(int(src), ()))
 
     def add_edges(self, src_keys: np.ndarray, dst_keys: np.ndarray) -> None:
-        """Bulk graph load (the sample's NetworkLoader analog)."""
-        for s, d in zip(np.asarray(src_keys).tolist(),
-                        np.asarray(dst_keys).tolist()):
-            self.follow(s, d)
+        """Bulk graph load (the sample's NetworkLoader analog).
+
+        Vectorized: dedups against BOTH the new batch and existing edges
+        with numpy, then extends adjacency lists wholesale — ``follow``'s
+        per-edge membership scan is O(degree) and would make a power-law
+        celebrity (100k followers) quadratic to load."""
+        src = np.asarray(src_keys, dtype=np.int64)
+        dst = np.asarray(dst_keys, dtype=np.int64)
+        if len(src) == 0:
+            return
+        pairs = np.unique(np.stack([src, dst], axis=1), axis=0)
+        for s, grp_start, grp_end in _group_ranges(pairs[:, 0]):
+            lst = self._adj.setdefault(int(s), [])
+            new = pairs[grp_start:grp_end, 1].tolist()
+            if lst:
+                existing = set(lst)
+                new = [d for d in new if d not in existing]
+            lst.extend(new)
+            self.edge_count += len(new)
+        self._dirty = True
 
     # -- device mirror -------------------------------------------------------
 

@@ -101,8 +101,8 @@ class FusedTickProgram:
                     Batch(rows=rows, args=args, mask=mask), n_rows))
         states = {**states, type_name: state2}
         miss_total = jnp.int32(0)
-        if depth >= self.engine.config.max_rounds_per_tick:
-            return states, miss_total
+        delivered = jnp.int32(0)
+        at_cap = depth >= self.engine.config.max_rounds_per_tick
 
         out_batches: List[Tuple[str, str, Any, Any, Any]] = []
         emits = emits if isinstance(emits, (tuple, list)) else (emits,)
@@ -117,23 +117,44 @@ class FusedTickProgram:
             out_batches.append((e.interface, e.method, ekeys, e.args, emask))
 
         fan = self.engine._fanouts.get((type_name, method))
-        if fan is not None:
+        if fan is not None and not at_cap:
             fanout, dst_type, dst_method = fan
             src_keys = self._src_keys_for(type_name, rows)
             dkeys, dargs, dvalid = fanout.expand(src_keys, args, mask)
-            fanout._pending_totals.pop()  # fused windows verify via misses
+            total, width = fanout._pending_totals.pop()
+            # expansion past the CSR width never materialized: count the
+            # overflow as misses so verify() fails loudly (the unfused
+            # path raises FanoutOverflowError for the same condition)
+            miss_total = miss_total + jnp.maximum(
+                total - jnp.int32(width), 0)
             out_batches.append((dst_type, dst_method, dkeys, dargs, dvalid))
+        elif fan is not None and at_cap:
+            # a fan-out the cap prevents from running would silently lose
+            # deliveries — surface it via the miss counter
+            miss_total = miss_total + jnp.sum(
+                jnp.asarray(mask, jnp.int32))
+
+        if at_cap:
+            # the unfused engine SPILLS round-cap emits to the next tick;
+            # a fused window cannot, so count them as misses — verify()
+            # then tells the caller this chain is too deep to fuse
+            for _, _, _ekeys, _eargs, emask in out_batches:
+                miss_total = miss_total + jnp.sum(
+                    jnp.asarray(emask, jnp.int32))
+            return states, miss_total, delivered
 
         for dst_type, dst_method, ekeys, eargs, emask in out_batches:
             dst_arena = self.engine.arena_for(dst_type)
             self._note_arena(dst_type, dst_arena)
             from orleans_tpu.tensor.engine import resolve_rows_on_device
             drows, miss = resolve_rows_on_device(dst_arena, ekeys, emask)
-            states, sub_miss = self._apply_group(
+            delivered = delivered + jnp.sum(jnp.asarray(emask, jnp.int32))
+            states, sub_miss, sub_del = self._apply_group(
                 states, dst_type, dst_method, drows, eargs,
                 drows >= 0, depth + 1)
             miss_total = miss_total + miss + sub_miss
-        return states, miss_total
+            delivered = delivered + sub_del
+        return states, miss_total, delivered
 
     def _src_keys_for(self, type_name: str, rows):
         arena = self.engine.arena_for(type_name)
@@ -172,7 +193,7 @@ class FusedTickProgram:
             def discover(args_t):
                 states: Dict[str, Any] = {
                     self.type_name: self.src_arena.state}
-                states, miss = self._apply_group(
+                states, miss, _delivered = self._apply_group(
                     states, self.type_name, self.method, src_rows, args_t,
                     mask, depth=1)
                 return miss
@@ -191,12 +212,13 @@ class FusedTickProgram:
                 # static leaves (identical every tick) ride OUTSIDE the
                 # scan xs: slicing a [T, m] stack per iteration costs
                 # real bandwidth; a closed-over [m] array costs nothing
-                states, miss = self._apply_group(
+                states, miss, delivered = self._apply_group(
                     states, self.type_name, self.method, src_rows,
                     {**static_args, **args_t}, mask, depth=1)
-                return states, miss
-            states, misses = jax.lax.scan(one_tick, states, stacked_args)
-            return states, jnp.sum(misses)
+                return states, (miss, delivered)
+            states, (misses, delivered) = jax.lax.scan(one_tick, states,
+                                                       stacked_args)
+            return states, jnp.sum(misses), jnp.sum(delivered)
 
         self._touched = touched
         return jax.jit(window, donate_argnums=(0,))
@@ -229,17 +251,33 @@ class FusedTickProgram:
                 lambda a: a[0], stacked_args)}
             self._compiled = self._build(example_args_t)
         states = {n: engine.arena_for(n).state for n in self._touched}
-        new_states, miss = self._compiled(states, static_args, stacked_args)
+        new_states, miss, delivered = self._compiled(
+            states, static_args, stacked_args)
         for n in self._touched:
             engine.arena_for(n).state = new_states[n]
-        self._pending_miss.append(miss)
+        self._pending_miss.append((miss, delivered))
         engine.tick_number += n_ticks
         engine.ticks_run += n_ticks
         engine.messages_processed += n_ticks * self.n_msgs
+        # collection safety: the window advanced the tick clock without
+        # routing through the engine's touch path — every row of a fused
+        # arena is a live participant, so stamp them all or the idle
+        # sweep would evict hot state mid-steady-state
+        for n in self._touched:
+            arena = engine.arena_for(n)
+            arena.last_use_tick[arena._key_of_row >= 0] = engine.tick_number
 
     def verify(self) -> int:
-        """Sync point: total emit misses across run() calls since the last
-        verify.  Nonzero = the window touched unactivated grains and its
-        deliveries to them were dropped — re-run those ticks unfused."""
+        """Sync point: total exactness violations across run() calls since
+        the last verify — emit misses (cold destinations), fan-out budget
+        overflows, and round-cap spills all count.  Nonzero = the window
+        was NOT exact; re-run those ticks unfused.  Also folds the
+        windows\' emit/fan-out delivery counts into the engine\'s
+        messages_processed (run() counts only source injections eagerly —
+        delivery counts live on device until this sync)."""
         pending, self._pending_miss = self._pending_miss, []
-        return sum(int(m) for m in pending)
+        misses = 0
+        for m, d in pending:
+            misses += int(m)
+            self.engine.messages_processed += int(d)
+        return misses

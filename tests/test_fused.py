@@ -146,3 +146,34 @@ def test_fused_rebuilds_after_arena_growth(run):
         np.testing.assert_array_equal(hb, 4)
 
     run(main())
+
+
+def test_fused_chirper_loader_matches_unfused(run):
+    """run_chirper_load_fused delivers exactly what the unfused loader
+    does for the same graph (modulo its extra warm window)."""
+
+    async def main():
+        from samples.chirper import (
+            build_follow_graph,
+            run_chirper_load,
+            run_chirper_load_fused,
+        )
+
+        fan = build_follow_graph(150, mean_followers=6.0, seed=5)
+        e1 = TensorEngine()
+        await run_chirper_load(e1, n_accounts=150, n_ticks=4, fanout=fan)
+        a1 = e1.arena_for("ChirperAccount")
+        rows1 = a1.resolve_rows(np.arange(150, dtype=np.int64))
+        ref = np.asarray(a1.state["received"])[rows1]
+
+        fan2 = build_follow_graph(150, mean_followers=6.0, seed=5)
+        e2 = TensorEngine()
+        stats = await run_chirper_load_fused(e2, n_accounts=150, n_ticks=4,
+                                             window=2, fanout=fan2)
+        a2 = e2.arena_for("ChirperAccount")
+        rows2 = a2.resolve_rows(np.arange(150, dtype=np.int64))
+        got = np.asarray(a2.state["received"])[rows2]
+        total_ticks = stats["ticks"] + 2  # + warm window
+        np.testing.assert_allclose(got / total_ticks, ref / 4)
+
+    run(main())
