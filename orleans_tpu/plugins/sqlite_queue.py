@@ -54,6 +54,9 @@ class SqliteQueueAdapter(QueueAdapter):
     );
     """
 
+    #: events kept after ack for rewind-token replay
+    retain: int = 256
+
     def __init__(self, path: str = ":memory:", n_queues: int = 8) -> None:
         self.path = path
         self.n_queues = n_queues
@@ -118,8 +121,8 @@ class SqliteQueueAdapter(QueueAdapter):
                     "WHERE queue_id=?", (up_to_seq + 1, queue_id))
                 self._conn.execute(
                     "DELETE FROM stream_events WHERE queue_id=? AND seq<"
-                    "(SELECT cursor FROM stream_cursors WHERE queue_id=?)",
-                    (queue_id, queue_id))
+                    "(SELECT cursor FROM stream_cursors WHERE queue_id=?)"
+                    " - ?", (queue_id, queue_id, self.retain))
                 self._conn.execute("COMMIT")
             except BaseException:
                 self._conn.execute("ROLLBACK")
@@ -146,7 +149,20 @@ class SqliteQueueReceiver(QueueAdapterReceiver):
                                        self.queue_id, max_count)
 
     async def ack(self, up_to_seq: int) -> None:
-        """Durable delivery offset + trim (the delete-after-processing
-        of the reference's queue receipts)."""
+        """Durable delivery offset + trim past the retention window (the
+        delete-after-processing of the reference's queue receipts)."""
         await asyncio.to_thread(self.adapter._ack_sync, self.queue_id,
                                 up_to_seq)
+
+    async def read_from(self, seq: int,
+                        max_count: int) -> List[QueueMessage]:
+        def _read():
+            with self.adapter._lock:
+                rows = self.adapter._conn.execute(
+                    "SELECT payload FROM stream_events WHERE queue_id=? "
+                    "AND seq>=? AND seq<(SELECT cursor FROM stream_cursors"
+                    " WHERE queue_id=?) ORDER BY seq LIMIT ?",
+                    (self.queue_id, seq, self.queue_id,
+                     max_count)).fetchall()
+            return [codec.deserialize(b) for (b,) in rows]
+        return await asyncio.to_thread(_read)

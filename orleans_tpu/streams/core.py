@@ -57,6 +57,11 @@ class StreamSubscriptionHandle:
     stream_id: StreamId
     subscription_id: int
     consumer: GrainId
+    # rewind token (reference: StreamSequenceToken): deliver retained
+    # events with seq >= from_seq to this subscription on attach.  Only
+    # queue-backed providers can honor it (SMS has no history — same as
+    # the reference's SimpleMessageStreamProvider).
+    from_seq: Optional[int] = None
 
     async def unsubscribe(self) -> None:
         from orleans_tpu.core.reference import current_runtime
@@ -267,8 +272,12 @@ class StreamImpl:
 
     async def subscribe(self, on_next: OnNext,
                         on_error: Optional[OnError] = None,
-                        on_completed: Optional[OnCompleted] = None
+                        on_completed: Optional[OnCompleted] = None,
+                        from_seq: Optional[int] = None
                         ) -> StreamSubscriptionHandle:
+        """``from_seq`` is the rewind token (reference: SubscribeAsync
+        with a StreamSequenceToken): queue-backed providers replay
+        RETAINED events with seq >= from_seq to this subscription."""
         from orleans_tpu.core import context as ctx
         act = ctx.current_activation()
         if act is None:
@@ -276,7 +285,8 @@ class StreamImpl:
         handle = StreamSubscriptionHandle(
             stream_id=self.stream_id,
             subscription_id=new_subscription_id(),
-            consumer=act.grain_id)
+            consumer=act.grain_id,
+            from_seq=from_seq)
         _consumer_extension().attach(
             handle.subscription_id, _Callbacks(on_next, on_error, on_completed))
         await self._provider.register_subscription(handle)

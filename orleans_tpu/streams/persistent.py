@@ -59,6 +59,15 @@ class QueueAdapterReceiver:
     async def ack(self, up_to_seq: int) -> None:
         raise NotImplementedError
 
+    async def read_from(self, seq: int,
+                        max_count: int) -> List[QueueMessage]:
+        """Replay retained ACKED events in [seq, cursor) — rewind-token
+        backfill.  Stops at the ack cursor: the un-acked tail is delivered
+        by the normal flow, so capping here avoids systematic double
+        delivery on the overlap.  Bounded by the retention window, like
+        the reference's cache-bounded rewind."""
+        raise NotImplementedError
+
 
 class QueueAdapter:
     """(reference: IQueueAdapter — QueueMessageBatchAsync + CreateReceiver)"""
@@ -76,6 +85,9 @@ class InMemoryQueueAdapter(QueueAdapter):
     """Process-local queue backend; silos in one process share it via
     ``shared_backing()`` the way the reference's test clusters share the
     Azure storage emulator (reference: AzureQueueAdapter.cs:34 stand-in)."""
+
+    #: events kept after ack for rewind-token replay
+    retain: int = 256
 
     def __init__(self, n_queues: int = 8,
                  backing: Optional[Dict] = None) -> None:
@@ -99,12 +111,13 @@ class InMemoryQueueAdapter(QueueAdapter):
         slot["events"].append(msg)
 
     def create_receiver(self, queue_id: int) -> "_InMemoryReceiver":
-        return _InMemoryReceiver(self._slot(queue_id))
+        return _InMemoryReceiver(self._slot(queue_id), self.retain)
 
 
 class _InMemoryReceiver(QueueAdapterReceiver):
-    def __init__(self, slot: Dict) -> None:
+    def __init__(self, slot: Dict, retain: int = 256) -> None:
         self._slot = slot
+        self._retain = retain
 
     async def get_queue_messages(self, max_count: int) -> List[QueueMessage]:
         events, cursor = self._slot["events"], self._slot["cursor"]
@@ -113,12 +126,19 @@ class _InMemoryReceiver(QueueAdapterReceiver):
         return events[start:start + max_count]
 
     async def ack(self, up_to_seq: int) -> None:
-        """Advance the shared cursor; delivered events may be trimmed
-        (the durable-offset model: handoff resumes at cursor)."""
+        """Advance the shared cursor; delivered events trim only past the
+        retention window (kept for rewind-token replay)."""
         slot = self._slot
         slot["cursor"] = max(slot["cursor"], up_to_seq + 1)
-        while slot["events"] and slot["events"][0].seq < slot["cursor"]:
+        keep_from = slot["cursor"] - self._retain
+        while slot["events"] and slot["events"][0].seq < keep_from:
             slot["events"].pop(0)
+
+    async def read_from(self, seq: int,
+                        max_count: int) -> List[QueueMessage]:
+        cursor = self._slot["cursor"]
+        return [m for m in self._slot["events"]
+                if seq <= m.seq < cursor][:max_count]
 
 
 # ---------------------------------------------------------------------------
@@ -244,6 +264,9 @@ class PullingAgent:
         # grains, so pub/sub pushes can't reach them (reference agents ARE
         # SystemTargets and get pushes; the TTL keeps the view fresh here)
         self._consumer_cache: Dict[StreamId, Tuple[list, float]] = {}
+        # stream → sub ids already replayed (backfill once per sub; ids
+        # pruned when the sub leaves so the set cannot grow unboundedly)
+        self._backfilled: Dict[StreamId, set] = {}
 
     def start(self) -> None:
         import contextvars
@@ -310,9 +333,51 @@ class PullingAgent:
             return hit[0]
         from orleans_tpu.core.factory import factory
         ref = factory.get_grain(IPubSubRendezvous, stream_id.pubsub_key())
-        consumers = await self._call_in_silo(ref.consumers, stream_id)
+        consumers = await self._call_in_silo(ref.consumers_detailed,
+                                             stream_id)
         self._consumer_cache[stream_id] = (consumers, now)
+        await self._backfill_new_tokened(stream_id, consumers)
         return consumers
+
+    async def _backfill_new_tokened(self, stream_id: StreamId,
+                                    consumers: list) -> None:
+        """Rewind-token replay (reference: SubscribeAsync with a
+        StreamSequenceToken): a subscription carrying ``from_seq`` gets
+        the retained ACKED events with seq >= from_seq delivered once,
+        directly and only to it; newer events arrive through the normal
+        flow.  Replay runs as a background task so a long history (up to
+        cache_size events) never head-of-line-blocks live deliveries on
+        this agent's queue — ordering is preserved WITHIN the replay and
+        within the live flow, but not across the attach boundary."""
+        done = self._backfilled.setdefault(stream_id, set())
+        # prune: ids no longer subscribed free their slot (and memory)
+        done &= {s for s, _, _ in consumers}
+        self._backfilled[stream_id] = done
+        for s, c, tok in consumers:
+            if tok is None or s in done:
+                continue
+            done.add(s)
+            asyncio.get_running_loop().create_task(
+                self._replay(stream_id, s, c, tok))
+
+    async def _replay(self, stream_id: StreamId, sub_id: int, consumer,
+                      tok: int) -> None:
+        from orleans_tpu.core.reference import GrainReference
+
+        iface_id = IStreamConsumer.__grain_interface_info__.interface_id
+        ref = GrainReference(consumer, iface_id)
+        try:
+            msgs = await self.receiver.read_from(tok, self.provider.cache_size)
+            for m in msgs:
+                if m.stream_id != stream_id or m.kind != "item":
+                    continue
+                await self._call_in_silo(ref.stream_deliver, sub_id,
+                                         m.stream_id, m.item, m.seq)
+        except Exception:  # noqa: BLE001 — the next consumer-cache refresh
+            # retries a failed replay from the start (at-least-once)
+            self.logger.warn(
+                f"rewind replay to sub {sub_id} failed; will retry")
+            self._backfilled.get(stream_id, set()).discard(sub_id)
 
     async def _call_in_silo(self, fn, *args):
         from orleans_tpu.core.reference import _current_runtime, bind_runtime
@@ -335,13 +400,13 @@ class PullingAgent:
             sends = [self._call_in_silo(
                 GrainReference(c, iface_id).stream_deliver,
                 s, msg.stream_id, msg.item, msg.seq)
-                for s, c in consumers]
+                for s, c, _tok in consumers]
         else:
             error = msg.item if msg.kind == "error" else None
             sends = [self._call_in_silo(
                 GrainReference(c, iface_id).stream_complete,
                 s, msg.stream_id, error)
-                for s, c in consumers]
+                for s, c, _tok in consumers]
         results = await asyncio.gather(*sends, return_exceptions=True)
         ok = True
         for r in results:
@@ -429,6 +494,24 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
         self.name = name
         self.balancer = self._balancer_cls(name)
         self.manager = PersistentStreamPullingManager(self)
+
+    async def register_subscription(self, handle) -> None:
+        """Pub/sub registration plus rewind poke: a from_seq subscription
+        on an IDLE stream would otherwise wait for new traffic before its
+        replay runs (the agent only consults pub/sub while delivering).
+        When this silo owns the stream's queue, refresh the agent's
+        consumer view now so the backfill starts on attach; a
+        remote-owned queue replays at that agent's next pull/TTL refresh
+        (reference: agents are pubsub-registered SystemTargets and get
+        pushes — ours are not grains, so local-poke + TTL covers it)."""
+        await super().register_subscription(handle)
+        if getattr(handle, "from_seq", None) is None:
+            return
+        q = self.mapper.queue_for(handle.stream_id)
+        agent = self.manager.agents.get(q) if self.manager else None
+        if agent is not None:
+            agent._consumer_cache.pop(handle.stream_id, None)
+            await agent._consumers(handle.stream_id)
 
     async def start(self) -> None:
         self.manager.start()

@@ -64,6 +64,7 @@ class IPubSubRendezvous:
     async def register_consumer(self, handle) -> None: ...
     async def unregister_consumer(self, handle) -> None: ...
     async def consumers(self, stream_id) -> list: ...
+    async def consumers_detailed(self, stream_id) -> list: ...
     async def consumer_handles_of(self, stream_id, grain_id: GrainId) -> list: ...
     async def producer_count(self, stream_id) -> int: ...
     async def consumer_count(self, stream_id) -> int: ...
@@ -196,6 +197,23 @@ class PubSubRendezvousGrain(Grain, IPubSubRendezvous):
 
     async def consumers(self, stream_id: StreamId) -> list:
         return self._consumer_list(stream_id)
+
+    async def consumers_detailed(self, stream_id: StreamId) -> list:
+        """(sub_id, consumer, from_seq) triples — the pulling agents need
+        the rewind token; implicit subscriptions carry None."""
+        out = [(h.subscription_id, h.consumer,
+                getattr(h, "from_seq", None))
+               for h in self.consumer_subs.values()]
+        explicit = {g for _, g, _ in out}
+        from orleans_tpu.streams.core import (
+            implicit_subscribers,
+            implicit_subscription_id,
+        )
+        for g in implicit_subscribers(stream_id):
+            if g not in explicit:
+                out.append((implicit_subscription_id(stream_id, g), g,
+                            None))
+        return out
 
     async def consumer_handles_of(self, stream_id: StreamId,
                                   grain_id: GrainId) -> list:

@@ -136,6 +136,7 @@ class DeviceFanout:
         if len(src) == 0:
             return
         pairs = np.unique(np.stack([src, dst], axis=1), axis=0)
+        added = 0
         for s, grp_start, grp_end in _group_ranges(pairs[:, 0]):
             lst = self._adj.setdefault(int(s), [])
             new = pairs[grp_start:grp_end, 1].tolist()
@@ -143,8 +144,10 @@ class DeviceFanout:
                 existing = set(lst)
                 new = [d for d in new if d not in existing]
             lst.extend(new)
-            self.edge_count += len(new)
-        self._dirty = True
+            added += len(new)
+        self.edge_count += added
+        if added:
+            self._dirty = True
 
     # -- device mirror -------------------------------------------------------
 

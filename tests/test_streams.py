@@ -552,3 +552,104 @@ def test_persistent_stream_over_sqlite_queue(run, tmp_path):
             await silo2.stop(graceful=False)
 
     run(go())
+
+
+@grain_interface
+class IRewindConsumerGrain:
+    async def join_from(self, provider: str, ns: str, key, from_seq: int): ...
+    async def received(self) -> list: ...
+
+
+@grain_class
+class RewindConsumerGrain(Grain, IRewindConsumerGrain):
+    def __init__(self) -> None:
+        self.items = []
+
+    async def join_from(self, provider, ns, key, from_seq):
+        stream = self.get_stream(provider, ns, key)
+        async def on_next(item, seq):
+            self.items.append((item, seq))
+        await stream.subscribe(on_next, from_seq=from_seq)
+
+    async def received(self):
+        return list(self.items)
+
+
+def test_rewind_token_replays_retained_events(run):
+    """A subscription carrying a sequence token (reference:
+    StreamSequenceToken) receives RETAINED events from that seq even
+    though they were produced, delivered and acked before it existed."""
+
+    async def go():
+        silo = Silo(name="rewind")
+        silo.add_stream_provider("pq", PersistentStreamProvider(
+            InMemoryQueueAdapter(n_queues=2), pull_period=0.01,
+            consumer_cache_ttl=0.0))
+        await silo.start()
+        try:
+            f = silo.attach_client()
+            # early consumer drives delivery + ack of the first events
+            c1 = f.get_grain(IStreamConsumerGrain, 70)
+            await c1.join("pq", "history", 3)
+            producer = f.get_grain(IStreamProducerGrain, 71)
+            await producer.produce("pq", "history", 3, ["e0", "e1", "e2"])
+
+            async def until(c, n):
+                while len(await c.received()) < n:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(until(c1, 3), timeout=5.0)
+            seqs = dict((i, s) for i, s in await c1.received())
+
+            # late consumer rewinds to e1's sequence on an IDLE stream:
+            # the subscription poke triggers replay without new traffic
+            c2 = f.get_grain(IRewindConsumerGrain, 72)
+            await c2.join_from("pq", "history", 3, seqs["e1"])
+            await asyncio.wait_for(until(c2, 2), timeout=5.0)
+            got = [i for i, _ in await c2.received()]
+            assert got == ["e1", "e2"], got
+            assert [s for _, s in await c2.received()] \
+                == [seqs["e1"], seqs["e2"]]
+            # live traffic still flows to the rewound sub afterwards
+            await producer.produce("pq", "history", 3, ["e3"])
+            await asyncio.wait_for(until(c2, 3), timeout=5.0)
+            got = [i for i, _ in await c2.received()]
+            assert got == ["e1", "e2", "e3"], got
+            assert "e0" not in got  # before the token
+        finally:
+            await silo.stop(graceful=False)
+
+    run(go())
+
+
+def test_rewind_token_on_sqlite_queue(run, tmp_path):
+    async def go():
+        from orleans_tpu.plugins.sqlite_queue import SqliteQueueAdapter
+
+        silo = Silo(name="rewind-sqlite")
+        silo.add_stream_provider("pq", PersistentStreamProvider(
+            SqliteQueueAdapter(path=str(tmp_path / "rw.db"), n_queues=2),
+            pull_period=0.01, consumer_cache_ttl=0.0))
+        await silo.start()
+        try:
+            f = silo.attach_client()
+            c1 = f.get_grain(IStreamConsumerGrain, 75)
+            await c1.join("pq", "dhistory", 4)
+            producer = f.get_grain(IStreamProducerGrain, 76)
+            await producer.produce("pq", "dhistory", 4, ["a", "b"])
+
+            async def until(c, n):
+                while len(await c.received()) < n:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(until(c1, 2), timeout=5.0)
+            c2 = f.get_grain(IRewindConsumerGrain, 77)
+            await c2.join_from("pq", "dhistory", 4, 0)
+            await producer.produce("pq", "dhistory", 4, ["c"])
+            await asyncio.wait_for(until(c2, 3), timeout=5.0)
+            got = [i for i, _ in await c2.received()]
+            assert got[:2] == ["a", "b"], got
+        finally:
+            await silo.stop(graceful=False)
+
+    run(go())
