@@ -28,6 +28,7 @@ from orleans_tpu.tensor.vector_grain import (
 )
 from orleans_tpu.tensor.engine import TensorEngine
 from orleans_tpu.tensor.fanout import DeviceFanout, FanoutOverflowError
+from orleans_tpu.tensor.fused import FusedTickProgram
 from orleans_tpu.tensor.persistence import (
     FileVectorStore,
     MemoryVectorStore,
@@ -52,4 +53,5 @@ __all__ = [
     "TensorEngine",
     "DeviceFanout",
     "FanoutOverflowError",
+    "FusedTickProgram",
 ]
