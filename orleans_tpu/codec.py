@@ -324,6 +324,13 @@ class SerializationManager:
             self._write(obj.value, w, ctx)
             return
         if isinstance(obj, np.ndarray):
+            if obj.dtype.hasobject:
+                # tobytes() of an object array would write raw PyObject
+                # heap POINTERS to the wire — fail at the sender, locally
+                raise TypeError(
+                    "object-dtype ndarrays are not wire-serializable "
+                    f"(dtype {obj.dtype!r}); convert to a numeric dtype "
+                    "or a list")
             w.token(Token.NDARRAY)
             w.string(str(obj.dtype))
             w.varint(obj.ndim)
@@ -443,6 +450,11 @@ class SerializationManager:
             return Immutable(self._read(r, ctx))
         if t == Token.NDARRAY:
             dtype = np.dtype(r.string())
+            if dtype.hasobject:
+                # a corrupted/hostile dtype string must never construct an
+                # object array (np.frombuffer on object dtypes is at best
+                # undefined; the wire only ever carries numeric arrays)
+                raise ValueError(f"refusing object ndarray dtype {dtype!r}")
             ndim = r.varint()
             shape = tuple(r.varint() for _ in range(ndim))
             data = r.raw()

@@ -235,6 +235,12 @@ class GatewayAcceptor:
         except (asyncio.IncompleteReadError, ConnectionError):
             pass  # client vanished: clean up below (reference:
             #       Gateway.RecordClosedSocket)
+        except Exception as exc:  # noqa: BLE001 — hostile/corrupt frames
+            # must cost only their own connection, never an unhandled-task
+            # traceback (the accept loop is internet-facing)
+            self.silo.logger.warn(
+                f"gateway connection dropped: {exc!r}", code=2901,
+                exc_info=True)
         finally:
             self._conns.discard(writer)
             writer.close()

@@ -200,6 +200,11 @@ class TcpTransport:
                 self.silo.message_center.deliver_local(msg)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
+        except Exception as exc:  # noqa: BLE001 — a malformed frame
+            # (bad magic, corrupt payload) costs only this connection
+            self.silo.logger.warn(
+                f"silo connection dropped: {exc!r}", code=2902,
+                exc_info=True)
         finally:
             self._accepted.discard(writer)
             writer.close()
