@@ -45,6 +45,15 @@ from orleans_tpu.tensor.vector_grain import (
 )
 
 
+def plan_windows(window: int, n_ticks: int):
+    """Uniform-window schedule used by the fused load drivers: one window
+    shape for the whole run (one compile), ticks rounded UP to whole
+    windows.  Returns (window, n_windows, total_ticks)."""
+    window = max(1, min(window, n_ticks))
+    n_windows = -(-n_ticks // window)
+    return window, n_windows, n_windows * window
+
+
 def _normalize(out):
     if isinstance(out, dict):
         return out, None, ()

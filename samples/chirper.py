@@ -186,11 +186,10 @@ async def run_chirper_load_fused(engine, n_accounts: int = 100_000,
     prog = engine.fuse_ticks("ChirperAccount", "publish", accounts)
     arena = engine.arena_for("ChirperAccount")
 
+    from orleans_tpu.tensor.fused import plan_windows
     if measure_latency:
         window = 1
-    window = min(window, n_ticks)
-    n_windows = -(-n_ticks // window)
-    n_ticks = n_windows * window
+    window, n_windows, n_ticks = plan_windows(window, n_ticks)
 
     def stacked_for(base: int):
         # per-tick chirp ids: one scanned [T, m] leaf

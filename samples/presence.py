@@ -220,13 +220,10 @@ async def run_presence_load_fused(engine, n_players: int = 100_000,
     game_arena = engine.arena_for("GameGrain")
     tick_durations = []
 
+    from orleans_tpu.tensor.fused import plan_windows
     if measure_latency:
         window = 1
-    window = min(window, n_ticks)
-    # uniform window shape: one compile covers the whole run; total ticks
-    # round UP to whole windows and are reported as executed
-    n_windows = -(-n_ticks // window)
-    n_ticks = n_windows * window
+    window, n_windows, n_ticks = plan_windows(window, n_ticks)
 
     # untimed warm window: compilation is a one-time cost, not steady
     # state (the unfused loader warms the same way via its caller)

@@ -177,3 +177,28 @@ def test_fused_chirper_loader_matches_unfused(run):
         np.testing.assert_allclose(got / total_ticks, ref / 4)
 
     run(main())
+
+
+def test_fused_gps_masked_emits(run):
+    """GPS through the fused path: the movement gate's emit MASK works
+    inside a fused window — notifier fan-in total equals the devices'
+    own moved-fix counters exactly."""
+
+    async def main():
+        from samples.gpstracker import run_gps_load_fused
+
+        engine = TensorEngine()
+        stats = await run_gps_load_fused(engine, n_devices=600, n_ticks=6,
+                                         window=3, move_fraction=0.5,
+                                         seed=9)
+        assert stats["engine"] == "fused"
+        dev = engine.arena_for("DeviceGrain")
+        notif = engine.arena_for("PushNotifierGrain")
+        moves_total = int(np.asarray(dev.state["moves"]).sum())
+        forwarded = int(np.asarray(notif.state["forwarded"]).sum())
+        assert forwarded == moves_total == stats["forwarded_total"]
+        assert moves_total > 0
+        # speed state advanced for moved devices
+        assert float(np.asarray(dev.state["speed"]).max()) > 0
+
+    run(main())
