@@ -1,0 +1,67 @@
+"""GPSTracker on the host (per-message) path — the single-silo CPU
+baseline for the gpstracker bench mode.
+
+Same shape as samples/gpstracker.py but executed as classic virtual
+actors: one RPC per device fix, one forward per movement (reference:
+Samples/GPSTracker/GPSTracker.GrainImplementation/DeviceGrain.cs:37 →
+PushNotifierGrain.cs:39 batching notifier)."""
+
+from __future__ import annotations
+
+import math
+
+from orleans_tpu import Grain, grain_interface, one_way
+from orleans_tpu.core.grain import grain_class, stateless_worker
+
+EARTH_R = 6371.0 * 1000.0
+
+
+@grain_interface
+class IHostPushNotifier:
+    @one_way
+    async def send_message(self, speed: float): ...
+    async def totals(self) -> tuple: ...
+
+
+@grain_class
+@stateless_worker()
+class HostPushNotifierGrain(Grain, IHostPushNotifier):
+    forwarded = 0           # class-level: stateless-worker pool aggregate
+    speed_sum = 0.0
+
+    async def send_message(self, speed: float):
+        HostPushNotifierGrain.forwarded += 1
+        HostPushNotifierGrain.speed_sum += speed
+
+    async def totals(self) -> tuple:
+        return (HostPushNotifierGrain.forwarded,
+                HostPushNotifierGrain.speed_sum)
+
+
+@grain_interface
+class IHostDevice:
+    async def process_message(self, lat: float, lon: float, ts: float): ...
+
+
+@grain_class
+class HostDeviceGrain(Grain, IHostDevice):
+    def __init__(self) -> None:
+        self.lat = None
+        self.lon = None
+        self.ts = None
+
+    async def process_message(self, lat, lon, ts):
+        """(reference: DeviceGrain.ProcessMessage — notify only when the
+        position changed; GetSpeed :64)"""
+        moved = self.lat is None or self.lat != lat or self.lon != lon
+        if moved:
+            speed = 0.0
+            if self.lat is not None and ts > self.ts:
+                x = (lon - self.lon) * math.cos(
+                    math.radians((lat + self.lat) / 2))
+                y = lat - self.lat
+                dist = math.sqrt(x * x + y * y) * math.radians(1.0) * EARTH_R
+                speed = dist / (ts - self.ts)
+            notifier = self.get_grain(IHostPushNotifier, 0)
+            await notifier.send_message(speed)
+        self.lat, self.lon, self.ts = lat, lon, ts

@@ -346,3 +346,39 @@ def test_fanout_no_duplicate_delivery_on_miss_redelivery(run):
         np.testing.assert_array_equal(published, [1, 1, 0, 0])
 
     run(main())
+
+
+def test_gps_host_path(run):
+    """Host-path GPS parity: per-fix RPC with movement-gated notifier
+    forward (reference: DeviceGrain.ProcessMessage)."""
+
+    async def main():
+        import asyncio as _a
+
+        from orleans_tpu.runtime.silo import Silo
+        from samples.gpstracker_host import (
+            HostPushNotifierGrain,
+            IHostDevice,
+            IHostPushNotifier,
+        )
+
+        HostPushNotifierGrain.forwarded = 0
+        HostPushNotifierGrain.speed_sum = 0.0
+        silo = Silo(name="gps-host")
+        await silo.start()
+        try:
+            f = silo.attach_client()
+            d = f.get_grain(IHostDevice, 3001)
+            await d.process_message(47.60, -122.1, 1.0)   # first fix: moved
+            await d.process_message(47.60, -122.1, 2.0)   # unchanged: gated
+            await d.process_message(47.601, -122.1, 12.0)  # moved again
+            await _a.sleep(0.05)  # one-way forwards drain
+            n = f.get_grain(IHostPushNotifier, 0)
+            forwarded, speed_sum = await n.totals()
+            assert forwarded == 2, forwarded
+            # second move: ~0.001 deg over 10s ≈ 11.1 m/s
+            assert 10.0 < speed_sum < 13.0, speed_sum
+        finally:
+            await silo.stop()
+
+    run(main())
