@@ -19,6 +19,7 @@ Commands::
     activations                total activation count
     collect [age_limit]        force idle-activation collection
     tensor-collect [ticks]     force vector-grain row collection
+    tensor-stats               tick-engine counters (throughput, p99s)
     lookup <type> <key>        directory lookup for one grain
     unregister <type> <key>    force-remove a directory registration
 """
@@ -62,6 +63,8 @@ async def run_command(config: Dict[str, Any], command: str,
         if command == "stats":
             return [vars(s) if hasattr(s, "__dict__") else s
                     for s in await mgmt.get_runtime_statistics()]
+        if command == "tensor-stats":
+            return await mgmt.get_tensor_statistics()
         if command == "grainstats":
             return [f"{s.plane}:{s.grain_type}@{s.silo}"
                     f" = {s.activation_count}"
@@ -98,7 +101,7 @@ def main(argv=None) -> None:
                         "(shared membership_db locates the cluster)")
     parser.add_argument("command", help="hosts | stats | grainstats | "
                         "activations | collect | tensor-collect | "
-                        "lookup | unregister")
+                        "tensor-stats | lookup | unregister")
     parser.add_argument("args", nargs="*")
     ns = parser.parse_args(argv)
 

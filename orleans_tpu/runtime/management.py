@@ -96,6 +96,15 @@ class SiloControl:
             return 0
         return engine.collect_idle(idle_ticks)
 
+    async def get_tensor_statistics(self) -> dict:
+        """The tick engine's performance counters — throughput, TRUE
+        latency percentiles, arena row counts (the tensor-plane analog of
+        GetRuntimeStatistics; reference: SiloControl stats surface)."""
+        engine = self.silo.tensor_engine
+        if engine is None:
+            return {}
+        return engine.snapshot()
+
     async def get_detailed_grain_report(self, grain_id: GrainId
                                         ) -> DetailedGrainReport:
         """(reference: GetDetailedGrainReport :120)"""
@@ -146,6 +155,7 @@ class IManagementGrain:
     async def force_activation_collection(self, age_limit: float = 0.0) -> int: ...
     async def force_tensor_collection(self, idle_ticks: int = 0) -> int: ...
     async def get_runtime_statistics(self) -> list: ...
+    async def get_tensor_statistics(self) -> list: ...
     async def lookup(self, grain_id: GrainId) -> Optional[str]: ...
     async def unregister(self, grain_id: GrainId) -> bool: ...
 
@@ -204,6 +214,10 @@ class ManagementGrain(Grain, IManagementGrain):
 
     async def get_runtime_statistics(self) -> list:
         return await self._fanout("get_runtime_statistics")
+
+    async def get_tensor_statistics(self) -> list:
+        """Per-silo tick-engine counters, empty dicts filtered."""
+        return [s for s in await self._fanout("get_tensor_statistics") if s]
 
     async def lookup(self, grain_id: GrainId) -> Optional[str]:
         return await self._silo.system_rpc(

@@ -210,3 +210,45 @@ class FailingGrain(Grain, IFailingGrain):
 
     async def ok(self) -> str:
         return "fine"
+
+
+async def assert_loss_injection_recovers(cluster, key_base: int,
+                                         n_grains: int = 16,
+                                         drop_rate: float = 0.3,
+                                         seed: int = 11) -> None:
+    """Shared fault-injection scenario (reference: Dispatcher
+    MessageLossInjectionRate): drop a fraction of APPLICATION messages on
+    the cluster's fabric; retrying callers must converge.  Used by both
+    the in-proc and TCP transport suites so the loss-injection contract
+    has one body."""
+    import asyncio
+    import random
+
+    from orleans_tpu.runtime.messaging import Category
+
+    rng = random.Random(seed)
+
+    def drop(msg):
+        return (msg.category == Category.APPLICATION
+                and rng.random() < drop_rate)
+
+    cluster.fabric.drop_predicate = drop
+    try:
+        for s in cluster.silos:
+            s.runtime_client.response_timeout = 0.3
+        factory = cluster.attach_client(0)
+        refs = [factory.get_grain(IFailingGrain, key_base + i)
+                for i in range(n_grains)]
+
+        async def robust_call(r):
+            for _ in range(25):
+                try:
+                    return await r.ok()
+                except Exception:
+                    continue
+            raise AssertionError("never succeeded")
+
+        results = await asyncio.gather(*(robust_call(r) for r in refs))
+        assert all(x == "fine" for x in results)
+    finally:
+        cluster.fabric.drop_predicate = None

@@ -280,3 +280,31 @@ def test_non_hosting_member_gets_no_placements(run, tmp_path):
             await host.stop()
 
     run(main())
+
+
+def test_tensor_statistics_fanout(run):
+    """Tick-engine counters (throughput, true latency percentiles, arena
+    sizes) flow through the management surface."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            # put some tensor traffic on silo 0's engine
+            from samples.presence import run_presence_load
+            await run_presence_load(cluster.silos[0].tensor_engine,
+                                    n_players=300, n_games=3, n_ticks=3)
+
+            factory = cluster.attach_client(0)
+            mgmt = factory.get_grain(IManagementGrain, 0)
+            stats = await mgmt.get_tensor_statistics()
+            assert len(stats) >= 1
+            busy = max(stats, key=lambda s: s["messages"])
+            assert busy["messages"] >= 2 * 300 * 3
+            lat = busy["tick_latency"]
+            assert lat["n"] > 0 and lat["p99"] >= lat["p50"] > 0
+            assert busy["arenas"]["PresenceGrain"] == 300
+        finally:
+            await cluster.stop()
+
+    run(main())

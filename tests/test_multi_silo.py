@@ -165,40 +165,18 @@ def test_silo_kills_itself_when_declared_dead(run):
 
 
 def test_message_loss_injection_resend(run):
-    """Transient loss is healed by timeouts + the membership layer
-    (reference analog: MessageLossInjectionRate + resend machinery)."""
+    """(reference: Dispatcher MessageLossInjectionRate) — in-proc fabric
+    variant of the shared loss-injection scenario."""
 
     async def main():
+        from tests.fixture_grains import assert_loss_injection_recovers
+
         cluster = await TestingCluster(n_silos=2).start()
         try:
             await cluster.wait_for_liveness_convergence()
-            # drop ~30% of APPLICATION messages crossing the fabric
-            import random
-            rng = random.Random(7)
-            from orleans_tpu.runtime.messaging import Category
-
-            def drop(msg):
-                return (msg.category == Category.APPLICATION
-                        and rng.random() < 0.3)
-
-            cluster.fabric.drop_predicate = drop
-            for s in cluster.silos:
-                s.runtime_client.response_timeout = 0.3
-            factory = cluster.attach_client(0)
-            refs = [factory.get_grain(IFailingGrain, i) for i in range(20)]
-
-            async def robust_call(r):
-                for _ in range(20):
-                    try:
-                        return await r.ok()
-                    except Exception:
-                        continue
-                raise AssertionError("never succeeded")
-
-            results = await asyncio.gather(*(robust_call(r) for r in refs))
-            assert all(x == "fine" for x in results)
+            await assert_loss_injection_recovers(cluster, key_base=0,
+                                                 n_grains=20, seed=7)
         finally:
-            cluster.fabric.drop_predicate = None
             await cluster.stop()
 
     run(main())

@@ -334,46 +334,19 @@ def test_persistent_streams_over_tcp_cluster_failover(run, tmp_path):
 def test_tcp_message_loss_injection_recovers(run):
     """Deterministic message-loss injection works on the TCP fabric too
     (reference: Dispatcher MessageLossInjectionRate — product-level fault
-    injection must cover the real wire, not just the in-proc fabric):
-    with 30% of application messages dropped before the socket, callers
-    with retries still converge."""
+    injection must cover the real wire, not just the in-proc fabric)."""
 
     async def main():
-        import random
-
-        from orleans_tpu.runtime.messaging import Category
+        from tests.fixture_grains import assert_loss_injection_recovers
 
         cluster = await TestingCluster(n_silos=2, transport="tcp").start()
         try:
             await cluster.wait_for_liveness_convergence()
-            rng = random.Random(11)
-
-            def drop(msg):
-                return (msg.category == Category.APPLICATION
-                        and rng.random() < 0.3)
-
-            cluster.fabric.drop_predicate = drop
-            for s in cluster.silos:
-                s.runtime_client.response_timeout = 0.3
-            factory = cluster.attach_client(0)
-            refs = [factory.get_grain(IFailingGrain, 9600 + i)
-                    for i in range(16)]
-
-            async def robust_call(r):
-                for _ in range(25):
-                    try:
-                        return await r.ok()
-                    except Exception:
-                        continue
-                raise AssertionError("never succeeded")
-
-            results = await asyncio.gather(*(robust_call(r) for r in refs))
-            assert all(x == "fine" for x in results)
-            # liveness must have survived the loss window (ping/system
-            # categories were never dropped)
+            await assert_loss_injection_recovers(cluster, key_base=9600)
+            # liveness survived the loss window (ping/system categories
+            # were never dropped)
             await cluster.wait_for_liveness_convergence(timeout=10.0)
         finally:
-            cluster.fabric.drop_predicate = None
             await cluster.stop()
 
     run(main())
