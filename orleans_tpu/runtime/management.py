@@ -99,11 +99,13 @@ class SiloControl:
     async def get_tensor_statistics(self) -> dict:
         """The tick engine's performance counters — throughput, TRUE
         latency percentiles, arena row counts (the tensor-plane analog of
-        GetRuntimeStatistics; reference: SiloControl stats surface)."""
+        GetRuntimeStatistics; reference: SiloControl stats surface).
+        Rows carry the silo address so operators can attribute a hot or
+        stalled engine."""
         engine = self.silo.tensor_engine
         if engine is None:
             return {}
-        return engine.snapshot()
+        return {"silo": str(self.silo.address), **engine.snapshot()}
 
     async def get_detailed_grain_report(self, grain_id: GrainId
                                         ) -> DetailedGrainReport:

@@ -233,6 +233,8 @@ async def assert_loss_injection_recovers(cluster, key_base: int,
                 and rng.random() < drop_rate)
 
     cluster.fabric.drop_predicate = drop
+    saved_timeouts = {s: s.runtime_client.response_timeout
+                      for s in cluster.silos}
     try:
         for s in cluster.silos:
             s.runtime_client.response_timeout = 0.3
@@ -252,3 +254,5 @@ async def assert_loss_injection_recovers(cluster, key_base: int,
         assert all(x == "fine" for x in results)
     finally:
         cluster.fabric.drop_predicate = None
+        for s, t in saved_timeouts.items():
+            s.runtime_client.response_timeout = t

@@ -350,3 +350,41 @@ def test_tcp_message_loss_injection_recovers(run):
             await cluster.stop()
 
     run(main())
+
+
+def test_tcp_cluster_churn_chaos(run):
+    """Sustained membership churn over real sockets: repeated
+    kill-one/start-one cycles with continuous application traffic — the
+    cluster must re-converge and keep serving after every cycle
+    (reference analog: LivenessTests' kill/restart matrix)."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=3, transport="tcp").start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            factory = cluster.attach_client(0)
+
+            async def call_batch(base):
+                refs = [factory.get_grain(IFailingGrain, base + i)
+                        for i in range(8)]
+                results = await asyncio.gather(
+                    *(r.ok() for r in refs), return_exceptions=True)
+                return sum(1 for r in results if r == "fine")
+
+            for cycle in range(3):
+                # never kill the silo the client is attached to
+                victim = cluster.silos[-1]
+                cluster.kill_silo(victim)
+                await cluster.wait_for_liveness_convergence(timeout=20.0)
+                ok = await call_batch(9700 + 100 * cycle)
+                assert ok == 8, (cycle, "post-kill", ok)
+
+                await cluster.start_additional_silo()
+                await cluster.wait_for_liveness_convergence(timeout=20.0)
+                ok = await call_batch(9750 + 100 * cycle)
+                assert ok == 8, (cycle, "post-join", ok)
+            assert len(cluster.silos) == 3
+        finally:
+            await cluster.stop()
+
+    run(main())
