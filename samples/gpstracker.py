@@ -170,7 +170,8 @@ async def run_gps_load(engine, n_devices: int = 100_000, n_ticks: int = 10,
 
 async def run_gps_load_fused(engine, n_devices: int = 100_000,
                              n_ticks: int = 10, move_fraction: float = 0.7,
-                             window: int = 10, seed: int = 0
+                             window: int = 10, seed: int = 0,
+                             measure_latency: bool = False
                              ) -> Dict[str, float]:
     """GPS through the FUSED tick path: the per-fix kernel, the movement
     gate (emit mask), and the notifier fan-in compile into one program
@@ -193,6 +194,8 @@ async def run_gps_load_fused(engine, n_devices: int = 100_000,
               "device": jnp.asarray(devices.astype(np.int32))}
 
     from orleans_tpu.tensor.fused import plan_windows
+    if measure_latency:
+        window = 1
     window, n_windows, n_ticks = plan_windows(window, n_ticks)
 
     # position cursor carries ACROSS windows: device tracks continue where
@@ -221,9 +224,14 @@ async def run_gps_load_fused(engine, n_devices: int = 100_000,
 
     windows = [window_args(w + 1) for w in range(n_windows)]
     _jax.block_until_ready(windows)
+    tick_durations = []
     t0 = time.perf_counter()
     for stacked in windows:
+        w0 = time.perf_counter()
         prog.run(stacked, static_args=static)
+        if measure_latency:
+            _jax.block_until_ready(notif.state["forwarded"])
+            tick_durations.append(time.perf_counter() - w0)
     _jax.block_until_ready(notif.state["forwarded"])
     elapsed = time.perf_counter() - t0
     assert prog.verify() == 0, "fused window touched unactivated grains"
@@ -232,10 +240,15 @@ async def run_gps_load_fused(engine, n_devices: int = 100_000,
     # same units as run_gps_load: fixes injected + notifications delivered,
     # counting only the TIMED windows
     messages = n_devices * n_ticks + (forwarded - forwarded_before)
-    return {
+    stats: Dict[str, float] = {
         "devices": n_devices, "ticks": n_ticks, "seconds": elapsed,
         "messages": messages,
         "messages_per_sec": messages / elapsed,
         "forwarded_total": forwarded,
         "engine": "fused",
     }
+    if tick_durations:
+        d = np.asarray(tick_durations)
+        stats["tick_p50_seconds"] = float(np.percentile(d, 50))
+        stats["tick_p99_seconds"] = float(np.percentile(d, 99))
+    return stats

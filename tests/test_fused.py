@@ -202,3 +202,45 @@ def test_fused_gps_masked_emits(run):
         assert float(np.asarray(dev.state["speed"]).max()) > 0
 
     run(main())
+
+
+def test_fused_windows_do_not_starve_collection_clock(run):
+    """With automatic collection ENABLED, fused windows advance the tick
+    clock without routing through the engine's touch path — the run()
+    stamp guard must keep every fused arena's rows hot, or the idle sweep
+    would evict live steady-state rows mid-run."""
+
+    async def main():
+        import jax.numpy as jnp
+
+        from orleans_tpu.config import TensorEngineConfig
+
+        cfg = TensorEngineConfig()
+        cfg.collection_idle_ticks = 2     # aggressive idle eviction
+        cfg.collection_every_ticks = 1
+        engine = TensorEngine(config=cfg)
+        players = np.arange(64, dtype=np.int64)
+        engine.arena_for("PresenceGrain").resolve_rows(players)
+        engine.arena_for("GameGrain").resolve_rows(
+            np.arange(4, dtype=np.int64))
+        prog = engine.fuse_ticks("PresenceGrain", "heartbeat", players)
+        static = {"game": jnp.zeros(64, jnp.int32),
+                  "score": jnp.ones(64, jnp.float32)}
+
+        # many windows, each advancing the clock well past the idle limit
+        for w in range(5):
+            prog.run({"tick": jnp.arange(w * 8 + 1, w * 8 + 9,
+                                         dtype=jnp.int32)},
+                     static_args=static)
+            # the sweep the unfused loop would run between ticks
+            engine.collect_idle(cfg.collection_idle_ticks)
+        assert prog.verify() == 0
+
+        arena = engine.arena_for("PresenceGrain")
+        assert arena.live_count == 64  # nothing evicted
+        rows = arena.resolve_rows(players)
+        hb = np.asarray(arena.state["heartbeats"])[rows]
+        np.testing.assert_array_equal(hb, 5 * 8)
+        assert engine.arena_for("GameGrain").live_count == 4
+
+    run(main())
