@@ -178,6 +178,16 @@ class TensorEngine:
         # adaptive controller (SURVEY §7 hard-part 5) reads the same data
         self.tick_durations: deque = deque(maxlen=self.config.latency_window)
         self._adaptive_interval = self.config.tick_interval
+        # per-stage host wall time (the StageAnalysis analog, reference:
+        # src/Orleans/Statistics/StageAnalysis.cs:81): cumulative seconds
+        # per pipeline stage plus the last tick's breakdown, so a slow tick
+        # can name its slow stage.  Device work is async-dispatched; a
+        # stage's time is its host-side cost plus any device sync its data
+        # dependencies force.
+        self.stage_seconds: Dict[str, float] = defaultdict(float)
+        self.last_tick_stages: Dict[str, float] = {}
+        self._tick_stages: Dict[str, float] = defaultdict(float)
+        self._in_tick = False
 
         self._step_cache: Dict[Tuple[str, str, int], Callable] = {}
         self._pending_checks: List[_MissCheck] = []
@@ -436,10 +446,13 @@ class TensorEngine:
         t0 = time.perf_counter()
         self.tick_number += 1
         self.ticks_run += 1
+        stages = self._tick_stages = defaultdict(float)
+        self._in_tick = True
         if (self.config.collection_idle_ticks
                 and self.config.collection_every_ticks > 0
                 and self.tick_number % self.config.collection_every_ticks == 0):
             self.collect_idle(self.config.collection_idle_ticks)
+            stages["collect"] += time.perf_counter() - t0
         if len(self._pending_checks) >= self.config.miss_check_cap:
             # bound device memory pinned by parked optimistic checks
             self._drain_checks()
@@ -450,11 +463,17 @@ class TensorEngine:
                 break
             self.queues = defaultdict(list)
             for (type_name, method), batches in pending.items():
+                tf = time.perf_counter()
                 self._run_fanout(type_name, method, batches)
+                stages["fanout"] += time.perf_counter() - tf
                 self._run_group(type_name, method, batches)
             rounds += 1
             self.rounds_run += 1
         dt = time.perf_counter() - t0
+        self._in_tick = False
+        for k, v in stages.items():
+            self.stage_seconds[k] += v
+        self.last_tick_stages = dict(stages)
         self.tick_seconds += dt
         self.tick_durations.append(dt)
         self._adapt(dt)
@@ -539,6 +558,7 @@ class TensorEngine:
         Returns True if new work was queued."""
         if not self._pending_checks:
             return False
+        t0 = time.perf_counter()
         checks = self._pending_checks
         self._pending_checks = []
         requeued = False
@@ -560,6 +580,11 @@ class TensorEngine:
                 args=c.args, keys_dev=c.keys, mask=missing,
                 no_fanout=True))
             requeued = True
+        # within a tick the drain is part of that tick's breakdown (folded
+        # into stage_seconds at tick end); between ticks it accrues to the
+        # cumulative totals directly
+        sink = self._tick_stages if self._in_tick else self.stage_seconds
+        sink["miss_checks"] += time.perf_counter() - t0
         return requeued
 
     # -- group execution ----------------------------------------------------
@@ -576,6 +601,8 @@ class TensorEngine:
         (stable) sizes instead of being padded to buckets."""
         info = vector_type(type_name)
         arena = self.arena_for(type_name)
+        stages = self._tick_stages
+        t_res = time.perf_counter()
 
         # re-resolve if any batch's resolution itself grew/repacked the
         # arena (growth is rare; the loop converges immediately after)
@@ -624,6 +651,8 @@ class TensorEngine:
 
         self.messages_processed += m_total
         want_results = any(b.future is not None for b in batches)
+        t_apply = time.perf_counter()
+        stages["resolve"] += t_apply - t_res
 
         step = self._get_step(info, method)
         if mask is None:
@@ -636,9 +665,14 @@ class TensorEngine:
             # cross to the host, so record their traffic on the device-side
             # use clock — otherwise collection would evict hot rows
             arena.touch_rows_dev(rows, self.tick_number)
+        t_route = time.perf_counter()
+        stages["apply"] += t_route - t_apply
         self._route_emits(emits)
+        stages["route"] += time.perf_counter() - t_route
         if want_results:
+            t_dr = time.perf_counter()
             self._deliver_results(batches, results)
+            stages["results"] += time.perf_counter() - t_dr
 
     def _deliver_results(self, batches: List[PendingBatch],
                          results: Any) -> None:
@@ -710,6 +744,8 @@ class TensorEngine:
             "msgs_per_sec": (self.messages_processed / self.tick_seconds
                              if self.tick_seconds > 0 else 0.0),
             "activation_passes": self.activation_passes,
+            "stages": dict(self.stage_seconds),
+            "last_tick_stages": dict(self.last_tick_stages),
             "tick_latency": self.latency_stats(),
             "arenas": {name: a.live_count for name, a in self.arenas.items()},
             "evicted": sum(a.evicted_count for a in self.arenas.values()),

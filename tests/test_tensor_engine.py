@@ -274,3 +274,44 @@ def test_wide_keys_use_host_path_and_device_routing_refuses(run):
             arena.device_index()
 
     run(main())
+
+
+def test_per_stage_tick_profiling_names_the_slow_stage(run):
+    """The tick pipeline is profiled per stage (resolve/apply/route/...),
+    the StageAnalysis analog (reference: src/Orleans/Statistics/
+    StageAnalysis.cs:81): a slow tick must be attributable to a stage."""
+
+    async def main():
+        import time as _time
+
+        engine = TensorEngine()
+        keys = np.arange(64, dtype=np.int64)
+        engine.send_batch("AccumGrain", "add", keys,
+                          {"v": np.float32(np.ones(64))})
+        await engine.flush()
+        snap = engine.snapshot()
+        stages = snap["stages"]
+        assert {"resolve", "apply", "route"} <= set(stages)
+        assert all(v >= 0 for v in stages.values())
+        # stage sum cannot exceed total tick wall time
+        assert sum(snap["last_tick_stages"].values()) <= \
+            max(engine.tick_durations) + 1e-6
+
+        # make resolution artificially slow; the breakdown must name it
+        arena = engine.arena_for("AccumGrain")
+        orig = arena.resolve_rows
+
+        def slow_resolve(*a, **kw):
+            _time.sleep(0.05)
+            return orig(*a, **kw)
+
+        arena.resolve_rows = slow_resolve
+        engine.stage_seconds.clear()
+        engine.send_batch("AccumGrain", "add", keys,
+                          {"v": np.float32(np.ones(64))})
+        await engine.flush()
+        stages = engine.snapshot()["stages"]
+        assert max(stages, key=stages.get) == "resolve"
+        assert stages["resolve"] >= 0.05
+
+    run(main())
