@@ -274,3 +274,4 @@ class SystemTargetCodes(IntEnum):
     PROVIDER_MANAGER = 19
     DEPLOYMENT_LOAD_PUBLISHER = 22
     STREAM_PULLING_MANAGER = 23
+    VECTOR_ROUTER = 24

@@ -140,6 +140,16 @@ class Dispatcher:
             self._respond_error(msg, RuntimeError(
                 "vector grain message but tensor engine disabled"))
             return
+        # single-activation enforcement: a vector grain's arena row lives
+        # ONLY on its ring owner — non-owners forward instead of injecting
+        # into their own engine (reference: the directory registration race
+        # resolution, Catalog.cs:533-563; LocalGrainDirectory.cs:510)
+        if self.silo.vector_router is not None:
+            owner = self.silo.ring.calculate_target_silo(msg.target_grain)
+            if owner is not None and owner != self.silo.address:
+                msg.target_silo = owner
+                self.try_forward(msg, f"vector grain owned by {owner}")
+                return
         minfo = vt.methods.get(msg.method_name)
         if minfo is None:
             self._respond_error(msg, AttributeError(

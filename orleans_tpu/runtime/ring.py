@@ -65,6 +65,10 @@ class VirtualBucketsRing:
         self._owners: Dict[int, SiloAddress] = {}
         self._members: List[SiloAddress] = []
         self._listeners: List[RingChangeListener] = []
+        # bumped on every topology change; consumers caching derived
+        # lookup tables (the vector router's owner arrays) key on it
+        self.version = 0
+        self._owner_table = None
         self.add_silo(my_address)
 
     # -- membership-driven updates -----------------------------------------
@@ -105,6 +109,8 @@ class VirtualBucketsRing:
         self._listeners.append(listener)
 
     def _notify(self) -> None:
+        self.version += 1
+        self._owner_table = None
         members = self.members
         for listener in self._listeners:
             listener(members, members)
@@ -123,6 +129,35 @@ class VirtualBucketsRing:
     def calculate_target_silo(self, grain_id: GrainId) -> Optional[SiloAddress]:
         """(reference: LocalGrainDirectory.CalculateTargetSilo :439)"""
         return self.owner_of_hash(grain_id.ring_hash())
+
+    def owners_of_hashes(self, points):
+        """Vectorized ``owner_of_hash`` for a uint32 array of ring points.
+
+        Returns ``(owner_idx int32[n], members)`` where ``owner_idx[i]``
+        indexes into ``members`` (-1 only on an empty ring).  This is the
+        batched ownership lookup behind the cross-silo vector data plane:
+        one searchsorted over the bucket points instead of a bisect per
+        message (same semantics as ``owner_of_hash``'s bisect_left)."""
+        import numpy as np
+        table = self._owner_table
+        if table is None:
+            if not self._points:
+                table = (None, None, [])
+            else:
+                members = self.members
+                midx = {s: i for i, s in enumerate(members)}
+                pts = np.asarray(self._points, dtype=np.int64)
+                own = np.asarray([midx[self._owners[p]]
+                                  for p in self._points], dtype=np.int32)
+                table = (pts, own, members)
+            self._owner_table = table
+        pts, own, members = table
+        points = np.asarray(points)
+        if pts is None:
+            return np.full(len(points), -1, dtype=np.int32), members
+        idx = np.searchsorted(pts, points.astype(np.int64))
+        idx[idx == len(pts)] = 0  # wrap: first bucket clockwise
+        return own[idx], members
 
     def my_range(self) -> List[RingRange]:
         """The hash ranges this silo owns (union of its buckets' ranges)."""

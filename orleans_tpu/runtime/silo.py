@@ -65,6 +65,7 @@ _SYSTEM_TARGET_CODES: Dict[str, int] = {
     "provider_manager": int(SystemTargetCodes.PROVIDER_MANAGER),
     "load_publisher": int(SystemTargetCodes.DEPLOYMENT_LOAD_PUBLISHER),
     "stream_pulling": int(SystemTargetCodes.STREAM_PULLING_MANAGER),
+    "vector_router": int(SystemTargetCodes.VECTOR_ROUTER),
 }
 _CODE_TO_NAME = {v: k for k, v in _SYSTEM_TARGET_CODES.items()}
 
@@ -226,6 +227,14 @@ class Silo:
             self.tensor_engine = TensorEngine(self, self.config.tensor)
         else:
             self.tensor_engine = None
+        # cross-silo vector data plane: clustered silos partition vector
+        # batches by ring owner and ship remote partitions as slabs
+        # (tensor/router.py; single-activation enforcement)
+        self.vector_router = None
+        if self.tensor_engine is not None and fabric is not None:
+            from orleans_tpu.tensor.router import VectorRouter
+            self.vector_router = VectorRouter(self)
+            self.register_system_target("vector_router", self.vector_router)
 
     # ================= lifecycle (reference: Silo.cs :414,:642) ============
 
@@ -303,6 +312,15 @@ class Silo:
             await self.catalog.deactivate_all()
             if self.membership_oracle is not None:
                 await self.membership_oracle.leave()
+            if self.tensor_engine is not None \
+                    and self.tensor_engine.store is not None:
+                # arena handoff through storage, AFTER the final drain and
+                # the membership goodbye: peers have rerouted, the engine
+                # is quiesced, so this write-back is the rows' final state
+                # — the new ring owners re-activate from it on first touch
+                # (reference: graceful Shutdown deactivates all grains
+                # through their storage bridge, Silo.cs:642-770)
+                await self.tensor_engine.checkpoint()
         self.catalog.stop_collector()
         for cb in self._stop_callbacks:
             res = cb()
@@ -483,6 +501,8 @@ class Silo:
                 if s not in live:
                     self.load_publisher.forget(s)
         self.grain_directory.schedule_heal()
+        if self.vector_router is not None:
+            self.vector_router.on_ring_changed()
         gateway = self.system_targets.get("gateway")
         if gateway is not None and gateway._clients:
             asyncio.get_running_loop().create_task(

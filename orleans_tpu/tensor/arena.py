@@ -361,6 +361,21 @@ class GrainArena:
         live = self._key_of_row >= 0
         victims = np.nonzero(
             live & (self.effective_last_use() < older_than_tick))[0]
+        return self._deactivate_rows(victims, write_back)
+
+    def evict_keys(self, keys: np.ndarray, write_back: bool = True) -> int:
+        """Deactivate specific keys (write-back first when a store is
+        attached) — the arena half of directory handoff on ring change:
+        rows this silo no longer owns leave through storage and the new
+        owner re-activates them on first touch (reference:
+        GrainDirectoryHandoffManager.cs:141; deactivate→storage→
+        reactivate cycle, Catalog.cs:836)."""
+        rows, found = self.lookup_rows(np.asarray(keys, dtype=np.int64))
+        return self._deactivate_rows(rows[found], write_back)
+
+    def _deactivate_rows(self, victims: np.ndarray, write_back: bool) -> int:
+        """Shared deactivation tail (collect + evict_keys): write-back,
+        free, compact."""
         if len(victims) == 0:
             return 0
         keys = self._key_of_row[victims]

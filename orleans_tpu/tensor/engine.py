@@ -191,6 +191,11 @@ class TensorEngine:
 
         self._step_cache: Dict[Tuple[str, str, int], Callable] = {}
         self._pending_checks: List[_MissCheck] = []
+        # cross-silo slab router (tensor/router.py); attached by the silo
+        # in cluster mode.  When set, batch entry points partition keys by
+        # ring owner and only locally-owned keys ever activate here
+        # (single-activation enforcement, reference: Catalog.cs:533-563)
+        self.router = None
         # (src_type, src_method) → (DeviceFanout, dst_type, dst_method):
         # one-to-many subscription expansion on the device (tensor/fanout.py)
         self._fanouts: Dict[Tuple[str, str], Tuple[Any, str, str]] = {}
@@ -277,8 +282,34 @@ class TensorEngine:
                    want_results: bool = False) -> Optional[asyncio.Future]:
         """Bulk message injection — the TPU-native client edge: one call
         carries a whole (dst, payload) tensor (north star: 'batched
-        adjacency+payload tensors')."""
+        adjacency+payload tensors').
+
+        In cluster mode host-key batches route through the VectorRouter:
+        the local partition enqueues here, remote partitions ship as slabs
+        to their ring owners.  Device-key batches stay local — remote keys
+        surface as optimistic-resolution misses and ship at the next
+        quiescence point (see _drain_checks)."""
         type_name = self._type_name(interface)
+        if self.router is not None:
+            if (isinstance(keys, jnp.ndarray) and keys.dtype == jnp.int32
+                    and not want_results):
+                # pure optimistic device path: remote keys surface as
+                # misses and ship at the quiescence point
+                return self.enqueue_local_batch(type_name, method, keys,
+                                                args)
+            # everything else resolves eagerly on the host, which would
+            # activate remote-owned keys locally — route instead
+            return self.router.route_batch(type_name, method,
+                                           np.asarray(keys), args,
+                                           want_results=want_results)
+        return self.enqueue_local_batch(type_name, method, keys, args,
+                                        want_results=want_results)
+
+    def enqueue_local_batch(self, type_name: str, method: str,
+                            keys, args: Any, want_results: bool = False
+                            ) -> Optional[asyncio.Future]:
+        """Queue a batch on THIS engine without ownership routing (the
+        router calls this for partitions it has already proven local)."""
         future = asyncio.get_running_loop().create_future() \
             if want_results else None
         if (isinstance(keys, jnp.ndarray) and keys.dtype == jnp.int32
@@ -331,12 +362,16 @@ class TensorEngine:
             self.queues[(dst_type, dst_method)].append(
                 PendingBatch(args=gargs, keys_dev=dst, mask=valid))
 
-    def make_injector(self, interface, method: str,
-                      keys: np.ndarray) -> "BatchInjector":
+    def make_injector(self, interface, method: str, keys: np.ndarray):
         """Pre-resolve a stable destination set once; subsequent injections
-        are zero-lookup (the gateway's steady-state client edge)."""
-        return BatchInjector(self, self._type_name(interface), method,
-                             np.asarray(keys, dtype=np.int64))
+        are zero-lookup (the gateway's steady-state client edge).  In
+        cluster mode the split by ring owner is part of what's resolved
+        once (router.make_injector → ClusterInjector)."""
+        type_name = self._type_name(interface)
+        keys = np.asarray(keys, dtype=np.int64)
+        if self.router is not None:
+            return self.router.make_injector(type_name, method, keys)
+        return BatchInjector(self, type_name, method, keys)
 
     def fuse_ticks(self, interface, method: str, keys: np.ndarray):
         """Compile the steady-state tick for (interface, method) over a
@@ -572,6 +607,35 @@ class TensorEngine:
                                                    miss_buf=MISS_BUF)
             mk = np.asarray(miss_keys)
             mk = mk[mk != KEY_SENTINEL].astype(np.int64)
+            if self.router is not None and len(mk):
+                # single-activation across silos: a miss key owned by a
+                # remote silo must NOT activate here — its messages are
+                # extracted and shipped to the owner as one slab per
+                # destination (tensor/router.py)
+                local_mask, remote = self.router.partition(c.type_name, mk)
+                if remote:
+                    keys_np = np.asarray(c.keys)
+                    missing_np = np.array(missing)  # writable host copy
+                    args_h = jax.tree_util.tree_map(np.asarray, c.args)
+                    shipped = np.zeros(len(keys_np), dtype=bool)
+                    for target, ridx in remote.items():
+                        sel = missing_np & np.isin(
+                            keys_np, mk[ridx].astype(keys_np.dtype))
+                        if not sel.any():
+                            continue
+                        sidx = np.nonzero(sel)[0]
+                        self.router.ship_slab(
+                            target, c.type_name, c.method,
+                            keys_np[sidx].astype(np.int64),
+                            jax.tree_util.tree_map(
+                                lambda a: a if np.ndim(a) == 0
+                                else a[sidx], args_h))
+                        shipped |= sel
+                    mk = mk[local_mask]
+                    missing_np &= ~shipped
+                    if len(mk) == 0 and not missing_np.any():
+                        continue  # whole batch shipped — nothing local left
+                    missing = jnp.asarray(missing_np)
             if len(mk):
                 c.arena.resolve_rows(mk, tick=self.tick_number)  # activates
             # re-deliver only the dropped messages; convergence across

@@ -1,0 +1,345 @@
+"""VectorRouter: the cross-silo batched vector data plane.
+
+The reference crosses the silo boundary one message at a time through a
+dedicated sender thread that batch-serializes whatever is queued
+(reference: src/OrleansRuntime/Messaging/OutgoingMessageSender.cs:128-176);
+the north star demands the inverse discipline — batches stay batches across
+the boundary.  When a vector batch's keys hash to a remote silo's arena,
+the router partitions the batch by ring owner, serializes each partition as
+ONE (keys, args) slab through the codec (first-class ndarray tokens), ships
+it over the silo transport, and the peer injects it into its engine as a
+batch — never through the per-message host path.
+
+Single-activation enforcement (reference: Catalog.cs:533-563 duplicate-
+activation race; LocalGrainDirectory.cs:510): a vector grain's arena row
+may exist ONLY on its ring owner.  Every entry point — host batches, the
+per-message dispatcher bridge, optimistic device-miss activation — derives
+ownership from the same vectorized ring hash (hashing.ring_hash_int_keys ==
+GrainId.ring_hash bit-for-bit), so "which silo owns this key" has exactly
+one answer everywhere.  On ring change, rows whose keys are no longer owned
+are written back and evicted (the arena half of directory handoff,
+reference: GrainDirectoryHandoffManager.cs:141); the new owner re-activates
+them from the store on first touch.
+
+Fan-out contract: DeviceFanout subscription graphs are owner-local state.
+A slab ships *pre-expansion* messages and the owner expands them through
+its own CSR — registering a remote key's subscriptions on a non-owner silo
+would double-deliver and is a configuration error.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from orleans_tpu.hashing import ring_hash_int_keys
+from orleans_tpu.ids import GrainCategory, SiloAddress
+
+
+def _gather_args(args: Any, idx: np.ndarray) -> Any:
+    """Take rows ``idx`` of every array leaf (scalar leaves broadcast)."""
+    return jax.tree_util.tree_map(
+        lambda a: a if np.ndim(a) == 0 else np.asarray(a)[idx], args)
+
+
+def _host_args(args: Any) -> Any:
+    return jax.tree_util.tree_map(np.asarray, args)
+
+
+class VectorRouter:
+    """One per clustered silo; registered as the ``vector_router`` system
+    target so peers can address slabs to it."""
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+        self.engine = silo.tensor_engine
+        self.engine.router = self
+        # owner tables cache, keyed by (ring.version, type_code) — the ring
+        # invalidates by version bump on membership change
+        self._my_index_cache: Tuple[int, int] = (-2, -2)
+        self.slabs_shipped = 0
+        self.messages_shipped = 0
+        self.slabs_received = 0
+        self.messages_received = 0
+
+    # ================= ownership ==========================================
+
+    def _my_index(self, members: List[SiloAddress]) -> int:
+        version = self.silo.ring.version
+        cached_version, idx = self._my_index_cache
+        if cached_version != version:
+            try:
+                idx = members.index(self.silo.address)
+            except ValueError:
+                idx = -1  # non-hosting observer: owns nothing
+            self._my_index_cache = (version, idx)
+        return idx
+
+    def partition(self, type_name: str, keys: np.ndarray
+                  ) -> Tuple[np.ndarray, Dict[SiloAddress, np.ndarray]]:
+        """Split ``keys`` (int64[n]) by ring owner.
+
+        Returns ``(local_mask bool[n], {owner: index_array})`` where the
+        index arrays cover exactly the non-local entries.  Single-member
+        rings short-circuit to all-local (zero hashing cost)."""
+        ring = self.silo.ring
+        keys = np.asarray(keys, dtype=np.int64)
+        if len(ring._members) <= 1 and self._my_index(ring.members) == 0:
+            return np.ones(len(keys), dtype=bool), {}
+        from orleans_tpu.tensor.vector_grain import vector_type
+        info = vector_type(type_name)
+        points = ring_hash_int_keys(info.type_code, keys,
+                                    category=int(GrainCategory.GRAIN))
+        owner_idx, members = ring.owners_of_hashes(points)
+        my = self._my_index(members)
+        local_mask = owner_idx == my
+        remote: Dict[SiloAddress, np.ndarray] = {}
+        if not local_mask.all():
+            for o in np.unique(owner_idx[~local_mask]):
+                if o < 0:
+                    continue
+                remote[members[int(o)]] = np.nonzero(owner_idx == o)[0]
+        return local_mask, remote
+
+    def owns_key(self, type_name: str, key: int) -> bool:
+        local, _ = self.partition(type_name,
+                                  np.asarray([key], dtype=np.int64))
+        return bool(local[0])
+
+    # ================= send side ==========================================
+
+    def route_batch(self, type_name: str, method: str, keys: np.ndarray,
+                    args: Any, want_results: bool = False
+                    ) -> Optional[asyncio.Future]:
+        """Cluster-level send_batch: local partition enqueues on this
+        silo's engine, each remote partition ships as one slab."""
+        keys = np.asarray(keys, dtype=np.int64)
+        local_mask, remote = self.partition(type_name, keys)
+        if not remote:
+            return self.engine.enqueue_local_batch(
+                type_name, method, keys, args, want_results=want_results)
+        args_h = _host_args(args)
+        if not want_results:
+            if local_mask.any():
+                lidx = np.nonzero(local_mask)[0]
+                self.engine.enqueue_local_batch(
+                    type_name, method, keys[lidx], _gather_args(args_h, lidx))
+            for target, idx in remote.items():
+                self.ship_slab(target, type_name, method, keys[idx],
+                               _gather_args(args_h, idx))
+            return None
+        return asyncio.get_running_loop().create_task(
+            self._route_with_results(type_name, method, keys, args_h,
+                                     local_mask, remote))
+
+    async def _route_with_results(self, type_name: str, method: str,
+                                  keys: np.ndarray, args_h: Any,
+                                  local_mask: np.ndarray,
+                                  remote: Dict[SiloAddress, np.ndarray],
+                                  hops: int = 0) -> Any:
+        """Scatter a want_results batch, await all partitions, reassemble
+        the result pytree in the caller's original message order."""
+        if remote and hops > self.silo.max_forward_count:
+            # diverged ring views could bounce a slab between silos
+            # forever — bound the hop chain like any forwarded request
+            # (reference: Dispatcher.TryForwardRequest :474)
+            raise RuntimeError(
+                f"vector slab for {type_name} exceeded max forward count "
+                f"({hops} hops; ring views diverged?)")
+        parts: List[Tuple[np.ndarray, Any]] = []  # (index array, awaitable)
+        if local_mask.any():
+            lidx = np.nonzero(local_mask)[0]
+            fut = self.engine.enqueue_local_batch(
+                type_name, method, keys[lidx], _gather_args(args_h, lidx),
+                want_results=True)
+            parts.append((lidx, fut))
+        for target, idx in remote.items():
+            self.messages_shipped += len(idx)
+            self.slabs_shipped += 1
+            coro = self.silo.system_rpc(
+                target, "vector_router", "call_slab",
+                (type_name, method, keys[idx], _gather_args(args_h, idx),
+                 hops + 1))
+            parts.append((idx, coro))
+        results = await asyncio.gather(*(p[1] for p in parts))
+        if all(r is None for r in results):
+            return None
+        n = len(keys)
+
+        def scatter(*leaves):
+            out = None
+            for (idx, _), leaf in zip(parts, leaves):
+                if leaf is None:
+                    continue
+                leaf = np.asarray(leaf)
+                if out is None:
+                    out = np.zeros((n,) + leaf.shape[1:], dtype=leaf.dtype)
+                out[idx] = leaf
+            return out
+
+        # all non-None parts share one handler → one tree structure
+        first = next(r for r in results if r is not None)
+        leaves_per_part = []
+        treedef = jax.tree_util.tree_structure(first)
+        for r in results:
+            if r is None:
+                leaves_per_part.append(
+                    [None] * treedef.num_leaves)
+            else:
+                leaves_per_part.append(jax.tree_util.tree_leaves(r))
+        combined = [scatter(*[lp[i] for lp in leaves_per_part])
+                    for i in range(treedef.num_leaves)]
+        return jax.tree_util.tree_unflatten(treedef, combined)
+
+    def ship_slab(self, target: SiloAddress, type_name: str, method: str,
+                  keys: np.ndarray, args: Any, hops: int = 0) -> None:
+        """One (keys, args) slab → one one-way message to the peer's
+        router (the batched silo boundary; never per-message send_one)."""
+        from orleans_tpu.ids import GrainId, SystemTargetCodes
+        from orleans_tpu.runtime.messaging import Category, Direction, Message
+        self.slabs_shipped += 1
+        self.messages_shipped += len(keys)
+        msg = Message(
+            category=Category.APPLICATION,
+            direction=Direction.ONE_WAY,
+            sending_silo=self.silo.address,
+            sending_grain=self.silo.client_grain_id,
+            target_silo=target,
+            target_grain=GrainId.system_target(
+                int(SystemTargetCodes.VECTOR_ROUTER)),
+            method_name="inject_slab",
+            args=(type_name, method, np.asarray(keys, dtype=np.int64),
+                  _host_args(args), hops),
+        )
+        self.silo.message_center.send_message(msg)
+
+    def make_injector(self, type_name: str, method: str, keys: np.ndarray):
+        """Cluster-aware steady-state injector: resolves the ownership
+        split once per ring version; every inject() is one local enqueue
+        + one slab per remote owner."""
+        return ClusterInjector(self, type_name, method,
+                               np.asarray(keys, dtype=np.int64))
+
+    # ================= receive side (system target) =======================
+
+    async def inject_slab(self, type_name: str, method: str,
+                          keys: np.ndarray, args: Any, hops: int = 0) -> None:
+        """Peer slab arrival: verify ownership (the ring may have moved
+        while the slab was in flight) and enqueue the owned part; forward
+        strays with a bounded hop count (reference: MaxForwardCount,
+        Dispatcher.TryForwardRequest :474)."""
+        keys = np.asarray(keys, dtype=np.int64)
+        self.slabs_received += 1
+        self.messages_received += len(keys)
+        local_mask, remote = self.partition(type_name, keys)
+        if local_mask.any():
+            idx = np.nonzero(local_mask)[0]
+            self.engine.enqueue_local_batch(
+                type_name, method, keys[idx], _gather_args(args, idx))
+            self.engine._wake_up()
+        for target, idx in remote.items():
+            if hops + 1 > self.silo.max_forward_count:
+                self.silo.logger.warn(
+                    f"dropping {len(idx)}-message slab for {type_name}: "
+                    f"exceeded max forward count", code=2910)
+                continue
+            self.ship_slab(target, type_name, method, keys[idx],
+                           _gather_args(args, idx), hops=hops + 1)
+
+    async def call_slab(self, type_name: str, method: str,
+                        keys: np.ndarray, args: Any, hops: int = 1) -> Any:
+        """Request/response slab (want_results path).  Re-partitions on
+        arrival (ring may have moved) with the hop chain bounded — never
+        an unbounded bounce between silos with diverged views."""
+        self.slabs_received += 1
+        self.messages_received += len(keys)
+        keys = np.asarray(keys, dtype=np.int64)
+        local_mask, remote = self.partition(type_name, keys)
+        self.engine._wake_up()
+        return await self._route_with_results(
+            type_name, method, keys, _host_args(args), local_mask, remote,
+            hops=hops)
+
+    # ================= handoff (ring change) ==============================
+
+    def on_ring_changed(self) -> None:
+        """Arena half of directory handoff (reference:
+        GrainDirectoryHandoffManager.cs:141): rows whose keys this silo no
+        longer owns are written back (when a store is attached) and
+        evicted; the new owner re-activates them from the store on first
+        touch (activation stage 2, Catalog.cs:731)."""
+        for type_name, arena in self.engine.arenas.items():
+            keys = arena.keys()
+            if len(keys) == 0:
+                continue
+            local_mask, _ = self.partition(type_name, keys)
+            stray = keys[~local_mask]
+            if len(stray):
+                evicted = arena.evict_keys(stray)
+                self.silo.logger.info(
+                    f"handoff: evicted {evicted} {type_name} rows no "
+                    f"longer owned here")
+
+    def snapshot(self) -> Dict[str, int]:
+        return {
+            "slabs_shipped": self.slabs_shipped,
+            "messages_shipped": self.messages_shipped,
+            "slabs_received": self.slabs_received,
+            "messages_received": self.messages_received,
+        }
+
+
+class ClusterInjector:
+    """Steady-state cluster injector: the ownership split of a stable key
+    set is computed once per ring version; each ``inject`` is one local
+    enqueue plus one pre-gathered slab per remote owner (the cross-silo
+    analog of BatchInjector's cached-row fast path).  A membership change
+    invalidates the split — injecting through a stale split would
+    re-activate keys the handoff just evicted."""
+
+    def __init__(self, router: VectorRouter, type_name: str, method: str,
+                 keys: np.ndarray) -> None:
+        self.router = router
+        self.type_name = type_name
+        self.method = method
+        self.keys = keys
+        self.n = len(keys)
+        self._ring_version = -1
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        self._ring_version = self.router.silo.ring.version
+        local_mask, remote = self.router.partition(self.type_name,
+                                                   self.keys)
+        self._all_local = not remote
+        self._local_idx = np.nonzero(local_mask)[0]
+        self._remote = [(target, idx) for target, idx in remote.items()]
+        self._local = None
+        if len(self._local_idx):
+            from orleans_tpu.tensor.engine import BatchInjector
+            self._local = BatchInjector(
+                self.router.engine, self.type_name, self.method,
+                self.keys if self._all_local
+                else self.keys[self._local_idx])
+
+    def inject(self, args: Any, want_results: bool = False
+               ) -> Optional[asyncio.Future]:
+        if self._ring_version != self.router.silo.ring.version:
+            self._rebuild()
+        if self._all_local and not want_results:
+            return self._local.inject(args)  # zero-copy fast path
+        if want_results:
+            # results need order reassembly — reuse the routed path
+            return self.router.route_batch(self.type_name, self.method,
+                                           self.keys, args,
+                                           want_results=True)
+        args_h = _host_args(args)
+        if self._local is not None:
+            self._local.inject(_gather_args(args_h, self._local_idx))
+        for target, idx in self._remote:
+            self.router.ship_slab(target, self.type_name, self.method,
+                                  self.keys[idx], _gather_args(args_h, idx))
+        return None

@@ -299,11 +299,15 @@ def test_tensor_statistics_fanout(run):
             mgmt = factory.get_grain(IManagementGrain, 0)
             stats = await mgmt.get_tensor_statistics()
             assert len(stats) >= 1
+            # the vector router splits the load by ring owner, so the
+            # cluster-wide totals (what the admin surface is for) carry
+            # the traffic, spread over the member silos
+            assert sum(s["messages"] for s in stats) >= 2 * 300 * 3
             busy = max(stats, key=lambda s: s["messages"])
-            assert busy["messages"] >= 2 * 300 * 3
             lat = busy["tick_latency"]
             assert lat["n"] > 0 and lat["p99"] >= lat["p50"] > 0
-            assert busy["arenas"]["PresenceGrain"] == 300
+            assert sum(s["arenas"].get("PresenceGrain", 0)
+                       for s in stats) == 300
         finally:
             await cluster.stop()
 

