@@ -379,10 +379,24 @@ class TensorEngine:
         — one dispatch per WINDOW instead of several per tick).  The
         returned FusedTickProgram's ``run(stacked_args)`` executes a
         whole [T, ...] window; ``verify()`` must report 0 misses for the
-        window to be exact."""
+        window to be exact.
+
+        Fused windows are single-engine programs: on a clustered silo the
+        key set must be entirely ring-owned here (fuse each silo's own
+        partition; cross-silo traffic rides the slab path instead)."""
+        type_name = self._type_name(interface)
+        keys = np.asarray(keys, dtype=np.int64)
+        if self.router is not None:
+            local_mask, remote = self.router.partition(type_name, keys)
+            if remote:
+                raise ValueError(
+                    f"fuse_ticks({type_name}): {int((~local_mask).sum())} "
+                    f"of {len(keys)} keys are ring-owned by other silos; "
+                    "a fused window would activate them locally (duplicate "
+                    "activation). Fuse only keys[local] per silo — "
+                    "partition with silo.vector_router.partition().")
         from orleans_tpu.tensor.fused import FusedTickProgram
-        return FusedTickProgram(self, self._type_name(interface), method,
-                                np.asarray(keys, dtype=np.int64))
+        return FusedTickProgram(self, type_name, method, keys)
 
     def send_one(self, grain_id: GrainId, method: MethodInfo,
                  args: tuple) -> Optional[asyncio.Future]:
@@ -653,6 +667,121 @@ class TensorEngine:
 
     # -- group execution ----------------------------------------------------
 
+    @staticmethod
+    def _coalesce_host_batches(batches: List[PendingBatch]
+                               ) -> List[PendingBatch]:
+        """Merge CONSECUTIVE runs of plain host-key batches (no cached
+        rows, no futures, no masks) into one numpy batch per run before
+        resolution.
+
+        Cross-silo slab arrivals queue one such batch per slab; without
+        merging, each distinct coalescing pattern produces a distinct
+        concatenated batch size and a fresh XLA compile — measured as THE
+        dominant cost of the cross-silo presence run (2.2s of a 3.2s run
+        compiling).  One merged batch pads to a stable bucket instead.
+        Only adjacent batches merge, so FIFO application order against
+        non-mergeable batches in the same round is preserved (matters for
+        last-writer-wins handlers)."""
+
+        def mergeable(b: PendingBatch) -> bool:
+            return (b.future is None and b.keys_host is not None
+                    and b.rows is None and b.keys_dev is None
+                    and b.mask is None and not b.no_fanout)
+
+        def merge(member: List[PendingBatch]) -> PendingBatch:
+            def cat(*leaves):
+                return np.concatenate(
+                    [np.broadcast_to(np.asarray(x),
+                                     (len(member[i].keys_host),)
+                                     + np.shape(x)[1:])
+                     if np.ndim(x) == 0 else np.asarray(x)
+                     for i, x in enumerate(leaves)])
+
+            return PendingBatch(
+                args=jax.tree_util.tree_map(cat,
+                                            *(b.args for b in member)),
+                keys_host=np.concatenate([b.keys_host for b in member]))
+
+        out: List[PendingBatch] = []
+        r = 0
+        while r < len(batches):
+            if not mergeable(batches[r]):
+                out.append(batches[r])
+                r += 1
+                continue
+            run_end = r
+            while run_end < len(batches) and mergeable(batches[run_end]):
+                run_end += 1
+            run = batches[r:run_end]
+            out.append(run[0] if len(run) == 1 else merge(run))
+            r = run_end
+        return out
+
+    def _filter_ownership(self, type_name: str, method: str,
+                          batches: List[PendingBatch]
+                          ) -> List[PendingBatch]:
+        """Resolve-time ownership re-check for host-key batches.
+
+        Ownership proven at ENQUEUE time can be stale by DRAIN time (a
+        ring change between the two evicts the keys via handoff); blindly
+        re-resolving would re-activate them here while the new owner also
+        activates them — a duplicate activation.  Strays found now are
+        shipped (or, for result-carrying batches, the whole batch is
+        re-routed and its future chained).  Single-member rings
+        short-circuit inside partition(), so the single-silo hot path
+        pays one cheap call."""
+        arena = self.arenas.get(type_name)
+        gen = arena.generation if arena is not None else -1
+        out: List[PendingBatch] = []
+        for b in batches:
+            if b.keys_host is None:
+                out.append(b)  # device keys: the miss path owns routing
+                continue
+            if b.rows is not None and b.generation == gen:
+                # injector fast path: rows resolved under this generation,
+                # and evictions always bump it — still-valid rows imply
+                # still-owned keys
+                out.append(b)
+                continue
+            local_mask, remote = self.router.partition(type_name,
+                                                       b.keys_host)
+            if not remote:
+                out.append(b)
+                continue
+            if b.future is not None:
+                # results are positional over the full batch — re-route
+                # the whole thing and chain the caller's future
+                routed = self.router.route_batch(
+                    type_name, method, b.keys_host, b.args,
+                    want_results=True)
+
+                def relay(f: asyncio.Future, dst=b.future) -> None:
+                    if dst.done():
+                        return
+                    if f.exception() is not None:
+                        dst.set_exception(f.exception())
+                    else:
+                        dst.set_result(f.result())
+
+                routed.add_done_callback(relay)
+                continue
+            args_h = jax.tree_util.tree_map(np.asarray, b.args)
+            for target, ridx in remote.items():
+                self.router.ship_slab(
+                    target, type_name, method, b.keys_host[ridx],
+                    jax.tree_util.tree_map(
+                        lambda a: a if np.ndim(a) == 0 else a[ridx],
+                        args_h))
+            lidx = np.nonzero(local_mask)[0]
+            if len(lidx):
+                out.append(PendingBatch(
+                    args=jax.tree_util.tree_map(
+                        lambda a: a if np.ndim(a) == 0 else a[lidx],
+                        args_h),
+                    keys_host=b.keys_host[lidx],
+                    no_fanout=b.no_fanout))
+        return out
+
     def _run_group(self, type_name: str, method: str,
                    batches: List[PendingBatch]) -> None:
         """Execute one (type, method) group.
@@ -667,6 +796,11 @@ class TensorEngine:
         arena = self.arena_for(type_name)
         stages = self._tick_stages
         t_res = time.perf_counter()
+        if self.router is not None:
+            batches = self._filter_ownership(type_name, method, batches)
+            if not batches:
+                return
+        batches = self._coalesce_host_batches(batches)
 
         # re-resolve if any batch's resolution itself grew/repacked the
         # arena (growth is rare; the loop converges immediately after)

@@ -45,6 +45,18 @@ def _gather_args(args: Any, idx: np.ndarray) -> Any:
         lambda a: a if np.ndim(a) == 0 else np.asarray(a)[idx], args)
 
 
+@jax.jit
+def _gather_args_dev(args: Any, idx) -> Any:
+    """Device-side partition gather (scalar leaves pass through) — keeps
+    the local slice of a device-resident payload on device and shrinks
+    the remote slices BEFORE they cross to the host."""
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda a: a if jnp.ndim(a) == 0 else jnp.take(a, idx, axis=0),
+        args)
+
+
 def _host_args(args: Any) -> Any:
     return jax.tree_util.tree_map(np.asarray, args)
 
@@ -279,9 +291,20 @@ class VectorRouter:
             stray = keys[~local_mask]
             if len(stray):
                 evicted = arena.evict_keys(stray)
-                self.silo.logger.info(
-                    f"handoff: evicted {evicted} {type_name} rows no "
-                    f"longer owned here")
+                if arena.store is None:
+                    # eviction preserves single-activation either way, but
+                    # without a store the rows' state cannot follow them —
+                    # same contract as the reference's storage-less grains
+                    # (deactivation discards state), surfaced loudly
+                    self.silo.logger.warn(
+                        f"handoff: evicted {evicted} {type_name} rows "
+                        "WITHOUT write-back (no VectorStore attached) — "
+                        "their state restarts from field defaults on the "
+                        "new owner", code=2911)
+                else:
+                    self.silo.logger.info(
+                        f"handoff: evicted {evicted} {type_name} rows no "
+                        f"longer owned here")
 
     def snapshot(self) -> Dict[str, int]:
         return {
@@ -311,12 +334,17 @@ class ClusterInjector:
         self._rebuild()
 
     def _rebuild(self) -> None:
+        import jax.numpy as jnp
+
         self._ring_version = self.router.silo.ring.version
         local_mask, remote = self.router.partition(self.type_name,
                                                    self.keys)
         self._all_local = not remote
         self._local_idx = np.nonzero(local_mask)[0]
-        self._remote = [(target, idx) for target, idx in remote.items()]
+        self._local_idx_dev = jnp.asarray(self._local_idx.astype(np.int32))
+        self._remote = [(target, idx,
+                         jnp.asarray(idx.astype(np.int32)))
+                        for target, idx in remote.items()]
         self._local = None
         if len(self._local_idx):
             from orleans_tpu.tensor.engine import BatchInjector
@@ -336,10 +364,23 @@ class ClusterInjector:
             return self.router.route_batch(self.type_name, self.method,
                                            self.keys, args,
                                            want_results=True)
+        if any(isinstance(leaf, jax.Array)
+               for leaf in jax.tree_util.tree_leaves(args)):
+            # device payloads: gather partitions ON DEVICE — the local
+            # slice never touches the host, remote slices cross at their
+            # partition size, not the full payload's
+            if self._local is not None:
+                self._local.inject(_gather_args_dev(args,
+                                                    self._local_idx_dev))
+            for target, idx, idx_dev in self._remote:
+                self.router.ship_slab(
+                    target, self.type_name, self.method, self.keys[idx],
+                    jax.device_get(_gather_args_dev(args, idx_dev)))
+            return None
         args_h = _host_args(args)
         if self._local is not None:
             self._local.inject(_gather_args(args_h, self._local_idx))
-        for target, idx in self._remote:
+        for target, idx, _ in self._remote:
             self.router.ship_slab(target, self.type_name, self.method,
                                   self.keys[idx], _gather_args(args_h, idx))
         return None

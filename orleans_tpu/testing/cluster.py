@@ -142,6 +142,30 @@ class TestingCluster:
 
     # ================= convenience ========================================
 
+    async def quiesce_engines(self, rounds: int = 300,
+                              poll: float = 0.01) -> None:
+        """Quiesce the cluster's tensor data plane: flush every silo's
+        engine until no engine processes anything new — slabs may still
+        be in flight between silos after any single engine drains
+        (tensor/router.py), so stability must be observed cluster-wide."""
+        last, stable = -1, 0
+        for _ in range(rounds):
+            for silo in self.silos:
+                if silo.tensor_engine is not None:
+                    await silo.tensor_engine.flush()
+            await asyncio.sleep(poll)
+            total = sum(s.tensor_engine.messages_processed
+                        for s in self.silos
+                        if s.tensor_engine is not None)
+            if total == last:
+                stable += 1
+                if stable >= 3:
+                    return
+            else:
+                stable = 0
+            last = total
+        raise TimeoutError("tensor data plane did not quiesce")
+
     async def wait_for_liveness_convergence(self, timeout: float = 10.0) -> None:
         """Wait until every live silo's view equals exactly the live set —
         in particular, killed silos must have been DECLARED dead by every

@@ -1,0 +1,109 @@
+"""The cross-silo presence workload over real TCP: the deployment shape.
+
+VERDICT r2's done-criterion for the cross-silo data plane: presence load
+driven through a 2-silo TCP cluster — players and games split by ring
+owner — with exact message counts and throughput within 5x of the
+single-silo fused engine (reference boundary being replaced:
+OutgoingMessageSender.cs:128-176 per-message send with socket-level
+batching; here batches stay batches across the wire).
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from orleans_tpu.config import SiloConfig
+from orleans_tpu.testing.cluster import TestingCluster
+from samples.presence import run_presence_load, run_presence_load_fused
+
+N_PLAYERS, N_GAMES, N_TICKS = 2000, 20, 20
+
+
+def relaxed_liveness(name: str) -> SiloConfig:
+    """Benchmark-grade liveness timings: XLA compiles inside the measured
+    loop can stall the event loop past the test-default probe windows and
+    make healthy silos declare each other (or themselves) dead."""
+    cfg = SiloConfig(name=name)
+    cfg.liveness.probe_timeout = 2.0
+    cfg.liveness.probe_period = 2.0
+    cfg.liveness.num_missed_probes_limit = 10
+    return cfg
+
+
+async def settle(cluster):
+    await cluster.quiesce_engines()
+
+
+def cluster_game_updates(cluster) -> int:
+    total = 0
+    for s in cluster.silos:
+        arena = s.tensor_engine.arenas.get("GameGrain")
+        if arena is not None and arena.live_count:
+            total += int(np.asarray(arena.state["updates"]).sum())
+    return total
+
+
+def test_cross_silo_presence_exact_and_fast(run):
+    async def main():
+        cluster = await TestingCluster(
+            n_silos=2, transport="tcp",
+            config_factory=relaxed_liveness).start()
+        try:
+            a = cluster.silos[0]
+            # warmup: compile every steady-state program shape
+            await run_presence_load(a.tensor_engine, n_players=N_PLAYERS,
+                                    n_games=N_GAMES, n_ticks=2)
+            await settle(cluster)
+            base = cluster_game_updates(cluster)
+
+            t0 = time.perf_counter()
+            await run_presence_load(a.tensor_engine, n_players=N_PLAYERS,
+                                    n_games=N_GAMES, n_ticks=N_TICKS)
+            await settle(cluster)
+            cross_dt = time.perf_counter() - t0
+
+            # message counts exact: every heartbeat of every tick reached
+            # its game's arena row exactly once, wherever it lived
+            updates = cluster_game_updates(cluster) - base
+            assert updates == N_PLAYERS * N_TICKS, \
+                (updates, N_PLAYERS * N_TICKS)
+            # the load really crossed silos, as slabs
+            shipped = sum(s.vector_router.messages_shipped
+                          for s in cluster.silos)
+            received = sum(s.vector_router.messages_received
+                           for s in cluster.silos)
+            assert shipped > N_PLAYERS  # heartbeats + game updates crossed
+            assert received == shipped  # none lost
+            for s in cluster.silos:
+                arena = s.tensor_engine.arenas.get("PresenceGrain")
+                assert arena is not None and arena.live_count > 0, \
+                    f"{s.name} hosts no players — load did not split"
+
+            cross_rate = 2 * N_PLAYERS * N_TICKS / cross_dt
+            return cross_rate
+        finally:
+            await cluster.stop()
+
+    async def fused_baseline():
+        from orleans_tpu.tensor.engine import TensorEngine
+        engine = TensorEngine()
+        await run_presence_load_fused(engine, n_players=N_PLAYERS,
+                                      n_games=N_GAMES, n_ticks=N_TICKS,
+                                      window=N_TICKS)  # warmup/compile
+        t0 = time.perf_counter()
+        stats = await run_presence_load_fused(engine, n_players=N_PLAYERS,
+                                              n_games=N_GAMES,
+                                              n_ticks=N_TICKS,
+                                              window=N_TICKS)
+        return stats["messages"] / (time.perf_counter() - t0)
+
+    cross_rate = run(main())
+    fused_rate = run(fused_baseline())
+    ratio = fused_rate / cross_rate
+    # VERDICT criterion: within 5x of single-silo fused (measured ~1x on
+    # this path after slab coalescing; 5x bounds CI noise, not the design)
+    assert ratio <= 5.0, \
+        f"cross-silo {cross_rate:,.0f} msg/s vs fused {fused_rate:,.0f} " \
+        f"msg/s = {ratio:.1f}x (budget 5x)"

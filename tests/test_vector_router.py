@@ -48,26 +48,8 @@ class RouteCounter(VectorGrain):
         return state, {"echo": batch.args["v"] * 2}, ()
 
 
-async def settle(cluster, rounds: int = 40):
-    """Quiesce the whole cluster: flush every engine until no engine
-    processes anything new (slabs may be in flight between silos)."""
-    last = -1
-    stable = 0
-    for _ in range(rounds):
-        for silo in cluster.silos:
-            if silo.tensor_engine is not None:
-                await silo.tensor_engine.flush()
-        await asyncio.sleep(0.02)
-        total = sum(s.tensor_engine.messages_processed
-                    for s in cluster.silos if s.tensor_engine is not None)
-        if total == last:
-            stable += 1
-            if stable >= 3:
-                return
-        else:
-            stable = 0
-        last = total
-    raise TimeoutError("cluster did not quiesce")
+async def settle(cluster):
+    await cluster.quiesce_engines()
 
 
 def arena_rows(cluster, type_name):
@@ -271,6 +253,47 @@ def test_injector_repartitions_after_membership_change(run):
             assert {s for s, _ in rows.values()} == \
                 {s.name for s in cluster.silos}
             assert all(int(r["count"]) == 2 for _, r in rows.values())
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_stale_enqueued_batch_reroutes_at_resolve_time(run):
+    """A host-key batch queued BEFORE a ring change must not re-activate
+    keys the handoff evicted: ownership is re-checked at resolve time and
+    strays ship to the owner (the enqueue-time check alone is racy)."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            a, b = cluster.silos
+            n = 80
+            keys = np.arange(n, dtype=np.int64)
+            # simulate the race: a batch that bypassed enqueue routing
+            # (as one proven local before a ring move would have)
+            a.tensor_engine.enqueue_local_batch(
+                "RouteCounter", "add", keys,
+                {"v": np.ones(n, np.float32)})
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")  # asserts no dupes
+            assert set(rows) == set(range(n))
+            assert {s for s, _ in rows.values()} == {a.name, b.name}
+            assert all(int(r["count"]) == 1 for _, r in rows.values())
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_fuse_ticks_rejects_remote_keys(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            a = cluster.silos[0]
+            with pytest.raises(ValueError, match="ring-owned by other"):
+                a.tensor_engine.fuse_ticks(
+                    "RouteCounter", "add", np.arange(50, dtype=np.int64))
         finally:
             await cluster.stop()
 
