@@ -89,6 +89,13 @@ class TensorEngineConfig:
     # max parked optimistic miss-checks before a forced (synchronizing)
     # drain — bounds device memory pinned by deferred delivery checks
     miss_check_cap: int = 16
+    # auto-fusion (tensor/autofuse.py): after auto_fusion_ticks
+    # consecutive ticks with an identical injection pattern the engine
+    # transparently compiles the steady tick into a fused window of
+    # auto_fusion_window ticks, rolling back (exactly) on any miss.
+    # 0 disables detection.
+    auto_fusion_ticks: int = 16
+    auto_fusion_window: int = 16
 
 
 @dataclass

@@ -1,0 +1,236 @@
+"""Auto-fusion: the engine detects its own steady state and compiles it.
+
+Manual fusion (tensor/fused.py) asks the caller to hand the engine a frozen
+key set and drive whole windows.  Auto-fusion removes the ceremony: the
+loader calls nothing but ``injector.inject(args)`` per tick, and the engine
+
+1. **detects** K consecutive ticks carrying an identical injection
+   pattern — same (type, method), same key set (object identity on the
+   injector's cached arrays), same arena generation, same args dict with
+   a stable static/per-tick split (leaves reused by identity are static);
+2. **compiles** the steady tick into a FusedTickProgram and switches to
+   window mode: injections buffer their per-tick leaves and every
+   ``auto_fusion_window`` ticks execute as ONE device program;
+3. **verifies** each window's device-side miss counter and, on a nonzero
+   count (a cold destination, fan-out overflow or round-cap spill inside
+   the window), **rolls back** the window from a pre-run state snapshot
+   and replays its ticks through the exact unfused path — transparency
+   never costs exactness;
+4. **disengages** on any pattern break (foreign traffic, changed leaf
+   identity, ring change), replaying buffered ticks unfused one at a
+   time so per-tick application order is preserved.
+
+No reference analog — the reference's dispatcher walks queues per message
+(Dispatcher.cs:38); this is the north-star payoff for making dispatch
+data-flow (contract: tensor/fused.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class AutoFuser:
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        # detection state
+        self._sig: Optional[Tuple] = None
+        self._count = 0
+        self._prev_top: Dict[str, Any] = {}
+        self._static_keys: set = set()
+        self._activation_passes = -1
+        # engaged-window state
+        self._program = None
+        self._pattern: Optional[Tuple[str, str]] = None
+        self._pattern_rows = None
+        self._pattern_keys = None
+        self._pattern_generation = -1
+        self._static_args: Dict[str, Any] = {}
+        self._buffer: List[Dict[str, Any]] = []
+        self._replaying = False
+        # caches / stats
+        self._programs: Dict[Tuple, Any] = {}
+        self._disabled: Dict[Tuple, int] = {}   # sig → ring version at ban
+        self.windows_run = 0
+        self.windows_rolled_back = 0
+        self.ticks_fused = 0
+
+    # ================= detection ==========================================
+
+    def _reset(self) -> None:
+        self._sig = None
+        self._count = 0
+        self._prev_top = {}
+        self._static_keys = set()
+        self._program = None
+
+    def _ring_version(self) -> int:
+        silo = self.engine.silo
+        return silo.ring.version if silo is not None else 0
+
+    def offer(self) -> bool:
+        """Called at tick start.  Returns True when the tick's work was
+        consumed into the fused window (caller skips the unfused path)."""
+        cfg = self.engine.config
+        if cfg.auto_fusion_ticks <= 0 or self._replaying:
+            return False
+        live = [(k, v) for k, v in self.engine.queues.items() if v]
+        if len(live) != 1 or len(live[0][1]) != 1:
+            self._reset()
+            return False
+        (type_name, method), (b,) = live[0]
+        args = b.args
+        if (b.future is not None or b.rows is None or b.keys_host is None
+                or b.no_fanout or b.mask is not None
+                or not isinstance(args, dict)):
+            self._reset()
+            return False
+        arena = self.engine.arenas.get(type_name)
+        if arena is None or b.generation != arena.generation:
+            self._reset()
+            return False
+        sig = (type_name, method, id(b.keys_host), b.generation,
+               tuple(sorted(args)), self._ring_version())
+        if self._disabled.get(sig) == self._ring_version():
+            return False
+        if sig != self._sig:
+            self._reset()
+            self._sig = sig
+            self._count = 1
+            self._prev_top = dict(args)
+            self._static_keys = set(args)
+            self._activation_passes = self.engine.activation_passes
+            return False
+        # same signature again: refine the static split by leaf identity
+        self._static_keys = {k for k in self._static_keys
+                             if args[k] is self._prev_top.get(k)}
+        self._prev_top = dict(args)
+        self._count += 1
+        threshold = 2 if sig in self._programs else cfg.auto_fusion_ticks
+        if self._count < threshold:
+            return False
+        if self.engine.activation_passes != self._activation_passes:
+            # recent drains still activated cold grains — not steady yet
+            self._activation_passes = self.engine.activation_passes
+            self._count = 1
+            return False
+        if len(self._static_keys) == len(args):
+            return False  # nothing varies per tick: no window axis
+        if self._program is None and not self._engage(sig, b, args):
+            return False
+        # consume this tick into the window buffer
+        self.engine.queues[(type_name, method)].clear()
+        self._buffer.append(
+            {k: v for k, v in args.items() if k not in self._static_keys})
+        if len(self._buffer) >= cfg.auto_fusion_window:
+            self._run_window()
+        return True
+
+    def _engage(self, sig: Tuple, b, args: Dict[str, Any]) -> bool:
+        prog = self._programs.get(sig)
+        if prog is None:
+            try:
+                prog = self.engine.fuse_ticks(sig[0], sig[1], b.keys_host)
+            except ValueError:
+                # cluster: keys not all ring-owned here — never fuse this
+                # pattern while this ring stands
+                self._disabled[sig] = self._ring_version()
+                self._reset()
+                return False
+            self._programs[sig] = prog
+        self._program = prog
+        self._pattern = (sig[0], sig[1])
+        self._pattern_rows = b.rows
+        self._pattern_keys = b.keys_host
+        self._pattern_generation = b.generation
+        self._static_args = {k: args[k] for k in self._static_keys}
+        return True
+
+    # ================= window execution ====================================
+
+    def _run_window(self) -> None:
+        engine = self.engine
+        prog = self._program
+        t0 = time.perf_counter()
+        window = self._buffer
+        self._buffer = []
+        stacked = {
+            k: (jnp.stack([w[k] for w in window])
+                if isinstance(window[0][k], jax.Array)
+                else np.stack([np.asarray(w[k]) for w in window]))
+            for k in window[0]}
+
+        # make sure the program is traced so its touched-arena list is
+        # complete, then snapshot every touched arena BEFORE the run: the
+        # compiled window donates the state buffers, so the snapshot is
+        # the only road back if the window turns out non-exact
+        if prog._compiled is None or any(
+                engine.arena_for(n).generation != g
+                for n, g in prog._generations.items()):
+            prog.src_rows = jnp.asarray(
+                prog.src_arena.resolve_rows(prog.keys))
+            example = {**self._static_args,
+                       **jax.tree_util.tree_map(lambda a: a[0], stacked)}
+            prog._compiled = prog._build(example)
+        snapshot = {
+            n: {c: jnp.array(v, copy=True)
+                for c, v in engine.arena_for(n).state.items()}
+            for n in prog._touched}
+        counters = (engine.tick_number, engine.ticks_run,
+                    engine.messages_processed)
+
+        prog.run(stacked, static_args=self._static_args)
+        misses = prog.verify()
+        dt = time.perf_counter() - t0
+        self.windows_run += 1
+        for _ in range(len(window)):
+            # every message in the window completes by window end — record
+            # the window wall time as each tick's (conservative) latency
+            engine.tick_durations.append(dt)
+
+        if misses == 0:
+            self.ticks_fused += len(window)
+            return
+        # non-exact window (cold destination, fan-out overflow, round-cap
+        # spill): roll the state back and replay the ticks unfused — the
+        # slow path that keeps transparency exact
+        self.windows_rolled_back += 1
+        for n, cols in snapshot.items():
+            engine.arena_for(n).state = cols
+        (engine.tick_number, engine.ticks_run,
+         engine.messages_processed) = counters
+        self._buffer = window  # flush_partial replays them in order
+        self._reset()
+
+    # ================= drain integration ==================================
+
+    def flush_partial(self) -> bool:
+        """Re-enqueue ONE buffered tick for exact unfused replay (the
+        engine's drain loop calls this until it returns False).  One tick
+        per call preserves per-tick application order."""
+        if not self._buffer:
+            self._replaying = False
+            return False
+        from orleans_tpu.tensor.engine import PendingBatch
+
+        self._replaying = True
+        tick_args = self._buffer.pop(0)
+        self.engine.queues[self._pattern].append(PendingBatch(
+            args={**self._static_args, **tick_args},
+            rows=self._pattern_rows,
+            keys_host=self._pattern_keys,
+            generation=self._pattern_generation))
+        return True
+
+    def snapshot(self) -> Dict[str, int]:
+        return {
+            "windows_run": self.windows_run,
+            "windows_rolled_back": self.windows_rolled_back,
+            "ticks_fused": self.ticks_fused,
+        }

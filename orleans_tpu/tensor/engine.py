@@ -196,6 +196,10 @@ class TensorEngine:
         # ring owner and only locally-owned keys ever activate here
         # (single-activation enforcement, reference: Catalog.cs:533-563)
         self.router = None
+        # steady-state detector + transparent window compiler
+        # (tensor/autofuse.py)
+        from orleans_tpu.tensor.autofuse import AutoFuser
+        self.autofuser = AutoFuser(self)
         # (src_type, src_method) → (DeviceFanout, dst_type, dst_method):
         # one-to-many subscription expansion on the device (tensor/fanout.py)
         self._fanouts: Dict[Tuple[str, str], Tuple[Any, str, str]] = {}
@@ -479,10 +483,15 @@ class TensorEngine:
 
     async def flush(self) -> None:
         """Run ticks until every queue drains AND all optimistic
-        miss-checks have settled (full delivery — tests/benchmark ends)."""
+        miss-checks have settled (full delivery — tests/benchmark ends).
+        Partially-filled auto-fusion windows replay unfused here, one
+        buffered tick at a time (exact per-tick order)."""
         while True:
             await self.drain_queues()
-            if not self._drain_checks():
+            requeued = self._drain_checks()
+            if self.autofuser.flush_partial():
+                requeued = True
+            if not requeued:
                 break
         # quiescence point: surface any fan-out budget overruns (the hot
         # path parks totals on device instead of synchronizing per round)
@@ -492,6 +501,10 @@ class TensorEngine:
     # ================= tick execution =====================================
 
     def run_tick(self) -> None:
+        if self.autofuser.offer():
+            # the tick was consumed into (or ran as part of) a fused
+            # window — counters/latency are accounted by the window run
+            return
         t0 = time.perf_counter()
         self.tick_number += 1
         self.ticks_run += 1
