@@ -96,6 +96,19 @@ class TensorEngineConfig:
     # 0 disables detection.
     auto_fusion_ticks: int = 16
     auto_fusion_window: int = 16
+    # rollback hysteresis: after this many rolled-back windows for one
+    # signature the pattern is banned (until ring/generation change) —
+    # repeated rollbacks mean the workload regularly touches cold keys
+    # and fusion only adds snapshot + replay cost
+    auto_fusion_max_rollbacks: int = 3
+    # idle grace before a partially-filled window replays unfused: if no
+    # new work arrives for this long the engine's loop drains the buffer
+    # so mid-window ticks never strand awaiting an explicit flush()
+    auto_fusion_idle_flush: float = 0.02
+    # handoff fence (tensor/router.py): max seconds a silo defers unseen-
+    # key activation after a ring change while awaiting peers' write-back
+    # releases; a dead/stalled peer must not wedge the cluster
+    handoff_fence_timeout: float = 2.0
 
 
 @dataclass

@@ -235,6 +235,13 @@ class Silo:
             from orleans_tpu.tensor.router import VectorRouter
             self.vector_router = VectorRouter(self)
             self.register_system_target("vector_router", self.vector_router)
+        elif fabric is not None:
+            # tensor-less clustered silo: peers' handoff fences still
+            # await this silo's release on every ring change — answer
+            # with a stub that releases trivially (it owns no rows)
+            from orleans_tpu.tensor.router import HandoffFenceStub
+            self.register_system_target("vector_router",
+                                        HandoffFenceStub(self))
 
     # ================= lifecycle (reference: Silo.cs :414,:642) ============
 
@@ -310,17 +317,19 @@ class Silo:
                 await stop()
         if graceful:
             await self.catalog.deactivate_all()
-            if self.membership_oracle is not None:
-                await self.membership_oracle.leave()
             if self.tensor_engine is not None \
                     and self.tensor_engine.store is not None:
-                # arena handoff through storage, AFTER the final drain and
-                # the membership goodbye: peers have rerouted, the engine
-                # is quiesced, so this write-back is the rows' final state
-                # — the new ring owners re-activate from it on first touch
+                # arena handoff through storage, BEFORE the membership
+                # goodbye: the engine is already stopped and drained, so
+                # this write-back is the rows' final state AND it is
+                # durable before any peer learns of the departure — a peer
+                # that reroutes and re-activates our keys on first touch
+                # always reads this checkpoint, never pre-handoff state
                 # (reference: graceful Shutdown deactivates all grains
                 # through their storage bridge, Silo.cs:642-770)
                 await self.tensor_engine.checkpoint()
+            if self.membership_oracle is not None:
+                await self.membership_oracle.leave()
         self.catalog.stop_collector()
         for cb in self._stop_callbacks:
             res = cb()

@@ -28,6 +28,7 @@ data-flow (contract: tensor/fused.py).
 from __future__ import annotations
 
 import time
+from collections import defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -57,6 +58,11 @@ class AutoFuser:
         # caches / stats
         self._programs: Dict[Tuple, Any] = {}
         self._disabled: Dict[Tuple, int] = {}   # sig → ring version at ban
+        # rollback hysteresis: cumulative rollbacks per signature; a
+        # pattern that keeps touching cold keys pays snapshot + rollback +
+        # replay every window — after auto_fusion_max_rollbacks strikes it
+        # is banned like a fuse failure (until ring/generation change)
+        self._rollback_counts: Dict[Tuple, int] = {}
         self.windows_run = 0
         self.windows_rolled_back = 0
         self.ticks_fused = 0
@@ -70,6 +76,50 @@ class AutoFuser:
         self._static_keys = set()
         self._program = None
 
+    def has_buffer(self) -> bool:
+        return bool(self._buffer)
+
+    def idle_flush(self) -> None:
+        """Engine-loop idle path: the producer stopped mid-window — drain
+        every buffered tick through the unfused path now.  Detection
+        restarts when the pattern resumes (cheaply: the compiled program
+        is cached, so re-engagement needs only 2 matching ticks)."""
+        self._break()
+
+    def _break(self) -> None:
+        """Pattern break: any buffered window ticks MUST apply before the
+        breaking tick executes — replay them through the exact unfused
+        path now, then reset detection."""
+        if self._buffer:
+            self._replay_buffer()
+        self._reset()
+
+    def _replay_buffer(self) -> None:
+        """Synchronously drain the window buffer through the unfused path,
+        one engine tick per buffered tick (exact per-tick application
+        order).  Newer work already queued on the engine is stashed and
+        restored BEHIND the replayed ticks, so ordering holds even when
+        the break was foreign traffic arriving mid-window."""
+        engine = self.engine
+        stash = engine.queues
+        engine.queues = defaultdict(list)
+        try:
+            while self.flush_partial():
+                engine.run_tick()
+                # replayed ticks may emit follow-on rounds that spill past
+                # the round cap — drain them (bounded: a cyclic emit
+                # topology must spill to later ticks, as the unfused
+                # engine's round cap does, not hang this synchronous loop)
+                for _ in range(engine.config.max_rounds_per_tick):
+                    if not any(engine.queues.values()):
+                        break
+                    engine.run_tick()
+        finally:
+            self._replaying = False
+            for k, v in stash.items():
+                if v:
+                    engine.queues[k].extend(v)
+
     def _ring_version(self) -> int:
         silo = self.engine.silo
         return silo.ring.version if silo is not None else 0
@@ -82,25 +132,26 @@ class AutoFuser:
             return False
         live = [(k, v) for k, v in self.engine.queues.items() if v]
         if len(live) != 1 or len(live[0][1]) != 1:
-            self._reset()
+            self._break()
             return False
         (type_name, method), (b,) = live[0]
         args = b.args
         if (b.future is not None or b.rows is None or b.keys_host is None
                 or b.no_fanout or b.mask is not None
                 or not isinstance(args, dict)):
-            self._reset()
+            self._break()
             return False
         arena = self.engine.arenas.get(type_name)
         if arena is None or b.generation != arena.generation:
-            self._reset()
+            self._break()
             return False
         sig = (type_name, method, id(b.keys_host), b.generation,
                tuple(sorted(args)), self._ring_version())
         if self._disabled.get(sig) == self._ring_version():
+            self._break()
             return False
         if sig != self._sig:
-            self._reset()
+            self._break()
             self._sig = sig
             self._count = 1
             self._prev_top = dict(args)
@@ -108,13 +159,36 @@ class AutoFuser:
             self._activation_passes = self.engine.activation_passes
             return False
         # same signature again: refine the static split by leaf identity
-        self._static_keys = {k for k in self._static_keys
-                             if args[k] is self._prev_top.get(k)}
+        new_static = {k for k in self._static_keys
+                      if args[k] is self._prev_top.get(k)}
+        if self._program is not None \
+                and not set(self._static_args) <= new_static:
+            # a leaf that was static at ENGAGE time changed identity
+            # mid-window: window[0]'s per-tick stack lacks that leaf, so
+            # continuing would silently apply the frozen value to every
+            # buffered tick.  Disengage, replay the buffer unfused, and
+            # restart detection from this tick.
+            self._break()
+            self._sig = sig
+            self._count = 1
+            self._prev_top = dict(args)
+            self._static_keys = set(args)
+            self._activation_passes = self.engine.activation_passes
+            return False
+        self._static_keys = new_static
         self._prev_top = dict(args)
         self._count += 1
         threshold = 2 if sig in self._programs else cfg.auto_fusion_ticks
         if self._count < threshold:
             return False
+        if self.engine._pending_checks:
+            # outstanding optimistic miss-checks may still activate cold
+            # destinations — settle them BEFORE freezing a directory
+            # mirror, or the window would compile against an incomplete
+            # mirror and miss every emit (any activation they trigger
+            # bumps activation_passes, which the steadiness guard below
+            # turns into "not steady yet")
+            self.engine._drain_checks()
         if self.engine.activation_passes != self._activation_passes:
             # recent drains still activated cold grains — not steady yet
             self._activation_passes = self.engine.activation_passes
@@ -196,6 +270,10 @@ class AutoFuser:
 
         if misses == 0:
             self.ticks_fused += len(window)
+            # a clean window forgives earlier strikes: the ban targets
+            # patterns whose windows roll back back-to-back, not a
+            # steady pattern with a rare cold-key incident
+            self._rollback_counts.pop(self._sig, None)
             return
         # non-exact window (cold destination, fan-out overflow, round-cap
         # spill): roll the state back and replay the ticks unfused — the
@@ -205,7 +283,17 @@ class AutoFuser:
             engine.arena_for(n).state = cols
         (engine.tick_number, engine.ticks_run,
          engine.messages_processed) = counters
-        self._buffer = window  # flush_partial replays them in order
+        sig = self._sig
+        strikes = self._rollback_counts.get(sig, 0) + 1
+        self._rollback_counts[sig] = strikes
+        if strikes >= max(1, engine.config.auto_fusion_max_rollbacks):
+            # hysteresis: a pattern that repeatedly rolls back is paying
+            # for fusion without getting it — ban the signature until the
+            # ring (or arena generation, which is part of the sig) changes
+            self._disabled[sig] = self._ring_version()
+            self._programs.pop(sig, None)
+        self._buffer = window
+        self._replay_buffer()  # in order, unfused, BEFORE any newer work
         self._reset()
 
     # ================= drain integration ==================================

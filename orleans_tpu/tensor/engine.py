@@ -191,6 +191,9 @@ class TensorEngine:
 
         self._step_cache: Dict[Tuple[str, str, int], Callable] = {}
         self._pending_checks: List[_MissCheck] = []
+        # batches parked by the handoff fence during a tick's rounds;
+        # re-queued at tick end so they retry next tick, not next round
+        self._fence_deferred: List[Tuple[Tuple[str, str], PendingBatch]] = []
         # cross-silo slab router (tensor/router.py); attached by the silo
         # in cluster mode.  When set, batch entry points partition keys by
         # ring owner and only locally-owned keys ever activate here
@@ -471,15 +474,37 @@ class TensorEngine:
                     # yield so producers can batch up the next tick; the
                     # accumulation interval is the latency/throughput knob
                     await asyncio.sleep(self.tick_interval())
-                if not self._drain_checks():
-                    break
+                if self._drain_checks():
+                    continue
+                if self._running and self.autofuser.has_buffer():
+                    # partially-filled fused window and no new work: give
+                    # the producer one grace period to continue the
+                    # pattern, then replay the buffer unfused so buffered
+                    # ticks never strand awaiting an explicit flush()
+                    try:
+                        await asyncio.wait_for(
+                            self._wake.wait(),
+                            timeout=self.config.auto_fusion_idle_flush)
+                        self._wake.clear()
+                        continue
+                    except asyncio.TimeoutError:
+                        self.autofuser.idle_flush()
+                        continue
+                break
 
     async def drain_queues(self) -> None:
         """Dispatch all queued work to the device without waiting for
         deferred miss-checks (the pipelined steady-state path)."""
         while any(self.queues.values()):
             self.run_tick()
-            await asyncio.sleep(0)
+            if self.router is not None \
+                    and not self.router.handoff_settled():
+                # the handoff fence is re-queueing unseen-key batches —
+                # pace the retries instead of busy-spinning at sleep(0)
+                # for the whole fence window
+                await asyncio.sleep(0.002)
+            else:
+                await asyncio.sleep(0)
 
     async def flush(self) -> None:
         """Run ticks until every queue drains AND all optimistic
@@ -493,6 +518,11 @@ class TensorEngine:
                 requeued = True
             if not requeued:
                 break
+            if self.router is not None \
+                    and not self.router.handoff_settled():
+                # the handoff fence is deferring unseen-key activation —
+                # pace the retry loop while awaiting peers' releases
+                await asyncio.sleep(0.005)
         # quiescence point: surface any fan-out budget overruns (the hot
         # path parks totals on device instead of synchronizing per round)
         for fanout, _, _ in self._fanouts.values():
@@ -531,6 +561,10 @@ class TensorEngine:
                 self._run_group(type_name, method, batches)
             rounds += 1
             self.rounds_run += 1
+        if self._fence_deferred:
+            for qkey, b in self._fence_deferred:
+                self.queues[qkey].append(b)
+            self._fence_deferred = []
         dt = time.perf_counter() - t0
         self._in_tick = False
         for k, v in stages.items():
@@ -663,6 +697,16 @@ class TensorEngine:
                     if len(mk) == 0 and not missing_np.any():
                         continue  # whole batch shipped — nothing local left
                     missing = jnp.asarray(missing_np)
+            if len(mk) and self.router is not None \
+                    and not self.router.handoff_settled():
+                # handoff fence: activating these unseen keys could read
+                # the store before the previous owner's write-back lands —
+                # requeue and retry once peers release (or timeout)
+                self.queues[(c.type_name, c.method)].append(PendingBatch(
+                    args=c.args, keys_dev=c.keys, mask=missing,
+                    no_fanout=True))
+                requeued = True
+                continue
             if len(mk):
                 c.arena.resolve_rows(mk, tick=self.tick_number)  # activates
             # re-deliver only the dropped messages; convergence across
@@ -811,6 +855,28 @@ class TensorEngine:
         t_res = time.perf_counter()
         if self.router is not None:
             batches = self._filter_ownership(type_name, method, batches)
+            if batches and not self.router.handoff_settled():
+                # handoff fence: host-key batches touching UNSEEN keys
+                # would activate them from the store, racing the previous
+                # owner's write-back — defer those until peers release
+                # (or the fence times out); everything else flows
+                safe: List[PendingBatch] = []
+                for b in batches:
+                    if b.keys_host is not None and (
+                            b.rows is None or b.generation != arena.generation):
+                        _, found = arena.lookup_rows(b.keys_host)
+                        if not found.all():
+                            # this round's _run_fanout already expanded the
+                            # batch — a re-queued copy must not re-expand.
+                            # Park in a side list (re-queued at tick end)
+                            # so the round loop doesn't re-examine it
+                            # every round of this tick.
+                            b.no_fanout = True
+                            self._fence_deferred.append(
+                                ((type_name, method), b))
+                            continue
+                    safe.append(b)
+                batches = safe
             if not batches:
                 return
         batches = self._coalesce_host_batches(batches)
@@ -987,6 +1053,17 @@ class BatchInjector:
         self.n = len(keys)
 
     def _refresh(self) -> None:
+        router = self.engine.router
+        if router is not None and not router.handoff_settled():
+            _, found = self._arena.lookup_rows(self.keys)
+            if not found.all():
+                # handoff fence: eagerly activating unseen keys here could
+                # read the store before the previous owner's write-back.
+                # Defer the row cache — inject() falls back to keys_host
+                # batches, which the engine fences (and resolves) at drain
+                self.rows = None
+                self.generation = -2  # never matches: retry next inject
+                return
         rows = self._arena.resolve_rows(self.keys,
                                         tick=self.engine.tick_number)
         self.rows = jnp.asarray(rows)

@@ -30,7 +30,8 @@ would double-deliver and is a configuration error.
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Dict, List, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import jax
 import numpy as np
@@ -61,6 +62,23 @@ def _host_args(args: Any) -> Any:
     return jax.tree_util.tree_map(np.asarray, args)
 
 
+def _send_release(silo, target: SiloAddress, digest: Tuple[str, ...]) -> None:
+    """One-way handoff_release to a peer's vector_router target."""
+    from orleans_tpu.ids import GrainId, SystemTargetCodes
+    from orleans_tpu.runtime.messaging import Category, Direction, Message
+    silo.message_center.send_message(Message(
+        category=Category.SYSTEM,
+        direction=Direction.ONE_WAY,
+        sending_silo=silo.address,
+        sending_grain=silo.client_grain_id,
+        target_silo=target,
+        target_grain=GrainId.system_target(
+            int(SystemTargetCodes.VECTOR_ROUTER)),
+        method_name="handoff_release",
+        args=(list(digest), silo.address),
+    ))
+
+
 class VectorRouter:
     """One per clustered silo; registered as the ``vector_router`` system
     target so peers can address slabs to it."""
@@ -76,6 +94,35 @@ class VectorRouter:
         self.messages_shipped = 0
         self.slabs_received = 0
         self.messages_received = 0
+        self.slabs_requeued = 0
+        self.messages_dropped = 0
+        self.slab_retry_limit = 8
+        self._retry_tasks: Set[asyncio.Task] = set()
+        # -- handoff fence (ordering for ownership moves) ------------------
+        # A ring change moves key ranges between silos, but old and new
+        # owners process the change at independent times: the new owner's
+        # first-touch store READ could precede the old owner's write-back,
+        # silently losing state (the race the reference's
+        # GrainDirectoryHandoffManager transfer protocol closes).  Fence:
+        # after processing a change (write-back + evict done), each silo
+        # broadcasts handoff_release(view-digest) to its peers; a silo
+        # defers ACTIVATION of unseen keys until every alive peer has
+        # released the current view (or the fence times out — a dead/
+        # stalled peer must not wedge the cluster; its loss window is the
+        # documented checkpoint cadence).
+        self._fence_version = -1
+        self._barrier_digest: Tuple[str, ...] = ()
+        self._awaiting: Set[SiloAddress] = set()
+        self._acks: Dict[SiloAddress, Tuple[str, ...]] = {}
+        self._handoff_deadline = 0.0
+        self.handoff_timeout = getattr(silo.config.tensor,
+                                       "handoff_fence_timeout", 2.0)
+        # arm/broadcast on EVERY ring change, even before the silo is
+        # ACTIVE (a joining silo must release its peers — it holds no
+        # rows, so its release is trivially true; eviction for active
+        # silos already ran: the silo's own ring subscription precedes
+        # this one, so on_ring_changed's write-back happens first)
+        silo.ring.subscribe(lambda *_: self._arm_fence())
 
     # ================= ownership ==========================================
 
@@ -120,6 +167,55 @@ class VectorRouter:
         local, _ = self.partition(type_name,
                                   np.asarray([key], dtype=np.int64))
         return bool(local[0])
+
+    # ================= handoff fence ======================================
+
+    def _view_digest(self) -> Tuple[str, ...]:
+        return tuple(sorted(str(m) for m in self.silo.ring.members))
+
+    def _arm_fence(self) -> None:
+        """Ring changed: broadcast our release (write-back for this change
+        is already durable — the silo's eviction subscription runs before
+        this one) and start awaiting the peers' releases."""
+        ring = self.silo.ring
+        self._fence_version = ring.version
+        digest = self._view_digest()
+        self._barrier_digest = digest
+        peers = [m for m in ring.members if m != self.silo.address]
+        self._awaiting = {p for p in peers if self._acks.get(p) != digest}
+        self._handoff_deadline = time.monotonic() + self.handoff_timeout
+        for p in peers:
+            _send_release(self.silo, p, digest)
+
+    async def handoff_release(self, digest, sender: SiloAddress) -> None:
+        """Peer finished its write-back for the membership view ``digest``
+        — unseen keys in ranges we gained from it are now safe to
+        activate from the store."""
+        digest = tuple(digest)
+        self._acks[sender] = digest
+        if digest == self._barrier_digest:
+            self._awaiting.discard(sender)
+
+    def handoff_settled(self) -> bool:
+        """True when first-touch activation is safe: every alive peer has
+        released the current membership view (their write-back for any
+        range we gained is durable).  The engine defers unseen-key
+        activation while this is False; traffic to already-active rows is
+        unaffected."""
+        if self._fence_version != self.silo.ring.version:
+            self._arm_fence()
+        if not self._awaiting:
+            return True
+        if time.monotonic() >= self._handoff_deadline:
+            self.silo.logger.warn(
+                f"handoff fence timed out awaiting release from "
+                f"{[str(p) for p in self._awaiting]} — proceeding "
+                f"(their write-back may still be in flight)", code=2912)
+            self._awaiting.clear()
+            return True
+        alive = set(self.silo.active_silos())
+        self._awaiting = {p for p in self._awaiting if p in alive}
+        return not self._awaiting
 
     # ================= send side ==========================================
 
@@ -207,9 +303,13 @@ class VectorRouter:
         return jax.tree_util.tree_unflatten(treedef, combined)
 
     def ship_slab(self, target: SiloAddress, type_name: str, method: str,
-                  keys: np.ndarray, args: Any, hops: int = 0) -> None:
+                  keys: np.ndarray, args: Any, hops: int = 0,
+                  retries: int = 0) -> None:
         """One (keys, args) slab → one one-way message to the peer's
-        router (the batched silo boundary; never per-message send_one)."""
+        router (the batched silo boundary; never per-message send_one).
+        ``retries`` rides the wire so the backoff budget accumulates
+        across silos — a slab ping-ponging between diverged ring views
+        still hits the drop limit instead of circulating forever."""
         from orleans_tpu.ids import GrainId, SystemTargetCodes
         from orleans_tpu.runtime.messaging import Category, Direction, Message
         self.slabs_shipped += 1
@@ -224,7 +324,7 @@ class VectorRouter:
                 int(SystemTargetCodes.VECTOR_ROUTER)),
             method_name="inject_slab",
             args=(type_name, method, np.asarray(keys, dtype=np.int64),
-                  _host_args(args), hops),
+                  _host_args(args), hops, retries),
         )
         self.silo.message_center.send_message(msg)
 
@@ -238,14 +338,21 @@ class VectorRouter:
     # ================= receive side (system target) =======================
 
     async def inject_slab(self, type_name: str, method: str,
-                          keys: np.ndarray, args: Any, hops: int = 0) -> None:
+                          keys: np.ndarray, args: Any, hops: int = 0,
+                          retries: int = 0, _recount: bool = True) -> None:
         """Peer slab arrival: verify ownership (the ring may have moved
         while the slab was in flight) and enqueue the owned part; forward
         strays with a bounded hop count (reference: MaxForwardCount,
-        Dispatcher.TryForwardRequest :474)."""
+        Dispatcher.TryForwardRequest :474).  A slab that exhausts its hop
+        budget is NOT dropped: diverged ring views converge within a
+        membership refresh, so the holder parks it and re-injects with
+        backoff (the batched analog of the reference's resend-with-
+        backoff; only the retry budget's exhaustion loses messages, and
+        that is logged as an error)."""
         keys = np.asarray(keys, dtype=np.int64)
-        self.slabs_received += 1
-        self.messages_received += len(keys)
+        if _recount:  # local backoff re-entries must not double-count
+            self.slabs_received += 1
+            self.messages_received += len(keys)
         local_mask, remote = self.partition(type_name, keys)
         if local_mask.any():
             idx = np.nonzero(local_mask)[0]
@@ -254,12 +361,41 @@ class VectorRouter:
             self.engine._wake_up()
         for target, idx in remote.items():
             if hops + 1 > self.silo.max_forward_count:
-                self.silo.logger.warn(
-                    f"dropping {len(idx)}-message slab for {type_name}: "
-                    f"exceeded max forward count", code=2910)
+                self._backoff_reinject(type_name, method, keys[idx],
+                                       _gather_args(args, idx), retries)
                 continue
             self.ship_slab(target, type_name, method, keys[idx],
-                           _gather_args(args, idx), hops=hops + 1)
+                           _gather_args(args, idx), hops=hops + 1,
+                           retries=retries)
+
+    def _backoff_reinject(self, type_name: str, method: str,
+                          keys: np.ndarray, args: Any, retries: int) -> None:
+        """Over-forwarded slab: park it and retry with a fresh hop budget
+        once ring views have had time to converge."""
+        if retries >= self.slab_retry_limit:
+            self.messages_dropped += len(keys)
+            self.silo.logger.error(
+                f"dropping {len(keys)}-message slab for {type_name} after "
+                f"{retries} backoff retries: ring views never converged",
+                code=2910)
+            return
+        self.slabs_requeued += 1
+        delay = min(0.05 * (2 ** retries), 1.0)
+
+        async def retry() -> None:
+            await asyncio.sleep(delay)
+            from orleans_tpu.runtime.silo import SiloStatus
+            if self.silo.status == SiloStatus.DEAD:
+                return
+            await self.inject_slab(type_name, method, keys, args,
+                                   hops=0, retries=retries + 1,
+                                   _recount=False)
+
+        # hold a strong reference: asyncio keeps only weak refs to tasks,
+        # and this task is the sole holder of the parked slab's data
+        task = asyncio.get_running_loop().create_task(retry())
+        self._retry_tasks.add(task)
+        task.add_done_callback(self._retry_tasks.discard)
 
     async def call_slab(self, type_name: str, method: str,
                         keys: np.ndarray, args: Any, hops: int = 1) -> Any:
@@ -312,7 +448,43 @@ class VectorRouter:
             "messages_shipped": self.messages_shipped,
             "slabs_received": self.slabs_received,
             "messages_received": self.messages_received,
+            "slabs_requeued": self.slabs_requeued,
+            "messages_dropped": self.messages_dropped,
         }
+
+
+class HandoffFenceStub:
+    """The 'vector_router' system target for a clustered silo WITHOUT a
+    tensor engine: it owns no vector rows, so its write-back for any ring
+    change is trivially complete — but peers' handoff fences still await
+    its release.  The stub broadcasts releases so mixed clusters (tensor
+    + non-tensor silos) settle in one RTT instead of stalling every ring
+    change to the fence timeout."""
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+        silo.ring.subscribe(lambda *_: self._broadcast())
+
+    def _view_digest(self):
+        return tuple(sorted(str(m) for m in self.silo.ring.members))
+
+    def _broadcast(self) -> None:
+        digest = self._view_digest()
+        for p in self.silo.ring.members:
+            if p != self.silo.address:
+                _send_release(self.silo, p, digest)
+
+    async def handoff_release(self, digest, sender) -> None:
+        pass  # no fence here: nothing ever defers activation
+
+    async def inject_slab(self, type_name: str, method: str,
+                          keys, args, hops: int = 0, retries: int = 0,
+                          _recount: bool = True) -> None:
+        self.silo.logger.error(
+            f"dropping {len(keys)}-message slab for {type_name}: this "
+            f"silo has no tensor engine (ring misconfiguration — "
+            f"non-tensor silos should not own vector key ranges)",
+            code=2913)
 
 
 class ClusterInjector:

@@ -177,6 +177,9 @@ async def run_presence_load(engine, n_players: int = 100_000,
         "messages": messages,
         "messages_per_sec": messages / elapsed,
         "mean_tick_seconds": elapsed / n_ticks,
+        # transparent auto-fusion may have engaged mid-run (the loader
+        # only ever calls inject()); report how much of the run it took
+        "autofuse": engine.autofuser.snapshot(),
     }
     if tick_durations:
         d = np.asarray(tick_durations)

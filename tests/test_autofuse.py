@@ -1,0 +1,370 @@
+"""Auto-fusion (tensor/autofuse.py): the engine's transparent steady-state
+compiler must never cost exactness or ordering.
+
+Scenarios: engagement after K steady ticks; window exactness vs the
+unfused engine; cold-destination rollback-and-replay; pattern-break
+disengagement replaying buffered ticks BEFORE the breaking tick
+(per-tick application order); static-leaf identity change disengaging
+instead of freezing values; rollback hysteresis banning thrashing
+patterns; the clustered ban for non-ring-owned key sets; and the engine
+loop's idle flush draining a partial window without an explicit flush().
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.config import TensorEngineConfig
+from orleans_tpu.core.grain import batched_method
+from orleans_tpu.tensor import (
+    Batch,
+    Emit,
+    TensorEngine,
+    VectorGrain,
+    field,
+    scatter_rows,
+    seg_sum,
+    vector_grain,
+)
+from orleans_tpu.tensor.vector_grain import scatter_add_rows
+
+from samples.presence import run_presence_load
+
+
+def _cfg(**kw) -> TensorEngineConfig:
+    base = dict(auto_fusion_ticks=3, auto_fusion_window=4,
+                tick_interval=0.0)
+    base.update(kw)
+    return TensorEngineConfig(**base)
+
+
+@vector_grain
+class LwwGrain(VectorGrain):
+    """Last-writer-wins register + delivery counter: 'value' exposes
+    application ORDER, 'count' exposes delivery EXACTNESS."""
+
+    value = field(jnp.int32, 0)
+    count = field(jnp.int32, 0)
+
+    @batched_method
+    @staticmethod
+    def put(state, batch: Batch, n_rows: int):
+        ones = jnp.ones_like(batch.rows, dtype=jnp.int32) * batch.mask
+        v = jnp.broadcast_to(jnp.asarray(batch.args["v"], jnp.int32),
+                             batch.rows.shape)
+        return {
+            **state,
+            "value": scatter_rows(state["value"], batch.rows, v),
+            "count": scatter_add_rows(state["count"], batch.rows, ones),
+        }
+
+
+@vector_grain
+class HopGrain(VectorGrain):
+    """Emits to a per-tick destination — lets a test steer emits at cold
+    keys to force fused-window rollbacks."""
+
+    sent = field(jnp.int32, 0)
+
+    @batched_method
+    @staticmethod
+    def send(state, batch: Batch, n_rows: int):
+        ones = jnp.ones_like(batch.rows, dtype=jnp.int32) * batch.mask
+        state = {**state,
+                 "sent": scatter_add_rows(state["sent"], batch.rows, ones)}
+        emit = Emit(interface="LwwGrain", method="put",
+                    keys=batch.args["dst"],
+                    args={"v": batch.args["v"]}, mask=batch.mask)
+        return state, None, (emit,)
+
+
+def _lww_state(engine, keys):
+    arena = engine.arena_for("LwwGrain")
+    rows = arena.resolve_rows(np.asarray(keys, dtype=np.int64))
+    return (np.asarray(arena.state["value"])[rows],
+            np.asarray(arena.state["count"])[rows])
+
+
+def test_engages_and_stays_exact(run):
+    """After auto_fusion_ticks identical ticks the engine fuses windows;
+    the loader only calls inject(); totals match the unfused engine."""
+
+    async def main():
+        n, T = 64, 24
+        keys = np.arange(n, dtype=np.int64)
+
+        engine = TensorEngine(config=_cfg())
+        inj = engine.make_injector("LwwGrain", "put", keys)
+        for t in range(T):
+            inj.inject({"v": np.full(n, t + 1, np.int32)})
+            await engine.drain_queues()
+        await engine.flush()
+
+        af = engine.autofuser
+        assert af.windows_run > 0, "auto-fusion never engaged"
+        assert af.ticks_fused > 0
+        assert af.windows_rolled_back == 0
+        value, count = _lww_state(engine, keys)
+        np.testing.assert_array_equal(count, T)      # exact delivery
+        np.testing.assert_array_equal(value, T)      # last writer wins
+        assert engine.messages_processed == n * T
+
+    run(main())
+
+
+def test_presence_autofuses_with_inject_only_loader(run):
+    """The presence loader (inject() per tick, nothing else) engages
+    auto-fusion and matches the unfused engine's totals — the r2
+    transparency criterion's exactness half."""
+
+    async def main():
+        n_players, n_games, T = 2000, 20, 16
+
+        plain = TensorEngine(
+            config=TensorEngineConfig(auto_fusion_ticks=0))
+        await run_presence_load(plain, n_players=n_players,
+                                n_games=n_games, n_ticks=T)
+
+        auto = TensorEngine(config=_cfg(auto_fusion_ticks=4))
+        stats = await run_presence_load(auto, n_players=n_players,
+                                        n_games=n_games, n_ticks=T)
+        assert stats["autofuse"]["windows_run"] > 0
+        assert stats["autofuse"]["ticks_fused"] > 0
+
+        for type_name, keys in (("PresenceGrain", np.arange(n_players)),
+                                ("GameGrain", np.arange(n_games))):
+            a_ref = plain.arena_for(type_name)
+            a_auto = auto.arena_for(type_name)
+            rows_ref = a_ref.resolve_rows(keys.astype(np.int64))
+            rows_auto = a_auto.resolve_rows(keys.astype(np.int64))
+            for col in a_ref.state:
+                np.testing.assert_allclose(
+                    np.asarray(a_auto.state[col])[rows_auto],
+                    np.asarray(a_ref.state[col])[rows_ref], rtol=1e-5,
+                    err_msg=f"{type_name}.{col} diverged under autofuse")
+
+    run(main())
+
+
+def test_rollback_replays_exactly_on_cold_destination(run):
+    """A fused window whose emits touch an unactivated key rolls back and
+    replays unfused — counts stay exact, the cold key activates."""
+
+    async def main():
+        n, T = 32, 24
+        src = np.arange(n, dtype=np.int64)
+        engine = TensorEngine(
+            config=_cfg(auto_fusion_max_rollbacks=100))
+        engine.arena_for("HopGrain").reserve(n)
+        engine.arena_for("LwwGrain").reserve(n + 64)
+        inj = engine.make_injector("HopGrain", "send", src)
+
+        cold_tick = 18  # far past engagement, inside a fused window
+        for t in range(T):
+            dst = np.full(n, 5000 if t == cold_tick else 0, np.int32)
+            inj.inject({"dst": dst, "v": np.full(n, t + 1, np.int32)})
+            await engine.drain_queues()
+        await engine.flush()
+
+        af = engine.autofuser
+        assert af.windows_run > 0
+        assert af.windows_rolled_back >= 1, \
+            "cold destination did not trigger a rollback"
+        sent = np.asarray(engine.arena_for("HopGrain").state["sent"])
+        rows = engine.arena_for("HopGrain").resolve_rows(src)
+        np.testing.assert_array_equal(sent[rows], T)  # every tick applied
+        # deliveries: T-1 ticks to key 0, one tick to the cold key 5000
+        value0, count0 = _lww_state(engine, [0])
+        valuec, countc = _lww_state(engine, [5000])
+        assert int(count0[0]) == n * (T - 1)
+        assert int(countc[0]) == n
+
+    run(main())
+
+
+def test_pattern_break_replays_buffer_before_breaking_tick(run):
+    """Buffered window ticks must apply BEFORE the tick that broke the
+    pattern — the breaking write wins the last-writer-wins register."""
+
+    async def main():
+        n = 16
+        keys = np.arange(n, dtype=np.int64)
+        engine = TensorEngine(config=_cfg(auto_fusion_window=8))
+        inj = engine.make_injector("LwwGrain", "put", keys)
+
+        # engage, then leave 2 ticks buffered in a partial window
+        t_total = 0
+        for t in range(8):
+            inj.inject({"v": np.full(n, t + 1, np.int32)})
+            await engine.drain_queues()
+            t_total += 1
+        assert engine.autofuser.has_buffer(), \
+            "test setup: expected a partially-filled window"
+
+        # breaking tick: different key-set identity → signature break
+        other_keys = np.arange(n, dtype=np.int64)
+        engine.send_batch("LwwGrain", "put", other_keys,
+                          {"v": np.full(n, 99, np.int32)})
+        await engine.drain_queues()
+        t_total += 1
+        await engine.flush()
+
+        value, count = _lww_state(engine, keys)
+        np.testing.assert_array_equal(count, t_total)  # nothing lost
+        # ordering: buffered ticks (values ≤ 8) replayed BEFORE 99
+        np.testing.assert_array_equal(value, 99)
+
+    run(main())
+
+
+def test_static_leaf_identity_change_disengages(run):
+    """A leaf that was static at engage time changing identity mid-window
+    disengages (and replays) instead of silently freezing its value."""
+
+    async def main():
+        n, T = 32, 12
+        src = np.arange(n, dtype=np.int64)
+        engine = TensorEngine(config=_cfg(auto_fusion_window=8))
+        engine.arena_for("LwwGrain").reserve(n + 8)
+        engine.arena_for("LwwGrain").resolve_rows(
+            np.arange(2, dtype=np.int64))
+        inj = engine.make_injector("HopGrain", "send", src)
+
+        dst_static = np.zeros(n, np.int32)  # same identity → static leaf
+        for t in range(T):
+            inj.inject({"dst": dst_static,
+                        "v": np.full(n, t + 1, np.int32)})
+            await engine.drain_queues()
+        assert engine.autofuser._program is not None, \
+            "test setup: expected an engaged window"
+        assert "dst" in engine.autofuser._static_args
+
+        # mid-window: dst changes identity AND value — the new value must
+        # apply (a frozen static would keep delivering to key 0)
+        for t in range(T, T + 4):
+            inj.inject({"dst": np.ones(n, np.int32),
+                        "v": np.full(n, t + 1, np.int32)})
+            await engine.drain_queues()
+        await engine.flush()
+
+        _, count0 = _lww_state(engine, [0])
+        _, count1 = _lww_state(engine, [1])
+        assert int(count0[0]) == n * T
+        assert int(count1[0]) == n * 4, \
+            "post-change dst values were dropped (frozen static leaf)"
+
+    run(main())
+
+
+def test_rollback_hysteresis_bans_thrashing_pattern(run):
+    """A pattern that rolls back auto_fusion_max_rollbacks times is banned
+    — no further windows run for it (until ring/generation change)."""
+
+    async def main():
+        n, T, W = 32, 64, 4
+        src = np.arange(n, dtype=np.int64)
+        engine = TensorEngine(
+            config=_cfg(auto_fusion_max_rollbacks=2, auto_fusion_window=W))
+        engine.arena_for("HopGrain").reserve(n)
+        engine.arena_for("LwwGrain").reserve(4096)
+        inj = engine.make_injector("HopGrain", "send", src)
+
+        # every window touches a fresh cold key → rollback every window
+        for t in range(T):
+            dst = np.full(n, 100 + t // W, np.int32)
+            inj.inject({"dst": dst, "v": np.full(n, 1, np.int32)})
+            await engine.drain_queues()
+        await engine.flush()
+
+        af = engine.autofuser
+        assert af.windows_rolled_back == 2, \
+            f"expected exactly 2 rollbacks then a ban, " \
+            f"got {af.windows_rolled_back}"
+        assert af._disabled, "thrashing signature was not banned"
+        # exactness throughout: every tick delivered to its window's key
+        sent = np.asarray(engine.arena_for("HopGrain").state["sent"])
+        rows = engine.arena_for("HopGrain").resolve_rows(src)
+        np.testing.assert_array_equal(sent[rows], T)
+        total = 0
+        for w in range(T // W):
+            _, c = _lww_state(engine, [100 + w])
+            total += int(c[0])
+        assert total == n * T
+
+    run(main())
+
+
+def test_clustered_ban_for_remote_keys(run):
+    """On a clustered silo a steady pattern whose key set is not entirely
+    ring-owned must never fuse — a fused window would freeze remote keys
+    into a local program.  (Simulates a stale/bypassed ownership split: a
+    BatchInjector constructed directly instead of via make_injector.)"""
+
+    async def main():
+        from orleans_tpu.tensor.engine import BatchInjector
+        from orleans_tpu.testing.cluster import TestingCluster
+
+        cluster = TestingCluster(n_silos=2)
+        await cluster.start()
+        try:
+            s0 = cluster.silos[0]
+            engine = s0.tensor_engine
+            engine.config.auto_fusion_ticks = 3
+            keys = np.arange(64, dtype=np.int64)
+            _, remote = s0.vector_router.partition("LwwGrain", keys)
+            assert remote, "test setup: expected a split key set"
+            T = 12
+            inj = BatchInjector(engine, "LwwGrain", "put", keys)
+            for t in range(T):
+                inj.inject({"v": np.full(len(keys), t + 1, np.int32)})
+                await engine.drain_queues()
+            await cluster.quiesce_engines()
+
+            assert engine.autofuser.windows_run == 0
+            assert engine.autofuser._disabled, \
+                "mixed-ownership signature was not banned"
+            # delivery stayed exact through the unfused path
+            arena = engine.arenas["LwwGrain"]
+            rows, found = arena.lookup_rows(keys)
+            assert found.all()
+            counts = np.asarray(arena.state["count"])[rows]
+            np.testing.assert_array_equal(counts, T)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_idle_flush_drains_partial_window(run):
+    """With the engine LOOP running, a partially-filled window drains by
+    itself after the idle grace — no explicit flush() needed."""
+
+    async def main():
+        n = 16
+        keys = np.arange(n, dtype=np.int64)
+        engine = TensorEngine(config=_cfg(
+            auto_fusion_window=16, auto_fusion_idle_flush=0.05,
+            tick_interval=0.001))
+        engine.start()
+        try:
+            inj = engine.make_injector("LwwGrain", "put", keys)
+            T = 8
+            for t in range(T):
+                inj.inject({"v": np.full(n, t + 1, np.int32)})
+                await asyncio.sleep(0.005)
+            # wait for engagement + buffering + idle grace to elapse
+            deadline = asyncio.get_running_loop().time() + 5.0
+            while asyncio.get_running_loop().time() < deadline:
+                _, count = _lww_state(engine, keys)
+                if (count == T).all():
+                    break
+                await asyncio.sleep(0.02)
+            value, count = _lww_state(engine, keys)
+            np.testing.assert_array_equal(count, T)
+            np.testing.assert_array_equal(value, T)
+            assert not engine.autofuser.has_buffer()
+        finally:
+            await engine.stop()
+
+    run(main())
