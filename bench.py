@@ -31,6 +31,7 @@ def _quiet() -> None:
 
 async def _tensor_presence(n_players: int, n_games: int, n_ticks: int,
                            latency_ticks: int, warmup_ticks: int = 2) -> dict:
+    from orleans_tpu.config import TensorEngineConfig
     from orleans_tpu.tensor import TensorEngine
     from samples.presence import run_presence_load, run_presence_load_fused
 
@@ -50,14 +51,81 @@ async def _tensor_presence(n_players: int, n_games: int, n_ticks: int,
     stats["tick_p99_seconds"] = lat["tick_p99_seconds"]
     stats["latency_ticks"] = latency_ticks
     # transparency: also measure the unfused (per-round dispatch) engine
-    engine2 = TensorEngine()
+    # with auto-fusion OFF — the floor the fused tiers are compared to.
+    # Median of 3 short passes: tunneled-runtime throughput varies
+    # several-fold between moments, and a single 4-tick sample has been
+    # observed anywhere in that range
+    engine2 = TensorEngine(config=TensorEngineConfig(auto_fusion_ticks=0))
     await run_presence_load(engine2, n_players=n_players, n_games=n_games,
                             n_ticks=warmup_ticks)
-    unfused = await run_presence_load(engine2, n_players=n_players,
-                                      n_games=n_games,
-                                      n_ticks=max(4, n_ticks // 4))
-    stats["unfused_msgs_per_sec"] = unfused["messages_per_sec"]
+    unfused_runs = []
+    for _ in range(3):
+        u = await run_presence_load(engine2, n_players=n_players,
+                                    n_games=n_games,
+                                    n_ticks=max(4, n_ticks // 4))
+        unfused_runs.append(u["messages_per_sec"])
+    unfused_runs.sort()
+    stats["unfused_msgs_per_sec"] = unfused_runs[1]
+    # AUTO-fused: default engine config, loader calls nothing but
+    # inject() — the transparent tier's steady state.  The warm phase
+    # lets detection engage + compile; the warm-end flush resets the
+    # window, so the measured segment is exactly 1 re-detection tick +
+    # whole windows (re-engagement threshold is 2 for a cached program)
+    # and ends on a window boundary with nothing left to replay.
+    engine3 = TensorEngine()
+    w = engine3.config.auto_fusion_window
+    auto = await run_presence_load(
+        engine3, n_players=n_players, n_games=n_games,
+        n_ticks=1 + 3 * w,
+        warm_ticks=engine3.config.auto_fusion_ticks + 2 * w + 8)
+    stats["autofused_msgs_per_sec"] = auto["messages_per_sec"]
+    stats["autofuse"] = auto["autofuse"]
     return stats
+
+
+async def _presence_operating_points(n_players: int, n_games: int,
+                                     budgets, smoke: bool) -> list:
+    """The latency half of the north-star metric: (msgs/sec, true-p99)
+    pairs at bounded latency budgets, adaptive tick controller honoring
+    each budget (engine._adapt), plus the max-throughput point reported
+    separately by the headline run."""
+    from orleans_tpu.tensor import TensorEngine
+    from samples.presence import measure_sync_floor, run_presence_bounded
+
+    engine = TensorEngine()
+    # the rig's completion-observation floor (tunneled runtimes notify
+    # completion on a ~100ms cadence; direct-attached TPUs measure ~0) —
+    # subtracted for honoring decisions, published for the reader
+    floor, floor_p95 = measure_sync_floor()
+    n_ticks = 24 if smoke else 60
+    points = []
+    for budget in budgets:
+        rate = None
+        stats = None
+        for _attempt in range(4):
+            stats = await run_presence_bounded(
+                engine, n_players=n_players, n_games=n_games,
+                budget=budget, offered_rate=rate, n_ticks=n_ticks,
+                sync_floor=floor, sync_floor_p95=floor_p95)
+            if stats["honored"]:
+                break
+            rate = stats["offered_rate"] * 0.7  # overshot: offer less
+        points.append({
+            "budget_s": budget,
+            "msgs_per_sec": round(stats["messages_per_sec"], 1),
+            "msgs_per_sec_net_of_floor": round(
+                stats["messages_per_sec_net"], 1),
+            "p99_turn_latency_s": round(stats["tick_p99_seconds"], 4),
+            "p99_net_of_floor_s": round(stats["tick_p99_net_seconds"], 4),
+            "p50_turn_latency_s": round(stats["tick_p50_seconds"], 4),
+            "sync_floor_s": round(floor, 4),
+            "sync_floor_p95_s": round(floor_p95, 4),
+            "honored": stats["honored"],
+            "honored_strict": stats["honored_strict"],
+            "mean_batch_per_tick": round(stats["mean_batch"], 1),
+            "measured_ticks": stats["ticks"],
+        })
+    return points
 
 
 async def _tensor_chirper(n_accounts: int, mean_followers: float,
@@ -113,6 +181,61 @@ async def _tensor_gps(n_devices: int, n_ticks: int,
                                  n_ticks=max(2, n_ticks // 4))
     stats["unfused_msgs_per_sec"] = unfused["messages_per_sec"]
     return stats
+
+
+async def _tensor_twitter(n_tweets_per_tick: int, n_hashtags: int,
+                          n_ticks: int, latency_ticks: int) -> dict:
+    from orleans_tpu.tensor import TensorEngine
+    from samples.twitter_sentiment import run_twitter_load
+
+    engine = TensorEngine()
+    stats = await run_twitter_load(engine,
+                                   n_tweets_per_tick=n_tweets_per_tick,
+                                   n_hashtags=n_hashtags, n_ticks=n_ticks,
+                                   warm_ticks=2)
+    lat = await run_twitter_load(engine,
+                                 n_tweets_per_tick=n_tweets_per_tick,
+                                 n_hashtags=n_hashtags,
+                                 n_ticks=latency_ticks, seed=1,
+                                 warm_ticks=2, measure_latency=True)
+    stats["tick_p50_seconds"] = lat["tick_p50_seconds"]
+    stats["tick_p99_seconds"] = lat["tick_p99_seconds"]
+    stats["latency_ticks"] = latency_ticks
+    return stats
+
+
+async def _host_twitter_baseline(n_tweets: int = 500,
+                                 n_hashtags: int = 200,
+                                 tags_per_tweet: int = 2,
+                                 n_rounds: int = 3) -> float:
+    """Per-message actor path: one AddScore RPC per (tweet, hashtag) —
+    the reference's dispatcher → hashtag-grain execution model."""
+    import numpy as np
+
+    from samples.twitter_host import IHostHashtag
+    from orleans_tpu.runtime.silo import Silo
+
+    rng = np.random.default_rng(0)
+    silo = Silo(name="twitter-baseline")
+    await silo.start()
+    try:
+        factory = silo.attach_client()
+        refs = [factory.get_grain(IHostHashtag, i)
+                for i in range(n_hashtags)]
+        # warm activation pass
+        await asyncio.gather(*(r.add_score(0) for r in refs))
+        m = n_tweets * tags_per_tweet
+        t0 = time.perf_counter()
+        for _ in range(n_rounds):
+            idx = rng.integers(0, n_hashtags, m)
+            scores = rng.integers(-1, 2, m)
+            await asyncio.gather(*(refs[int(i)].add_score(int(s))
+                                   for i, s in zip(idx, scores)))
+        elapsed = time.perf_counter() - t0
+        # one dispatcher message per tweet + one AddScore per tag
+        return (n_tweets + m) * n_rounds / elapsed
+    finally:
+        await silo.stop(graceful=False)
 
 
 async def _host_gps_baseline(n_devices: int = 1000,
@@ -210,12 +333,19 @@ def main() -> None:
     parser.add_argument("--smoke", action="store_true",
                         help="small sizes for a quick correctness pass")
     parser.add_argument("--workload",
-                        choices=("presence", "chirper", "gpstracker"),
+                        choices=("presence", "chirper", "gpstracker",
+                                 "twitter"),
                         default="presence")
+    parser.add_argument("--target-latency", type=float, default=None,
+                        help="publish ONE latency-bounded presence "
+                             "operating point at this p99 budget (seconds) "
+                             "instead of the default 10ms + 50ms pair")
     parser.add_argument("--players", type=int, default=1_000_000)
     parser.add_argument("--games", type=int, default=10_000)
     parser.add_argument("--accounts", type=int, default=200_000)
     parser.add_argument("--devices", type=int, default=200_000)
+    parser.add_argument("--tweets-per-tick", type=int, default=100_000)
+    parser.add_argument("--hashtags", type=int, default=20_000)
     parser.add_argument("--mean-followers", type=float, default=25.0)
     parser.add_argument("--ticks", type=int, default=20)
     parser.add_argument("--latency-ticks", type=int, default=100)
@@ -226,6 +356,7 @@ def main() -> None:
         args.players, args.games, args.ticks = 10_000, 100, 5
         args.accounts, args.mean_followers = 5_000, 10.0
         args.devices = 5_000
+        args.tweets_per_tick, args.hashtags = 5_000, 500
         args.latency_ticks = 20
 
     async def run_chirper() -> dict:
@@ -280,6 +411,10 @@ def main() -> None:
     async def run() -> dict:
         stats = await _tensor_presence(args.players, args.games, args.ticks,
                                        args.latency_ticks)
+        budgets = ([args.target_latency] if args.target_latency
+                   else [0.010, 0.050])
+        points = await _presence_operating_points(
+            args.players, args.games, budgets, args.smoke)
         baseline = await _host_baseline()
         return {
             "metric": "presence_grain_messages_per_sec",
@@ -297,16 +432,58 @@ def main() -> None:
             "engine": "fused (one compiled program per tick window); "
                       "delivery exactness asserted via device miss counter",
             "unfused_msgs_per_sec": round(stats["unfused_msgs_per_sec"], 1),
+            "autofused_msgs_per_sec": round(stats["autofused_msgs_per_sec"],
+                                            1),
+            "autofused_vs_fused": round(stats["autofused_msgs_per_sec"]
+                                        / stats["messages_per_sec"], 3),
+            "autofuse": stats["autofuse"],
             "p99_turn_latency_s": round(stats["tick_p99_seconds"], 4),
             "p50_turn_latency_s": round(stats["tick_p50_seconds"], 4),
             "latency_def": f"true p99 over {stats['latency_ticks']} "
                            "device-synced single-tick windows of inject-to-"
                            "completion wall time; every message injected in "
-                           "a tick completes within that tick",
+                           "a tick completes within that tick. Raw values "
+                           "include the rig's completion-observation floor "
+                           "(sync_floor_s in the operating points): "
+                           "tunneled runtimes notify completion on a "
+                           "~100ms cadence, flooring every host-side "
+                           "latency MEASUREMENT independent of actual "
+                           "device latency",
+            # the other half of the north-star metric: throughput at
+            # BOUNDED p99 budgets, adaptive controller active; the
+            # headline value above is the max-throughput (unbounded) point
+            "latency_operating_points": points,
+        }
+
+    async def run_twitter() -> dict:
+        stats = await _tensor_twitter(args.tweets_per_tick, args.hashtags,
+                                      args.ticks, args.latency_ticks)
+        baseline = await _host_twitter_baseline()
+        return {
+            "metric": "twitter_grain_messages_per_sec",
+            "value": round(stats["messages_per_sec"], 1),
+            "unit": "msg/s",
+            "vs_baseline": round(stats["messages_per_sec"] / baseline, 2),
+            "baseline_msgs_per_sec": round(baseline, 1),
+            "baseline_def": "single-silo CPU per-message actor dispatch "
+                            "(this framework's Python host path, 500 "
+                            "tweets/round sub-sampled); one AddScore RPC "
+                            "per (tweet, hashtag)",
+            "grains": args.hashtags + 1,
+            "tweets": stats["tweets"],
+            "ticks": args.ticks,
+            "engine": "unfused batched tier (Zipf hot-row fan-in via "
+                      "sign-split segment sums; per-tick batch through "
+                      "send_batch)",
+            "p99_turn_latency_s": round(stats["tick_p99_seconds"], 4),
+            "p50_turn_latency_s": round(stats["tick_p50_seconds"], 4),
+            "latency_def": f"true p99 over {stats['latency_ticks']} "
+                           "device-synced ticks (tweet batch inject to "
+                           "counter-visible completion)",
         }
 
     runners = {"presence": run, "chirper": run_chirper,
-               "gpstracker": run_gps}
+               "gpstracker": run_gps, "twitter": run_twitter}
     result = asyncio.run(runners[args.workload]())
     print(json.dumps(result))
 

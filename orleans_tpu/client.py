@@ -161,6 +161,25 @@ class GrainClient:
             cb.future.set_exception(RequestTimeoutError(
                 f"client request {cb.message} timed out"))
 
+    # ================= batched vector edge ================================
+
+    def send_batch(self, interface, method: str, keys, args: Any,
+                   want_results: bool = False) -> Optional[asyncio.Future]:
+        """Ship a whole (keys, args) vector slab into the cluster as ONE
+        gateway frame (north star: 'batched adjacency+payload tensors'
+        from the client side; the reference's client edge is one proxy
+        message per call, Gateway.cs:37).  The gateway silo routes the
+        slab through its VectorRouter — never the per-message path.
+        ``want_results=True`` returns a future resolving to the result
+        pytree in the caller's key order."""
+        import numpy as np
+        type_name = interface if isinstance(interface, str) \
+            else interface.__name__
+        keys = np.asarray(keys, dtype=np.int64)
+        gateway = self._next_gateway()
+        return gateway.send_client_batch(type_name, method, keys, args,
+                                         want_results=want_results)
+
     # ================= receive path =======================================
 
     def _on_message(self, msg: Message) -> None:
@@ -248,6 +267,9 @@ class TcpGatewayHandle:
         self._pump: Optional[asyncio.Task] = None
         # control replies ("welcome"/"ok") resolve in arrival order
         self._control_waiters: "asyncio.Queue[asyncio.Future]" = None
+        # vector batch_id → result future (out-of-order safe)
+        self._batch_waiters: Dict[int, asyncio.Future] = {}
+        self._next_batch_id = 0
 
     @classmethod
     async def open(cls, host: str, port: int, client_id: GrainId,
@@ -275,6 +297,16 @@ class TcpGatewayHandle:
                 frame = await read_gateway_frame(self._reader)
                 if isinstance(frame, Message):
                     self._on_message(_rebase_expiration_inbound(frame))
+                elif isinstance(frame, dict) \
+                        and frame.get("op") == "batch_result":
+                    waiter = self._batch_waiters.pop(frame["batch_id"],
+                                                     None)
+                    if waiter is not None and not waiter.done():
+                        if "error" in frame:
+                            waiter.set_exception(
+                                RuntimeError(frame["error"]))
+                        else:
+                            waiter.set_result(frame.get("result"))
                 else:  # control reply
                     waiter = self._control_waiters.get_nowait() \
                         if not self._control_waiters.empty() else None
@@ -293,11 +325,37 @@ class TcpGatewayHandle:
                 if not waiter.done():
                     waiter.set_exception(ConnectionError(
                         f"gateway {self.host}:{self.port} disconnected"))
+            # likewise in-flight want_results batch futures — a dead
+            # socket can never deliver their result slabs
+            waiters, self._batch_waiters = self._batch_waiters, {}
+            for waiter in waiters.values():
+                if not waiter.done():
+                    waiter.set_exception(ConnectionError(
+                        f"gateway {self.host}:{self.port} disconnected"))
 
     def submit(self, msg: Message) -> None:
         if not self.alive:
             raise ConnectionError(f"gateway {self.host}:{self.port} is down")
         write_gateway_frame(self._writer, _with_ttl(msg))
+
+    def send_client_batch(self, type_name: str, method: str, keys, args,
+                          want_results: bool = False
+                          ) -> Optional[asyncio.Future]:
+        """One (keys, args) slab → one gateway frame (codec ndarray
+        tokens); results (if requested) come back as one slab too."""
+        if not self.alive:
+            raise ConnectionError(f"gateway {self.host}:{self.port} is down")
+        frame = {"op": "vector_batch", "type": type_name, "method": method,
+                 "keys": keys, "args": args}
+        future: Optional[asyncio.Future] = None
+        if want_results:
+            self._next_batch_id += 1
+            frame["batch_id"] = self._next_batch_id
+            frame["want_results"] = True
+            future = asyncio.get_running_loop().create_future()
+            self._batch_waiters[frame["batch_id"]] = future
+        write_gateway_frame(self._writer, frame)
+        return future
 
     async def _control(self, record: dict) -> dict:
         if not self.alive:

@@ -89,6 +89,14 @@ class TensorEngineConfig:
     # max parked optimistic miss-checks before a forced (synchronizing)
     # drain — bounds device memory pinned by deferred delivery checks
     miss_check_cap: int = 16
+    # periodic arena write-back cadence (ticks; 0 = only explicit
+    # checkpoints): bounds the state-loss window when a silo is KILLED
+    # (no goodbye, no graceful handoff write-back) to at most this many
+    # ticks of updates — survivors re-activate the dead silo's keys from
+    # the last periodic checkpoint.  Each checkpoint is a full
+    # device→host read of every live row, so small values trade
+    # throughput for a tighter loss bound.
+    checkpoint_every_ticks: int = 0
     # auto-fusion (tensor/autofuse.py): after auto_fusion_ticks
     # consecutive ticks with an identical injection pattern the engine
     # transparently compiles the steady tick into a fused window of
@@ -101,6 +109,11 @@ class TensorEngineConfig:
     # repeated rollbacks mean the workload regularly touches cold keys
     # and fusion only adds snapshot + replay cost
     auto_fusion_max_rollbacks: int = 3
+    # windows per exactness-verification sync: the device-side miss
+    # counter is read once per this many windows (completion observation
+    # costs ~100ms on tunneled runtimes), so a rollback replays up to
+    # verify_windows * window ticks; 1 = verify every window
+    auto_fusion_verify_windows: int = 4
     # idle grace before a partially-filled window replays unfused: if no
     # new work arrives for this long the engine's loop drains the buffer
     # so mid-window ticks never strand awaiting an explicit flush()

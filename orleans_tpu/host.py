@@ -86,17 +86,34 @@ def build_silo(config: Dict[str, Any],
     port = int(config.get("port", 0)) or fabric.reserve()
 
     membership_table = None
-    if config.get("membership_db"):
+    reminder_table = None
+    if config.get("table_service"):
+        # networked system tables: machines with NO shared disk form a
+        # cluster by pointing at one table service endpoint
+        # ("host:port" or {"host":..., "port":...}) — the reference's
+        # ZooKeeper/SQL/Azure table role (plugins/table_service.py)
+        from orleans_tpu.plugins.table_service import (
+            RemoteMembershipTable,
+            RemoteReminderTable,
+        )
+        spec = config["table_service"]
+        if isinstance(spec, str):
+            ts_host, _, ts_port = spec.rpartition(":")
+            spec = {"host": ts_host or "127.0.0.1", "port": int(ts_port)}
+        membership_table = RemoteMembershipTable(spec["host"],
+                                                 int(spec["port"]))
+        reminder_table = RemoteReminderTable(spec["host"],
+                                             int(spec["port"]))
+    if membership_table is None and config.get("membership_db"):
         from orleans_tpu.plugins.sqlite_tables import SqliteMembershipTable
         membership_table = SqliteMembershipTable(config["membership_db"])
-    elif config.get("membership_file"):
+    elif membership_table is None and config.get("membership_file"):
         from orleans_tpu.plugins.file_tables import FileMembershipTable
         membership_table = FileMembershipTable(config["membership_file"])
-    reminder_table = None
-    if config.get("reminder_db"):
+    if reminder_table is None and config.get("reminder_db"):
         from orleans_tpu.plugins.sqlite_tables import SqliteReminderTable
         reminder_table = SqliteReminderTable(config["reminder_db"])
-    elif config.get("reminder_file"):
+    elif reminder_table is None and config.get("reminder_file"):
         from orleans_tpu.plugins.file_tables import FileReminderTable
         reminder_table = FileReminderTable(config["reminder_file"])
 

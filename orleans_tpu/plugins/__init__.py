@@ -26,6 +26,11 @@ from orleans_tpu.plugins.stats_publisher import (
     SqliteStatisticsPublisher,
     StatisticsPublisher,
 )
+from orleans_tpu.plugins.table_service import (
+    RemoteMembershipTable,
+    RemoteReminderTable,
+    TableServiceServer,
+)
 
 __all__ = [
     "FileMembershipTable",
@@ -33,6 +38,8 @@ __all__ = [
     "GatewayListProvider",
     "LogStatisticsPublisher",
     "MembershipGatewayListProvider",
+    "RemoteMembershipTable",
+    "RemoteReminderTable",
     "SqliteMembershipTable",
     "SqliteQueueAdapter",
     "SqliteQueueReceiver",
@@ -40,4 +47,5 @@ __all__ = [
     "SqliteStatisticsPublisher",
     "StaticGatewayListProvider",
     "StatisticsPublisher",
+    "TableServiceServer",
 ]

@@ -1,0 +1,254 @@
+"""Networked system-table service: membership + reminders over TCP.
+
+The reference ships three NETWORK table backends so machines with no
+shared disk can form a cluster (reference:
+OrleansZooKeeperUtils/ZooKeeperBasedMembershipTable.cs:58,
+OrleansSQLUtils/SqlMembershipTable.cs:34,
+OrleansAzureUtils/AzureBasedMembershipTable.cs:37).  The sqlite/file
+families in this package are same-machine only; this module closes the
+gap with the smallest honest equivalent: a standalone asyncio service
+hosting the in-memory tables behind their EXACT contracts (CAS etags +
+table version for membership, per-row etags for reminders), and client
+table classes any silo can point at over the wire.
+
+Wire protocol: length-prefixed frames; payload = codec-serialized
+``(request_id, method, args)`` request and ``(request_id, kind, value)``
+response, where kind is "ok" / "cas" (CasConflictError — re-raised
+client-side so the oracle's read-retry discipline is untouched) /
+"error".  One persistent connection per client table with transparent
+reconnect: the CAS contract makes every write safe to retry after a
+dropped connection (a duplicate write surfaces as a version conflict,
+which the caller already handles).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+from orleans_tpu.codec import default_manager
+from orleans_tpu.runtime.membership import (
+    CasConflictError,
+    InMemoryMembershipTable,
+)
+from orleans_tpu.runtime.reminders import InMemoryReminderTable
+
+MAGIC = 0x54424C53  # "TBLS"
+_HDR = struct.Struct("<II")
+
+
+def _encode_frame(obj: Any) -> bytes:
+    payload = default_manager.serialize(obj)
+    return _HDR.pack(MAGIC, len(payload)) + payload
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_HDR.size)
+    magic, length = _HDR.unpack(header)
+    if magic != MAGIC:
+        raise ConnectionError(f"bad table-service frame magic {magic:#x}")
+    return default_manager.deserialize(await reader.readexactly(length))
+
+
+class TableServiceServer:
+    """Hosts the system tables for a cluster (run one instance, like the
+    reference's ZooKeeper ensemble / SQL server endpoint)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 membership_table=None, reminder_table=None) -> None:
+        self.host = host
+        self.port = port
+        # any object honoring the contracts works — the in-memory tables
+        # by default, or the sqlite tables for a DURABLE network service
+        self.membership = membership_table or InMemoryMembershipTable()
+        self.reminders = reminder_table or InMemoryReminderTable()
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.requests_served = 0
+
+    async def start(self) -> "TableServiceServer":
+        self._server = await asyncio.start_server(
+            self._serve_client, host=self.host, port=self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    async def _serve_client(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    request_id, method, args = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                self.requests_served += 1
+                try:
+                    target, name = method.split(".", 1)
+                    table = {"membership": self.membership,
+                             "reminders": self.reminders}[target]
+                    result = await getattr(table, name)(*args)
+                    reply = (request_id, "ok", result)
+                except CasConflictError as exc:
+                    reply = (request_id, "cas", str(exc))
+                except Exception as exc:  # noqa: BLE001 — ship to caller
+                    reply = (request_id, "error",
+                             f"{type(exc).__name__}: {exc}")
+                writer.write(_encode_frame(reply))
+                await writer.drain()
+        finally:
+            writer.close()
+
+
+class _TableClient:
+    """Shared RPC plumbing for the remote table classes: one persistent
+    connection, request/response correlation, reconnect-and-retry (safe:
+    every contract write is CAS-guarded)."""
+
+    def __init__(self, host: str, port: int,
+                 connect_timeout: float = 5.0, retries: int = 3) -> None:
+        self.host = host
+        self.port = port
+        self.connect_timeout = connect_timeout
+        self.retries = retries
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pump: Optional[asyncio.Task] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._lock = asyncio.Lock()
+
+    async def _connect(self) -> None:
+        if self._writer is not None:
+            return
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port),
+            self.connect_timeout)
+        self._pump = asyncio.get_running_loop().create_task(
+            self._pump_responses())
+
+    def _drop_connection(self, exc: Exception) -> None:
+        if self._writer is not None:
+            self._writer.close()
+        self._reader = self._writer = None
+        if self._pump is not None:
+            self._pump.cancel()
+            self._pump = None
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+    async def _pump_responses(self) -> None:
+        try:
+            while True:
+                request_id, kind, value = await _read_frame(self._reader)
+                fut = self._pending.pop(request_id, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == "ok":
+                    fut.set_result(value)
+                elif kind == "cas":
+                    fut.set_exception(CasConflictError(value))
+                else:
+                    fut.set_exception(RuntimeError(value))
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError) as exc:
+            if not isinstance(exc, asyncio.CancelledError):
+                self._drop_connection(
+                    ConnectionError("table service connection lost"))
+
+    async def call(self, method: str, *args: Any) -> Any:
+        last: Optional[Exception] = None
+        for attempt in range(self.retries):
+            try:
+                async with self._lock:
+                    await self._connect()
+                    self._next_id += 1
+                    request_id = self._next_id
+                    fut = asyncio.get_running_loop().create_future()
+                    self._pending[request_id] = fut
+                    self._writer.write(
+                        _encode_frame((request_id, method, list(args))))
+                    await self._writer.drain()
+                return await fut
+            except CasConflictError:
+                raise  # contract signal, not a transport failure
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError) as exc:
+                last = exc
+                self._drop_connection(
+                    ConnectionError("table service call failed"))
+                await asyncio.sleep(0.05 * (attempt + 1))
+        raise ConnectionError(
+            f"table service at {self.host}:{self.port} unreachable "
+            f"after {self.retries} attempts") from last
+
+    def close(self) -> None:
+        self._drop_connection(ConnectionError("client closed"))
+
+
+class RemoteMembershipTable:
+    """IMembershipTable contract over the wire (reference:
+    ZooKeeperBasedMembershipTable.cs:58 — same role: a shared external
+    CAS store that lets silos with no common disk form a cluster)."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self._client = _TableClient(host, port)
+
+    async def read_all(self):
+        return await self._client.call("membership.read_all")
+
+    async def insert_row(self, entry, table_version: int) -> None:
+        await self._client.call("membership.insert_row", entry,
+                                table_version)
+
+    async def update_row(self, entry, etag: int,
+                         table_version: int) -> None:
+        await self._client.call("membership.update_row", entry, etag,
+                                table_version)
+
+    async def update_iam_alive(self, silo, when: float) -> None:
+        await self._client.call("membership.update_iam_alive", silo, when)
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class RemoteReminderTable:
+    """ReminderTable contract over the wire (reference:
+    AzureBasedReminderTable / SqlReminderTable — the shared durable
+    reminder store)."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self._client = _TableClient(host, port)
+
+    async def init(self) -> None:  # noqa: B027 — contract hook
+        pass
+
+    async def read_row(self, grain_id, name):
+        return await self._client.call("reminders.read_row", grain_id,
+                                       name)
+
+    async def read_rows(self, grain_id):
+        return await self._client.call("reminders.read_rows", grain_id)
+
+    async def read_all(self):
+        return await self._client.call("reminders.read_all")
+
+    async def upsert_row(self, entry):
+        return await self._client.call("reminders.upsert_row", entry)
+
+    async def remove_row(self, grain_id, name, etag):
+        return await self._client.call("reminders.remove_row", grain_id,
+                                       name, etag)
+
+    def close(self) -> None:
+        self._client.close()

@@ -116,6 +116,34 @@ class Gateway:
         else:
             self.silo.message_center.send_message(msg)
 
+    # -- inbound vector batches (the batched client edge) -------------------
+
+    def submit_batch(self, type_name: str, method: str, keys, args,
+                     want_results: bool = False):
+        """A client pushed a whole (keys, args) vector slab through this
+        silo — the batched client edge the north star demands ('batched
+        adjacency+payload tensors' instead of the reference's per-message
+        Gateway.cs:37 proxy loop).  Routes through the tensor engine —
+        in cluster mode that is the VectorRouter's ownership split —
+        NEVER through the per-message dispatcher."""
+        engine = self.silo.tensor_engine
+        if engine is None:
+            raise RuntimeError(
+                f"silo {self.silo.name} has no tensor engine; vector "
+                f"batches need one (config.tensor.enabled)")
+        return engine.send_batch(type_name, method, keys, args,
+                                 want_results=want_results)
+
+    def send_client_batch(self, type_name: str, method: str, keys, args,
+                          want_results: bool = False):
+        """In-process client edge for vector slabs — wire-fidelity
+        roundtrips the slab through the codec (the ndarray tokens a real
+        socket would carry) before it enters the engine."""
+        if self.wire_fidelity:
+            keys, args = codec.deserialize(codec.serialize((keys, args)))
+        return self.submit_batch(type_name, method, keys, args,
+                                 want_results=want_results)
+
     # -- outbound to clients (reference: Gateway reply routing) ------------
 
     def deliver(self, msg: Message) -> None:
@@ -215,7 +243,47 @@ class GatewayAcceptor:
                                    already_wired=True)
                 elif isinstance(frame, dict):
                     op = frame.get("op")
-                    if op == "observer":
+                    if op == "vector_batch":
+                        # ONE slab in, ONE slab (of results) out — the
+                        # codec's first-class ndarray tokens carry the
+                        # tensors; nothing per-message anywhere.  A bad
+                        # slab (unknown type, no engine) costs only an
+                        # error reply, never the connection.
+                        batch_id = frame.get("batch_id")
+
+                        def _reply(f: "asyncio.Future",
+                                   _id=batch_id) -> None:
+                            if writer.is_closing():
+                                return
+                            if f.exception() is not None:
+                                write_gateway_frame(writer, {
+                                    "op": "batch_result", "batch_id": _id,
+                                    "error": repr(f.exception())})
+                            else:
+                                write_gateway_frame(writer, {
+                                    "op": "batch_result", "batch_id": _id,
+                                    "result": f.result()})
+
+                        try:
+                            fut = gateway.submit_batch(
+                                frame["type"], frame["method"],
+                                frame["keys"], frame["args"],
+                                want_results=frame.get("want_results",
+                                                       False))
+                        except Exception as exc:  # noqa: BLE001
+                            if batch_id is not None:
+                                write_gateway_frame(writer, {
+                                    "op": "batch_result",
+                                    "batch_id": batch_id,
+                                    "error": repr(exc)})
+                            else:
+                                self.silo.logger.warn(
+                                    f"gateway: bad vector batch dropped: "
+                                    f"{exc!r}", code=2902)
+                        else:
+                            if fut is not None:
+                                fut.add_done_callback(_reply)
+                    elif op == "observer":
                         await gateway.register_observer(client_id,
                                                         frame["observer_id"])
                         registered.append(frame["observer_id"])

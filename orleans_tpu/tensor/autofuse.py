@@ -55,6 +55,17 @@ class AutoFuser:
         self._static_args: Dict[str, Any] = {}
         self._buffer: List[Dict[str, Any]] = []
         self._replaying = False
+        # verification chain: windows whose device-side miss counters
+        # have not been read yet.  One observation per
+        # auto_fusion_verify_windows windows amortizes the ~100ms
+        # completion-observation cost of tunneled runtimes; rollback
+        # then spans the whole chain (snapshot refs are free — the
+        # programs never donate their state buffers).
+        self._unverified: List[List[Dict[str, Any]]] = []
+        self._chain_prog = None
+        self._chain_snapshot: Optional[Dict[str, Dict]] = None
+        self._chain_counters: Optional[Tuple[int, int, int]] = None
+        self._chain_generations: Dict[str, int] = {}
         # caches / stats
         self._programs: Dict[Tuple, Any] = {}
         self._disabled: Dict[Tuple, int] = {}   # sig → ring version at ban
@@ -77,7 +88,7 @@ class AutoFuser:
         self._program = None
 
     def has_buffer(self) -> bool:
-        return bool(self._buffer)
+        return bool(self._buffer) or bool(self._unverified)
 
     def idle_flush(self) -> None:
         """Engine-loop idle path: the producer stopped mid-window — drain
@@ -87,9 +98,11 @@ class AutoFuser:
         self._break()
 
     def _break(self) -> None:
-        """Pattern break: any buffered window ticks MUST apply before the
-        breaking tick executes — replay them through the exact unfused
-        path now, then reset detection."""
+        """Pattern break: settle the verification chain (it may roll
+        back, replaying chained + buffered ticks), then replay any
+        remaining buffered ticks — all BEFORE the breaking tick
+        executes, preserving per-tick application order."""
+        self._settle_chain()
         if self._buffer:
             self._replay_buffer()
         self._reset()
@@ -217,7 +230,34 @@ class AutoFuser:
                 self._disabled[sig] = self._ring_version()
                 self._reset()
                 return False
+            # no donation: the pre-run buffers stay valid, making the
+            # rollback snapshot a dict of references instead of device
+            # copies (see FusedTickProgram.donate)
+            prog.donate = False
             self._programs[sig] = prog
+        if prog._compiled is None:
+            # compile NOW, not when the first window fills: the compile
+            # stall lands on the engagement tick instead of surprising a
+            # steady-state window mid-run.  jax.jit is lazy, so lower +
+            # AOT-compile against the exact window avals — no device
+            # execution, and run() then calls the compiled executable
+            # directly (window shape and arg structure are fixed for the
+            # engagement's lifetime).
+            wrapped = prog._build(dict(args))
+            W = self.engine.config.auto_fusion_window
+            static_keys = self._static_keys
+
+            def aval(v):
+                a = np.asarray(v)
+                return jax.ShapeDtypeStruct((W,) + a.shape, a.dtype)
+
+            stacked0 = {k: aval(v) for k, v in args.items()
+                        if k not in static_keys}
+            states = {n: self.engine.arena_for(n).state
+                      for n in prog._touched}
+            prog._compiled = wrapped.lower(
+                states, {k: args[k] for k in static_keys}, stacked0,
+                jnp.zeros(2, jnp.int32)).compile()
         self._program = prog
         self._pattern = (sig[0], sig[1])
         self._pattern_rows = b.rows
@@ -241,43 +281,97 @@ class AutoFuser:
             for k in window[0]}
 
         # make sure the program is traced so its touched-arena list is
-        # complete, then snapshot every touched arena BEFORE the run: the
-        # compiled window donates the state buffers, so the snapshot is
-        # the only road back if the window turns out non-exact
+        # complete; a generation change forces both a rebuild and a
+        # settle of the outstanding chain (its snapshot refs belong to
+        # the old generation — rollback across a repack is impossible)
         if prog._compiled is None or any(
                 engine.arena_for(n).generation != g
                 for n, g in prog._generations.items()):
+            self._settle_chain()
             prog.src_rows = jnp.asarray(
                 prog.src_arena.resolve_rows(prog.keys))
             example = {**self._static_args,
                        **jax.tree_util.tree_map(lambda a: a[0], stacked)}
             prog._compiled = prog._build(example)
-        snapshot = {
-            n: {c: jnp.array(v, copy=True)
-                for c, v in engine.arena_for(n).state.items()}
-            for n in prog._touched}
-        counters = (engine.tick_number, engine.ticks_run,
-                    engine.messages_processed)
+        if self._chain_snapshot is None:
+            # chain start: the pre-run buffers ARE the snapshot — the
+            # programs never donate (see _engage), so these references
+            # stay valid until the chain settles
+            self._chain_prog = prog
+            self._chain_snapshot = {n: dict(engine.arena_for(n).state)
+                                    for n in prog._touched}
+            self._chain_counters = (engine.tick_number, engine.ticks_run,
+                                    engine.messages_processed)
+            self._chain_generations = {
+                n: engine.arena_for(n).generation for n in prog._touched}
 
         prog.run(stacked, static_args=self._static_args)
-        misses = prog.verify()
+        self._unverified.append(window)
+        # the window advanced the tick clock: honor the periodic
+        # checkpoint cadence in the fused steady state too (its write
+        # precedes verification; a later rollback simply re-checkpoints
+        # after the exact replay — the restore point stays consistent
+        # because replay re-runs through unfused ticks which checkpoint
+        # again at their own boundaries)
+        engine.maybe_periodic_checkpoint()
         dt = time.perf_counter() - t0
         self.windows_run += 1
         for _ in range(len(window)):
             # every message in the window completes by window end — record
             # the window wall time as each tick's (conservative) latency
             engine.tick_durations.append(dt)
+        if len(self._unverified) >= max(
+                1, engine.config.auto_fusion_verify_windows):
+            self._settle_chain()
 
+    def _settle_chain(self) -> None:
+        """Read the chain's accumulated device-side miss counter (ONE
+        completion observation for up to verify_windows windows).  Zero:
+        the chain was exact.  Nonzero: roll the state back to the chain
+        start and replay every chained tick (plus any newer buffered
+        ticks, in order) through the unfused path."""
+        if not self._unverified:
+            return
+        engine = self.engine
+        prog = self._chain_prog
+        windows, self._unverified = self._unverified, []
+        snapshot = self._chain_snapshot
+        counters = self._chain_counters
+        generations = self._chain_generations
+        self._chain_prog = None
+        self._chain_snapshot = None
+        self._chain_counters = None
+        self._chain_generations = {}
+        misses = prog.verify()
+        n_ticks = sum(len(w) for w in windows)
         if misses == 0:
-            self.ticks_fused += len(window)
-            # a clean window forgives earlier strikes: the ban targets
+            self.ticks_fused += n_ticks
+            # a clean chain forgives earlier strikes: the ban targets
             # patterns whose windows roll back back-to-back, not a
             # steady pattern with a rare cold-key incident
             self._rollback_counts.pop(self._sig, None)
             return
-        # non-exact window (cold destination, fan-out overflow, round-cap
-        # spill): roll the state back and replay the ticks unfused — the
-        # slow path that keeps transparency exact
+        # non-exact chain (cold destination, fan-out overflow, round-cap
+        # spill): roll back and replay unfused — the slow path that
+        # keeps transparency exact
+        if any(engine.arena_for(n).generation != g
+               for n, g in generations.items()):
+            # an arena repacked between the chain's windows (possible
+            # only via direct arena calls outside the engine's queues —
+            # queued traffic breaks the pattern first, which settles the
+            # chain): the old-generation snapshot cannot be restored
+            engine_log = getattr(getattr(engine, "silo", None), "logger",
+                                 None)
+            msg = (f"autofuse: {int(misses)} deliveries missed in a "
+                   f"fused chain but an arena repacked mid-chain — "
+                   f"rollback impossible, messages lost")
+            if engine_log is not None:
+                engine_log.error(msg, code=2914)
+            else:
+                import logging
+                logging.getLogger("orleans_tpu.autofuse").error(msg)
+            self._reset()
+            return
         self.windows_rolled_back += 1
         for n, cols in snapshot.items():
             engine.arena_for(n).state = cols
@@ -292,7 +386,8 @@ class AutoFuser:
             # ring (or arena generation, which is part of the sig) changes
             self._disabled[sig] = self._ring_version()
             self._programs.pop(sig, None)
-        self._buffer = window
+        # chained ticks replay FIRST, then any newer buffered ticks
+        self._buffer = [t for w in windows for t in w] + self._buffer
         self._replay_buffer()  # in order, unfused, BEFORE any newer work
         self._reset()
 
@@ -301,7 +396,12 @@ class AutoFuser:
     def flush_partial(self) -> bool:
         """Re-enqueue ONE buffered tick for exact unfused replay (the
         engine's drain loop calls this until it returns False).  One tick
-        per call preserves per-tick application order."""
+        per call preserves per-tick application order.  Settles the
+        verification chain first — flush means FULL delivery, including
+        any rollback-replay the chain still owes."""
+        if self._unverified and not self._replaying:
+            self._settle_chain()
+            return True
         if not self._buffer:
             self._replaying = False
             return False

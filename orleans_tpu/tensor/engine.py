@@ -171,6 +171,7 @@ class TensorEngine:
         self.tick_number = 0
         self.ticks_run = 0
         self.rounds_run = 0
+        self._last_checkpoint_tick = 0
         self.messages_processed = 0
         self.tick_seconds = 0.0
         self.activation_passes = 0
@@ -268,6 +269,26 @@ class TensorEngine:
         every arena through the store.  Returns rows written."""
         await self.flush()
         return sum(a.checkpoint() for a in self.arenas.values())
+
+    def maybe_periodic_checkpoint(self) -> float:
+        """Bounded-loss-window write-back (config checkpoint_every_ticks):
+        fires whenever the tick clock has advanced past the cadence since
+        the last write — called at unfused tick boundaries AND after fused
+        windows (which advance tick_number by whole windows), so the
+        promised bound holds in the fused steady state too.  At a tick or
+        window boundary the state is consistent, so this is a valid
+        restore point for survivors after a hard kill.  Returns seconds
+        spent (0.0 when it did not fire)."""
+        cadence = self.config.checkpoint_every_ticks
+        if cadence <= 0 \
+                or self.tick_number - self._last_checkpoint_tick < cadence:
+            return 0.0
+        t_cp = time.perf_counter()
+        for a in self.arenas.values():
+            if a.store is not None:
+                a.checkpoint()
+        self._last_checkpoint_tick = self.tick_number
+        return time.perf_counter() - t_cp
 
     def restore(self, type_names: Optional[List[str]] = None) -> int:
         """Re-activate all stored rows (process-restart resume).  With no
@@ -565,6 +586,9 @@ class TensorEngine:
             for qkey, b in self._fence_deferred:
                 self.queues[qkey].append(b)
             self._fence_deferred = []
+        t_cp = self.maybe_periodic_checkpoint()
+        if t_cp:
+            stages["checkpoint"] += t_cp
         dt = time.perf_counter() - t0
         self._in_tick = False
         for k, v in stages.items():

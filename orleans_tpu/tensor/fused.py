@@ -88,7 +88,13 @@ class FusedTickProgram:
         self._generations: Dict[str, int] = {}
         self._touched: List[str] = []
         self._compiled: Callable | None = None
-        self._pending_miss = []
+        self._totals = None  # device [miss, delivered] since last verify
+        # donate=False keeps the pre-run state buffers valid after the
+        # window executes, so a caller that may need to ROLL BACK (the
+        # auto-fuser) gets its snapshot for free — eager device copies
+        # are ruinously slow on tunneled runtimes.  Manual fused drivers
+        # keep donation (no rollback path; verify() asserts instead).
+        self.donate = True
 
     # -- trace-time recursion over the emit graph ---------------------------
 
@@ -216,7 +222,7 @@ class FusedTickProgram:
                 self.engine.arena_for(name)  # eager, concrete columns
         touched = list(self._touched)
 
-        def window(states, static_args, stacked_args):
+        def window(states, static_args, stacked_args, totals_in):
             def one_tick(states, args_t):
                 # static leaves (identical every tick) ride OUTSIDE the
                 # scan xs: slicing a [T, m] stack per iteration costs
@@ -227,10 +233,16 @@ class FusedTickProgram:
                 return states, (miss, delivered)
             states, (misses, delivered) = jax.lax.scan(one_tick, states,
                                                        stacked_args)
-            return states, jnp.sum(misses), jnp.sum(delivered)
+            # totals accumulate ON DEVICE across runs: verify() then
+            # reads one 2-element buffer no matter how many windows ran
+            # (each completion observation costs ~100ms on tunneled
+            # runtimes, so per-window reads would dominate)
+            return states, totals_in + jnp.stack(
+                [jnp.sum(misses), jnp.sum(delivered)])
 
         self._touched = touched
-        return jax.jit(window, donate_argnums=(0,))
+        return jax.jit(window,
+                       donate_argnums=(0,) if self.donate else ())
 
     def run(self, stacked_args: Any, static_args: Any = None) -> None:
         """Execute T fused ticks.
@@ -260,11 +272,12 @@ class FusedTickProgram:
                 lambda a: a[0], stacked_args)}
             self._compiled = self._build(example_args_t)
         states = {n: engine.arena_for(n).state for n in self._touched}
-        new_states, miss, delivered = self._compiled(
-            states, static_args, stacked_args)
+        totals_in = self._totals if self._totals is not None \
+            else jnp.zeros(2, dtype=jnp.int32)
+        new_states, self._totals = self._compiled(
+            states, static_args, stacked_args, totals_in)
         for n in self._touched:
             engine.arena_for(n).state = new_states[n]
-        self._pending_miss.append((miss, delivered))
         engine.tick_number += n_ticks
         engine.ticks_run += n_ticks
         engine.messages_processed += n_ticks * self.n_msgs
@@ -281,12 +294,14 @@ class FusedTickProgram:
         the last verify — emit misses (cold destinations), fan-out budget
         overflows, and round-cap spills all count.  Nonzero = the window
         was NOT exact; re-run those ticks unfused.  Also folds the
-        windows\' emit/fan-out delivery counts into the engine\'s
+        windows' emit/fan-out delivery counts into the engine's
         messages_processed (run() counts only source injections eagerly —
-        delivery counts live on device until this sync)."""
-        pending, self._pending_miss = self._pending_miss, []
-        misses = 0
-        for m, d in pending:
-            misses += int(m)
-            self.engine.messages_processed += int(d)
-        return misses
+        delivery counts live on device until this sync).  ONE 2-element
+        device read regardless of how many windows ran since the last
+        verify (the on-device totals accumulator)."""
+        if self._totals is None:
+            return 0
+        totals = np.asarray(self._totals)
+        self._totals = None
+        self.engine.messages_processed += int(totals[1])
+        return int(totals[0])

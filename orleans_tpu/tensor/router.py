@@ -121,7 +121,12 @@ class VectorRouter:
         # ACTIVE (a joining silo must release its peers — it holds no
         # rows, so its release is trivially true; eviction for active
         # silos already ran: the silo's own ring subscription precedes
-        # this one, so on_ring_changed's write-back happens first)
+        # this one, so on_ring_changed's write-back happens first).
+        # A SHUTTING_DOWN silo's release is also sound: its ranges move
+        # only at membership leave, and graceful stop checkpoints the
+        # arenas BEFORE the leave (silo.py stop ordering), so any range
+        # a peer gains from it is already durable; mid-shutdown ring
+        # changes caused by THIRD silos move no ranges away from it.
         silo.ring.subscribe(lambda *_: self._arm_fence())
 
     # ================= ownership ==========================================

@@ -34,7 +34,8 @@ class TestingCluster:
                  config_factory: Optional[Callable[[str], SiloConfig]] = None,
                  wire_fidelity: bool = True,
                  silo_setup: Optional[Callable[[Silo], None]] = None,
-                 transport: str = "inproc") -> None:
+                 transport: str = "inproc",
+                 table_service: bool = False) -> None:
         self.n_initial = n_silos
         self.config_factory = config_factory or self._default_config
         # per-silo wiring hook (providers etc.) run before silo.start()
@@ -54,6 +55,13 @@ class TestingCluster:
         # shared durable reminder store (reference: TestingSiloHost's
         # ReminderTableGrain / shared in-proc stores)
         self.reminder_table = InMemoryReminderTable()
+        # table_service=True: silos reach the system tables over TCP via
+        # a TableServiceServer started by start() — the "no shared disk"
+        # cluster formation mode (plugins/table_service.py; reference:
+        # ZooKeeper/SQL membership table deployments)
+        self._use_table_service = table_service
+        self.table_service = None
+        self._remote_tables: List = []
         self.storage_backing = MemoryStorage.shared_backing()
         # durable pub/sub state so stream subscriptions survive the death
         # of the silo hosting a rendezvous grain (reference: the test
@@ -77,6 +85,11 @@ class TestingCluster:
     # ================= lifecycle ==========================================
 
     async def start(self) -> "TestingCluster":
+        if self._use_table_service and self.table_service is None:
+            from orleans_tpu.plugins.table_service import TableServiceServer
+            self.table_service = await TableServiceServer(
+                membership_table=self.table,
+                reminder_table=self.reminder_table).start()
         for _ in range(self.n_initial):
             await self.start_additional_silo()
         return self
@@ -89,6 +102,16 @@ class TestingCluster:
         host, port = None, 0
         if self.transport == "tcp":
             host, port = self.fabric.host, self.fabric.reserve()
+        membership_table, reminder_table = self.table, self.reminder_table
+        if self.table_service is not None:
+            from orleans_tpu.plugins.table_service import (
+                RemoteMembershipTable,
+                RemoteReminderTable,
+            )
+            ts_host, ts_port = self.table_service.address
+            membership_table = RemoteMembershipTable(ts_host, ts_port)
+            reminder_table = RemoteReminderTable(ts_host, ts_port)
+            self._remote_tables += [membership_table, reminder_table]
         silo = Silo(
             config=self.config_factory(name),
             storage_providers={
@@ -96,8 +119,8 @@ class TestingCluster:
                 "PubSubStore": MemoryStorage(self.pubsub_backing),
             },
             fabric=self.fabric,
-            membership_table=self.table,
-            reminder_table=self.reminder_table,
+            membership_table=membership_table,
+            reminder_table=reminder_table,
             host=host, port=port,
         )
         if self.silo_setup is not None:
@@ -132,6 +155,12 @@ class TestingCluster:
         for silo in list(reversed(self.silos)):
             await silo.stop()
         self.silos.clear()
+        for t in self._remote_tables:
+            t.close()
+        self._remote_tables.clear()
+        if self.table_service is not None:
+            self.table_service.close()
+            self.table_service = None
 
     # ================= client =============================================
 

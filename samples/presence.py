@@ -97,7 +97,8 @@ async def run_presence_load(engine, n_players: int = 100_000,
                             n_ticks: int = 10,
                             seed: int = 0,
                             device_payloads: bool = True,
-                            measure_latency: bool = False) -> Dict[str, float]:
+                            measure_latency: bool = False,
+                            warm_ticks: int = 0) -> Dict[str, float]:
     """Drive ``n_ticks`` of heartbeats from every player; returns stats.
 
     Each tick is 2 logical messages per player (player heartbeat + game
@@ -144,6 +145,17 @@ async def run_presence_load(engine, n_players: int = 100_000,
     import jax as _jax
     game_arena = engine.arena_for("GameGrain")
     tick_durations = []
+
+    # untimed warm phase through the SAME injector: amortizes compiles
+    # AND lets transparent auto-fusion engage before the timed window
+    # (the signature keys on the injector's cached arrays, so a separate
+    # warm call with a fresh injector would not warm the fused program)
+    for t in range(warm_ticks):
+        injector.inject(args_for(t))
+        await engine.drain_queues()
+    if warm_ticks:
+        await engine.flush()
+        _jax.block_until_ready(game_arena.state["updates"])
 
     t0 = time.perf_counter()
     for t in range(n_ticks):
@@ -262,3 +274,196 @@ async def run_presence_load_fused(engine, n_players: int = 100_000,
         stats["tick_p99_seconds"] = float(np.percentile(d, 99))
         stats["tick_max_seconds"] = float(d.max())
     return stats
+
+
+def measure_sync_floor(repeats: int = 11) -> "Tuple[float, float]":
+    """The rig's host-observability floor: the wall time to OBSERVE the
+    completion of an in-flight device program whose device time is ~0.
+
+    On a direct-attached TPU this is ~0; on a tunneled runtime (IFRT
+    proxy) completion notifications arrive on a ~100ms cadence, flooring
+    every blocking latency MEASUREMENT regardless of actual device
+    latency.  Returns ``(median, p95)`` of the observation samples —
+    the channel has its OWN tail (~±30ms observed), which a per-tick p99
+    necessarily rides.  Published alongside latency numbers so
+    budget-honoring can be judged net of the rig artifact (measured:
+    block/spin/async-copy all floor identically, so no client-side
+    workaround exists)."""
+    import jax as _jax
+    from functools import partial
+
+    a = jnp.ones((512, 512), jnp.bfloat16)
+
+    @partial(_jax.jit, static_argnames=("n",))
+    def probe(x, n):
+        return jnp.sum(_jax.lax.scan(
+            lambda c, _: (c @ a, None), x, None, length=n)[0])
+
+    probe(a, 1).block_until_ready()  # compile
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        probe(a, 1).block_until_ready()
+        samples.append(time.perf_counter() - t0)
+    floor = float(np.median(samples))
+    p95 = float(np.percentile(samples, 95))
+    # device time of one 512^3 matmul is microseconds; anything beyond
+    # a couple ms is pure observation latency.  Below that, report 0 so
+    # direct-attached rigs use the strict definition.
+    if floor <= 2e-3:
+        return 0.0, 0.0
+    return floor, p95
+
+
+async def run_presence_bounded(engine, n_players: int, n_games: int,
+                               budget: float,
+                               offered_rate: Optional[float] = None,
+                               n_ticks: int = 40, warm_ticks: int = 12,
+                               sync_floor: float = 0.0,
+                               sync_floor_p95: float = 0.0,
+                               seed: int = 0) -> Dict[str, float]:
+    """One latency-bounded operating point: (msgs/sec, true p99 turn
+    latency) with the adaptive tick controller holding accumulation-wait
+    + tick-service inside ``budget`` (SURVEY §7 hard-part 5 — p99 is half
+    the north-star metric).
+
+    Closed loop per tick: sleep the controller's accumulation interval,
+    inject the heartbeats a rate-``offered_rate`` producer generated in
+    that window (rounded down to a precompiled batch-size ladder rung),
+    run the tick to completion, record window-start→completion wall time
+    — the turn latency of the window's OLDEST message, so the published
+    p99 is conservative.  The controller (engine._adapt) shrinks the
+    interval when ticks run long and grows it for throughput when the
+    budget has headroom.
+
+    ``offered_rate=None`` estimates the highest sustainable rate from the
+    warm pass's measured service times; the caller verifies p99 ≤ budget
+    and retries lower if the estimate overshot (bench.py does this).
+
+    ``sync_floor`` (see measure_sync_floor): the rig's completion-
+    observation floor.  It is SUBTRACTED for budget-honoring decisions
+    and rate estimation (it is measurement artifact, not engine
+    latency); both raw and net percentiles are returned.
+    """
+    import jax as _jax
+
+    cfg = engine.config
+    cfg.target_tick_latency = budget
+    cfg.tick_interval_max = budget * 0.5
+    cfg.tick_interval_min = max(1e-4, budget / 50.0)
+    # park optimistic miss-checks for the whole run instead of syncing
+    # them per tick: every destination is pre-activated, so the checks
+    # are all zero — they settle in ONE sync at the final flush, keeping
+    # the per-tick loop at exactly one blocking observation
+    cfg.miss_check_cap = 1_000_000
+    # window buffering trades latency for throughput — the opposite of
+    # this mode's contract — and its engage-compile would spike the p99
+    cfg.auto_fusion_ticks = 0
+    engine._adaptive_interval = budget / 4.0
+
+    rng = np.random.default_rng(seed)
+    players = np.arange(n_players, dtype=np.int64)
+    games = rng.integers(0, n_games, n_players).astype(np.int32)
+    scores = rng.random(n_players, dtype=np.float32)
+
+    engine.arena_for("PresenceGrain").reserve(n_players)
+    engine.arena_for("GameGrain").reserve(n_games)
+    # activate everything up front: the bounded loop measures steady
+    # state, not cold activation
+    engine.arena_for("GameGrain").resolve_rows(
+        np.arange(n_games, dtype=np.int64))
+
+    # batch-size ladder: precompiled prefix sizes so variable offered
+    # load maps to a bounded set of compiled shapes
+    ladder = [m for m in (2048, 8192, 32768, 131072, 524288)
+              if m < n_players] + [n_players]
+    rungs = []
+    for m in ladder:
+        rungs.append({
+            "m": m,
+            "injector": engine.make_injector("PresenceGrain", "heartbeat",
+                                             players[:m]),
+            "game": jnp.asarray(games[:m]),
+            "score": jnp.asarray(scores[:m]),
+        })
+    game_arena = engine.arena_for("GameGrain")
+
+    # warm pass: compile each rung (tick 1) and measure its synced
+    # service time (tick 2) for the rate estimate
+    service = {}
+    for rung in rungs:
+        for rep in range(2):
+            s0 = time.perf_counter()
+            rung["injector"].inject({"game": rung["game"],
+                                     "score": rung["score"],
+                                     "tick": np.int32(1)})
+            await engine.drain_queues()
+            _jax.block_until_ready(game_arena.state["updates"])
+            service[rung["m"]] = time.perf_counter() - s0
+    await engine.flush()  # settle the warm ticks' parked checks
+
+    if offered_rate is None:
+        candidates = [m / (budget - max(s - sync_floor, 1e-4))
+                      for m, s in service.items()
+                      if max(s - sync_floor, 1e-4) < 0.7 * budget]
+        offered_rate = max(candidates) if candidates \
+            else ladder[0] / budget
+
+    durations = []
+    messages = 0
+    tick_counter = 0
+    batch_sizes = []
+    window_start = time.perf_counter()
+    for t in range(warm_ticks + n_ticks):
+        await asyncio.sleep(engine.tick_interval())
+        accumulated = time.perf_counter() - window_start
+        m_target = offered_rate * accumulated
+        rung = rungs[0]
+        for r in rungs:
+            if r["m"] <= m_target:
+                rung = r
+        tick_counter += 1
+        rung["injector"].inject({"game": rung["game"],
+                                 "score": rung["score"],
+                                 "tick": np.int32(tick_counter)})
+        await engine.drain_queues()
+        # ONE blocking observation per tick: the game fan-in result of
+        # this tick's round chain (miss-checks settle at the final flush)
+        _jax.block_until_ready(game_arena.state["updates"])
+        done = time.perf_counter()
+        if t >= warm_ticks:
+            durations.append(done - window_start)
+            messages += 2 * rung["m"]
+            batch_sizes.append(rung["m"])
+        window_start = done
+    await engine.flush()  # settle parked checks; all pre-activated → zero
+
+    # durations tile the measured wall clock exactly (window_start resets
+    # at each observation), so wall throughput = messages / sum(d); the
+    # net figure removes the per-tick observation floor — the cost a
+    # deployment without a measuring host would not pay
+    d = np.asarray(durations)
+    elapsed = float(d.sum())
+    elapsed_net = float(np.maximum(d - sync_floor, 1e-4).sum())
+    p99 = float(np.percentile(d, 99))
+    return {
+        "budget_s": budget,
+        "offered_rate": offered_rate,
+        "messages": messages,
+        "seconds": elapsed,
+        "messages_per_sec": messages / elapsed,
+        "messages_per_sec_net": messages / elapsed_net,
+        "tick_p50_seconds": float(np.percentile(d, 50)),
+        "tick_p99_seconds": p99,
+        "tick_max_seconds": float(d.max()),
+        "mean_batch": float(np.mean(batch_sizes)),
+        "ticks": n_ticks,
+        "sync_floor_s": sync_floor,
+        "sync_floor_p95_s": sync_floor_p95,
+        "tick_p99_net_seconds": max(0.0, p99 - sync_floor),
+        # honored net of the rig's observation channel: a per-tick p99
+        # necessarily rides the channel's own tail, so the bound is
+        # budget + the channel's p95 (strict when the floor is 0)
+        "honored": bool(p99 - max(sync_floor_p95, sync_floor) <= budget),
+        "honored_strict": bool(p99 <= budget),
+    }

@@ -1,0 +1,64 @@
+"""TwitterSentiment on the host (per-message) path — the CPU baseline.
+
+Same workload shape as samples/twitter_sentiment.py executed as classic
+virtual actors: one dispatcher RPC per tweet, one AddScore RPC per
+(tweet, hashtag) into the per-hashtag grain, a counter increment on first
+activation — structurally the reference's execution model
+(reference: Samples/TwitterSentiment/TwitterGrains/
+TweetDispatcherGrain.cs:45 AddScore fan-out; HashtagGrain.cs AddScore :70,
+first-activation counter :55; CounterGrain.cs:46).  Used by bench.py to
+measure the per-message dispatch baseline the tensor engine is compared
+against.
+"""
+
+from __future__ import annotations
+
+from orleans_tpu import Grain, grain_interface, one_way
+from orleans_tpu.core.grain import grain_class
+
+
+@grain_interface
+class IHostCounter:
+    @one_way
+    async def increment(self, n: int): ...
+    async def total(self) -> int: ...
+
+
+@grain_interface
+class IHostHashtag:
+    async def add_score(self, score: int): ...
+    async def totals(self) -> tuple: ...
+
+
+@grain_class
+class HostCounterGrain(Grain, IHostCounter):
+    def __init__(self) -> None:
+        self.count = 0
+
+    async def increment(self, n: int):
+        self.count += n
+
+    async def total(self) -> int:
+        return self.count
+
+
+@grain_class
+class HostHashtagGrain(Grain, IHostHashtag):
+    def __init__(self) -> None:
+        self.total = 0
+        self.positive = 0
+        self.negative = 0
+        self.counted = False
+
+    async def add_score(self, score: int):
+        if not self.counted:
+            self.counted = True
+            await self.get_grain(IHostCounter, 0).increment(1)
+        self.total += 1
+        if score > 0:
+            self.positive += 1
+        elif score < 0:
+            self.negative += 1
+
+    async def totals(self) -> tuple:
+        return (self.total, self.positive, self.negative)

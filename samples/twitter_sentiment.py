@@ -120,10 +120,14 @@ def flatten_tweets(tweets: Sequence[Dict]) -> Dict[str, np.ndarray]:
 async def run_twitter_load(engine, n_tweets_per_tick: int = 50_000,
                            n_hashtags: int = 5_000, tags_per_tweet: int = 2,
                            n_ticks: int = 10, zipf_a: float = 1.4,
-                           seed: int = 0) -> Dict[str, float]:
+                           seed: int = 0, warm_ticks: int = 0,
+                           measure_latency: bool = False) -> Dict[str, float]:
     """Synthetic firehose: hashtag popularity ~ Zipf (a few trending tags
     absorb most of the traffic — the hot-row stress), sentiment scores in
-    {-1, 0, +1}."""
+    {-1, 0, +1}.  Payloads are pre-generated so the timed loop measures
+    the engine, not the synthetic producer.  ``measure_latency=True``
+    blocks on completion every tick: the recorded durations are true
+    inject→completion turn latencies."""
     import jax as _jax
 
     rng = np.random.default_rng(seed)
@@ -137,22 +141,43 @@ async def run_twitter_load(engine, n_tweets_per_tick: int = 50_000,
     engine.arena_for("TweetCounterGrain").reserve(1)
 
     m = n_tweets_per_tick * tags_per_tweet
-    t0 = time.perf_counter()
-    for t in range(n_ticks):
+    total = warm_ticks + n_ticks
+    payloads = []
+    for t in range(total):
         tag_idx = rng.choice(n_hashtags, size=m, p=weights)
-        engine.send_batch("HashtagGrain", "add_score", tag_keys[tag_idx], {
-            "score": rng.integers(-1, 2, size=m).astype(np.int32),
-        })
+        payloads.append((tag_keys[tag_idx],
+                         rng.integers(-1, 2, size=m).astype(np.int32)))
+
+    arena = engine.arena_for("HashtagGrain")
+    for t in range(warm_ticks):  # activation + compiles, untimed
+        keys, scores = payloads[t]
+        engine.send_batch("HashtagGrain", "add_score", keys,
+                          {"score": scores})
         await engine.drain_queues()
     await engine.flush()
-    arena = engine.arena_for("HashtagGrain")
+    _jax.block_until_ready(arena.state["total"])
+
+    tick_durations = []
+    t0 = time.perf_counter()
+    for t in range(warm_ticks, total):
+        tick_t0 = time.perf_counter()
+        keys, scores = payloads[t]
+        engine.send_batch("HashtagGrain", "add_score", keys,
+                          {"score": scores})
+        if measure_latency:
+            await engine.flush()
+            _jax.block_until_ready(arena.state["total"])
+            tick_durations.append(time.perf_counter() - tick_t0)
+        else:
+            await engine.drain_queues()
+    await engine.flush()
     _jax.block_until_ready(arena.state["total"])
     elapsed = time.perf_counter() - t0
 
     # per reference accounting: one AddScore per (tweet, hashtag) + one
     # dispatcher RPC per tweet
     messages = (m + n_tweets_per_tick) * n_ticks
-    return {
+    stats: Dict[str, float] = {
         "tweets": n_tweets_per_tick * n_ticks,
         "hashtags": n_hashtags,
         "ticks": n_ticks,
@@ -160,3 +185,9 @@ async def run_twitter_load(engine, n_tweets_per_tick: int = 50_000,
         "messages": messages,
         "messages_per_sec": messages / elapsed,
     }
+    if tick_durations:
+        d = np.asarray(tick_durations)
+        stats["tick_p50_seconds"] = float(np.percentile(d, 50))
+        stats["tick_p99_seconds"] = float(np.percentile(d, 99))
+        stats["tick_max_seconds"] = float(d.max())
+    return stats

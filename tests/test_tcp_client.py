@@ -185,3 +185,77 @@ def test_gateway_endpoints_advertised_in_membership(run):
             await cluster.stop()
 
     run(main())
+
+
+def test_tcp_client_batch_edge(run):
+    """The batched client edge: a TCP client ships 10k-key presence
+    batches as ONE gateway frame each; the gateway routes them through
+    the vector plane — ZERO vector traffic on the per-message path
+    (north star: 'batched adjacency+payload tensors' from the client;
+    reference edge: Gateway.cs:37 proxies one message per call)."""
+
+    async def main():
+        import numpy as np
+        import samples.presence  # registers PresenceGrain/GameGrain
+        from tests.test_cross_silo_presence import relaxed_liveness
+
+        cluster = await TestingCluster(
+            n_silos=2, transport="tcp",
+            config_factory=relaxed_liveness).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            client = await GrainClient().connect(
+                _gateway_endpoint(cluster.silos[0]))
+            try:
+                turns_before = [s.metrics.snapshot().get("turns_executed", 0)
+                                for s in cluster.silos]
+                n = 10_000
+                keys = np.arange(n, dtype=np.int64)
+                games = (keys % 50).astype(np.int32)
+                for t in range(3):
+                    client.send_batch(
+                        "PresenceGrain", "heartbeat", keys,
+                        {"game": games,
+                         "score": np.ones(n, np.float32),
+                         "tick": np.full(n, t + 1, np.int32)})
+                await cluster.quiesce_engines()
+
+                # exactness: every heartbeat landed, across both silos
+                total_hb = 0
+                total_upd = 0
+                for silo in cluster.silos:
+                    arenas = silo.tensor_engine.arenas
+                    pa = arenas.get("PresenceGrain")
+                    if pa is not None and len(pa.keys()):
+                        rows, _ = pa.lookup_rows(pa.keys())
+                        total_hb += int(np.asarray(
+                            pa.state["heartbeats"])[rows].sum())
+                    ga = arenas.get("GameGrain")
+                    if ga is not None and len(ga.keys()):
+                        rows, _ = ga.lookup_rows(ga.keys())
+                        total_upd += int(np.asarray(
+                            ga.state["updates"])[rows].sum())
+                assert total_hb == 3 * n
+                assert total_upd == 3 * n
+
+                # the per-message path carried NO vector traffic: no
+                # grain turns were executed anywhere for these batches
+                turns_after = [s.metrics.snapshot().get("turns_executed", 0)
+                               for s in cluster.silos]
+                assert turns_after == turns_before
+
+                # want_results: one slab out, one result slab back, in
+                # caller key order
+                fut = client.send_batch(
+                    "PresenceGrain", "heartbeat", keys[:64],
+                    {"game": games[:64],
+                     "score": np.ones(64, np.float32),
+                     "tick": np.full(64, 9, np.int32)},
+                    want_results=True)
+                await asyncio.wait_for(fut, timeout=30)
+            finally:
+                await client.close()
+        finally:
+            await cluster.stop()
+
+    run(main())
