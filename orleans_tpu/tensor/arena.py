@@ -50,6 +50,39 @@ def _hash_keys_u64(keys: np.ndarray) -> np.ndarray:
     return z ^ (z >> np.uint64(31))
 
 
+# -- wide (64-bit) key support ------------------------------------------------
+# Device int64 needs jax x64 mode, so a wide key rides the mesh as TWO
+# int32 words (reference key breadth: UniqueKey.cs:34 — two 64-bit words).
+# Routing hashes the words into a 30-bit bucket space (the int32 padding
+# sentinel can then never collide with a real hash) and verifies bucket
+# candidates against the full words on device.
+
+def split_wide_keys(keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """int64[n] → (hi int32[n], lo int32[n]) bit-pattern words."""
+    u = np.asarray(keys).astype(np.uint64)
+    hi = (u >> np.uint64(32)).astype(np.uint32).view(np.int32)
+    lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32).view(np.int32)
+    return hi, lo
+
+
+def join_wide_keys(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """(hi, lo) int32 words → int64 keys (bit-pattern inverse)."""
+    u = (np.asarray(hi).view(np.uint32).astype(np.uint64) << np.uint64(32)) \
+        | np.asarray(lo).view(np.uint32).astype(np.uint64)
+    return u.astype(np.int64)
+
+
+def mix32_np(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """30-bit bucket hash of a wide key's words; MUST stay bit-identical
+    to the device version (engine._mix32_dev)."""
+    h = (np.asarray(hi).view(np.uint32) * np.uint32(0x85EBCA6B)) \
+        ^ (np.asarray(lo).view(np.uint32) * np.uint32(0xC2B2AE35))
+    h = h ^ (h >> np.uint32(15))
+    h = h * np.uint32(0x27D4EB2F)
+    h = h ^ (h >> np.uint32(13))
+    return (h & np.uint32(0x3FFFFFFF)).astype(np.int32)
+
+
 class GrainArena:
 
     def __init__(self, info: VectorGrainInfo, capacity: int = 1024,
@@ -101,6 +134,13 @@ class GrainArena:
         self._dev_dense: Optional[jnp.ndarray] = None
         self._dev_index_stale = True
         self._dev_dense_stale = True
+        # wide-key (two-level hash/bucket) mirror — built on demand for
+        # arenas whose keys exceed int32 (see device_index_wide)
+        self._dev_wide: Optional[Tuple] = None
+        self._dev_wide_stale = True
+        # True once any activated key falls outside the int32 range:
+        # narrow emits to this arena then resolve through the wide mirror
+        self.has_wide_keys = False
 
     # -- state columns ------------------------------------------------------
 
@@ -143,6 +183,7 @@ class GrainArena:
         self._dirty = False
         self._dev_index_stale = True
         self._dev_dense_stale = True
+        self._dev_wide_stale = True
 
     # -- device-side directory mirror ---------------------------------------
 
@@ -228,6 +269,41 @@ class GrainArena:
         self._dev_dense_stale = False
         return dd
 
+    def device_index_wide(self) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                         jnp.ndarray, jnp.ndarray]:
+        """Wide-key directory mirror: ``(sorted_h, rows_by_h, hi_col,
+        lo_col)`` device arrays.  Destination resolution searchsorts the
+        30-bit bucket hashes, then verifies candidates against the full
+        key words per row — two gathers and one compare beyond the
+        narrow path, keeping 64-bit/hashed/string-keyed grains on the
+        device hot path (reference key breadth: UniqueKey.cs:34)."""
+        if self._dirty:
+            self._rebuild_index()
+        if self._dev_wide_stale or self._dev_wide is None:
+            hi, lo = split_wide_keys(self._sorted_keys)
+            h = mix32_np(hi, lo)
+            order = np.argsort(h, kind="stable")
+            pad = self.capacity - len(h)
+            sorted_h = np.concatenate(
+                [h[order], np.full(pad, 2**31 - 1, np.int32)])
+            rows_by_h = np.concatenate(
+                [self._sorted_rows[order], np.full(pad, -1, np.int32)])
+            hi_col = np.zeros(self.capacity, np.int32)
+            lo_col = np.full(self.capacity, -1, np.int32)
+            hi_col[self._sorted_rows] = hi
+            lo_col[self._sorted_rows] = lo
+            parts = tuple(jnp.asarray(p) for p in
+                          (sorted_h, rows_by_h, hi_col, lo_col))
+            if self.sharding is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                repl = NamedSharding(self.sharding.mesh, PartitionSpec())
+                parts = tuple(jax.device_put(p, repl) for p in parts)
+            if isinstance(parts[0], jax.core.Tracer):
+                return parts  # trace-local (see device_index)
+            self._dev_wide = parts
+            self._dev_wide_stale = False
+        return self._dev_wide
+
     def lookup_rows(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Vectorized lookup; returns (rows int32, found bool)."""
         if self._dirty:
@@ -259,6 +335,15 @@ class GrainArena:
         return rows
 
     def _activate_keys(self, keys: np.ndarray) -> None:
+        if len(keys) and int(keys.min()) < 0:
+            # the row map's free-slot sentinel is -1: the grain key
+            # domain is [0, 2**63) — hash wider identities into it
+            # (GrainId string/guid keys already do)
+            raise ValueError(
+                f"arena {self.info.name}: grain keys must be in "
+                f"[0, 2**63); got {int(keys.min())}")
+        if len(keys) and int(keys.max()) >= 2**31 - 1:
+            self.has_wide_keys = True
         shards = (_hash_keys_u64(keys) % np.uint64(self.n_shards)).astype(np.int64)
         # check capacity per shard; grow if any block would overflow
         counts = np.bincount(shards, minlength=self.n_shards)
@@ -454,6 +539,8 @@ class GrainArena:
         self._dev_dense_stale = True
         self._dev_sorted_keys = None
         self._dev_sorted_rows = None
+        self._dev_wide = None
+        self._dev_wide_stale = True
         self._init_state_columns(self.capacity)
         self.last_use_dev = self._dev_zeros_i32(self.capacity)
 

@@ -73,6 +73,9 @@ class PendingBatch:
     rows: Optional[jnp.ndarray] = None         # int32[m] device
     keys_host: Optional[np.ndarray] = None     # int64[m]
     keys_dev: Optional[jnp.ndarray] = None     # int32[m] device
+    # wide (64-bit) device keys as (hi, lo) int32 word pairs — resolved
+    # through the arena's two-level hash/bucket mirror
+    keys_wide: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
     mask: Optional[jnp.ndarray] = None         # bool[m] device (None = all)
     future: Optional[asyncio.Future] = None    # resolves to results[m]
     generation: int = -1                       # arena generation rows assume
@@ -85,6 +88,8 @@ class PendingBatch:
         for c in (self.rows, self.keys_host, self.keys_dev):
             if c is not None:
                 return len(c)
+        if self.keys_wide is not None:
+            return len(self.keys_wide[0])
         raise ValueError("empty batch")
 
 
@@ -133,9 +138,65 @@ def _resolve_rows_dense_kernel(dense, keys, valid):
     return rows, jnp.sum(hit ^ valid)  # miss count
 
 
+def _mix32_dev(hi, lo):
+    """Device twin of arena.mix32_np — MUST stay bit-identical."""
+    h = (hi.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)) \
+        ^ (lo.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x27D4EB2F)
+    h = h ^ (h >> 13)
+    return (h & jnp.uint32(0x3FFFFFFF)).astype(jnp.int32)
+
+
+#: bucket-collision probe depth: a run of >4 equal 30-bit hashes among
+#: live keys is astronomically unlikely; keys that still miss fall back
+#: to exact host-path redelivery (never silent loss, never a device loop)
+WIDE_PROBES = 4
+
+
+@jax.jit
+def _resolve_rows_wide_kernel(sorted_h, rows_by_h, hi_col, lo_col,
+                              hi, lo, valid):
+    """Two-level wide-key directory lookup: 30-bit bucket searchsorted,
+    then candidate rows verified against the full key words (the device
+    mirror for keys wider than int32; reference: UniqueKey.cs:34)."""
+    h = _mix32_dev(hi, lo)
+    n = sorted_h.shape[0]
+    cap = hi_col.shape[0]
+    idx = jnp.clip(jnp.searchsorted(sorted_h, h), 0, n - 1)
+    rows = jnp.full(h.shape, -1, jnp.int32)
+    for k in range(WIDE_PROBES):
+        j = jnp.clip(idx + k, 0, n - 1)
+        cand = rows_by_h[j]
+        cr = jnp.clip(cand, 0, cap - 1)
+        # `valid` folds into the returned rows — same invariant as the
+        # narrow kernels (downstream consumers mask on rows >= 0)
+        ok = valid & (sorted_h[j] == h) & (cand >= 0) \
+            & (hi_col[cr] == hi) & (lo_col[cr] == lo)
+        rows = jnp.where((rows < 0) & ok, cand, rows)
+    hit = (rows >= 0) & valid
+    return rows, jnp.sum(hit ^ valid)
+
+
 def resolve_rows_on_device(arena, keys, valid):
     """Pick the cheapest device resolve for this arena: dense direct-map
-    when the key space affords it, else sorted searchsorted."""
+    when the key space affords it, else sorted searchsorted; wide keys
+    (an ``(hi, lo)`` int32 word pair) and arenas holding wide keys use
+    the two-level hash/bucket mirror."""
+    if isinstance(keys, tuple):
+        hi, lo = keys
+        return _resolve_rows_wide_kernel(*arena.device_index_wide(),
+                                         hi, lo, valid)
+    if arena.has_wide_keys:
+        # narrow emit keys into a wide-keyed arena: the narrow mirror
+        # cannot exist (it would overflow); route through the wide one
+        # (an int32 emit key k is the wide key (0, k)).  Sentinel-parity
+        # with the narrow kernels: keys >= KEY_SENTINEL are padding,
+        # never lookups — without this a padding lane (0, 2**31-1) could
+        # alias a live grain whose key IS 2**31-1
+        valid = valid & (keys < KEY_SENTINEL)
+        return _resolve_rows_wide_kernel(
+            *arena.device_index_wide(), jnp.zeros_like(keys), keys, valid)
     dense = arena.dense_index()
     if dense is not None:
         return _resolve_rows_dense_kernel(dense, keys, valid)
@@ -384,6 +445,13 @@ class TensorEngine:
                     raise OverflowError(
                         "fanout src keys must be in [0, 2**31-1)")
                 skeys = jnp.asarray(b.keys_host.astype(np.int32))
+            elif b.keys_wide is not None:
+                # same contract as the host-key case, surfaced loudly
+                # instead of silently dropping the subscriber deliveries
+                raise OverflowError(
+                    "fanout expansion requires narrow int keys in "
+                    "[0, 2**31-1); wide (hi, lo) source keys cannot map "
+                    "through the CSR subscription graph")
             else:
                 continue  # row-only batch with no kept keys: nothing to map
             dst, gargs, valid = fanout.expand(skeys, b.args, b.mask)
@@ -662,9 +730,10 @@ class TensorEngine:
             # through to here too, re-resolving from the kept keys
             rows = arena.resolve_rows(b.keys_host, tick=self.tick_number)
             return rows.astype(np.int32), args  # numpy → host-pad path
-        keys = b.keys_dev
+        keys = b.keys_wide if b.keys_wide is not None else b.keys_dev
+        m = keys[0].shape[0] if isinstance(keys, tuple) else keys.shape[0]
         valid = b.mask if b.mask is not None \
-            else jnp.ones(keys.shape[0], dtype=bool)
+            else jnp.ones(m, dtype=bool)
         rows, miss_count = resolve_rows_on_device(arena, keys, valid)
         self._pending_checks.append(
             _MissCheck(arena=arena, type_name=arena.info.name,
@@ -688,6 +757,27 @@ class TensorEngine:
             if cnt == 0:
                 continue
             self.activation_passes += 1
+            if isinstance(c.keys, tuple):
+                # wide keys: redeliver the missed entries through the
+                # exact HOST path (reconstructed int64 keys) — activates,
+                # routes ownership, and cannot loop on pathological
+                # bucket-collision runs the device probes cannot resolve
+                from orleans_tpu.tensor.arena import join_wide_keys
+                missing_np = np.asarray((np.asarray(c.rows) < 0)
+                                        & np.asarray(c.valid))
+                idx = np.nonzero(missing_np)[0]
+                if len(idx) == 0:
+                    continue
+                keys64 = join_wide_keys(np.asarray(c.keys[0])[idx],
+                                        np.asarray(c.keys[1])[idx])
+                args_h = jax.tree_util.tree_map(np.asarray, c.args)
+                self.queues[(c.type_name, c.method)].append(PendingBatch(
+                    args=jax.tree_util.tree_map(
+                        lambda a: a if np.ndim(a) == 0 else a[idx],
+                        args_h),
+                    keys_host=keys64, no_fanout=True))
+                requeued = True
+                continue
             miss_keys, missing = _miss_keys_kernel(c.keys, c.rows, c.valid,
                                                    miss_buf=MISS_BUF)
             mk = np.asarray(miss_keys)
@@ -996,6 +1086,15 @@ class TensorEngine:
             if emit is None:
                 continue
             keys = emit.keys
+            if isinstance(keys, tuple):
+                # wide destination: (hi, lo) int32 word pair
+                hi, lo = (k if (isinstance(k, jnp.ndarray)
+                                and k.dtype == jnp.int32)
+                          else jnp.asarray(k, jnp.int32) for k in keys)
+                self.queues[(emit.interface, emit.method)].append(
+                    PendingBatch(args=emit.args, keys_wide=(hi, lo),
+                                 mask=emit.mask))
+                continue
             if not (isinstance(keys, jnp.ndarray) and keys.dtype == jnp.int32):
                 keys = jnp.asarray(keys, dtype=jnp.int32)
             self.queues[(emit.interface, emit.method)].append(PendingBatch(

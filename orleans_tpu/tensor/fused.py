@@ -124,11 +124,19 @@ class FusedTickProgram:
         for e in emits:
             if e is None:
                 continue
-            ekeys = e.keys if (hasattr(e.keys, "dtype")
-                               and e.keys.dtype == jnp.int32) \
-                else jnp.asarray(e.keys, jnp.int32)
-            emask = e.mask if e.mask is not None \
-                else ones_mask(ekeys.shape[0])
+            if isinstance(e.keys, tuple):
+                # wide destination keys ((hi, lo) int32 words) resolve
+                # through the wide mirror inside the window too
+                ekeys = tuple(
+                    k if (hasattr(k, "dtype") and k.dtype == jnp.int32)
+                    else jnp.asarray(k, jnp.int32) for k in e.keys)
+                m = ekeys[0].shape[0]
+            else:
+                ekeys = e.keys if (hasattr(e.keys, "dtype")
+                                   and e.keys.dtype == jnp.int32) \
+                    else jnp.asarray(e.keys, jnp.int32)
+                m = ekeys.shape[0]
+            emask = e.mask if e.mask is not None else ones_mask(m)
             out_batches.append((e.interface, e.method, ekeys, e.args, emask))
 
         fan = self.engine._fanouts.get((type_name, method))

@@ -117,11 +117,13 @@ class Emit:
 
     interface: str             # target interface name (static under jit)
     method: str                # target method name (static under jit)
-    # grain primary keys [M'] (may repeat).  Device routing requires keys
-    # in [0, 2**31-1): wider keys cannot ride the int32 device directory
-    # mirror and must go through host-side send_batch instead (the arena
-    # raises OverflowError if a >int32 key ever reaches its device index).
-    keys: jnp.ndarray
+    # grain primary keys [M'] (may repeat).  An int32 array routes
+    # through the narrow device directory mirror (keys in [0, 2**31-1));
+    # WIDE keys (full 64-bit space — hashed/string/guid identities,
+    # reference: UniqueKey.cs:34) ride as an ``(hi, lo)`` int32 word
+    # pair and resolve through the arena's two-level hash/bucket mirror
+    # (arena.device_index_wide) — still entirely on device.
+    keys: Any
     args: Any                  # pytree of [M', ...]
     mask: Optional[jnp.ndarray] = None  # bool[M']; None = all valid
 

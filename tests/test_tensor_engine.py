@@ -246,14 +246,18 @@ def test_turn_observer_tolerates_cancellation(run):
     run(main())
 
 
-def test_wide_keys_use_host_path_and_device_routing_refuses(run):
-    """Documented v1 constraint (README 'Device routing keys'): the device
-    directory mirror is int32-keyed.  Wide (hashed/string) keys still work
-    through host-side resolution; asking for the device index with wide
-    keys raises a clear OverflowError instead of corrupting routes."""
+def test_wide_keys_resolve_on_device(run):
+    """Keys beyond int32 route on DEVICE through the two-level
+    hash/bucket mirror (arena.device_index_wide; the r3-era refusal is
+    gone — only the NARROW mirror still refuses wide keys, because the
+    wide one serves them).  Host-path dispatch and results keep working
+    unchanged."""
 
     async def main():
         import pytest
+        import jax.numpy as _jnp
+        from orleans_tpu.tensor.arena import split_wide_keys
+        from orleans_tpu.tensor.engine import resolve_rows_on_device
 
         engine = TensorEngine()
         arena = engine.arena_for("AccumGrain")
@@ -269,7 +273,17 @@ def test_wide_keys_use_host_path_and_device_routing_refuses(run):
         rows = arena.resolve_rows(wide)
         assert arena.live_count >= 2 and rows[0] != rows[1]
 
-        # device mirror refuses wide keys loudly
+        # device path: the wide mirror resolves the same keys to the
+        # same rows, entirely on device
+        hi, lo = split_wide_keys(wide)
+        drows, miss = resolve_rows_on_device(
+            arena, (_jnp.asarray(hi), _jnp.asarray(lo)),
+            _jnp.ones(2, dtype=bool))
+        assert int(miss) == 0
+        np.testing.assert_array_equal(np.asarray(drows), rows)
+
+        # the narrow int32 mirror still refuses loudly (it cannot hold
+        # these keys); the wide mirror is the supported path
         with pytest.raises(OverflowError, match="int32"):
             arena.device_index()
 
