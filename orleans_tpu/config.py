@@ -84,7 +84,12 @@ class TensorEngineConfig:
     # 0 disables automatic sweeps (collect_idle() remains callable).
     collection_idle_ticks: int = 0
     collection_every_ticks: int = 64
-    bucket_sizes: tuple = (256, 4096, 65536, 1 << 20)  # padded batch buckets
+    # padded host-batch buckets: a batch compiles at the smallest bucket
+    # ≥ its size, so the ladder bounds both compile count and padding
+    # waste (the old 65536 → 1M jump made a 200k-message batch pay 5×
+    # its compute in padding)
+    bucket_sizes: tuple = (256, 4096, 32768, 131072, 262144, 524288,
+                           1 << 20)
     mesh_axis: str = "grains"
     # max parked optimistic miss-checks before a forced (synchronizing)
     # drain — bounds device memory pinned by deferred delivery checks

@@ -137,6 +137,16 @@ def build_silo(config: Dict[str, Any],
         result = load_attr(config["startup"])(silo)
         if isinstance(result, dict):
             silo.services.update(result)
+    if not silo.statistics_publishers \
+            and config.get("default_stats_log", True):
+        # hosted silos dump their metrics periodically by default
+        # (reference: LogStatistics.cs:33 'DumpCounters' runs out of the
+        # box); disable with "default_stats_log": false or replace via a
+        # statistics provider block
+        from orleans_tpu.plugins.stats_publisher import (
+            LogStatisticsPublisher,
+        )
+        silo.statistics_publishers["log"] = LogStatisticsPublisher()
     return silo
 
 
