@@ -766,12 +766,17 @@ class TensorEngine:
         self._pending_checks = []
         requeued = False
         # one batched sync for all parked counts — a single device
-        # transfer regardless of how many checks are parked
+        # transfer regardless of how many checks are parked.  The arity
+        # pads to the next power of two so the varargs jit compiles
+        # O(log cap) programs, not one per distinct count
         if len(checks) == 1:
             counts = [int(checks[0].miss_count)]
         else:
-            counts = np.asarray(_stack_counts(
-                *[c.miss_count for c in checks])).tolist()
+            n = len(checks)
+            padded = 1 << (n - 1).bit_length()
+            xs = [c.miss_count for c in checks] \
+                + [np.int32(0)] * (padded - n)
+            counts = np.asarray(_stack_counts(*xs))[:n].tolist()
         for c, cnt in zip(checks, counts):
             if cnt == 0:
                 continue
@@ -1125,9 +1130,11 @@ class TensorEngine:
         for b in self.config.bucket_sizes:
             if m <= b:
                 return b
-        # beyond the ladder: compile at the exact size (padding smaller
-        # than m would corrupt the batch)
-        return m
+        # beyond the ladder: round up to a multiple of the last rung so
+        # oversized batches still share compiles (never pad SHORTER than
+        # m — that would corrupt the batch)
+        last = self.config.bucket_sizes[-1]
+        return -(-m // last) * last
 
     def _get_step(self, info: VectorGrainInfo, method: str) -> Callable:
         key = (info.name, method)
