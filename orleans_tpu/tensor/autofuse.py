@@ -28,6 +28,7 @@ data-flow (contract: tensor/fused.py).
 from __future__ import annotations
 
 import time
+import weakref
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -74,9 +75,30 @@ class AutoFuser:
         # replay every window — after auto_fusion_max_rollbacks strikes it
         # is banned like a fuse failure (until ring/generation change)
         self._rollback_counts: Dict[Tuple, int] = {}
+        # identity-memoized CONTENT digests of key arrays: the signature
+        # must survive a loader recreating its injector (fresh array,
+        # same keys), or every reconnect/loader restart would pay the
+        # full detection threshold AND a recompile.  The digest hashes
+        # the bytes ONCE per array identity; the weakref guards against
+        # id() reuse after garbage collection.
+        self._digest_cache: Dict[int, Tuple[Any, int]] = {}
         self.windows_run = 0
         self.windows_rolled_back = 0
         self.ticks_fused = 0
+
+    def _keys_digest(self, arr: np.ndarray) -> int:
+        ent = self._digest_cache.get(id(arr))
+        if ent is not None and ent[0]() is arr:
+            return ent[1]
+        digest = hash((len(arr), arr.tobytes()))
+        if len(self._digest_cache) > 256:
+            self._digest_cache.clear()
+        try:
+            ref = weakref.ref(arr)
+        except TypeError:  # non-weakrefable array subclass
+            return digest
+        self._digest_cache[id(arr)] = (ref, digest)
+        return digest
 
     # ================= detection ==========================================
 
@@ -158,8 +180,8 @@ class AutoFuser:
         if arena is None or b.generation != arena.generation:
             self._break()
             return False
-        sig = (type_name, method, id(b.keys_host), b.generation,
-               tuple(sorted(args)), self._ring_version())
+        sig = (type_name, method, self._keys_digest(b.keys_host),
+               b.generation, tuple(sorted(args)), self._ring_version())
         if self._disabled.get(sig) == self._ring_version():
             self._break()
             return False
@@ -221,6 +243,8 @@ class AutoFuser:
 
     def _engage(self, sig: Tuple, b, args: Dict[str, Any]) -> bool:
         prog = self._programs.get(sig)
+        if prog is not None and not np.array_equal(prog.keys, b.keys_host):
+            prog = None  # content-digest collision: never reuse blindly
         if prog is None:
             try:
                 prog = self.engine.fuse_ticks(sig[0], sig[1], b.keys_host)

@@ -52,9 +52,13 @@ def test_cross_silo_presence_exact_and_fast(run):
             config_factory=relaxed_liveness).start()
         try:
             a = cluster.silos[0]
-            # warmup: compile every steady-state program shape
+            # warmup: compile every steady-state program shape AND let
+            # both silos' auto-fusers engage (detection threshold +
+            # window + engage compile happen here, untimed; the content-
+            # keyed signature keeps the warmed programs valid for the
+            # measured loader's fresh injector)
             await run_presence_load(a.tensor_engine, n_players=N_PLAYERS,
-                                    n_games=N_GAMES, n_ticks=2)
+                                    n_games=N_GAMES, n_ticks=40)
             await settle(cluster)
             base = cluster_game_updates(cluster)
 
@@ -170,6 +174,36 @@ def test_cross_silo_want_results_round_has_throughput(run):
             assert ratio <= 25.0, \
                 f"want_results {rpc_rate:,.0f} msg/s vs one-way " \
                 f"{oneway_rate:,.0f} msg/s = {ratio:.1f}x (budget 25x)"
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_receiving_silo_caches_steady_slab_injectors(run):
+    """Steady cross-silo traffic repeats the same slab key sets; the
+    receiver caches a BatchInjector per recurring shape so repeats ride
+    the cached-row fast path instead of re-resolving rows per slab —
+    and delivery stays exact.  (Fusing the slab-fed pattern itself is
+    blocked by concurrent slab streams per tick — heartbeats AND game
+    updates interleave — which the single-pattern window detector
+    rightly refuses.)"""
+
+    async def main():
+        cluster = await TestingCluster(
+            n_silos=2, transport="tcp",
+            config_factory=relaxed_liveness).start()
+        try:
+            a, b = cluster.silos
+            await run_presence_load(a.tensor_engine, n_players=N_PLAYERS,
+                                    n_games=N_GAMES, n_ticks=40)
+            await settle(cluster)
+            # exactness across the whole run
+            assert cluster_game_updates(cluster) == N_PLAYERS * 40
+            assert b.vector_router._slab_injectors, \
+                "recurring slab shapes were not cached on the receiver"
+            for inj in b.vector_router._slab_injectors.values():
+                assert inj.rows is not None  # cached-row fast path live
         finally:
             await cluster.stop()
 
