@@ -376,12 +376,20 @@ class MembershipOracle:
     # ================= heartbeats + refresh ===============================
 
     async def _iam_alive_loop(self) -> None:
-        """(reference: IAmAlive timer :195)"""
+        """(reference: IAmAlive timer :195).  A TRANSIENT table outage
+        (networked backend unreachable, CAS store restarting) must not
+        kill the loop — a dead heartbeat loop gets a healthy silo
+        declared dead as soon as peers' vote windows elapse."""
         try:
             while self._running:
                 await asyncio.sleep(self.config.iam_alive_table_publish)
-                await self.table.update_iam_alive(self.silo.address,
-                                                  time.time())
+                try:
+                    await self.table.update_iam_alive(self.silo.address,
+                                                      time.time())
+                except Exception as exc:  # noqa: BLE001 — retry next beat
+                    self.logger.warn(
+                        f"IAmAlive table write failed ({exc!r}); retrying "
+                        f"next period", code=2501)
         except asyncio.CancelledError:
             pass
 
@@ -389,7 +397,14 @@ class MembershipOracle:
         try:
             while self._running:
                 await asyncio.sleep(self.config.table_refresh_timeout)
-                await self.refresh_view()
+                try:
+                    await self.refresh_view()
+                except Exception as exc:  # noqa: BLE001 — keep last view,
+                    # retry next period (reference: table read failures are
+                    # logged, the oracle keeps operating on its last view)
+                    self.logger.warn(
+                        f"membership table refresh failed ({exc!r}); "
+                        f"keeping last view", code=2502)
         except asyncio.CancelledError:
             pass
 

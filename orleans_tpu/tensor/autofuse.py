@@ -87,17 +87,21 @@ class AutoFuser:
         self.ticks_fused = 0
 
     def _keys_digest(self, arr: np.ndarray) -> int:
-        ent = self._digest_cache.get(id(arr))
+        key = id(arr)
+        ent = self._digest_cache.get(key)
         if ent is not None and ent[0]() is arr:
+            # LRU touch: insertion order doubles as recency order
+            self._digest_cache[key] = self._digest_cache.pop(key)
             return ent[1]
         digest = hash((len(arr), arr.tobytes()))
-        if len(self._digest_cache) > 256:
-            self._digest_cache.clear()
+        while len(self._digest_cache) >= 256:
+            # evict ONE least-recently-used entry; hot arrays stay memoized
+            self._digest_cache.pop(next(iter(self._digest_cache)))
         try:
             ref = weakref.ref(arr)
         except TypeError:  # non-weakrefable array subclass
             return digest
-        self._digest_cache[id(arr)] = (ref, digest)
+        self._digest_cache[key] = (ref, digest)
         return digest
 
     # ================= detection ==========================================

@@ -1175,6 +1175,7 @@ class TensorEngine:
             "stages": dict(self.stage_seconds),
             "last_tick_stages": dict(self.last_tick_stages),
             "tick_latency": self.latency_stats(),
+            "autofuse": self.autofuser.snapshot(),
             "arenas": {name: a.live_count for name, a in self.arenas.items()},
             "evicted": sum(a.evicted_count for a in self.arenas.values()),
             "restored": sum(a.restored_count for a in self.arenas.values()),

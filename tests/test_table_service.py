@@ -164,3 +164,64 @@ def test_cluster_forms_over_table_service(run):
             await cluster.stop()
 
     run(go())
+
+
+def test_cluster_survives_table_service_outage(run):
+    """A transient table-service outage (server down, then back at the
+    same port) must not kill the silos' liveness loops or the cluster:
+    both silos keep their last membership view during the outage, keep
+    serving traffic, and resume heartbeats/refresh after recovery."""
+
+    async def go():
+        from orleans_tpu.testing.cluster import TestingCluster
+        import tests.test_autofuse  # registers LwwGrain
+
+        cluster = TestingCluster(n_silos=2, transport="tcp",
+                                 table_service=True)
+        await cluster.start()
+        try:
+            s0, s1 = cluster.silos
+            assert len(s0.active_silos()) == 2
+
+            # take the service DOWN mid-run (keep its state + port)
+            port = cluster.table_service.port
+            table = cluster.table_service.membership
+            cluster.table_service.close()
+            # sever live client connections so calls actually fail
+            for rt in cluster._remote_tables:
+                rt._client._drop_connection(ConnectionError("outage"))
+
+            # several heartbeat/refresh periods elapse during the outage
+            await asyncio.sleep(1.5)
+            # liveness loops are still ALIVE (health check green) and the
+            # last view stands
+            for s in cluster.silos:
+                assert s.membership_oracle.check_health(), \
+                    f"{s.name}: a liveness loop died during the outage"
+                assert len(s.active_silos()) == 2
+
+            # traffic still flows during the outage
+            keys = np.arange(32, dtype=np.int64)
+            s0.tensor_engine.send_batch(
+                "LwwGrain", "put", keys,
+                {"v": np.full(32, 7, np.int32)})
+            await cluster.quiesce_engines()
+
+            # service returns at the SAME port with the same state
+            from orleans_tpu.plugins.table_service import TableServiceServer
+            revived = TableServiceServer(
+                port=port, membership_table=table,
+                reminder_table=cluster.table_service.reminders)
+            await revived.start()
+            cluster.table_service = revived
+            served_before = revived.requests_served
+            await asyncio.sleep(1.5)  # heartbeat + refresh resume
+            assert revived.requests_served > served_before, \
+                "silos never reconnected to the revived table service"
+            for s in cluster.silos:
+                assert s.membership_oracle.check_health()
+                assert len(s.active_silos()) == 2
+        finally:
+            await cluster.stop()
+
+    run(go())
