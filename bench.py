@@ -183,6 +183,49 @@ async def _tensor_gps(n_devices: int, n_ticks: int,
     return stats
 
 
+async def _helloworld_bench(n_grains: int = 2000, n_rounds: int = 5,
+                            latency_calls: int = 2000) -> dict:
+    """The PR1 config (reference: Samples/HelloWorld — one silo, RPC
+    through the full per-message pipeline).  This measures the CONTROL
+    plane: dispatcher, catalog, turn gate, correlation — per-message by
+    design, so the number is the host path's ceiling, not the tensor
+    engine's."""
+    import numpy as np
+
+    from samples.helloworld import IHello
+    from orleans_tpu.runtime.silo import Silo
+
+    silo = Silo(name="hello-bench")
+    await silo.start()
+    try:
+        factory = silo.attach_client()
+        refs = [factory.get_grain(IHello, i) for i in range(n_grains)]
+        await asyncio.gather(*(r.say_hello("warm") for r in refs))
+        t0 = time.perf_counter()
+        for _ in range(n_rounds):
+            await asyncio.gather(*(r.say_hello("hi") for r in refs))
+        elapsed = time.perf_counter() - t0
+        throughput = n_grains * n_rounds / elapsed
+
+        # per-call latency, serialized (true turn round-trip)
+        ref = refs[0]
+        lat = []
+        for _ in range(latency_calls):
+            c0 = time.perf_counter()
+            await ref.say_hello("ping")
+            lat.append(time.perf_counter() - c0)
+        d = np.asarray(lat)
+        return {
+            "throughput": throughput,
+            "p50": float(np.percentile(d, 50)),
+            "p99": float(np.percentile(d, 99)),
+            "grains": n_grains,
+            "calls": n_grains * n_rounds + latency_calls,
+        }
+    finally:
+        await silo.stop(graceful=False)
+
+
 async def _tensor_twitter(n_tweets_per_tick: int, n_hashtags: int,
                           n_ticks: int, latency_ticks: int) -> dict:
     from orleans_tpu.tensor import TensorEngine
@@ -334,7 +377,7 @@ def main() -> None:
                         help="small sizes for a quick correctness pass")
     parser.add_argument("--workload",
                         choices=("presence", "chirper", "gpstracker",
-                                 "twitter"),
+                                 "twitter", "helloworld"),
                         default="presence")
     parser.add_argument("--target-latency", type=float, default=None,
                         help="publish ONE latency-bounded presence "
@@ -482,8 +525,34 @@ def main() -> None:
                            "counter-visible completion)",
         }
 
+    async def run_hello() -> dict:
+        if args.smoke:
+            stats = await _helloworld_bench(n_grains=200, n_rounds=3,
+                                            latency_calls=200)
+        else:
+            stats = await _helloworld_bench()
+        return {
+            "metric": "helloworld_rpc_per_sec",
+            "value": round(stats["throughput"], 1),
+            "unit": "rpc/s",
+            "vs_baseline": 1.0,
+            "baseline_def": "this IS the per-message host path (the PR1 "
+                            "config exercises the control plane: "
+                            "dispatcher, catalog, turn gate, correlation "
+                            "— per-message by design); the tensor engine "
+                            "workloads are benchmarked against it",
+            "grains": stats["grains"],
+            "calls": stats["calls"],
+            "engine": "host path (asyncio per-message pipeline)",
+            "p99_turn_latency_s": round(stats["p99"], 6),
+            "p50_turn_latency_s": round(stats["p50"], 6),
+            "latency_def": "serialized single-call round-trip "
+                           "(reference → invoke → response) wall time",
+        }
+
     runners = {"presence": run, "chirper": run_chirper,
-               "gpstracker": run_gps, "twitter": run_twitter}
+               "gpstracker": run_gps, "twitter": run_twitter,
+               "helloworld": run_hello}
     result = asyncio.run(runners[args.workload]())
     print(json.dumps(result))
 

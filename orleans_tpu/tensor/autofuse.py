@@ -94,13 +94,13 @@ class AutoFuser:
             self._digest_cache[key] = self._digest_cache.pop(key)
             return ent[1]
         digest = hash((len(arr), arr.tobytes()))
+        try:
+            ref = weakref.ref(arr)
+        except TypeError:  # non-weakrefable array subclass: no memo
+            return digest
         while len(self._digest_cache) >= 256:
             # evict ONE least-recently-used entry; hot arrays stay memoized
             self._digest_cache.pop(next(iter(self._digest_cache)))
-        try:
-            ref = weakref.ref(arr)
-        except TypeError:  # non-weakrefable array subclass
-            return digest
         self._digest_cache[key] = (ref, digest)
         return digest
 
