@@ -1,0 +1,72 @@
+"""Presence with WIDE (64-bit hashed-identity) game keys.
+
+The same heartbeat→fan-in pipeline as samples/presence.py, but game
+identities live in the full [0, 2^63) key space (hashed string names —
+the reference's UniqueKey shape, UniqueKey.cs:34) and emits address them
+as (hi, lo) int32 word pairs through the arena's two-level wide mirror
+(arena.device_index_wide).  Used by the wide-key tests and the multichip
+dryrun.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.core.grain import batched_method
+from orleans_tpu.hashing import jenkins_hash
+from orleans_tpu.tensor import (
+    Batch,
+    Emit,
+    VectorGrain,
+    field,
+    seg_sum,
+    vector_grain,
+)
+from orleans_tpu.tensor.vector_grain import scatter_add_rows
+
+
+def wide_game_keys(n: int) -> np.ndarray:
+    """String-identity games hashed into the full 64-bit space."""
+    return np.array(
+        [((jenkins_hash(f"game-{i}".encode()) << 33)
+          ^ jenkins_hash(f"g2-{i}".encode())) & 0x7FFFFFFFFFFFFFFF
+         for i in range(n)],
+        dtype=np.uint64).astype(np.int64)
+
+
+@vector_grain
+class WidePresence(VectorGrain):
+    """Presence whose emit destination is an (hi, lo) word pair."""
+
+    heartbeats = field(jnp.int32, 0)
+
+    @batched_method
+    @staticmethod
+    def heartbeat(state, batch: Batch, n_rows: int):
+        ones = jnp.ones_like(batch.rows, dtype=jnp.int32) * batch.mask
+        state = {**state,
+                 "heartbeats": scatter_add_rows(state["heartbeats"],
+                                                batch.rows, ones)}
+        emit = Emit(interface="WideGame", method="update",
+                    keys=(batch.args["game_hi"], batch.args["game_lo"]),
+                    args={"score": batch.args["score"], "count": ones},
+                    mask=batch.mask)
+        return state, None, (emit,)
+
+
+@vector_grain
+class WideGame(VectorGrain):
+    total_score = field(jnp.float32, 0.0)
+    updates = field(jnp.int32, 0)
+
+    @batched_method
+    @staticmethod
+    def update(state, batch: Batch, n_rows: int):
+        return {
+            **state,
+            "total_score": state["total_score"]
+            + seg_sum(batch.args["score"], batch.rows, n_rows),
+            "updates": state["updates"]
+            + seg_sum(batch.args["count"], batch.rows, n_rows),
+        }

