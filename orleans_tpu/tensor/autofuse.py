@@ -37,24 +37,43 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class _PatternState:
+    """Per-(type, method) detection/engagement state of one steady
+    injection stream.  A tick's steady state may carry SEVERAL streams
+    (an app running presence + chirper at once; aligned cross-silo slab
+    arrivals) — the fuser tracks the whole set and compiles ONE window
+    program applying every stream per tick, in canonical order."""
+
+    __slots__ = ("key", "sig", "prev_top", "static_keys", "rows",
+                 "keys_host", "generation", "static_args")
+
+    def __init__(self, key: Tuple[str, str], sig: Tuple,
+                 args: Dict[str, Any], b) -> None:
+        self.key = key
+        self.sig = sig
+        self.prev_top = dict(args)
+        self.static_keys = set(args)
+        self.rows = b.rows
+        self.keys_host = b.keys_host
+        self.generation = b.generation
+        self.static_args: Dict[str, Any] = {}
+
+
 class AutoFuser:
 
     def __init__(self, engine) -> None:
         self.engine = engine
-        # detection state
+        # detection state: the steady SET of patterns (sorted by
+        # (type, method)) plus a composite signature over all of them
         self._sig: Optional[Tuple] = None
         self._count = 0
-        self._prev_top: Dict[str, Any] = {}
-        self._static_keys: set = set()
+        self._patterns: List[_PatternState] = []
         self._activation_passes = -1
         # engaged-window state
         self._program = None
-        self._pattern: Optional[Tuple[str, str]] = None
-        self._pattern_rows = None
-        self._pattern_keys = None
-        self._pattern_generation = -1
-        self._static_args: Dict[str, Any] = {}
-        self._buffer: List[Dict[str, Any]] = []
+        # per tick, one per-tick-leaf dict PER PATTERN (aligned with
+        # self._patterns)
+        self._buffer: List[List[Dict[str, Any]]] = []
         self._replaying = False
         # verification chain: windows whose device-side miss counters
         # have not been read yet.  One observation per
@@ -109,8 +128,7 @@ class AutoFuser:
     def _reset(self) -> None:
         self._sig = None
         self._count = 0
-        self._prev_top = {}
-        self._static_keys = set()
+        self._patterns = []
         self._program = None
 
     def has_buffer(self) -> bool:
@@ -163,59 +181,77 @@ class AutoFuser:
         silo = self.engine.silo
         return silo.ring.version if silo is not None else 0
 
+    def _scan_live(self) -> Optional[List[Tuple]]:
+        """Inspect the live queues; return ``[(key, batch, args, psig)]``
+        sorted by (type, method) when EVERY live queue carries exactly
+        one fusable injection batch, else None."""
+        live = sorted((k, v) for k, v in self.engine.queues.items() if v)
+        if not live:
+            return None
+        entries = []
+        for key, batches in live:
+            if len(batches) != 1:
+                return None
+            b = batches[0]
+            args = b.args
+            if (b.future is not None or b.rows is None
+                    or b.keys_host is None or b.no_fanout
+                    or b.mask is not None or not isinstance(args, dict)):
+                return None
+            arena = self.engine.arenas.get(key[0])
+            if arena is None or b.generation != arena.generation:
+                return None
+            psig = (key[0], key[1], self._keys_digest(b.keys_host),
+                    b.generation, tuple(sorted(args)))
+            entries.append((key, b, args, psig))
+        return entries
+
     def offer(self) -> bool:
         """Called at tick start.  Returns True when the tick's work was
         consumed into the fused window (caller skips the unfused path)."""
         cfg = self.engine.config
         if cfg.auto_fusion_ticks <= 0 or self._replaying:
             return False
-        live = [(k, v) for k, v in self.engine.queues.items() if v]
-        if len(live) != 1 or len(live[0][1]) != 1:
+        entries = self._scan_live()
+        if entries is None:
             self._break()
             return False
-        (type_name, method), (b,) = live[0]
-        args = b.args
-        if (b.future is not None or b.rows is None or b.keys_host is None
-                or b.no_fanout or b.mask is not None
-                or not isinstance(args, dict)):
-            self._break()
-            return False
-        arena = self.engine.arenas.get(type_name)
-        if arena is None or b.generation != arena.generation:
-            self._break()
-            return False
-        sig = (type_name, method, self._keys_digest(b.keys_host),
-               b.generation, tuple(sorted(args)), self._ring_version())
+        sig = (tuple(e[3] for e in entries), self._ring_version())
         if self._disabled.get(sig) == self._ring_version():
             self._break()
             return False
+
+        def seed() -> None:
+            self._sig = sig
+            self._count = 1
+            self._patterns = [_PatternState(key, psig, args, b)
+                              for key, b, args, psig in entries]
+            self._activation_passes = self.engine.activation_passes
+
         if sig != self._sig:
             self._break()
-            self._sig = sig
-            self._count = 1
-            self._prev_top = dict(args)
-            self._static_keys = set(args)
-            self._activation_passes = self.engine.activation_passes
+            seed()
             return False
-        # same signature again: refine the static split by leaf identity
-        new_static = {k for k in self._static_keys
-                      if args[k] is self._prev_top.get(k)}
-        if self._program is not None \
-                and not set(self._static_args) <= new_static:
-            # a leaf that was static at ENGAGE time changed identity
-            # mid-window: window[0]'s per-tick stack lacks that leaf, so
-            # continuing would silently apply the frozen value to every
-            # buffered tick.  Disengage, replay the buffer unfused, and
-            # restart detection from this tick.
+        # same composite signature again: refine every pattern's static
+        # split by leaf identity
+        shrunk_engaged = False
+        for pat, (key, b, args, _psig) in zip(self._patterns, entries):
+            new_static = {k for k in pat.static_keys
+                          if args[k] is pat.prev_top.get(k)}
+            if self._program is not None \
+                    and not set(pat.static_args) <= new_static:
+                # a leaf that was static at ENGAGE time changed identity
+                # mid-window: window[0]'s per-tick stack lacks that leaf,
+                # so continuing would silently apply the frozen value to
+                # every buffered tick.  Disengage, replay the buffer
+                # unfused, and restart detection from this tick.
+                shrunk_engaged = True
+            pat.static_keys = new_static
+            pat.prev_top = dict(args)
+        if shrunk_engaged:
             self._break()
-            self._sig = sig
-            self._count = 1
-            self._prev_top = dict(args)
-            self._static_keys = set(args)
-            self._activation_passes = self.engine.activation_passes
+            seed()
             return False
-        self._static_keys = new_static
-        self._prev_top = dict(args)
         self._count += 1
         threshold = 2 if sig in self._programs else cfg.auto_fusion_ticks
         if self._count < threshold:
@@ -233,36 +269,52 @@ class AutoFuser:
             self._activation_passes = self.engine.activation_passes
             self._count = 1
             return False
-        if len(self._static_keys) == len(args):
+        if all(len(pat.static_keys) == len(e[2])
+               for pat, e in zip(self._patterns, entries)):
             return False  # nothing varies per tick: no window axis
-        if self._program is None and not self._engage(sig, b, args):
+        if self._program is None and not self._engage(sig, entries):
             return False
         # consume this tick into the window buffer
-        self.engine.queues[(type_name, method)].clear()
-        self._buffer.append(
-            {k: v for k, v in args.items() if k not in self._static_keys})
+        for key, _b, _args, _p in entries:
+            self.engine.queues[key].clear()
+        self._buffer.append([
+            {k: v for k, v in args.items() if k not in pat.static_keys}
+            for pat, (_key, _b, args, _p) in zip(self._patterns, entries)])
         if len(self._buffer) >= cfg.auto_fusion_window:
             self._run_window()
         return True
 
-    def _engage(self, sig: Tuple, b, args: Dict[str, Any]) -> bool:
+    def _engage(self, sig: Tuple, entries: List[Tuple]) -> bool:
+        from orleans_tpu.tensor.fused import FusedTickProgram
+
         prog = self._programs.get(sig)
-        if prog is not None and not np.array_equal(prog.keys, b.keys_host):
+        if prog is not None and (
+                len(prog.sources) != len(entries)
+                or any(not np.array_equal(s.keys, e[1].keys_host)
+                       for s, e in zip(prog.sources, entries))):
             prog = None  # content-digest collision: never reuse blindly
         if prog is None:
-            try:
-                prog = self.engine.fuse_ticks(sig[0], sig[1], b.keys_host)
-            except ValueError:
-                # cluster: keys not all ring-owned here — never fuse this
-                # pattern while this ring stands
-                self._disabled[sig] = self._ring_version()
-                self._reset()
-                return False
+            # clustered silos: every source's key set must be entirely
+            # ring-owned here (same contract as engine.fuse_ticks)
+            router = self.engine.router
+            if router is not None:
+                for _key, b, _args, _p in entries:
+                    _local, remote = router.partition(_key[0], b.keys_host)
+                    if remote:
+                        self._disabled[sig] = self._ring_version()
+                        self._reset()
+                        return False
+            prog = FusedTickProgram.multi(
+                self.engine,
+                [(key[0], key[1], b.keys_host)
+                 for key, b, _args, _p in entries])
             # no donation: the pre-run buffers stay valid, making the
             # rollback snapshot a dict of references instead of device
             # copies (see FusedTickProgram.donate)
             prog.donate = False
             self._programs[sig] = prog
+        for pat, (_key, _b, args, _p) in zip(self._patterns, entries):
+            pat.static_args = {k: args[k] for k in pat.static_keys}
         if prog._compiled is None:
             # compile NOW, not when the first window fills: the compile
             # stall lands on the engagement tick instead of surprising a
@@ -271,27 +323,26 @@ class AutoFuser:
             # execution, and run() then calls the compiled executable
             # directly (window shape and arg structure are fixed for the
             # engagement's lifetime).
-            wrapped = prog._build(dict(args))
+            wrapped = prog._build(
+                [dict(e[2]) for e in entries] if prog._is_multi()
+                else dict(entries[0][2]))
             W = self.engine.config.auto_fusion_window
-            static_keys = self._static_keys
 
             def aval(v):
                 a = np.asarray(v)
                 return jax.ShapeDtypeStruct((W,) + a.shape, a.dtype)
 
-            stacked0 = {k: aval(v) for k, v in args.items()
-                        if k not in static_keys}
+            stacked0 = [
+                {k: aval(v) for k, v in e[2].items()
+                 if k not in pat.static_keys}
+                for pat, e in zip(self._patterns, entries)]
+            statics0 = [pat.static_args for pat in self._patterns]
             states = {n: self.engine.arena_for(n).state
                       for n in prog._touched}
             prog._compiled = wrapped.lower(
-                states, {k: args[k] for k in static_keys}, stacked0,
+                states, statics0, stacked0,
                 jnp.zeros(2, jnp.int32)).compile()
         self._program = prog
-        self._pattern = (sig[0], sig[1])
-        self._pattern_rows = b.rows
-        self._pattern_keys = b.keys_host
-        self._pattern_generation = b.generation
-        self._static_args = {k: args[k] for k in self._static_keys}
         return True
 
     # ================= window execution ====================================
@@ -302,25 +353,26 @@ class AutoFuser:
         t0 = time.perf_counter()
         window = self._buffer
         self._buffer = []
-        stacked = {
-            k: (jnp.stack([w[k] for w in window])
-                if isinstance(window[0][k], jax.Array)
-                else np.stack([np.asarray(w[k]) for w in window]))
-            for k in window[0]}
 
-        # make sure the program is traced so its touched-arena list is
-        # complete; a generation change forces both a rebuild and a
-        # settle of the outstanding chain (its snapshot refs belong to
-        # the old generation — rollback across a repack is impossible)
+        def stack_source(i: int) -> Dict[str, Any]:
+            first = window[0][i]
+            return {
+                k: (jnp.stack([w[i][k] for w in window])
+                    if isinstance(first[k], jax.Array)
+                    else np.stack([np.asarray(w[i][k]) for w in window]))
+                for k in first}
+
+        stackeds = [stack_source(i) for i in range(len(self._patterns))]
+        statics = [pat.static_args for pat in self._patterns]
+
+        # a generation change since the trace forces a settle of the
+        # outstanding chain BEFORE prog.run rebuilds against the fresh
+        # mirrors (the chain's snapshot refs belong to the old
+        # generation — rollback across a repack is impossible)
         if prog._compiled is None or any(
                 engine.arena_for(n).generation != g
                 for n, g in prog._generations.items()):
             self._settle_chain()
-            prog.src_rows = jnp.asarray(
-                prog.src_arena.resolve_rows(prog.keys))
-            example = {**self._static_args,
-                       **jax.tree_util.tree_map(lambda a: a[0], stacked)}
-            prog._compiled = prog._build(example)
         if self._chain_snapshot is None:
             # chain start: the pre-run buffers ARE the snapshot — the
             # programs never donate (see _engage), so these references
@@ -333,7 +385,8 @@ class AutoFuser:
             self._chain_generations = {
                 n: engine.arena_for(n).generation for n in prog._touched}
 
-        prog.run(stacked, static_args=self._static_args)
+        prog.run(stackeds if prog._is_multi() else stackeds[0],
+                 static_args=statics if prog._is_multi() else statics[0])
         self._unverified.append(window)
         # the window advanced the tick clock: honor the periodic
         # checkpoint cadence in the fused steady state too (its write
@@ -424,9 +477,11 @@ class AutoFuser:
     def flush_partial(self) -> bool:
         """Re-enqueue ONE buffered tick for exact unfused replay (the
         engine's drain loop calls this until it returns False).  One tick
-        per call preserves per-tick application order.  Settles the
-        verification chain first — flush means FULL delivery, including
-        any rollback-replay the chain still owes."""
+        per call preserves per-tick application order; every pattern's
+        batch of that tick re-enqueues together, matching how the tick
+        originally arrived.  Settles the verification chain first —
+        flush means FULL delivery, including any rollback-replay the
+        chain still owes."""
         if self._unverified and not self._replaying:
             self._settle_chain()
             return True
@@ -437,11 +492,12 @@ class AutoFuser:
 
         self._replaying = True
         tick_args = self._buffer.pop(0)
-        self.engine.queues[self._pattern].append(PendingBatch(
-            args={**self._static_args, **tick_args},
-            rows=self._pattern_rows,
-            keys_host=self._pattern_keys,
-            generation=self._pattern_generation))
+        for pat, per_tick in zip(self._patterns, tick_args):
+            self.engine.queues[pat.key].append(PendingBatch(
+                args={**pat.static_args, **per_tick},
+                rows=pat.rows,
+                keys_host=pat.keys_host,
+                generation=pat.generation))
         return True
 
     def snapshot(self) -> Dict[str, int]:

@@ -64,27 +64,52 @@ def _normalize(out):
     return state, results, emits
 
 
-class FusedTickProgram:
-    """One compiled multi-tick program for a stable injection pattern.
+class _Source:
+    """One injection pattern of a fused window (a multi-pattern window
+    applies several per tick, in a canonical order)."""
 
-    Built by ``TensorEngine.fuse_ticks``.  Calling ``run`` executes T
-    ticks in one dispatch and updates the arenas' states; ``misses``
-    accumulates the device-side count of emit destinations that were not
-    in the frozen directory mirror (must be 0 for the window to be
-    exact — check with ``verify()``)."""
+    def __init__(self, engine, type_name: str, method: str,
+                 keys: np.ndarray) -> None:
+        if vector_type(type_name) is None:
+            raise KeyError(f"{type_name!r} is not a @vector_grain type")
+        self.type_name = type_name
+        self.method = method
+        self.arena = engine.arena_for(type_name)
+        self.keys = np.asarray(keys, dtype=np.int64)
+        self.rows = jnp.asarray(self.arena.resolve_rows(self.keys))
+
+
+class FusedTickProgram:
+    """One compiled multi-tick program for one or more stable injection
+    patterns.
+
+    Built by ``TensorEngine.fuse_ticks`` (single pattern — ``run`` takes
+    one stacked/static pytree pair) or ``FusedTickProgram.multi``
+    (several concurrent steady patterns — ``run`` takes LISTS aligned
+    with the sources, applied per tick in source order).  Calling
+    ``run`` executes T ticks in one dispatch and updates the arenas'
+    states; ``misses`` accumulates the device-side count of emit
+    destinations that were not in the frozen directory mirror (must be
+    0 for the window to be exact — check with ``verify()``)."""
 
     def __init__(self, engine, type_name: str, method: str,
                  keys: np.ndarray) -> None:
         self.engine = engine
-        self.type_name = type_name
-        self.method = method
-        info = vector_type(type_name)
-        if info is None:
-            raise KeyError(f"{type_name!r} is not a @vector_grain type")
-        self.src_arena = engine.arena_for(type_name)
-        self.keys = np.asarray(keys, dtype=np.int64)
-        self.src_rows = jnp.asarray(self.src_arena.resolve_rows(self.keys))
-        self.n_msgs = len(keys)
+        self.sources = [_Source(engine, type_name, method, keys)]
+        self._finish_init()
+
+    @classmethod
+    def multi(cls, engine,
+              sources: "List[Tuple[str, str, np.ndarray]]"
+              ) -> "FusedTickProgram":
+        self = cls.__new__(cls)
+        self.engine = engine
+        self.sources = [_Source(engine, t, m, k) for t, m, k in sources]
+        self._finish_init()
+        return self
+
+    def _finish_init(self) -> None:
+        self.n_msgs = sum(len(s.keys) for s in self.sources)
         self._generations: Dict[str, int] = {}
         self._touched: List[str] = []
         self._compiled: Callable | None = None
@@ -95,6 +120,42 @@ class FusedTickProgram:
         # are ruinously slow on tunneled runtimes.  Manual fused drivers
         # keep donation (no rollback path; verify() asserts instead).
         self.donate = True
+
+    # -- legacy single-source aliases (manual drivers, tests) ---------------
+
+    @property
+    def type_name(self) -> str:
+        return self.sources[0].type_name
+
+    @property
+    def method(self) -> str:
+        return self.sources[0].method
+
+    @property
+    def keys(self) -> np.ndarray:
+        return self.sources[0].keys
+
+    @property
+    def src_arena(self):
+        return self.sources[0].arena
+
+    @property
+    def src_rows(self):
+        return self.sources[0].rows
+
+    @src_rows.setter
+    def src_rows(self, value) -> None:
+        self.sources[0].rows = value
+
+    def _is_multi(self) -> bool:
+        return len(self.sources) > 1
+
+    def _as_lists(self, stacked_args: Any, static_args: Any
+                  ) -> Tuple[List[Any], List[Any]]:
+        if self._is_multi():
+            return list(stacked_args), list(static_args or
+                                            [{}] * len(self.sources))
+        return [stacked_args], [static_args or {}]
 
     # -- trace-time recursion over the emit graph ---------------------------
 
@@ -194,11 +255,31 @@ class FusedTickProgram:
     # -- compile + run -------------------------------------------------------
 
     def _build(self, example_args_t: Any) -> Callable:
-        self._generations.clear()
-        self._touched = [self.type_name]
-        self._generations[self.type_name] = self.src_arena.generation
-        src_rows = self.src_rows
-        mask = ones_mask(self.n_msgs)
+        examples = example_args_t if self._is_multi() \
+            else [example_args_t]
+        src_rows = [s.rows for s in self.sources]
+        masks = [ones_mask(len(s.keys)) for s in self.sources]
+
+        def apply_all(states, per_source_args):
+            miss_tot = jnp.int32(0)
+            del_tot = jnp.int32(0)
+            for i, src in enumerate(self.sources):
+                states, miss, dd = self._apply_group(
+                    states, src.type_name, src.method, src_rows[i],
+                    per_source_args[i], masks[i], depth=1)
+                miss_tot = miss_tot + miss
+                del_tot = del_tot + dd
+            return states, miss_tot, del_tot
+
+        def reset_discovery() -> None:
+            self._generations = {s.type_name: s.arena.generation
+                                 for s in self.sources}
+            self._touched = []
+            for s in self.sources:
+                if s.type_name not in self._touched:
+                    self._touched.append(s.type_name)
+
+        reset_discovery()
 
         # discovery: abstractly trace ONE tick so the emit graph's
         # destination arenas are known before the scan carry is fixed.
@@ -210,18 +291,15 @@ class FusedTickProgram:
         # reused closure would hit the cache and silently skip the trace.
         while True:
             known = set(self.engine.arenas)
-            self._generations = {self.type_name: self.src_arena.generation}
-            self._touched = [self.type_name]
+            reset_discovery()
 
-            def discover(args_t):
+            def discover(args_per_source):
                 states: Dict[str, Any] = {
-                    self.type_name: self.src_arena.state}
-                states, miss, _delivered = self._apply_group(
-                    states, self.type_name, self.method, src_rows, args_t,
-                    mask, depth=1)
+                    s.type_name: s.arena.state for s in self.sources}
+                _states, miss, _d = apply_all(states, args_per_source)
                 return miss
 
-            jax.eval_shape(discover, example_args_t)
+            jax.eval_shape(discover, examples)
             born_in_trace = set(self.engine.arenas) - known
             if not born_in_trace:
                 break
@@ -230,17 +308,17 @@ class FusedTickProgram:
                 self.engine.arena_for(name)  # eager, concrete columns
         touched = list(self._touched)
 
-        def window(states, static_args, stacked_args, totals_in):
-            def one_tick(states, args_t):
+        def window(states, statics, stackeds, totals_in):
+            def one_tick(states, args_ts):
                 # static leaves (identical every tick) ride OUTSIDE the
                 # scan xs: slicing a [T, m] stack per iteration costs
                 # real bandwidth; a closed-over [m] array costs nothing
-                states, miss, delivered = self._apply_group(
-                    states, self.type_name, self.method, src_rows,
-                    {**static_args, **args_t}, mask, depth=1)
+                merged = [{**statics[i], **args_ts[i]}
+                          for i in range(len(self.sources))]
+                states, miss, delivered = apply_all(states, merged)
                 return states, (miss, delivered)
             states, (misses, delivered) = jax.lax.scan(one_tick, states,
-                                                       stacked_args)
+                                                       tuple(stackeds))
             # totals accumulate ON DEVICE across runs: verify() then
             # reads one 2-element buffer no matter how many windows ran
             # (each completion observation costs ~100ms on tunneled
@@ -259,10 +337,12 @@ class FusedTickProgram:
         leading [T, ...] axis (e.g. the tick counter).  ``static_args``:
         leaves identical every tick, passed at their natural [m, ...]
         shape — they are closed over by the scan instead of stacked, so a
-        steady payload costs no per-tick slicing bandwidth."""
+        steady payload costs no per-tick slicing bandwidth.  Multi-source
+        programs (``FusedTickProgram.multi``) take LISTS of both, aligned
+        with ``sources``."""
         engine = self.engine
-        static_args = static_args or {}
-        leaves = jax.tree_util.tree_leaves(stacked_args)
+        stackeds, statics = self._as_lists(stacked_args, static_args)
+        leaves = jax.tree_util.tree_leaves(stackeds)
         if not leaves:
             raise ValueError(
                 "stacked_args needs at least one [T, ...] leaf (e.g. a "
@@ -274,16 +354,19 @@ class FusedTickProgram:
             # arenas grew/repacked since the trace: re-resolve the source
             # rows from the KEPT keys and re-trace against fresh mirrors
             # (the unfused engine's generation discipline)
-            self.src_rows = jnp.asarray(
-                self.src_arena.resolve_rows(self.keys))
-            example_args_t = {**static_args, **jax.tree_util.tree_map(
-                lambda a: a[0], stacked_args)}
-            self._compiled = self._build(example_args_t)
+            for s in self.sources:
+                s.rows = jnp.asarray(s.arena.resolve_rows(s.keys))
+            examples = [
+                {**statics[i], **jax.tree_util.tree_map(lambda a: a[0],
+                                                        stackeds[i])}
+                for i in range(len(self.sources))]
+            self._compiled = self._build(
+                examples if self._is_multi() else examples[0])
         states = {n: engine.arena_for(n).state for n in self._touched}
         totals_in = self._totals if self._totals is not None \
             else jnp.zeros(2, dtype=jnp.int32)
         new_states, self._totals = self._compiled(
-            states, static_args, stacked_args, totals_in)
+            states, statics, stackeds, totals_in)
         for n in self._touched:
             engine.arena_for(n).state = new_states[n]
         engine.tick_number += n_ticks

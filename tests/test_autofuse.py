@@ -238,7 +238,7 @@ def test_static_leaf_identity_change_disengages(run):
             await engine.drain_queues()
         assert engine.autofuser._program is not None, \
             "test setup: expected an engaged window"
-        assert "dst" in engine.autofuser._static_args
+        assert "dst" in engine.autofuser._patterns[0].static_args
 
         # mid-window: dst changes identity AND value — the new value must
         # apply (a frozen static would keep delivering to key 0)
@@ -477,5 +477,105 @@ def test_gpstracker_autofuses_with_gated_emits(run):
                     np.asarray(a_auto.state[col])[ra],
                     np.asarray(a_ref.state[col])[rr], rtol=1e-5,
                     err_msg=f"{type_name}.{col} diverged under autofuse")
+
+    run(main())
+
+
+def test_two_concurrent_patterns_fuse_together(run):
+    """A tick carrying TWO steady streams (presence heartbeats AND lww
+    puts) compiles into ONE multi-pattern window; both streams' totals
+    match independent unfused engines exactly."""
+
+    async def main():
+        import samples.presence  # registers presence grains
+
+        n, T = 512, 24
+        keys = np.arange(n, dtype=np.int64)
+        games = (keys % 8).astype(np.int32)
+
+        def drive(engine):
+            inj_p = engine.make_injector("PresenceGrain", "heartbeat",
+                                         keys)
+            inj_l = engine.make_injector("LwwGrain", "put", keys)
+            g_d = jnp.asarray(games)
+            s_d = jnp.ones(n, jnp.float32)
+            return inj_p, inj_l, g_d, s_d
+
+        plain = TensorEngine(config=TensorEngineConfig(auto_fusion_ticks=0))
+        inj_p, inj_l, g_d, s_d = drive(plain)
+        for t in range(T):
+            inj_p.inject({"game": g_d, "score": s_d,
+                          "tick": np.int32(t + 1)})
+            inj_l.inject({"v": np.full(n, t + 1, np.int32)})
+            await plain.drain_queues()
+        await plain.flush()
+
+        auto = TensorEngine(config=_cfg(auto_fusion_window=4))
+        inj_p, inj_l, g_d, s_d = drive(auto)
+        for t in range(T):
+            inj_p.inject({"game": g_d, "score": s_d,
+                          "tick": np.int32(t + 1)})
+            inj_l.inject({"v": np.full(n, t + 1, np.int32)})
+            await auto.drain_queues()
+        await auto.flush()
+
+        af = auto.autofuser
+        assert af.ticks_fused > 0, "two-stream steady state never fused"
+        assert len(af._programs) >= 1
+        prog = next(iter(af._programs.values()))
+        assert len(prog.sources) == 2, \
+            "expected ONE program applying BOTH streams per tick"
+
+        for type_name in ("PresenceGrain", "GameGrain", "LwwGrain"):
+            a_ref = plain.arena_for(type_name)
+            a_auto = auto.arena_for(type_name)
+            kr = a_ref.keys()
+            rr, _ = a_ref.lookup_rows(kr)
+            ra, found = a_auto.lookup_rows(kr)
+            assert found.all()
+            for col in a_ref.state:
+                np.testing.assert_allclose(
+                    np.asarray(a_auto.state[col])[ra],
+                    np.asarray(a_ref.state[col])[rr], rtol=1e-5,
+                    err_msg=f"{type_name}.{col} diverged (2-pattern)")
+
+    run(main())
+
+
+def test_pattern_set_change_breaks_and_replays(run):
+    """One of two fused streams stopping is a pattern break: buffered
+    ticks of BOTH streams replay in order before the new shape runs."""
+
+    async def main():
+        n = 64
+        keys = np.arange(n, dtype=np.int64)
+        engine = TensorEngine(config=_cfg(auto_fusion_window=8))
+        inj_a = engine.make_injector("LwwGrain", "put", keys)
+        inj_b = engine.make_injector("HopGrain", "send", keys)
+        engine.arena_for("LwwGrain").reserve(n + 8)
+        dst0 = np.zeros(n, np.int32)
+
+        T = 10
+        for t in range(T):
+            inj_a.inject({"v": np.full(n, t + 1, np.int32)})
+            inj_b.inject({"dst": dst0, "v": np.full(n, 100 + t, np.int32)})
+            await engine.drain_queues()
+        assert engine.autofuser.has_buffer(), \
+            "test setup: expected a partially-filled 2-stream window"
+
+        # stream B stops: the 1-stream tick is a different composite
+        # signature — buffered 2-stream ticks must apply FIRST
+        for t in range(T, T + 3):
+            inj_a.inject({"v": np.full(n, t + 1, np.int32)})
+            await engine.drain_queues()
+        await engine.flush()
+
+        value, count = _lww_state(engine, keys)
+        # LwwGrain saw T puts + 3 more puts + T hop deliveries to key 0
+        np.testing.assert_array_equal(count[1:], T + 3)
+        np.testing.assert_array_equal(value[1:], T + 3)  # order held
+        sent = np.asarray(engine.arena_for("HopGrain").state["sent"])
+        rows = engine.arena_for("HopGrain").resolve_rows(keys)
+        np.testing.assert_array_equal(sent[rows], T)
 
     run(main())
