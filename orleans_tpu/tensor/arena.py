@@ -141,6 +141,26 @@ class GrainArena:
         # True once any activated key falls outside the int32 range:
         # narrow emits to this arena then resolve through the wide mirror
         self.has_wide_keys = False
+        # weakref to the owning TensorEngine (set by engine.arena_for):
+        # row moves settle its auto-fusion chain first — see
+        # _settle_owner_chain
+        self._owner_engine: Optional[Any] = None
+
+    def _settle_owner_chain(self) -> None:
+        """Rows are about to move (growth / compaction / reshard): settle
+        the owning engine's auto-fusion verification chain FIRST, while
+        its pre-move state snapshot is still restorable.  This makes
+        rollback-across-a-repack structurally impossible — the chain
+        either verifies exact or rolls back and replays NOW, against the
+        current row layout (contract: tensor/autofuse.py _settle_chain).
+        Recursion-safe: a settle-triggered replay that re-enters a row
+        move finds the chain already drained."""
+        ref = self._owner_engine
+        engine = ref() if ref is not None else None
+        if engine is not None:
+            fuser = getattr(engine, "autofuser", None)
+            if fuser is not None and fuser._unverified:
+                fuser._settle_chain()
 
     # -- state columns ------------------------------------------------------
 
@@ -387,6 +407,7 @@ class GrainArena:
         """Double the per-shard block size, repacking rows so each shard's
         block stays contiguous (rows move; the key index is rebuilt —
         resharding is the same op at a bigger granularity)."""
+        self._settle_owner_chain()
         old_per = self.shard_capacity
         new_per = old_per * 2
         new_capacity = new_per * self.n_shards
@@ -443,6 +464,9 @@ class GrainArena:
         the storage bridge first, so a later message to an evicted grain
         re-activates it with its state (the deactivate→storage→reactivate
         cycle of the reference).  Returns the number of rows evicted."""
+        # settle BEFORE computing victims: a settle-triggered replay may
+        # grow/repack this arena, which would invalidate victim row ids
+        self._settle_owner_chain()
         live = self._key_of_row >= 0
         victims = np.nonzero(
             live & (self.effective_last_use() < older_than_tick))[0]
@@ -455,6 +479,7 @@ class GrainArena:
         owner re-activates them on first touch (reference:
         GrainDirectoryHandoffManager.cs:141; deactivate→storage→
         reactivate cycle, Catalog.cs:836)."""
+        self._settle_owner_chain()
         rows, found = self.lookup_rows(np.asarray(keys, dtype=np.int64))
         return self._deactivate_rows(rows[found], write_back)
 
@@ -519,6 +544,7 @@ class GrainArena:
         ProcessSiloRemoveEvent); here every row's owner is recomputed from
         the same stable key hash and the state gathers to its new block in
         one scatter per column."""
+        self._settle_owner_chain()
         live_rows = np.nonzero(self._key_of_row >= 0)[0]
         keys = self._key_of_row[live_rows]
         last_use = self.effective_last_use()[live_rows]

@@ -351,6 +351,21 @@ class AutoFuser:
         engine = self.engine
         prog = self._program
         t0 = time.perf_counter()
+
+        # a generation change since the trace forces a settle of the
+        # outstanding chain BEFORE this window pops from the buffer: if
+        # the settle rolls back, its replay drains the chained ticks AND
+        # this window (still buffered) through the unfused path while
+        # the pattern state is intact — no orphan window can exist
+        if prog._compiled is None or any(
+                engine.arena_for(n).generation != g
+                for n, g in prog._generations.items()):
+            self._settle_chain()
+            if self._program is None or not self._patterns:
+                # the settle rolled back and reset detection: the
+                # buffered ticks (this window included) were already
+                # replayed unfused — nothing left to run fused
+                return
         window = self._buffer
         self._buffer = []
 
@@ -364,15 +379,13 @@ class AutoFuser:
 
         stackeds = [stack_source(i) for i in range(len(self._patterns))]
         statics = [pat.static_args for pat in self._patterns]
-
-        # a generation change since the trace forces a settle of the
-        # outstanding chain BEFORE prog.run rebuilds against the fresh
-        # mirrors (the chain's snapshot refs belong to the old
-        # generation — rollback across a repack is impossible)
-        if prog._compiled is None or any(
-                engine.arena_for(n).generation != g
-                for n, g in prog._generations.items()):
-            self._settle_chain()
+        # resolve/rebuild BEFORE the chain snapshot: re-resolution can
+        # auto-activate evicted source keys and GROW an arena — a grow
+        # after the snapshot would make it unrestorable (the chain is
+        # empty here whenever prepare has real work to do: the
+        # generation-mismatch settle above ran first)
+        prog.prepare(stackeds if prog._is_multi() else stackeds[0],
+                     statics if prog._is_multi() else statics[0])
         if self._chain_snapshot is None:
             # chain start: the pre-run buffers ARE the snapshot — the
             # programs never donate (see _engage), so these references
@@ -389,12 +402,17 @@ class AutoFuser:
                  static_args=statics if prog._is_multi() else statics[0])
         self._unverified.append(window)
         # the window advanced the tick clock: honor the periodic
-        # checkpoint cadence in the fused steady state too (its write
-        # precedes verification; a later rollback simply re-checkpoints
-        # after the exact replay — the restore point stays consistent
-        # because replay re-runs through unfused ticks which checkpoint
-        # again at their own boundaries)
-        engine.maybe_periodic_checkpoint()
+        # checkpoint cadence in the fused steady state too — but VERIFY
+        # FIRST.  A checkpoint taken before verification could persist
+        # non-exact state (a hard kill before the rollback replay would
+        # then restore missed deliveries as fact), so a due checkpoint
+        # settles the chain and only then writes.  On a clean settle the
+        # write below is a verified-exact restore point; on rollback the
+        # replay runs unfused ticks that checkpoint at their own
+        # boundaries, and the write below covers any remainder.
+        if engine.checkpoint_due():
+            self._settle_chain()
+            engine.maybe_periodic_checkpoint()
         dt = time.perf_counter() - t0
         self.windows_run += 1
         for _ in range(len(window)):
@@ -434,25 +452,22 @@ class AutoFuser:
             return
         # non-exact chain (cold destination, fan-out overflow, round-cap
         # spill): roll back and replay unfused — the slow path that
-        # keeps transparency exact
+        # keeps transparency exact.  A mid-chain repack is structurally
+        # impossible: every row move (growth/compaction/reshard) settles
+        # the owning engine's chain FIRST while the snapshot is still
+        # restorable (GrainArena._settle_owner_chain), and queued traffic
+        # breaks the pattern — which settles — before it can touch an
+        # arena.  A generation mismatch here is therefore a bug, not an
+        # operating condition.
         if any(engine.arena_for(n).generation != g
                for n, g in generations.items()):
-            # an arena repacked between the chain's windows (possible
-            # only via direct arena calls outside the engine's queues —
-            # queued traffic breaks the pattern first, which settles the
-            # chain): the old-generation snapshot cannot be restored
-            engine_log = getattr(getattr(engine, "silo", None), "logger",
-                                 None)
-            msg = (f"autofuse: {int(misses)} deliveries missed in a "
-                   f"fused chain but an arena repacked mid-chain — "
-                   f"rollback impossible, messages lost")
-            if engine_log is not None:
-                engine_log.error(msg, code=2914)
-            else:
-                import logging
-                logging.getLogger("orleans_tpu.autofuse").error(msg)
-            self._reset()
-            return
+            # a hard invariant, not an operating condition — raise (not
+            # assert: -O must not turn this into restoring an
+            # old-generation snapshot over a repacked arena)
+            raise RuntimeError(
+                "autofuse: arena repacked mid-chain — a row move "
+                "bypassed _settle_owner_chain; rollback snapshot is "
+                "unrestorable")
         self.windows_rolled_back += 1
         for n, cols in snapshot.items():
             engine.arena_for(n).state = cols

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+import weakref
 from collections import defaultdict, deque
 from dataclasses import dataclass, field as dc_field
 from functools import partial
@@ -310,6 +311,10 @@ class TensorEngine:
             arena = GrainArena(info, capacity=self.initial_capacity,
                                n_shards=self.n_shards, sharding=self.sharding,
                                store=self.store)
+            # row moves (growth/compaction/reshard) must settle this
+            # engine's auto-fusion chain FIRST — see
+            # GrainArena._settle_owner_chain
+            arena._owner_engine = weakref.ref(self)
             self.arenas[type_name] = arena
         return arena
 
@@ -354,9 +359,7 @@ class TensorEngine:
         window boundary the state is consistent, so this is a valid
         restore point for survivors after a hard kill.  Returns seconds
         spent (0.0 when it did not fire)."""
-        cadence = self.config.checkpoint_every_ticks
-        if cadence <= 0 \
-                or self.tick_number - self._last_checkpoint_tick < cadence:
+        if not self.checkpoint_due():
             return 0.0
         t_cp = time.perf_counter()
         for a in self.arenas.values():
@@ -364,6 +367,14 @@ class TensorEngine:
                 a.checkpoint()
         self._last_checkpoint_tick = self.tick_number
         return time.perf_counter() - t_cp
+
+    def checkpoint_due(self) -> bool:
+        """True when the periodic checkpoint cadence has elapsed — the
+        predicate of maybe_periodic_checkpoint, shared so the auto-fuser
+        can settle its verification chain before a due write."""
+        cadence = self.config.checkpoint_every_ticks
+        return cadence > 0 and \
+            self.tick_number - self._last_checkpoint_tick >= cadence
 
     def restore(self, type_names: Optional[List[str]] = None) -> int:
         """Re-activate all stored rows (process-restart resume).  With no
@@ -451,9 +462,19 @@ class TensorEngine:
         for b in batches:
             if b.no_fanout:
                 continue
+            mask = b.mask
             if b.keys_dev is not None:
-                skeys = b.keys_dev
-            elif b.keys_host is not None:
+                # device-key sources expand AFTER resolution, inside
+                # _run_group (_expand_resolved_fanout): the SAME resolve
+                # that applies the batch gates its expansion, so a source
+                # entry that misses (unseen grain) does not fan out until
+                # its miss-path redelivery applies — source update and
+                # subscriber delivery land in the same tick, which a
+                # tick-boundary checkpoint relies on.  Host-key batches
+                # resolve inline (activation precedes apply), so they
+                # expand here as before.
+                continue
+            if b.keys_host is not None:
                 if (b.keys_host >= KEY_SENTINEL).any() or \
                         (b.keys_host < 0).any():
                     raise OverflowError(
@@ -468,7 +489,26 @@ class TensorEngine:
                     "through the CSR subscription graph")
             else:
                 continue  # row-only batch with no kept keys: nothing to map
-            dst, gargs, valid = fanout.expand(skeys, b.args, b.mask)
+            dst, gargs, valid = fanout.expand(skeys, b.args, mask)
+            self.queues[(dst_type, dst_method)].append(
+                PendingBatch(args=gargs, keys_dev=dst, mask=valid))
+
+    def _expand_resolved_fanout(self, fan, batches: List[PendingBatch],
+                                resolved: List[Tuple]) -> None:
+        """Device-key fan-out expansion, gated by the SAME resolution the
+        apply step uses (one resolve dispatch; the gate and the miss
+        check cannot disagree): hit entries expand now — their subscriber
+        deliveries run next round of this tick, exactly where
+        _run_fanout's pre-group expansion would have put them — and
+        missed entries expand when their miss-path redelivery applies."""
+        fanout, dst_type, dst_method = fan
+        for b, (rows, _args) in zip(batches, resolved):
+            if b.no_fanout or b.keys_dev is None:
+                continue
+            base = b.mask if b.mask is not None \
+                else _mask_for(b.keys_dev.shape[0])
+            dst, gargs, valid = fanout.expand(
+                b.keys_dev, b.args, base & (rows >= 0))
             self.queues[(dst_type, dst_method)].append(
                 PendingBatch(args=gargs, keys_dev=dst, mask=valid))
 
@@ -659,6 +699,14 @@ class TensorEngine:
             self.queues = defaultdict(list)
             for (type_name, method), batches in pending.items():
                 tf = time.perf_counter()
+                if self.router is not None:
+                    # ownership + handoff fence BEFORE fan-out: shipped
+                    # and fence-deferred batches must not expand their
+                    # subscriber deliveries locally this tick
+                    batches = self._route_group(type_name, method, batches)
+                    if not batches:
+                        stages["fanout"] += time.perf_counter() - tf
+                        continue
                 self._run_fanout(type_name, method, batches)
                 stages["fanout"] += time.perf_counter() - tf
                 self._run_group(type_name, method, batches)
@@ -839,7 +887,11 @@ class TensorEngine:
                     and not self.router.handoff_settled():
                 # handoff fence: activating these unseen keys could read
                 # the store before the previous owner's write-back lands —
-                # requeue and retry once peers release (or timeout)
+                # requeue and retry once peers release (or timeout).
+                # no_fanout while fenced: every masked entry is known
+                # unresolvable, so expansion would only enqueue phantom
+                # all-masked destination batches each retry cycle; the
+                # post-settle requeue below re-enables fan-out.
                 self.queues[(c.type_name, c.method)].append(PendingBatch(
                     args=c.args, keys_dev=c.keys, mask=missing,
                     no_fanout=True))
@@ -847,11 +899,11 @@ class TensorEngine:
                 continue
             if len(mk):
                 c.arena.resolve_rows(mk, tick=self.tick_number)  # activates
-            # re-deliver only the dropped messages; convergence across
-            # cycles even when unique misses exceed MISS_BUF
+            # re-deliver only the dropped messages (fan-out enabled — see
+            # the fenced requeue above); convergence across cycles even
+            # when unique misses exceed MISS_BUF
             self.queues[(c.type_name, c.method)].append(PendingBatch(
-                args=c.args, keys_dev=c.keys, mask=missing,
-                no_fanout=True))
+                args=c.args, keys_dev=c.keys, mask=missing))
             requeued = True
         # within a tick the drain is part of that tick's breakdown (folded
         # into stage_seconds at tick end); between ticks it accrues to the
@@ -977,6 +1029,41 @@ class TensorEngine:
                     no_fanout=b.no_fanout))
         return out
 
+    def _route_group(self, type_name: str, method: str,
+                     batches: List[PendingBatch]) -> List[PendingBatch]:
+        """Clustered pre-pass of one (type, method) group, run BEFORE
+        fan-out expansion: ship non-owned partitions (ownership re-check)
+        and park fence-deferred batches.  Ordering matters — a batch the
+        handoff fence defers must defer WITH its fan-out unexpanded, or
+        subscriber deliveries would apply a full tick before the source
+        grain's own update (and a tick-boundary checkpoint between the
+        two would persist the subscriber effects without the source
+        update).  The deferred batch re-queues at tick end with fan-out
+        still enabled, so source update and subscriber deliveries land
+        in the SAME later tick."""
+        arena = self.arena_for(type_name)
+        batches = self._filter_ownership(type_name, method, batches)
+        if batches and not self.router.handoff_settled():
+            # handoff fence: host-key batches touching UNSEEN keys
+            # would activate them from the store, racing the previous
+            # owner's write-back — defer those until peers release
+            # (or the fence times out); everything else flows
+            safe: List[PendingBatch] = []
+            for b in batches:
+                if b.keys_host is not None and (
+                        b.rows is None or b.generation != arena.generation):
+                    _, found = arena.lookup_rows(b.keys_host)
+                    if not found.all():
+                        # park in a side list (re-queued at tick end) so
+                        # the round loop doesn't re-examine it every
+                        # round of this tick
+                        self._fence_deferred.append(
+                            ((type_name, method), b))
+                        continue
+                safe.append(b)
+            batches = safe
+        return batches
+
     def _run_group(self, type_name: str, method: str,
                    batches: List[PendingBatch]) -> None:
         """Execute one (type, method) group.
@@ -991,32 +1078,6 @@ class TensorEngine:
         arena = self.arena_for(type_name)
         stages = self._tick_stages
         t_res = time.perf_counter()
-        if self.router is not None:
-            batches = self._filter_ownership(type_name, method, batches)
-            if batches and not self.router.handoff_settled():
-                # handoff fence: host-key batches touching UNSEEN keys
-                # would activate them from the store, racing the previous
-                # owner's write-back — defer those until peers release
-                # (or the fence times out); everything else flows
-                safe: List[PendingBatch] = []
-                for b in batches:
-                    if b.keys_host is not None and (
-                            b.rows is None or b.generation != arena.generation):
-                        _, found = arena.lookup_rows(b.keys_host)
-                        if not found.all():
-                            # this round's _run_fanout already expanded the
-                            # batch — a re-queued copy must not re-expand.
-                            # Park in a side list (re-queued at tick end)
-                            # so the round loop doesn't re-examine it
-                            # every round of this tick.
-                            b.no_fanout = True
-                            self._fence_deferred.append(
-                                ((type_name, method), b))
-                            continue
-                    safe.append(b)
-                batches = safe
-            if not batches:
-                return
         batches = self._coalesce_host_batches(batches)
 
         # re-resolve if any batch's resolution itself grew/repacked the
@@ -1027,6 +1088,9 @@ class TensorEngine:
                         for b in batches]
             if arena.generation == gen0:
                 break
+        fan = self._fanouts.get((type_name, method))
+        if fan is not None:
+            self._expand_resolved_fanout(fan, batches, resolved)
         masks = [b.mask for b in batches]
         if len(resolved) == 1:
             rows, args = resolved[0]

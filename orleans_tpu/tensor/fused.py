@@ -330,6 +330,28 @@ class FusedTickProgram:
         return jax.jit(window,
                        donate_argnums=(0,) if self.donate else ())
 
+    def prepare(self, stacked_args: Any, static_args: Any = None) -> None:
+        """Re-resolve the source rows and re-trace if any touched arena
+        grew/repacked since the trace (the unfused engine's generation
+        discipline).  Idempotent; ``run`` calls it first.  Callers that
+        snapshot arena state for rollback (the auto-fuser) MUST call
+        this BEFORE taking the snapshot: source re-resolution
+        auto-activates evicted keys, which can GROW an arena — a
+        post-snapshot grow would make the snapshot unrestorable."""
+        engine = self.engine
+        stackeds, statics = self._as_lists(stacked_args, static_args)
+        if self._compiled is None or any(
+                engine.arena_for(n).generation != g
+                for n, g in self._generations.items()):
+            for s in self.sources:
+                s.rows = jnp.asarray(s.arena.resolve_rows(s.keys))
+            examples = [
+                {**statics[i], **jax.tree_util.tree_map(lambda a: a[0],
+                                                        stackeds[i])}
+                for i in range(len(self.sources))]
+            self._compiled = self._build(
+                examples if self._is_multi() else examples[0])
+
     def run(self, stacked_args: Any, static_args: Any = None) -> None:
         """Execute T fused ticks.
 
@@ -348,20 +370,7 @@ class FusedTickProgram:
                 "stacked_args needs at least one [T, ...] leaf (e.g. a "
                 "tick counter) — it sets the window length")
         n_ticks = leaves[0].shape[0]
-        if self._compiled is None or any(
-                engine.arena_for(n).generation != g
-                for n, g in self._generations.items()):
-            # arenas grew/repacked since the trace: re-resolve the source
-            # rows from the KEPT keys and re-trace against fresh mirrors
-            # (the unfused engine's generation discipline)
-            for s in self.sources:
-                s.rows = jnp.asarray(s.arena.resolve_rows(s.keys))
-            examples = [
-                {**statics[i], **jax.tree_util.tree_map(lambda a: a[0],
-                                                        stackeds[i])}
-                for i in range(len(self.sources))]
-            self._compiled = self._build(
-                examples if self._is_multi() else examples[0])
+        self.prepare(stacked_args, static_args)
         states = {n: engine.arena_for(n).state for n in self._touched}
         totals_in = self._totals if self._totals is not None \
             else jnp.zeros(2, dtype=jnp.int32)

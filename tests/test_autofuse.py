@@ -579,3 +579,88 @@ def test_pattern_set_change_breaks_and_replays(run):
         np.testing.assert_array_equal(sent[rows], T)
 
     run(main())
+
+
+def test_out_of_band_repack_settles_clean_chain(run):
+    """A direct arena call that moves rows (reserve → grow) while a
+    verification chain is outstanding settles the chain FIRST
+    (GrainArena._settle_owner_chain) — exactness survives a mid-run
+    repack with no rollback when the chain was clean."""
+
+    async def main():
+        n, T = 32, 24
+        keys = np.arange(n, dtype=np.int64)
+        engine = TensorEngine(config=_cfg(auto_fusion_verify_windows=8))
+        inj = engine.make_injector("LwwGrain", "put", keys)
+        arena = engine.arena_for("LwwGrain")
+
+        repacked = False
+        for t in range(T):
+            inj.inject({"v": np.full(n, t + 1, np.int32)})
+            await engine.drain_queues()
+            if not repacked and engine.autofuser._unverified:
+                gen0 = arena.generation
+                arena.reserve(arena.capacity * 4)  # out-of-band row move
+                assert arena.generation > gen0, "reserve did not repack"
+                assert not engine.autofuser._unverified, \
+                    "row move left the verification chain outstanding"
+                repacked = True
+        assert repacked, "test setup: never saw an unverified chain"
+        await engine.flush()
+
+        af = engine.autofuser
+        assert af.windows_run > 0
+        assert af.windows_rolled_back == 0
+        value, count = _lww_state(engine, keys)
+        np.testing.assert_array_equal(count, T)      # exact delivery
+        np.testing.assert_array_equal(value, T)      # order held
+        assert engine.messages_processed == n * T
+
+    run(main())
+
+
+def test_out_of_band_repack_with_dirty_chain_replays_exactly(run):
+    """The previously-lossy path (r4 code 2914): a chain carrying misses
+    (a cold-destination window) hits an out-of-band arena repack.  The
+    row move settles the chain first — rollback + unfused replay happen
+    AT THE REPACK, while the snapshot is still restorable — so nothing
+    is lost and the cold key activates."""
+
+    async def main():
+        n, T = 32, 24
+        src = np.arange(n, dtype=np.int64)
+        engine = TensorEngine(config=_cfg(auto_fusion_max_rollbacks=100,
+                                          auto_fusion_verify_windows=8))
+        hop = engine.arena_for("HopGrain")
+        hop.reserve(n)
+        engine.arena_for("LwwGrain").reserve(n + 64)
+        inj = engine.make_injector("HopGrain", "send", src)
+
+        cold_tick = 14  # lands in the 4th fused window of the chain
+        repacked = False
+        for t in range(T):
+            dst = np.full(n, 5000 if t == cold_tick else 0, np.int32)
+            inj.inject({"dst": dst, "v": np.full(n, t + 1, np.int32)})
+            await engine.drain_queues()
+            if (not repacked and t > cold_tick
+                    and engine.autofuser.windows_run >= 4
+                    and engine.autofuser._unverified):
+                # the chain now carries the cold tick's misses on device;
+                # move rows out-of-band BEFORE any settle reads them
+                hop.reserve(hop.capacity * 4)
+                repacked = True
+        assert repacked, "test setup: dirty chain never outstanding"
+        await engine.flush()
+
+        af = engine.autofuser
+        assert af.windows_rolled_back >= 1, \
+            "the repack-time settle did not roll the dirty chain back"
+        sent = np.asarray(engine.arena_for("HopGrain").state["sent"])
+        rows = engine.arena_for("HopGrain").resolve_rows(src)
+        np.testing.assert_array_equal(sent[rows], T)  # every tick applied
+        value0, count0 = _lww_state(engine, [0])
+        valuec, countc = _lww_state(engine, [5000])
+        assert int(count0[0]) == n * (T - 1)
+        assert int(countc[0]) == n  # the cold key's deliveries landed
+
+    run(main())

@@ -551,3 +551,83 @@ def test_hard_kill_loss_window_bounded_by_cadence(run):
             await cluster.stop()
 
     run(main())
+
+
+@vector_grain
+class FenceGrain(VectorGrain):
+    """Source/subscriber pair for the handoff-fence ordering test."""
+
+    hits = field(jnp.int32, 0)
+    notes = field(jnp.int32, 0)
+
+    @batched_method
+    @staticmethod
+    def ping(state, batch: Batch, n_rows: int):
+        ones = jnp.ones_like(batch.rows, dtype=jnp.int32) * batch.mask
+        return {**state, "hits": state["hits"] + seg_sum(
+            ones, batch.rows, n_rows)}
+
+    @batched_method
+    @staticmethod
+    def note(state, batch: Batch, n_rows: int):
+        ones = jnp.ones_like(batch.rows, dtype=jnp.int32) * batch.mask
+        return {**state, "notes": state["notes"] + seg_sum(
+            ones, batch.rows, n_rows)}
+
+
+def test_fence_defers_batch_with_fanout_unexpanded(run):
+    """A batch the handoff fence defers must defer WITH its fan-out
+    unexpanded: under the r4 ordering the subscriber delivery applied a
+    full tick before the source grain's own update, so a tick-boundary
+    checkpoint between the two persisted subscriber effects without the
+    source update.  Source update and subscriber delivery must land in
+    the SAME tick once the fence releases."""
+
+    async def main():
+        from orleans_tpu.tensor.fanout import DeviceFanout
+
+        cluster = await TestingCluster(n_silos=1).start()
+        try:
+            silo = cluster.silos[0]
+            engine = silo.tensor_engine
+            fan = DeviceFanout(budget=16)
+            fan.follow(1, 2)  # subscriber key 2 follows source key 1
+            engine.register_fanout("FenceGrain", "ping", fan,
+                                   "FenceGrain", "note")
+
+            # subscriber key 2 is ACTIVE before the fence arms; source
+            # key 1 stays unseen (first-touch activation is what the
+            # fence gates)
+            engine.send_batch("FenceGrain", "note",
+                              np.array([2], dtype=np.int64),
+                              {"v": np.array([0], np.int32)})
+            await engine.drain_queues()
+            arena = engine.arena_for("FenceGrain")
+            assert int(arena.read_row(2)["notes"]) == 1
+
+            router = silo.vector_router
+            orig = router.handoff_settled
+            router.handoff_settled = lambda: False
+            try:
+                engine.send_batch("FenceGrain", "ping",
+                                  np.array([1], dtype=np.int64),
+                                  {"v": np.array([7], np.int32)})
+                for _ in range(3):  # fenced ticks: batch defers each time
+                    engine.run_tick()
+                # NOTHING may have applied while the fence held — neither
+                # the source update (key 1 unseen) nor, critically, the
+                # subscriber delivery its fan-out would expand
+                assert int(arena.read_row(2)["notes"]) == 1, \
+                    "subscriber delivery applied while source was fenced"
+                rows, found = arena.lookup_rows(
+                    np.array([1], dtype=np.int64))
+                assert not found.any(), "fenced source key activated"
+            finally:
+                router.handoff_settled = orig
+            await engine.flush()
+            assert int(arena.read_row(1)["hits"]) == 1
+            assert int(arena.read_row(2)["notes"]) == 2
+        finally:
+            await cluster.stop()
+
+    run(main())
