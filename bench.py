@@ -229,21 +229,30 @@ async def _helloworld_bench(n_grains: int = 2000, n_rounds: int = 5,
 async def _tensor_twitter(n_tweets_per_tick: int, n_hashtags: int,
                           n_ticks: int, latency_ticks: int) -> dict:
     from orleans_tpu.tensor import TensorEngine
-    from samples.twitter_sentiment import run_twitter_load
+    from samples.twitter_sentiment import (
+        run_twitter_load,
+        run_twitter_load_fused,
+    )
 
     engine = TensorEngine()
-    stats = await run_twitter_load(engine,
-                                   n_tweets_per_tick=n_tweets_per_tick,
-                                   n_hashtags=n_hashtags, n_ticks=n_ticks,
-                                   warm_ticks=2)
-    lat = await run_twitter_load(engine,
-                                 n_tweets_per_tick=n_tweets_per_tick,
-                                 n_hashtags=n_hashtags,
-                                 n_ticks=latency_ticks, seed=1,
-                                 warm_ticks=2, measure_latency=True)
+    stats = await run_twitter_load_fused(
+        engine, n_tweets_per_tick=n_tweets_per_tick,
+        n_hashtags=n_hashtags, n_ticks=n_ticks)
+    lat = await run_twitter_load_fused(
+        engine, n_tweets_per_tick=n_tweets_per_tick,
+        n_hashtags=n_hashtags, n_ticks=latency_ticks, seed=1,
+        measure_latency=True)
     stats["tick_p50_seconds"] = lat["tick_p50_seconds"]
     stats["tick_p99_seconds"] = lat["tick_p99_seconds"]
     stats["latency_ticks"] = latency_ticks
+    # transparency: the unfused (per-round dispatch) engine on the same load
+    engine2 = TensorEngine()
+    unfused = await run_twitter_load(engine2,
+                                     n_tweets_per_tick=n_tweets_per_tick,
+                                     n_hashtags=n_hashtags,
+                                     n_ticks=max(2, n_ticks // 4),
+                                     warm_ticks=2)
+    stats["unfused_msgs_per_sec"] = unfused["messages_per_sec"]
     return stats
 
 
@@ -451,6 +460,48 @@ def main() -> None:
                            "device-synced single-tick windows",
         }
 
+    async def _secondary_workloads() -> dict:
+        """Compact numbers for the four non-headline BASELINE configs,
+        published with every default run so a regression in ANY workload
+        is driver-visible round over round.  Sizes are smaller than the
+        dedicated --workload modes (labeled per entry); run those for
+        full-scale figures."""
+        if args.smoke:
+            ch_n, gp_n, tw_n, tw_h = 2_000, 2_000, 2_000, 300
+            ticks, lat_ticks = 5, 8
+            hello = dict(n_grains=100, n_rounds=2, latency_calls=100)
+        else:
+            ch_n, gp_n, tw_n, tw_h = 50_000, 50_000, 50_000, 10_000
+            ticks, lat_ticks = 10, 20
+            hello = dict(n_grains=1_000, n_rounds=4, latency_calls=500)
+        out = {}
+        ch = await _tensor_chirper(ch_n, 15.0, ticks, lat_ticks)
+        out["chirper"] = {
+            "msgs_per_sec": round(ch["messages_per_sec"], 1),
+            "p99_turn_latency_s": round(ch["tick_p99_seconds"], 4),
+            "grains": ch_n, "edges": ch["edges"], "ticks": ticks,
+        }
+        gp = await _tensor_gps(gp_n, ticks, lat_ticks)
+        out["gpstracker"] = {
+            "msgs_per_sec": round(gp["messages_per_sec"], 1),
+            "p99_turn_latency_s": round(gp["tick_p99_seconds"], 4),
+            "grains": gp_n, "ticks": gp["ticks"],
+        }
+        tw = await _tensor_twitter(tw_n, tw_h, ticks, lat_ticks)
+        out["twitter"] = {
+            "msgs_per_sec": round(tw["messages_per_sec"], 1),
+            "p99_turn_latency_s": round(tw["tick_p99_seconds"], 4),
+            "unfused_msgs_per_sec": round(tw["unfused_msgs_per_sec"], 1),
+            "hashtags": tw_h, "tweets_per_tick": tw_n, "ticks": tw["ticks"],
+        }
+        he = await _helloworld_bench(**hello)
+        out["helloworld"] = {
+            "rpc_per_sec": round(he["throughput"], 1),
+            "p99_turn_latency_s": round(he["p99"], 6),
+            "grains": he["grains"],
+        }
+        return out
+
     async def run() -> dict:
         stats = await _tensor_presence(args.players, args.games, args.ticks,
                                        args.latency_ticks)
@@ -496,6 +547,10 @@ def main() -> None:
             # BOUNDED p99 budgets, adaptive controller active; the
             # headline value above is the max-throughput (unbounded) point
             "latency_operating_points": points,
+            # compact per-config coverage (BASELINE configs 1-5) so any
+            # workload regression shows in the driver artifact; sizes are
+            # reduced — the dedicated --workload modes publish full scale
+            "secondary_workloads": await _secondary_workloads(),
         }
 
     async def run_twitter() -> dict:
@@ -514,10 +569,13 @@ def main() -> None:
                             "per (tweet, hashtag)",
             "grains": args.hashtags + 1,
             "tweets": stats["tweets"],
-            "ticks": args.ticks,
-            "engine": "unfused batched tier (Zipf hot-row fan-in via "
-                      "sign-split segment sums; per-tick batch through "
-                      "send_batch)",
+            "ticks": stats["ticks"],
+            "engine": "fused (dispatcher pool with per-tick tweet-slab "
+                      "args; hashtag resolve + Zipf sign-split fan-in + "
+                      "counter chain compiled into one window program)",
+            "unfused_msgs_per_sec": round(stats["unfused_msgs_per_sec"], 1),
+            "fused_vs_unfused": round(stats["messages_per_sec"]
+                                      / stats["unfused_msgs_per_sec"], 2),
             "p99_turn_latency_s": round(stats["tick_p99_seconds"], 4),
             "p50_turn_latency_s": round(stats["tick_p50_seconds"], 4),
             "latency_def": f"true p99 over {stats['latency_ticks']} "

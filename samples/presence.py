@@ -258,7 +258,10 @@ async def run_presence_load_fused(engine, n_players: int = 100_000,
             tick_durations.append(time.perf_counter() - w0)
     _jax.block_until_ready(game_arena.state["updates"])
     elapsed = time.perf_counter() - t0
-    assert prog.verify() == 0, "fused window touched unactivated grains"
+    misses = prog.verify()
+    if misses:  # not assert: -O must not skip exactness verification
+        raise RuntimeError(
+            f"fused window touched {misses} unactivated grains")
 
     messages = 2 * n_players * n_ticks
     stats: Dict[str, float] = {
@@ -344,6 +347,15 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
     observation floor.  It is SUBTRACTED for budget-honoring decisions
     and rate estimation (it is measurement artifact, not engine
     latency); both raw and net percentiles are returned.
+
+    Latency mode rides the FUSED single-tick program: each bounded tick
+    — heartbeat kernel, device-mirror resolve of the game emits, game
+    fan-in — is ONE compiled XLA call (window=1: no buffering, so none
+    of window fusion's batching-vs-latency tradeoff), where the unfused
+    path dispatches each stage separately (inject→resolve→apply→route→
+    fan-in) and pays per-dispatch overhead on tunneled rigs.  Delivery
+    exactness is asserted via the programs' device-side miss counters
+    at the end of the run.
     """
     import jax as _jax
 
@@ -351,56 +363,57 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
     cfg.target_tick_latency = budget
     cfg.tick_interval_max = budget * 0.5
     cfg.tick_interval_min = max(1e-4, budget / 50.0)
-    # park optimistic miss-checks for the whole run instead of syncing
-    # them per tick: every destination is pre-activated, so the checks
-    # are all zero — they settle in ONE sync at the final flush, keeping
-    # the per-tick loop at exactly one blocking observation
-    cfg.miss_check_cap = 1_000_000
-    # window buffering trades latency for throughput — the opposite of
-    # this mode's contract — and its engage-compile would spike the p99
-    cfg.auto_fusion_ticks = 0
     engine._adaptive_interval = budget / 4.0
 
-    rng = np.random.default_rng(seed)
-    players = np.arange(n_players, dtype=np.int64)
-    games = rng.integers(0, n_games, n_players).astype(np.int32)
-    scores = rng.random(n_players, dtype=np.float32)
-
-    engine.arena_for("PresenceGrain").reserve(n_players)
-    engine.arena_for("GameGrain").reserve(n_games)
-    # activate everything up front: the bounded loop measures steady
-    # state, not cold activation
-    engine.arena_for("GameGrain").resolve_rows(
-        np.arange(n_games, dtype=np.int64))
-
-    # batch-size ladder: precompiled prefix sizes so variable offered
-    # load maps to a bounded set of compiled shapes
-    ladder = [m for m in (2048, 8192, 32768, 131072, 524288)
-              if m < n_players] + [n_players]
-    rungs = []
-    for m in ladder:
-        rungs.append({
-            "m": m,
-            "injector": engine.make_injector("PresenceGrain", "heartbeat",
-                                             players[:m]),
-            "game": jnp.asarray(games[:m]),
-            "score": jnp.asarray(scores[:m]),
-        })
     game_arena = engine.arena_for("GameGrain")
+    # the rung ladder (programs + compiles + measured service times) is
+    # cached on the engine: bench.py retries this function up to 4 times
+    # per budget on one engine, and rebuilding ~6 fused programs per
+    # attempt would be almost all compile wall time on tunneled rigs
+    cache = getattr(engine, "_bounded_rung_cache", None)
+    if cache is not None and cache["n_players"] == n_players:
+        rungs, service = cache["rungs"], cache["service"]
+    else:
+        rng = np.random.default_rng(seed)
+        players = np.arange(n_players, dtype=np.int64)
+        games = rng.integers(0, n_games, n_players).astype(np.int32)
+        scores = rng.random(n_players, dtype=np.float32)
 
-    # warm pass: compile each rung (tick 1) and measure its synced
-    # service time (tick 2) for the rate estimate
-    service = {}
-    for rung in rungs:
-        for rep in range(2):
-            s0 = time.perf_counter()
-            rung["injector"].inject({"game": rung["game"],
-                                     "score": rung["score"],
-                                     "tick": np.int32(1)})
-            await engine.drain_queues()
-            _jax.block_until_ready(game_arena.state["updates"])
-            service[rung["m"]] = time.perf_counter() - s0
-    await engine.flush()  # settle the warm ticks' parked checks
+        engine.arena_for("PresenceGrain").reserve(n_players)
+        engine.arena_for("GameGrain").reserve(n_games)
+        # activate everything up front: the bounded loop measures steady
+        # state, not cold activation
+        engine.arena_for("PresenceGrain").resolve_rows(players)
+        engine.arena_for("GameGrain").resolve_rows(
+            np.arange(n_games, dtype=np.int64))
+
+        # batch-size ladder: one compiled window=1 program per prefix
+        # size, so variable offered load maps to a bounded set of
+        # compiled shapes
+        ladder = [m for m in (2048, 8192, 32768, 131072, 524288)
+                  if m < n_players] + [n_players]
+        rungs = []
+        for m in ladder:
+            rungs.append({
+                "m": m,
+                "prog": engine.fuse_ticks("PresenceGrain", "heartbeat",
+                                          players[:m]),
+                "static": {"game": jnp.asarray(games[:m]),
+                           "score": jnp.asarray(scores[:m])},
+            })
+
+        # warm pass: compile each rung (rep 1) and measure its synced
+        # service time (rep 2) for the rate estimate
+        service = {}
+        for rung in rungs:
+            for rep in range(2):
+                s0 = time.perf_counter()
+                rung["prog"].run({"tick": np.full(1, 1, np.int32)},
+                                 static_args=rung["static"])
+                _jax.block_until_ready(game_arena.state["updates"])
+                service[rung["m"]] = time.perf_counter() - s0
+        engine._bounded_rung_cache = {"n_players": n_players,
+                                      "rungs": rungs, "service": service}
 
     if offered_rate is None:
         candidates = [m / (budget - max(s - sync_floor, 1e-4))
@@ -423,20 +436,26 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
             if r["m"] <= m_target:
                 rung = r
         tick_counter += 1
-        rung["injector"].inject({"game": rung["game"],
-                                 "score": rung["score"],
-                                 "tick": np.int32(tick_counter)})
-        await engine.drain_queues()
-        # ONE blocking observation per tick: the game fan-in result of
-        # this tick's round chain (miss-checks settle at the final flush)
+        svc0 = time.perf_counter()
+        # the whole tick is one dispatch + one blocking observation
+        rung["prog"].run({"tick": np.full(1, tick_counter, np.int32)},
+                         static_args=rung["static"])
         _jax.block_until_ready(game_arena.state["updates"])
         done = time.perf_counter()
+        # feed the controller the tick SERVICE time (the engine loop
+        # does this from run_tick; the fused path bypasses it)
+        engine._adapt(done - svc0)
         if t >= warm_ticks:
             durations.append(done - window_start)
             messages += 2 * rung["m"]
             batch_sizes.append(rung["m"])
         window_start = done
-    await engine.flush()  # settle parked checks; all pre-activated → zero
+    # exactness: every window resolved every emit in the frozen mirror
+    for rung in rungs:
+        misses = rung["prog"].verify()
+        if misses:  # not assert: -O must not skip exactness verification
+            raise RuntimeError(
+                f"bounded fused tick touched {misses} unactivated grains")
 
     # durations tile the measured wall clock exactly (window_start resets
     # at each observation), so wall throughput = messages / sum(d); the

@@ -89,6 +89,34 @@ class HashtagGrain(VectorGrain):
 
 
 @vector_grain
+class TweetDispatcherGrain(VectorGrain):
+    """Batched dispatcher tier (reference: TweetDispatcherGrain.cs:45 —
+    a ``[StatelessWorker]`` pool fanning each tweet's hashtags out as
+    AddScore calls).  The pool is a FIXED small row set, so the per-tick
+    tweet slab rides as args — which makes the whole tick fusable: fixed
+    source keys + per-tick (hashtag_key, score) leaves + an emit whose
+    destinations come from the args, resolved in the frozen device
+    mirror inside the window."""
+
+    dispatched = field(jnp.int32, 0)      # ticks this pool slot served
+
+    @batched_method
+    @staticmethod
+    def dispatch(state, batch: Batch, n_rows: int):
+        rows, args = batch.rows, batch.args
+        ones = jnp.asarray(batch.mask, jnp.int32)
+        state = {
+            **state,
+            "dispatched": state["dispatched"] + seg_sum(ones, rows, n_rows),
+        }
+        emit = Emit(
+            interface="HashtagGrain", method="add_score",
+            keys=jnp.asarray(args["keys"], jnp.int32),
+            args={"score": jnp.asarray(args["score"], jnp.int32)})
+        return state, None, (emit,)
+
+
+@vector_grain
 class TweetCounterGrain(VectorGrain):
     """Singleton activation counter (reference: CounterGrain.cs:46)."""
 
@@ -130,23 +158,14 @@ async def run_twitter_load(engine, n_tweets_per_tick: int = 50_000,
     inject→completion turn latencies."""
     import jax as _jax
 
-    rng = np.random.default_rng(seed)
-    ranks = np.arange(1, n_hashtags + 1, dtype=np.float64)
-    weights = ranks ** (-zipf_a)
-    weights /= weights.sum()
-    tag_keys = (np.arange(n_hashtags, dtype=np.int64) * 2654435761) \
-        % 0x7FFFFFFE  # pre-hashed tag key space
+    m = n_tweets_per_tick * tags_per_tweet
+    total = warm_ticks + n_ticks
+    # shared generator with the fused loader: exactness tests compare
+    # the two engines over bit-identical payload sequences
+    _tag_keys, payloads = _zipf_payloads(n_hashtags, m, total, zipf_a, seed)
 
     engine.arena_for("HashtagGrain").reserve(n_hashtags)
     engine.arena_for("TweetCounterGrain").reserve(1)
-
-    m = n_tweets_per_tick * tags_per_tweet
-    total = warm_ticks + n_ticks
-    payloads = []
-    for t in range(total):
-        tag_idx = rng.choice(n_hashtags, size=m, p=weights)
-        payloads.append((tag_keys[tag_idx],
-                         rng.integers(-1, 2, size=m).astype(np.int32)))
 
     arena = engine.arena_for("HashtagGrain")
     for t in range(warm_ticks):  # activation + compiles, untimed
@@ -184,6 +203,124 @@ async def run_twitter_load(engine, n_tweets_per_tick: int = 50_000,
         "seconds": elapsed,
         "messages": messages,
         "messages_per_sec": messages / elapsed,
+    }
+    if tick_durations:
+        d = np.asarray(tick_durations)
+        stats["tick_p50_seconds"] = float(np.percentile(d, 50))
+        stats["tick_p99_seconds"] = float(np.percentile(d, 99))
+        stats["tick_max_seconds"] = float(d.max())
+    return stats
+
+
+def _zipf_payloads(n_hashtags: int, m: int, n_ticks: int, zipf_a: float,
+                   seed: int):
+    """(tag_keys, [(keys, scores)] per tick) — shared by the unfused and
+    fused loaders so exactness tests can compare them tick for tick."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_hashtags + 1, dtype=np.float64)
+    weights = ranks ** (-zipf_a)
+    weights /= weights.sum()
+    tag_keys = (np.arange(n_hashtags, dtype=np.int64) * 2654435761) \
+        % 0x7FFFFFFE
+    payloads = []
+    for _ in range(n_ticks):
+        tag_idx = rng.choice(n_hashtags, size=m, p=weights)
+        payloads.append((tag_keys[tag_idx],
+                         rng.integers(-1, 2, size=m).astype(np.int32)))
+    return tag_keys, payloads
+
+
+async def run_twitter_load_fused(engine, n_tweets_per_tick: int = 50_000,
+                                 n_hashtags: int = 5_000,
+                                 tags_per_tweet: int = 2,
+                                 n_ticks: int = 10, window: int = 10,
+                                 zipf_a: float = 1.4, seed: int = 0,
+                                 n_dispatchers: int = 64,
+                                 measure_latency: bool = False
+                                 ) -> Dict[str, float]:
+    """The firehose through the FUSED tick path: the dispatcher pool's
+    key set is fixed, each tick's (hashtag_key, score) slab rides as
+    per-tick stacked args, and the whole chain — dispatcher emit →
+    device-mirror resolve of the hashtag keys → Zipf sign-split fan-in →
+    counter increment — compiles into one ``lax.scan`` window
+    (tensor/fused.py).  Steady state requires every hashtag activated
+    (warmed untimed); exactness is asserted via the program's device
+    miss counter.  ``measure_latency=True`` uses window=1 and blocks per
+    tick, so the durations are true inject→completion turn latencies."""
+    import jax as _jax
+
+    m = n_tweets_per_tick * tags_per_tweet
+    from orleans_tpu.tensor.fused import plan_windows
+    if measure_latency:
+        window = 1
+    window, n_windows, n_ticks = plan_windows(window, n_ticks)
+    tag_keys, payloads = _zipf_payloads(n_hashtags, m,
+                                        n_windows * window, zipf_a, seed)
+
+    engine.arena_for("TweetDispatcherGrain").reserve(n_dispatchers)
+    engine.arena_for("HashtagGrain").reserve(n_hashtags)
+    engine.arena_for("TweetCounterGrain").reserve(1)
+    # steady state: every destination activated before the first window
+    engine.arena_for("HashtagGrain").resolve_rows(tag_keys)
+    engine.arena_for("TweetCounterGrain").resolve_rows(
+        np.asarray([COUNTER_KEY], dtype=np.int64))
+
+    pool = np.arange(n_dispatchers, dtype=np.int64)
+    prog = engine.fuse_ticks("TweetDispatcherGrain", "dispatch", pool)
+    # no donation: the pre-warm state buffers stay valid, so the warm
+    # window's effects can be rolled back exactly (the timed run then
+    # starts from the same state an unfused run of the same payloads
+    # would — exactness tests compare the two tick for tick)
+    prog.donate = False
+
+    def stacked_for(w: int):
+        ticks = payloads[w * window:(w + 1) * window]
+        return {"keys": np.stack([k.astype(np.int32) for k, _ in ticks]),
+                "score": np.stack([s for _, s in ticks])}
+
+    hashtag_arena = engine.arena_for("HashtagGrain")
+    # untimed warm window (compile + mirror build) on tick 0's slab,
+    # rolled back afterwards so warming never perturbs the measured state
+    warm = stacked_for(0)
+    prog.prepare(warm)
+    snap = {n: dict(engine.arena_for(n).state) for n in prog._touched}
+    counters0 = (engine.tick_number, engine.ticks_run,
+                 engine.messages_processed)
+    prog.run(warm)
+    _jax.block_until_ready(hashtag_arena.state["total"])
+    misses = prog.verify()
+    if misses:  # not assert: -O must not skip exactness verification
+        raise RuntimeError(
+            f"twitter warm window touched {misses} cold grains")
+    for n, cols in snap.items():
+        engine.arena_for(n).state = cols
+    (engine.tick_number, engine.ticks_run,
+     engine.messages_processed) = counters0
+
+    tick_durations = []
+    t0 = time.perf_counter()
+    for w in range(n_windows):
+        w0 = time.perf_counter()
+        prog.run(stacked_for(w))
+        if measure_latency:
+            _jax.block_until_ready(hashtag_arena.state["total"])
+            tick_durations.append(time.perf_counter() - w0)
+    _jax.block_until_ready(hashtag_arena.state["total"])
+    elapsed = time.perf_counter() - t0
+    misses = prog.verify()
+    if misses:  # not assert: -O must not skip exactness verification
+        raise RuntimeError(
+            f"fused twitter window touched {misses} cold grains")
+
+    messages = (m + n_tweets_per_tick) * n_ticks
+    stats: Dict[str, float] = {
+        "tweets": n_tweets_per_tick * n_ticks,
+        "hashtags": n_hashtags,
+        "ticks": n_ticks,
+        "seconds": elapsed,
+        "messages": messages,
+        "messages_per_sec": messages / elapsed,
+        "engine": "fused",
     }
     if tick_durations:
         d = np.asarray(tick_durations)

@@ -382,3 +382,71 @@ def test_gps_host_path(run):
             await silo.stop()
 
     run(main())
+
+
+def test_presence_bounded_latency_mode_fused_exact(run):
+    """The latency-bounded operating point rides the window=1 fused
+    program (one XLA call per tick).  Exactness: every injected
+    heartbeat lands exactly one game update, asserted through both the
+    state columns and the device miss counters folded at end of run."""
+
+    async def main():
+        from samples.presence import run_presence_bounded
+
+        engine = TensorEngine()
+        stats = await run_presence_bounded(
+            engine, n_players=4096, n_games=64, budget=0.05,
+            n_ticks=12, warm_ticks=4)
+        assert stats["messages"] > 0
+        assert stats["tick_p99_seconds"] > 0
+        assert stats["mean_batch"] >= 2048
+        upd = np.asarray(engine.arena_for("GameGrain").state["updates"])
+        hb = np.asarray(
+            engine.arena_for("PresenceGrain").state["heartbeats"])
+        assert int(upd.sum()) == int(hb.sum())  # one update per heartbeat
+        # verify() folded the emit deliveries into messages_processed
+        assert engine.messages_processed == int(upd.sum()) + int(hb.sum())
+
+    run(main())
+
+
+def test_twitter_fused_matches_unfused(run):
+    """The fused twitter tier (dispatcher pool + per-tick slab args +
+    in-window hashtag resolve) must produce byte-identical hashtag and
+    counter state to the unfused engine over the same Zipf payloads."""
+
+    async def main():
+        from samples.twitter_sentiment import (
+            COUNTER_KEY,
+            _zipf_payloads,
+            run_twitter_load,
+            run_twitter_load_fused,
+        )
+
+        n_tweets, n_tags, T = 2_000, 300, 8
+        plain = TensorEngine()
+        await run_twitter_load(plain, n_tweets_per_tick=n_tweets,
+                               n_hashtags=n_tags, n_ticks=T,
+                               warm_ticks=0, seed=3)
+        fused = TensorEngine()
+        stats = await run_twitter_load_fused(
+            fused, n_tweets_per_tick=n_tweets, n_hashtags=n_tags,
+            n_ticks=T, window=4, seed=3)
+        assert stats["engine"] == "fused"
+
+        tag_keys, _ = _zipf_payloads(n_tags, n_tweets * 2, T, 1.4, 3)
+        a_ref = plain.arena_for("HashtagGrain")
+        a_fus = fused.arena_for("HashtagGrain")
+        rows_ref = a_ref.resolve_rows(tag_keys)
+        rows_fus = a_fus.resolve_rows(tag_keys)
+        for col in ("total", "positive", "negative", "counted",
+                    "last_score"):
+            np.testing.assert_array_equal(
+                np.asarray(a_fus.state[col])[rows_fus],
+                np.asarray(a_ref.state[col])[rows_ref],
+                err_msg=f"HashtagGrain.{col} diverged under fusion")
+        c_ref = plain.arena_for("TweetCounterGrain").read_row(COUNTER_KEY)
+        c_fus = fused.arena_for("TweetCounterGrain").read_row(COUNTER_KEY)
+        assert int(c_ref["hashtags"]) == int(c_fus["hashtags"])
+
+    run(main())
