@@ -37,6 +37,16 @@ from orleans_tpu.runtime.reminders import InMemoryReminderTable
 MAGIC = 0x54424C53  # "TBLS"
 _HDR = struct.Struct("<II")
 
+# wire-callable contract methods, nothing else: dispatch goes through
+# this allowlist, never bare getattr, so a network client cannot invoke
+# arbitrary attributes of the table objects
+_ALLOWED = {
+    "membership": frozenset({"read_all", "insert_row", "update_row",
+                             "update_iam_alive"}),
+    "reminders": frozenset({"read_row", "read_rows", "read_all",
+                            "upsert_row", "remove_row"}),
+}
+
 
 def _encode_frame(obj: Any) -> bytes:
     payload = default_manager.serialize(obj)
@@ -64,6 +74,7 @@ class TableServiceServer:
         self.membership = membership_table or InMemoryMembershipTable()
         self.reminders = reminder_table or InMemoryReminderTable()
         self._server: Optional[asyncio.base_events.Server] = None
+        self._client_writers: set = set()
         self.requests_served = 0
 
     async def start(self) -> "TableServiceServer":
@@ -73,9 +84,15 @@ class TableServiceServer:
         return self
 
     def close(self) -> None:
+        """Stop the service like a process death would: the listener AND
+        every established client connection go down (closing only the
+        listener would keep serving connected clients — not an outage)."""
         if self._server is not None:
             self._server.close()
             self._server = None
+        for writer in list(self._client_writers):
+            writer.close()
+        self._client_writers.clear()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -83,6 +100,7 @@ class TableServiceServer:
 
     async def _serve_client(self, reader: asyncio.StreamReader,
                             writer: asyncio.StreamWriter) -> None:
+        self._client_writers.add(writer)
         try:
             while True:
                 try:
@@ -92,6 +110,10 @@ class TableServiceServer:
                 self.requests_served += 1
                 try:
                     target, name = method.split(".", 1)
+                    if name not in _ALLOWED.get(target, ()):
+                        raise PermissionError(
+                            f"method {method!r} is not a table-service "
+                            f"contract method")
                     table = {"membership": self.membership,
                              "reminders": self.reminders}[target]
                     result = await getattr(table, name)(*args)
@@ -103,7 +125,10 @@ class TableServiceServer:
                              f"{type(exc).__name__}: {exc}")
                 writer.write(_encode_frame(reply))
                 await writer.drain()
+        except ConnectionResetError:
+            pass
         finally:
+            self._client_writers.discard(writer)
             writer.close()
 
 
@@ -252,3 +277,58 @@ class RemoteReminderTable:
 
     def close(self) -> None:
         self._client.close()
+
+
+# ---------------------------------------------------------------------------
+# standalone host:  python -m orleans_tpu.plugins.table_service
+# ---------------------------------------------------------------------------
+
+async def serve(host: str, port: int, db: Optional[str] = None) -> None:
+    """Run the table service until SIGTERM/SIGINT.  With ``db`` the
+    tables are sqlite-backed — a service-process crash loses nothing,
+    and a restart on the same file resumes the cluster's membership and
+    reminders (the durable, externally-hosted store role of the
+    reference's ZooKeeper/SQL deployments:
+    ZooKeeperBasedMembershipTable.cs:58, SqlMembershipTable.cs:34)."""
+    import signal
+
+    membership = reminders = None
+    if db:
+        from orleans_tpu.plugins.sqlite_tables import (
+            SqliteMembershipTable,
+            SqliteReminderTable,
+        )
+        membership = SqliteMembershipTable(db)
+        reminders = SqliteReminderTable(db)
+    server = await TableServiceServer(
+        host=host, port=port, membership_table=membership,
+        reminder_table=reminders).start()
+    mode = f"durable sqlite at {db}" if db else "in-memory (non-durable)"
+    print(f"table service listening on {server.host}:{server.port} "
+          f"[{mode}]", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # non-POSIX loop
+            pass
+    await stop.wait()
+    server.close()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m orleans_tpu.plugins.table_service",
+        description="standalone membership + reminder table service "
+                    "(the cluster's shared external store)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7300)
+    parser.add_argument("--db", default=None,
+                        help="sqlite file path: makes the service "
+                             "DURABLE (membership + reminders survive a "
+                             "service-process crash/restart)")
+    args = parser.parse_args(argv)
+    asyncio.run(serve(args.host, args.port, args.db))

@@ -1,0 +1,7 @@
+"""``python -m orleans_tpu.plugins.table_service`` — the deployable
+standalone host for the cluster's shared membership + reminder store
+(see serve()/main() in the package __init__)."""
+
+from orleans_tpu.plugins.table_service import main
+
+main()

@@ -208,6 +208,21 @@ class MembershipOracle:
         (reference: SiloStatusChangeNotification :309 'recipients re-read
         the table, not trusting payload')."""
         snapshot, _version = await self.table.read_all()
+        if (self._running and self.my_status == SiloStatus.ACTIVE
+                and self.silo.address not in snapshot):
+            # the table lost my registration — the realistic case is a
+            # table-service restart from an empty store.  Re-register
+            # rather than wedge: update_iam_alive silently no-ops on a
+            # missing row, so without this a restarted blank table would
+            # never re-learn the live silos (and new joiners would see
+            # an empty cluster).  Stale held etags are irrelevant here:
+            # _write_myself re-reads before every attempt.
+            self.logger.warn(
+                f"{self.silo.address}: own ACTIVE row missing from the "
+                f"membership table (table restarted empty?) — "
+                f"re-registering", code=2915)
+            await self._write_myself(SiloStatus.ACTIVE, time.time())
+            snapshot, _version = await self.table.read_all()
         new_view: Dict[SiloAddress, SiloStatus] = {}
         new_hosting: Dict[SiloAddress, bool] = {}
         for addr, (entry, _etag) in snapshot.items():

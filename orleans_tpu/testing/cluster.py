@@ -15,7 +15,7 @@ shared InMemoryMembershipTable — the same trust boundaries, minus threads.
 from __future__ import annotations
 
 import asyncio
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from orleans_tpu.config import SiloConfig
 from orleans_tpu.core.factory import GrainFactory
@@ -35,7 +35,9 @@ class TestingCluster:
                  wire_fidelity: bool = True,
                  silo_setup: Optional[Callable[[Silo], None]] = None,
                  transport: str = "inproc",
-                 table_service: bool = False) -> None:
+                 table_service: bool = False,
+                 table_service_address: Optional[Tuple[str, int]] = None
+                 ) -> None:
         self.n_initial = n_silos
         self.config_factory = config_factory or self._default_config
         # per-silo wiring hook (providers etc.) run before silo.start()
@@ -61,6 +63,10 @@ class TestingCluster:
         # ZooKeeper/SQL membership table deployments)
         self._use_table_service = table_service
         self.table_service = None
+        # external table service (e.g. a `python -m
+        # orleans_tpu.plugins.table_service` process): silos connect to
+        # this address instead of an in-process server started by start()
+        self._table_service_address = table_service_address
         self._remote_tables: List = []
         self.storage_backing = MemoryStorage.shared_backing()
         # durable pub/sub state so stream subscriptions survive the death
@@ -103,12 +109,14 @@ class TestingCluster:
         if self.transport == "tcp":
             host, port = self.fabric.host, self.fabric.reserve()
         membership_table, reminder_table = self.table, self.reminder_table
-        if self.table_service is not None:
+        if self.table_service is not None \
+                or self._table_service_address is not None:
             from orleans_tpu.plugins.table_service import (
                 RemoteMembershipTable,
                 RemoteReminderTable,
             )
-            ts_host, ts_port = self.table_service.address
+            ts_host, ts_port = (self._table_service_address
+                                or self.table_service.address)
             membership_table = RemoteMembershipTable(ts_host, ts_port)
             reminder_table = RemoteReminderTable(ts_host, ts_port)
             self._remote_tables += [membership_table, reminder_table]

@@ -371,7 +371,7 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
     # per budget on one engine, and rebuilding ~6 fused programs per
     # attempt would be almost all compile wall time on tunneled rigs
     cache = getattr(engine, "_bounded_rung_cache", None)
-    if cache is not None and cache["n_players"] == n_players:
+    if cache is not None and cache["key"] == (n_players, n_games, seed):
         rungs, service = cache["rungs"], cache["service"]
     else:
         rng = np.random.default_rng(seed)
@@ -412,7 +412,7 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
                                  static_args=rung["static"])
                 _jax.block_until_ready(game_arena.state["updates"])
                 service[rung["m"]] = time.perf_counter() - s0
-        engine._bounded_rung_cache = {"n_players": n_players,
+        engine._bounded_rung_cache = {"key": (n_players, n_games, seed),
                                       "rungs": rungs, "service": service}
 
     if offered_rate is None:
@@ -420,7 +420,7 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
                       for m, s in service.items()
                       if max(s - sync_floor, 1e-4) < 0.7 * budget]
         offered_rate = max(candidates) if candidates \
-            else ladder[0] / budget
+            else rungs[0]["m"] / budget
 
     durations = []
     messages = 0

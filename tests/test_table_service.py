@@ -225,3 +225,172 @@ def test_cluster_survives_table_service_outage(run):
             await cluster.stop()
 
     run(go())
+
+
+def test_dispatch_allowlist_blocks_non_contract_methods(run):
+    """The server must dispatch ONLY contract methods — a wire client
+    invoking any other attribute (private helpers, dunders) gets an
+    error reply, not an execution."""
+
+    async def go():
+        from orleans_tpu.plugins.table_service import _TableClient
+
+        server = await TableServiceServer().start()
+        try:
+            client = _TableClient(*server.address)
+            for bad in ("membership.__class__", "membership._conn",
+                        "reminders.__init__", "membership.close",
+                        "bogus.read_all"):
+                try:
+                    await client.call(bad)
+                except RuntimeError as exc:
+                    assert ("not a table-service contract method"
+                            in str(exc)) or "KeyError" in str(exc), bad
+                else:
+                    raise AssertionError(f"{bad} was dispatched")
+            # the contract path still works after rejected calls
+            snap, version = await client.call("membership.read_all")
+            assert snap == {} and version == 0
+            client.close()
+        finally:
+            server.close()
+
+    run(go())
+
+
+async def _wait_port(host: str, port: int, timeout: float = 30.0) -> None:
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        try:
+            _r, w = await asyncio.open_connection(host, port)
+            w.close()
+            return
+        except OSError:
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(f"service at {host}:{port} never came up")
+            await asyncio.sleep(0.2)
+
+
+def _spawn_service(port: int, db: str):
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "orleans_tpu.plugins.table_service",
+         "--port", str(port), "--db", db],
+        cwd=str(Path(__file__).resolve().parents[1]), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def test_durable_service_survives_process_kill(run):
+    """The deployable shape: the service runs as a REAL separate process
+    on sqlite tables.  SIGKILL the process, restart it on the same db —
+    the cluster resumes with membership intact and a new silo can join
+    (the reference's durable external store role:
+    ZooKeeperBasedMembershipTable.cs:58 / SqlMembershipTable.cs:34)."""
+
+    async def go():
+        import socket
+        import tempfile
+        from pathlib import Path
+
+        from orleans_tpu.testing.cluster import TestingCluster
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        tmp = tempfile.mkdtemp(prefix="tblsvc")
+        db = str(Path(tmp) / "tables.db")
+
+        proc = _spawn_service(port, db)
+        cluster = None
+        try:
+            await _wait_port("127.0.0.1", port)
+            cluster = TestingCluster(
+                n_silos=2, transport="tcp",
+                table_service_address=("127.0.0.1", port))
+            await cluster.start()
+            s0, s1 = cluster.silos
+            assert set(s0.active_silos()) == {s0.address, s1.address}
+
+            proc.kill()  # hard service-process death — no flush, no bye
+            proc.wait(timeout=10)
+            await asyncio.sleep(0.5)  # silos run against the outage
+
+            proc = _spawn_service(port, db)  # restart on the SAME db
+            await _wait_port("127.0.0.1", port)
+            await asyncio.sleep(1.5)  # reconnect + refresh
+
+            # membership survived the crash: the restarted service reads
+            # both ACTIVE rows back from sqlite, silos still see each
+            # other, and the liveness loops are all healthy
+            table = RemoteMembershipTable("127.0.0.1", port)
+            snap, _version = await table.read_all()
+            assert {s0.address, s1.address} <= set(snap)
+            for s in cluster.silos:
+                assert s.membership_oracle.check_health()
+                assert len(s.active_silos()) == 2
+            # a NEW silo joins through the restarted service and sees all
+            s2 = await cluster.start_additional_silo()
+            await asyncio.sleep(1.0)
+            assert len(s2.active_silos()) == 3
+            table.close()
+        finally:
+            if cluster is not None:
+                await cluster.stop()
+            proc.kill()
+            proc.wait(timeout=10)
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    run(go())
+
+
+def test_service_restart_with_empty_table_reregisters(run):
+    """The OTHER realistic crash: the service restarts with a BLANK
+    store (in-memory tables, or a lost db file).  Silos holding live
+    etags must re-register rather than wedge — refresh_view notices its
+    own ACTIVE row missing and re-inserts (membership.py code 2915), so
+    the blank table re-learns the live cluster and new joiners see it."""
+
+    async def go():
+        from orleans_tpu.testing.cluster import TestingCluster
+
+        cluster = TestingCluster(n_silos=2, transport="tcp",
+                                 table_service=True)
+        await cluster.start()
+        try:
+            s0, s1 = cluster.silos
+            assert set(s0.active_silos()) == {s0.address, s1.address}
+            port = cluster.table_service.port
+            cluster.table_service.close()
+            await asyncio.sleep(0.3)
+
+            # revive at the same port with FRESH, EMPTY tables
+            revived = await TableServiceServer(port=port).start()
+            cluster.table_service = revived
+
+            # within a few refresh periods every silo re-registers
+            deadline = asyncio.get_running_loop().time() + 8.0
+            while True:
+                snap, _v = await revived.membership.read_all()
+                if {s0.address, s1.address} <= set(snap):
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(
+                        f"silos never re-registered; table has "
+                        f"{list(snap)}")
+                await asyncio.sleep(0.2)
+            # a couple more refresh periods: each silo's VIEW re-learns
+            # the peer from the re-populated table
+            await asyncio.sleep(1.0)
+            for s in cluster.silos:
+                assert s.membership_oracle.check_health()
+                assert len(s.active_silos()) == 2
+        finally:
+            await cluster.stop()
+
+    run(go())
