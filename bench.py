@@ -460,6 +460,44 @@ def main() -> None:
                            "device-synced single-tick windows",
         }
 
+    async def _stream_fed_presence() -> dict:
+        """The stream→tensor bridge end to end: slab heartbeats through
+        the durable sqlite queue, pulled and injected as single slabs
+        (streams/persistent.py TensorSinkBinding)."""
+        import tempfile
+        from pathlib import Path
+
+        from orleans_tpu.plugins.sqlite_queue import SqliteQueueAdapter
+        from orleans_tpu.streams import PersistentStreamProvider
+        from orleans_tpu.testing.cluster import TestingCluster
+        from samples.presence_stream import run_presence_stream_load
+
+        n_players = 10_000 if args.smoke else 200_000
+        db = str(Path(tempfile.mkdtemp(prefix="benchq")) / "queue.db")
+
+        def setup(silo):
+            p = PersistentStreamProvider(
+                SqliteQueueAdapter(path=db, n_queues=1),
+                pull_period=0.001, batch_size=16)
+            p.bind_tensor_sink("presence-hb", "PresenceGrain", "heartbeat")
+            silo.add_stream_provider("pstream", p)
+
+        cluster = await TestingCluster(n_silos=1, silo_setup=setup).start()
+        try:
+            silo = cluster.silos[0]
+            await run_presence_stream_load(silo, n_players=n_players,
+                                           n_slabs=2)  # warm
+            stats = await run_presence_stream_load(
+                silo, n_players=n_players, n_slabs=10)
+            return {
+                "msgs_per_sec": round(stats["messages_per_sec"], 1),
+                "players": n_players,
+                "pipeline": "producer → durable sqlite queue → pulling "
+                            "agent → ONE slab per pull run → engine",
+            }
+        finally:
+            await cluster.stop()
+
     async def _secondary_workloads() -> dict:
         """Compact numbers for the four non-headline BASELINE configs,
         published with every default run so a regression in ANY workload
@@ -547,6 +585,8 @@ def main() -> None:
             # BOUNDED p99 budgets, adaptive controller active; the
             # headline value above is the max-throughput (unbounded) point
             "latency_operating_points": points,
+            # queue-fed tier: the stream→tensor bridge's end-to-end rate
+            "stream_fed": await _stream_fed_presence(),
             # compact per-config coverage (BASELINE configs 1-5) so any
             # workload regression shows in the driver artifact; sizes are
             # reduced — the dedicated --workload modes publish full scale

@@ -25,6 +25,8 @@ from orleans_tpu.streams.simple import SimpleMessageStreamProvider
 from orleans_tpu.streams.persistent import (
     InMemoryQueueAdapter,
     PersistentStreamProvider,
+    QueueMessage,
+    TensorSinkBinding,
 )
 
 __all__ = [
@@ -34,4 +36,6 @@ __all__ = [
     "SimpleMessageStreamProvider",
     "PersistentStreamProvider",
     "InMemoryQueueAdapter",
+    "QueueMessage",
+    "TensorSinkBinding",
 ]

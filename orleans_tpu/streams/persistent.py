@@ -46,6 +46,22 @@ class QueueMessage:
     kind: str = "item"  # item | completed | error
 
 
+@dataclass(frozen=True)
+class TensorSinkBinding:
+    """A stream namespace bound to a vector-grain batch edge — the
+    stream→tensor bridge (see PersistentStreamProvider.bind_tensor_sink).
+
+    ``key_field`` names the item field carrying the destination grain
+    key; every other field becomes a batch-args column.  Items may be
+    single events (scalar fields) or SLABS (ndarray fields of k events)
+    — batches stay batches from the producer through the queue into the
+    engine."""
+
+    type_name: str
+    method: str
+    key_field: str = "key"
+
+
 # ---------------------------------------------------------------------------
 # adapters (reference: IQueueAdapter / IQueueAdapterReceiver)
 # ---------------------------------------------------------------------------
@@ -291,10 +307,27 @@ class PullingAgent:
                         min(p.batch_size, space))
                     self.cache.add(msgs)  # dedup by seq
                 progressed = False
-                for m in self.cache.window(delivered_up_to + 1):
+                window_msgs = list(self.cache.window(delivered_up_to + 1))
+                k = 0
+                while k < len(window_msgs):
                     if attempts and time.monotonic() < retry_at:
                         break  # backing off before redelivering the head
-                    ok = await self._deliver(m)
+                    m = window_msgs[k]
+                    sink = p.tensor_sink_for(m) if m.kind == "item" else None
+                    if sink is not None:
+                        # stream→tensor bridge: the maximal run of events
+                        # bound to the same sink delivers as ONE slab
+                        run = [m]
+                        while (k + len(run) < len(window_msgs)
+                               and window_msgs[k + len(run)].kind == "item"
+                               and p.tensor_sink_for(
+                                   window_msgs[k + len(run)]) is sink):
+                            run.append(window_msgs[k + len(run)])
+                        ok = await self._deliver_slab(sink, run)
+                        n = len(run)
+                    else:
+                        ok = await self._deliver(m)
+                        n = 1
                     if not ok:
                         attempts += 1
                         if attempts < p.max_delivery_attempts:
@@ -308,14 +341,28 @@ class PullingAgent:
                                 p.retry_backoff_initial * (2 ** (attempts - 1)),
                                 p.retry_backoff_max)
                             break
-                        self.logger.warn(
-                            f"dropping seq={m.seq} on {m.stream_id} after "
-                            f"{attempts} failed delivery attempts")
+                        if sink is not None and n > 1:
+                            # poison isolation: a failing RUN retries one
+                            # message at a time, so only the malformed
+                            # event drops — never its good neighbors
+                            for mm in run:
+                                if not await self._deliver_slab(sink, [mm]):
+                                    self.logger.warn(
+                                        f"dropping seq={mm.seq} on "
+                                        f"{mm.stream_id} (poison event "
+                                        f"isolated from a {n}-message run "
+                                        f"after {attempts} attempts)")
+                        else:
+                            self.logger.warn(
+                                f"dropping seq={m.seq} on {m.stream_id} "
+                                f"after {attempts} failed delivery attempts")
                     attempts = 0
-                    await self.receiver.ack(m.seq)
-                    delivered_up_to = m.seq
-                    self.delivered += 1
+                    last_seq = window_msgs[k + n - 1].seq
+                    await self.receiver.ack(last_seq)
+                    delivered_up_to = last_seq
+                    self.delivered += n
                     progressed = True
+                    k += n
                 if progressed:
                     self.cache.trim_to(delivered_up_to)
                     continue  # drain hot queue without sleeping
@@ -386,6 +433,70 @@ class PullingAgent:
             return await fn(*args)
         finally:
             _current_runtime.reset(token)
+
+    async def _deliver_slab(self, sink: TensorSinkBinding,
+                            run: List[QueueMessage]) -> bool:
+        """Inject a run of sink-bound events as ONE vector-grain slab
+        through the engine's batch edge (send_batch — cluster routing
+        ships non-owned partitions as slabs), then run the engine to a
+        quiescent queue before the caller acks: a hard kill before
+        completion redelivers the un-acked run (at-least-once, the same
+        contract as per-event host delivery).  The reference seam: the
+        pulling agent delivering a pulled BATCH to consumers
+        (PersistentStreamPullingAgent.cs:335-370) — here the batch stays
+        one tensor instead of N turns."""
+        import numpy as np
+
+        engine = getattr(self.provider.silo, "tensor_engine", None)
+        if engine is None:
+            self.logger.warn(
+                f"tensor sink {sink.type_name}.{sink.method} bound but "
+                f"silo has no tensor engine")
+            return False
+        try:
+            keys: List[np.ndarray] = []
+            cols: Dict[str, List[np.ndarray]] = {}
+            fields: Optional[frozenset] = None
+            for m in run:
+                item = m.item
+                fset = frozenset(item)
+                if fields is None:
+                    fields = fset
+                elif fset != fields:
+                    # args columns must cover every event: a field absent
+                    # from some items would concatenate SHORTER than the
+                    # key column and silently broadcast-misapply
+                    raise ValueError(
+                        f"sink items disagree on fields: "
+                        f"{sorted(fields)} vs {sorted(fset)}")
+                kv = item[sink.key_field]
+                if isinstance(kv, np.ndarray):
+                    # slab-valued item: arrays of k events each
+                    keys.append(kv.astype(np.int64, copy=False))
+                    width = len(kv)
+                else:
+                    keys.append(np.asarray([kv], dtype=np.int64))
+                    width = 1
+                for f, v in item.items():
+                    if f == sink.key_field:
+                        continue
+                    arr = v if isinstance(v, np.ndarray) else np.asarray([v])
+                    if len(arr) != width:
+                        raise ValueError(
+                            f"sink item field {f!r} has {len(arr)} rows, "
+                            f"key field has {width}")
+                    cols.setdefault(f, []).append(arr)
+            slab_keys = np.concatenate(keys)
+            args = {f: np.concatenate(vs) if len(vs) > 1 else vs[0]
+                    for f, vs in cols.items()}
+            engine.send_batch(sink.type_name, sink.method, slab_keys, args)
+            await engine.drain_queues()
+            return True
+        except Exception as exc:  # noqa: BLE001 — retried by the pull loop
+            self.logger.warn(
+                f"slab delivery of {len(run)} events to "
+                f"{sink.type_name}.{sink.method} failed: {exc!r}")
+            return False
 
     async def _deliver(self, msg: QueueMessage) -> bool:
         """Deliver one event to every subscriber.  Returns False when any
@@ -488,6 +599,9 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
         self.silo = None
         self.balancer = None
         self.manager: Optional[PersistentStreamPullingManager] = None
+        # stream namespace → vector-grain batch edge (the stream→tensor
+        # bridge; see bind_tensor_sink)
+        self.tensor_sinks: Dict[str, TensorSinkBinding] = {}
 
     def init(self, silo, name: str) -> None:
         self.silo = silo
@@ -512,6 +626,28 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
         if agent is not None:
             agent._consumer_cache.pop(handle.stream_id, None)
             await agent._consumers(handle.stream_id)
+
+    def bind_tensor_sink(self, namespace: str, interface, method: str,
+                         key_field: str = "key") -> None:
+        """Bind every stream in ``namespace`` to a vector-grain batch
+        edge: pulling agents deliver each pull cycle's events for these
+        streams as ONE slab injection (engine.send_batch) instead of one
+        host turn per (event, consumer) — the stream→tensor bridge that
+        lets queue-fed workloads reach the data plane's throughput tier.
+        Bind on EVERY silo hosting this provider (agents are balanced
+        across the cluster).  Items must be dicts carrying ``key_field``
+        plus the batch-args fields, scalar (one event) or ndarray-valued
+        (a slab of events)."""
+        type_name = interface if isinstance(interface, str) \
+            else interface.__name__
+        self.tensor_sinks[namespace] = TensorSinkBinding(
+            type_name, method, key_field)
+
+    def tensor_sink_for(self, msg: QueueMessage
+                        ) -> Optional[TensorSinkBinding]:
+        if not self.tensor_sinks:
+            return None
+        return self.tensor_sinks.get(msg.stream_id.namespace)
 
     async def start(self) -> None:
         self.manager.start()

@@ -165,13 +165,23 @@ def scatter_rows(column: jnp.ndarray, rows: jnp.ndarray,
                  values: jnp.ndarray) -> jnp.ndarray:
     """Overwrite ``column[rows] = values``; padding rows (-1) dropped.
     Last writer wins for duplicate rows (matching arrival order is not
-    guaranteed across a tick — use seg_* for order-free combining)."""
-    return column.at[rows].set(values, mode="drop")
+    guaranteed across a tick — use seg_* for order-free combining).
+
+    mode="drop" alone is NOT enough: JAX normalizes negative indices
+    BEFORE the bounds check, so a padding row of -1 would wrap to the
+    LAST row and silently corrupt whichever grain lives there once the
+    arena fills.  Remap negatives past the end first — those really
+    drop."""
+    safe = jnp.where(rows >= 0, rows, column.shape[0])
+    return column.at[safe].set(values, mode="drop")
 
 
 def scatter_add_rows(column: jnp.ndarray, rows: jnp.ndarray,
                      values: jnp.ndarray) -> jnp.ndarray:
-    return column.at[rows].add(values, mode="drop")
+    """``column[rows] += values`` with padding rows (-1) dropped (same
+    negative-wrap guard as scatter_rows)."""
+    safe = jnp.where(rows >= 0, rows, column.shape[0])
+    return column.at[safe].add(values, mode="drop")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,233 @@
+"""Stream→tensor bridge (streams/persistent.py TensorSinkBinding): a
+pulling agent's pull cycle delivers sink-bound events as ONE slab
+through the engine's batch edge, acked only after the engine runs it —
+exactness and crash-resume over the durable sqlite queue adapter.
+
+Reference seam: the pulling agent delivering a pulled batch to
+consumers (PersistentStreamPullingAgent.cs:335-370) — here the batch
+stays one tensor instead of N host turns.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+
+from orleans_tpu.plugins.sqlite_queue import SqliteQueueAdapter
+from orleans_tpu.streams import PersistentStreamProvider
+from orleans_tpu.streams.core import StreamId
+from orleans_tpu.testing.cluster import TestingCluster
+
+import tests.test_autofuse  # noqa: F401 — registers LwwGrain
+
+
+def _provider_setup(db: str, n_queues: int = 2):
+    def setup(silo):
+        provider = PersistentStreamProvider(
+            SqliteQueueAdapter(path=db, n_queues=n_queues),
+            pull_period=0.01, consumer_cache_ttl=0.0)
+        provider.bind_tensor_sink("lww-events", "LwwGrain", "put",
+                                  key_field="key")
+        silo.add_stream_provider("pq", provider)
+    return setup
+
+
+def _lww_rows(silo, keys):
+    arena = silo.tensor_engine.arena_for("LwwGrain")
+    rows = arena.resolve_rows(np.asarray(keys, dtype=np.int64))
+    return (np.asarray(arena.state["value"])[rows],
+            np.asarray(arena.state["count"])[rows])
+
+
+def test_sink_delivers_slabs_and_single_events_exactly(run, tmp_path):
+    """Mixed slab-valued and scalar items on a sink-bound stream arrive
+    exactly once each, in queue order, through ONE injection per run."""
+
+    async def main():
+        db = str(tmp_path / "bridge.db")
+        cluster = await TestingCluster(
+            n_silos=1, silo_setup=_provider_setup(db)).start()
+        try:
+            silo = cluster.silos[0]
+            provider = silo.stream_providers["pq"]
+            sid = StreamId(provider="pq", namespace="lww-events", key=1)
+
+            n = 64
+            keys = np.arange(n, dtype=np.int64)
+            # 3 slab items + 2 scalar items, one stream → one queue →
+            # strictly ordered; value is last-writer-wins
+            for t in range(3):
+                await provider.produce(sid, [{
+                    "key": keys, "v": np.full(n, t + 1, np.int32)}])
+            await provider.produce(sid, [{"key": 7, "v": 100},
+                                         {"key": 7, "v": 101}])
+
+            agent_delivered = 0
+
+            async def drained():
+                while True:
+                    d = sum(a.delivered
+                            for a in provider.manager.agents.values())
+                    if d >= 5:
+                        return d
+                    await asyncio.sleep(0.01)
+
+            agent_delivered = await asyncio.wait_for(drained(), timeout=10)
+            assert agent_delivered == 5
+            await silo.tensor_engine.flush()
+
+            value, count = _lww_rows(silo, keys)
+            expected_counts = np.full(n, 3)
+            expected_counts[7] += 2  # the two scalar events
+            np.testing.assert_array_equal(count, expected_counts)
+            # order held: slabs 1..3 then the scalar 100, 101
+            assert int(value[7]) == 101
+            np.testing.assert_array_equal(np.delete(value, 7), 3)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_sink_crash_resume_over_sqlite(run, tmp_path):
+    """Hard-kill the silo whose agent owns the sink-bound queue: the
+    replacement resumes from the durable cursor, redelivers the un-acked
+    tail (at-least-once), and the stream keeps flowing."""
+
+    async def main():
+        db = str(tmp_path / "bridge-crash.db")
+        cluster = await TestingCluster(
+            n_silos=1, transport="tcp",
+            silo_setup=_provider_setup(db)).start()
+        try:
+            s0 = cluster.silos[0]
+            provider = s0.stream_providers["pq"]
+            sid = StreamId(provider="pq", namespace="lww-events", key=2)
+            n = 32
+            keys = np.arange(n, dtype=np.int64)
+
+            for t in range(4):
+                await provider.produce(sid, [{
+                    "key": keys, "v": np.full(n, t + 1, np.int32)}])
+
+            async def delivered_at_least(p, k):
+                while sum(a.delivered
+                          for a in p.manager.agents.values()) < k:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(delivered_at_least(provider, 4),
+                                   timeout=10)
+
+            cluster.kill_silo(s0)  # no goodbye: cursor is whatever is acked
+            s1 = await cluster.start_additional_silo()
+            provider1 = s1.stream_providers["pq"]
+
+            # produce AFTER the crash: the new silo's agent must resume
+            # from the durable cursor and deliver the new slabs
+            for t in range(4, 6):
+                await provider1.produce(sid, [{
+                    "key": keys, "v": np.full(n, t + 1, np.int32)}])
+            await asyncio.wait_for(delivered_at_least(provider1, 2),
+                                   timeout=15)
+            await s1.tensor_engine.flush()
+
+            value, count = _lww_rows(s1, keys)
+            # the new silo's arena state restarted empty (no storage
+            # attached): at LEAST the post-crash slabs applied here, plus
+            # any redelivered un-acked tail — at-least-once, never less
+            assert (count >= 2).all(), count.min()
+            np.testing.assert_array_equal(value, 6)  # last writer won
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_stream_fed_presence_reaches_throughput_tier(run, tmp_path):
+    """The stream-fed presence pipeline (queue → pulling agent → slab →
+    engine) sustains >= 1M msg/s end to end on the durable sqlite
+    adapter — the VERDICT r4 criterion for the bridge."""
+
+    async def main():
+        from samples.presence_stream import run_presence_stream_load
+
+        db = str(tmp_path / "bridge-perf.db")
+
+        def setup(silo):
+            provider = PersistentStreamProvider(
+                SqliteQueueAdapter(path=db, n_queues=1),
+                pull_period=0.001, batch_size=16)
+            provider.bind_tensor_sink("presence-hb", "PresenceGrain",
+                                      "heartbeat")
+            silo.add_stream_provider("pstream", provider)
+
+        cluster = await TestingCluster(n_silos=1,
+                                       silo_setup=setup).start()
+        try:
+            silo = cluster.silos[0]
+            # warm: activation + compile out of the measured window
+            warm = await run_presence_stream_load(
+                silo, n_players=50_000, n_slabs=2)
+            stats = await run_presence_stream_load(
+                silo, n_players=50_000, n_slabs=8)
+            # exactness first: every queued heartbeat applied
+            hb = np.asarray(silo.tensor_engine.arena_for(
+                "PresenceGrain").state["heartbeats"])
+            assert int(hb.sum()) == (warm["messages"] + stats["messages"]) // 2
+            assert stats["messages_per_sec"] >= 1_000_000, stats
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_poison_event_isolated_from_slab_run(run, tmp_path):
+    """A malformed item in a run of good slabs must drop ALONE at the
+    poison cap — the run retries one message at a time, so good
+    neighbors still deliver (the per-event path's poison semantics)."""
+
+    async def main():
+        db = str(tmp_path / "bridge-poison.db")
+
+        def setup(silo):
+            provider = PersistentStreamProvider(
+                SqliteQueueAdapter(path=db, n_queues=1),
+                pull_period=0.005, consumer_cache_ttl=0.0,
+                max_delivery_attempts=2, retry_backoff_initial=0.01,
+                retry_backoff_max=0.02)
+            provider.bind_tensor_sink("lww-events", "LwwGrain", "put",
+                                      key_field="key")
+            silo.add_stream_provider("pq", provider)
+
+        cluster = await TestingCluster(n_silos=1,
+                                       silo_setup=setup).start()
+        try:
+            silo = cluster.silos[0]
+            provider = silo.stream_providers["pq"]
+            sid = StreamId(provider="pq", namespace="lww-events", key=3)
+            n = 16
+            keys = np.arange(n, dtype=np.int64)
+
+            await provider.produce(sid, [
+                {"key": keys, "v": np.full(n, 1, np.int32)},
+                # poison: v column width disagrees with the key column
+                {"key": keys, "v": np.full(3, 9, np.int32)},
+                {"key": keys, "v": np.full(n, 2, np.int32)},
+            ])
+
+            async def drained():
+                while sum(a.delivered
+                          for a in provider.manager.agents.values()) < 3:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(drained(), timeout=10)
+            await silo.tensor_engine.flush()
+            value, count = _lww_rows(silo, keys)
+            # both GOOD slabs landed exactly once; the poison one dropped
+            np.testing.assert_array_equal(count, 2)
+            np.testing.assert_array_equal(value, 2)  # order held
+        finally:
+            await cluster.stop()
+
+    run(main())

@@ -329,3 +329,24 @@ def test_per_stage_tick_profiling_names_the_slow_stage(run):
         assert stages["resolve"] >= 0.05
 
     run(main())
+
+
+def test_scatter_helpers_drop_padding_rows():
+    """scatter_rows / scatter_add_rows must DROP padding rows (-1), not
+    let JAX's negative-index normalization wrap them onto the LAST row —
+    once an arena fills, that wrap silently corrupts whichever grain
+    lives there (the padded host-batch path hits this every tick)."""
+    import jax.numpy as jnp
+
+    from orleans_tpu.tensor.vector_grain import (
+        scatter_add_rows,
+        scatter_rows,
+    )
+
+    col = jnp.zeros(4, jnp.int32)
+    rows = jnp.asarray([-1, 1, -1, 3])
+    vals = jnp.asarray([9, 5, 9, 7], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(scatter_rows(col, rows, vals)), [0, 5, 0, 7])
+    np.testing.assert_array_equal(
+        np.asarray(scatter_add_rows(col, rows, vals)), [0, 5, 0, 7])
