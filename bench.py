@@ -460,6 +460,45 @@ def main() -> None:
                            "device-synced single-tick windows",
         }
 
+    async def _scale_probe() -> dict:
+        """SURVEY §5 scaling claim (O(1M) activations/silo,
+        ActivationCollector.cs:37) pushed 4x: Presence at 4M grains on
+        one chip — activation at scale, fused steady state, bulk
+        deactivation + shard compaction (generation bump), and the
+        re-activation re-trace afterwards."""
+        import numpy as np
+
+        from orleans_tpu.tensor import TensorEngine
+        from samples.presence import run_presence_load_fused
+
+        n_players = 40_000 if args.smoke else 4_000_000
+        n_games = max(1, n_players // 100)
+        engine = TensorEngine()
+        stats = await run_presence_load_fused(
+            engine, n_players=n_players, n_games=n_games,
+            n_ticks=6, window=3)
+        arena = engine.arena_for("PresenceGrain")
+        mirror = "dense" if arena.dense_index() is not None else "sorted"
+        t0 = time.perf_counter()
+        evicted = arena.evict_keys(
+            np.arange(n_players // 2, dtype=np.int64), write_back=False)
+        evict_s = time.perf_counter() - t0
+        # the evicted half re-activates and the program re-traces against
+        # the compacted layout — collection under pressure must not
+        # degrade the steady state
+        post = await run_presence_load_fused(
+            engine, n_players=n_players, n_games=n_games,
+            n_ticks=3, window=3)
+        return {
+            "players": n_players,
+            "msgs_per_sec": round(stats["messages_per_sec"], 1),
+            "device_mirror": mirror,
+            "arena_capacity": arena.capacity,
+            "evicted_half_count": evicted,
+            "evict_compact_seconds": round(evict_s, 3),
+            "post_repack_msgs_per_sec": round(post["messages_per_sec"], 1),
+        }
+
     async def _stream_fed_presence() -> dict:
         """The stream→tensor bridge end to end: slab heartbeats through
         the durable sqlite queue, pulled and injected as single slabs
@@ -472,8 +511,11 @@ def main() -> None:
         from orleans_tpu.testing.cluster import TestingCluster
         from samples.presence_stream import run_presence_stream_load
 
+        import shutil
+
         n_players = 10_000 if args.smoke else 200_000
-        db = str(Path(tempfile.mkdtemp(prefix="benchq")) / "queue.db")
+        tmp = tempfile.mkdtemp(prefix="benchq")
+        db = str(Path(tmp) / "queue.db")
 
         def setup(silo):
             p = PersistentStreamProvider(
@@ -497,6 +539,7 @@ def main() -> None:
             }
         finally:
             await cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
 
     async def _secondary_workloads() -> dict:
         """Compact numbers for the four non-headline BASELINE configs,
@@ -585,6 +628,8 @@ def main() -> None:
             # BOUNDED p99 budgets, adaptive controller active; the
             # headline value above is the max-throughput (unbounded) point
             "latency_operating_points": points,
+            # 4M-grain scale proof (SURVEY §5 scaling claim, 4x)
+            "scale_4m": await _scale_probe(),
             # queue-fed tier: the stream→tensor bridge's end-to-end rate
             "stream_fed": await _stream_fed_presence(),
             # compact per-config coverage (BASELINE configs 1-5) so any

@@ -135,6 +135,9 @@ class SiloConfig:
     # DeploymentLoadPublisher cadence (reference: GlobalConfiguration
     # DeploymentLoadPublisherRefreshTime); 0 disables the broadcast
     load_publish_period: float = 1.0
+    # adaptive directory-cache maintenance cadence (reference:
+    # AdaptiveDirectoryCacheMaintainer.cs:34); 0 disables the loop
+    directory_cache_maintenance_period: float = 5.0
     # watchdog health-check cadence (reference: Watchdog.cs
     # healthCheckPeriod); 0 disables the watchdog
     watchdog_period: float = 5.0

@@ -29,17 +29,37 @@ from orleans_tpu.runtime.ring import VirtualBucketsRing
 
 class GrainDirectoryCache:
     """LRU cache of remote directory entries
-    (reference: LRUBasedGrainDirectoryCache.cs:30)."""
+    (reference: LRUBasedGrainDirectoryCache.cs:30), with per-round hit
+    tracking feeding the adaptive maintainer
+    (reference: AdaptiveGrainDirectoryCache.cs:30 access counts)."""
 
     def __init__(self, max_size: int = 100_000):
         self.max_size = max_size
         self._entries: "OrderedDict[GrainId, ActivationAddress]" = OrderedDict()
+        # hit tracking is OFF until a maintainer attaches (track_hits):
+        # with the maintenance loop disabled nothing would ever drain
+        # _hits, and an unbounded per-distinct-grain dict is a slow leak
+        self.track_hits = False
+        self._hits: Dict[GrainId, int] = {}
 
     def get(self, grain_id: GrainId) -> Optional[ActivationAddress]:
         addr = self._entries.get(grain_id)
         if addr is not None:
             self._entries.move_to_end(grain_id)
+            if self.track_hits:
+                self._hits[grain_id] = self._hits.get(grain_id, 0) + 1
         return addr
+
+    def peek(self, grain_id: GrainId) -> Optional[ActivationAddress]:
+        """Read without recording a hit or touching LRU order — the
+        maintainer's own checks must not make entries self-sustainingly
+        hot."""
+        return self._entries.get(grain_id)
+
+    def drain_hits(self) -> Dict[GrainId, int]:
+        """Hit counts since the last drain (one maintenance round)."""
+        hits, self._hits = self._hits, {}
+        return hits
 
     def put(self, grain_id: GrainId, addr: ActivationAddress) -> None:
         self._entries[grain_id] = addr
@@ -49,11 +69,13 @@ class GrainDirectoryCache:
 
     def invalidate(self, grain_id: GrainId) -> None:
         self._entries.pop(grain_id, None)
+        self._hits.pop(grain_id, None)
 
     def invalidate_silo(self, silo: SiloAddress) -> None:
         dead = [g for g, a in self._entries.items() if a.silo == silo]
         for g in dead:
             del self._entries[g]
+            self._hits.pop(g, None)
 
 
 class GrainDirectoryPartition:
@@ -287,6 +309,87 @@ class LocalGrainDirectory:
             await self.heal_after_ring_change()
 
 
+class AdaptiveDirectoryCacheMaintainer:
+    """Background refresh/promote loop over the directory cache's HOT
+    entries (reference: AdaptiveDirectoryCacheMaintainer.cs:34 — the
+    reference periodically revalidates cached entries by access count;
+    stale ones drop before a message pays a wrong-silo forward hop).
+
+    Each round: take the entries hit since the last round, batch them by
+    DIRECTORY OWNER, validate each batch in one system-RPC
+    (remote_lookup_batch), re-put still-valid entries (refreshing their
+    LRU position — promotion) and invalidate moved/gone ones.  The
+    device-mirror fast path makes this mostly moot for vector traffic;
+    host-path RPC to remote grains is what benefits."""
+
+    def __init__(self, directory: LocalGrainDirectory,
+                 period: float = 5.0, max_batch: int = 512) -> None:
+        self.directory = directory
+        directory.cache.track_hits = True  # drained by run_round
+        self.period = period
+        self.max_batch = max_batch
+        self.rounds = 0
+        self.refreshed = 0
+        self.invalidated = 0
+        self._task = None
+
+    def start(self) -> None:
+        import asyncio
+        import contextvars
+        self._task = asyncio.get_running_loop().create_task(
+            self._loop(), context=contextvars.Context())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        import asyncio
+        while True:
+            await asyncio.sleep(self.period)
+            try:
+                await self.run_round()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — advisory maintenance only
+                pass
+
+    async def run_round(self) -> None:
+        d = self.directory
+        hits = d.cache.drain_hits()
+        if not hits:
+            return
+        self.rounds += 1
+        hot = sorted(hits, key=hits.get, reverse=True)[:self.max_batch]
+        by_owner: Dict[SiloAddress, List[GrainId]] = {}
+        for g in hot:
+            if d.cache.peek(g) is None:  # peek: a get() would record a
+                continue                 # hit and self-sustain the entry
+            by_owner.setdefault(d.owner_of(g), []).append(g)
+        for owner, ids in by_owner.items():
+            if owner == d.silo.address:
+                addrs = [d.partition.lookup(g) for g in ids]
+            else:
+                try:
+                    addrs = await d.silo.system_rpc(
+                        owner, "directory", "remote_lookup_batch", (ids,),
+                        timeout=5.0)
+                except Exception:  # noqa: BLE001 — owner unreachable:
+                    continue       # membership handles it, not this loop
+            for g, addr in zip(ids, addrs):
+                if addr is None or not d.silo.is_silo_alive(addr.silo):
+                    d.cache.invalidate(g)
+                    self.invalidated += 1
+                else:
+                    d.cache.put(g, addr)  # refresh + promote
+                    self.refreshed += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        return {"rounds": self.rounds, "refreshed": self.refreshed,
+                "invalidated": self.invalidated}
+
+
 class RemoteGrainDirectory:
     """System-target facade exposing partition ops to other silos
     (reference: RemoteGrainDirectory.cs:32).  Registered on every silo under
@@ -305,6 +408,12 @@ class RemoteGrainDirectory:
     async def remote_lookup(self, grain_id: GrainId
                             ) -> Optional[ActivationAddress]:
         return self.directory.partition.lookup(grain_id)
+
+    async def remote_lookup_batch(self, grain_ids: List[GrainId]
+                                  ) -> List[Optional[ActivationAddress]]:
+        """One round-trip validates a whole hot set (the adaptive cache
+        maintainer's refresh batch)."""
+        return [self.directory.partition.lookup(g) for g in grain_ids]
 
     async def accept_handoff(self, entries: Dict[GrainId, ActivationAddress]
                              ) -> None:

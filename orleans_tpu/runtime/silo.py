@@ -215,6 +215,18 @@ class Silo:
             )
             self.load_publisher = DeploymentLoadPublisher(
                 self, self.config.load_publish_period)
+        # adaptive directory-cache maintainer: refresh/promote hot cache
+        # lines, drop moved/stale ones (reference:
+        # AdaptiveDirectoryCacheMaintainer.cs:34)
+        self.cache_maintainer = None
+        if fabric is not None \
+                and self.config.directory_cache_maintenance_period > 0:
+            from orleans_tpu.runtime.directory import (
+                AdaptiveDirectoryCacheMaintainer,
+            )
+            self.cache_maintainer = AdaptiveDirectoryCacheMaintainer(
+                self.grain_directory,
+                period=self.config.directory_cache_maintenance_period)
         self._stop_callbacks: List[Callable[[], Any]] = []
 
         # elasticity: membership-driven ring changes re-assert directory
@@ -279,6 +291,8 @@ class Silo:
             self.tensor_engine.start()
         if self.load_publisher is not None:
             self.load_publisher.start()
+        if self.cache_maintainer is not None:
+            self.cache_maintainer.start()
         # bootstrap providers: app startup logic inside the live silo
         # (reference: Silo.cs:542-552 — after stream providers start)
         for name, (provider, cfg) in self.bootstrap_providers.items():
@@ -303,6 +317,8 @@ class Silo:
             self.watchdog.stop()
         if self.load_publisher is not None:
             self.load_publisher.stop()
+        if self.cache_maintainer is not None:
+            self.cache_maintainer.stop()
         if self.tensor_engine is not None:
             await self.tensor_engine.stop(drain=graceful)
         # reminder timers must die on ANY stop — a zombie service would
@@ -375,6 +391,8 @@ class Silo:
             self.watchdog.stop()
         if self.load_publisher is not None:
             self.load_publisher.stop()
+        if self.cache_maintainer is not None:
+            self.cache_maintainer.stop()
         if self._stats_report_task is not None:
             self._stats_report_task.cancel()
             self._stats_report_task = None

@@ -283,6 +283,9 @@ class PullingAgent:
         # stream → sub ids already replayed (backfill once per sub; ids
         # pruned when the sub leaves so the set cannot grow unboundedly)
         self._backfilled: Dict[StreamId, set] = {}
+        # sink-bound streams already checked for starved pub/sub
+        # subscribers (one advisory warning per stream)
+        self._sink_checked: set = set()
 
     def start(self) -> None:
         import contextvars
@@ -316,12 +319,22 @@ class PullingAgent:
                     sink = p.tensor_sink_for(m) if m.kind == "item" else None
                     if sink is not None:
                         # stream→tensor bridge: the maximal run of events
-                        # bound to the same sink delivers as ONE slab
+                        # bound to the same sink AND carrying the same
+                        # field set delivers as ONE slab (splitting on a
+                        # field-set boundary keeps mixed-schema traffic
+                        # on the fast path — a mixed run would fail
+                        # validation and burn the whole retry schedule)
+                        def fset(msg):
+                            return frozenset(msg.item) \
+                                if isinstance(msg.item, dict) else None
                         run = [m]
+                        head_fields = fset(m)
                         while (k + len(run) < len(window_msgs)
                                and window_msgs[k + len(run)].kind == "item"
                                and p.tensor_sink_for(
-                                   window_msgs[k + len(run)]) is sink):
+                                   window_msgs[k + len(run)]) is sink
+                               and fset(window_msgs[k + len(run)])
+                               == head_fields):
                             run.append(window_msgs[k + len(run)])
                         ok = await self._deliver_slab(sink, run)
                         n = len(run)
@@ -453,6 +466,32 @@ class PullingAgent:
                 f"tensor sink {sink.type_name}.{sink.method} bound but "
                 f"silo has no tensor engine")
             return False
+        stream_id = run[0].stream_id
+        if stream_id not in self._sink_checked:
+            # a sink-bound namespace routes items EXCLUSIVELY to the
+            # engine — a regular pub/sub subscriber on the same stream
+            # would silently receive nothing, so surface that loudly
+            # once.  Checked-once even on failure: this is advisory, and
+            # re-arming would stall every slab on a doomed RPC while the
+            # rendezvous silo is unreachable.  Direct rendezvous query,
+            # NOT _consumers(): that path side-effects rewind backfill,
+            # which would double-deliver retained events to a tokened
+            # subscriber the engine already covered.
+            self._sink_checked.add(stream_id)
+            try:
+                from orleans_tpu.core.factory import factory
+                ref = factory.get_grain(IPubSubRendezvous,
+                                        stream_id.pubsub_key())
+                consumers = await self._call_in_silo(
+                    ref.consumers_detailed, stream_id)
+                if consumers:
+                    self.logger.warn(
+                        f"{len(consumers)} pub/sub subscriber(s) on "
+                        f"{stream_id} will receive NO items: the "
+                        f"namespace is tensor-sink-bound to "
+                        f"{sink.type_name}.{sink.method}", code=2916)
+            except Exception:  # noqa: BLE001 — advisory only
+                pass
         try:
             keys: List[np.ndarray] = []
             cols: Dict[str, List[np.ndarray]] = {}

@@ -180,3 +180,58 @@ def test_message_loss_injection_resend(run):
             await cluster.stop()
 
     run(main())
+
+
+def test_adaptive_cache_maintainer_refreshes_and_invalidates(run):
+    """The adaptive directory-cache maintainer (reference:
+    AdaptiveDirectoryCacheMaintainer.cs:34): hot cache lines validate
+    against the directory owner in one batched RPC per owner — a
+    still-registered entry refreshes (promote), a stale one (activation
+    gone) drops before a message pays the wrong-silo forward hop."""
+
+    async def main():
+        from orleans_tpu.core.grain import grain_id_for
+
+        cluster = await TestingCluster(n_silos=3).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            # activate grains through silo 2's client, then call them
+            # through silo 0 so silo 0 fills directory-cache lines for
+            # remotely-hosted, remotely-owned grains
+            f2 = cluster.attach_client(2)
+            f0 = cluster.attach_client(0)
+            for i in range(40):
+                await f2.get_grain(ICounterGrain, 900 + i).add(1)
+            for i in range(40):
+                await f0.get_grain(ICounterGrain, 900 + i).add(1)
+            a = cluster.silos[0]
+            cached = [g for g in list(a.grain_directory.cache._entries)]
+            assert cached, "no cache lines formed on the calling silo"
+
+            # touch the cached entries (hits feed the maintainer), then
+            # run one maintenance round: all still valid → refreshed
+            for g in cached:
+                a.grain_directory.cache.get(g)
+            m = a.cache_maintainer
+            await m.run_round()
+            assert m.refreshed >= len(cached), m.snapshot()
+            assert m.invalidated == 0
+
+            # make one entry stale: deactivate its activation (owner
+            # partition unregisters) without telling silo 0
+            victim = cached[0]
+            host = next(s for s in cluster.silos
+                        if s.catalog.directory.by_grain.get(victim))
+            act = host.catalog.directory.by_grain[victim][0]
+            host.catalog.schedule_deactivation(act)
+            await asyncio.sleep(0.3)  # deactivation + unregister settle
+
+            assert a.grain_directory.cache.get(victim) is not None
+            await m.run_round()
+            assert a.grain_directory.cache.get(victim) is None, \
+                "stale cache line survived a maintenance round"
+            assert m.invalidated >= 1
+        finally:
+            await cluster.stop()
+
+    run(main())
