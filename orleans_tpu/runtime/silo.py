@@ -461,6 +461,10 @@ class Silo:
                 and self.config.load_publish_period > 0:
             self.load_publisher.publish_period = \
                 self.config.load_publish_period
+        if self.cache_maintainer is not None \
+                and self.config.directory_cache_maintenance_period > 0:
+            self.cache_maintainer.period = \
+                self.config.directory_cache_maintenance_period
         for cb in self._config_listeners:
             try:
                 res = cb(self.config)

@@ -479,11 +479,9 @@ class PullingAgent:
             # subscriber the engine already covered.
             self._sink_checked.add(stream_id)
             try:
-                from orleans_tpu.core.factory import factory
-                ref = factory.get_grain(IPubSubRendezvous,
-                                        stream_id.pubsub_key())
                 consumers = await self._call_in_silo(
-                    ref.consumers_detailed, stream_id)
+                    self.provider._pubsub(stream_id).consumers_detailed,
+                    stream_id)
                 if consumers:
                     self.logger.warn(
                         f"{len(consumers)} pub/sub subscriber(s) on "

@@ -664,3 +664,65 @@ def test_out_of_band_repack_with_dirty_chain_replays_exactly(run):
         assert int(countc[0]) == n  # the cold key's deliveries landed
 
     run(main())
+
+
+def test_twitter_autofuses_with_inject_only_loader(run):
+    """The TwitterSentiment dispatcher-pool pattern autofuses
+    TRANSPARENTLY: the loader only calls inject() on the fixed pool with
+    per-tick (hashtag, score) slab args, and the engine compiles the
+    dispatch → hashtag fan-in → counter chain itself — totals match the
+    unfused engine exactly."""
+
+    async def main():
+        from samples.twitter_sentiment import (  # noqa: F401 — registers
+            COUNTER_KEY,
+            HashtagGrain,
+            TweetCounterGrain,
+            TweetDispatcherGrain,
+            _zipf_payloads,
+        )
+
+        n_tweets, n_tags, T = 1_000, 200, 24
+        m = n_tweets * 2
+        tag_keys, payloads = _zipf_payloads(n_tags, m, T, 1.4, 5)
+        pool = np.arange(8, dtype=np.int64)
+
+        async def drive(engine):
+            engine.arena_for("TweetDispatcherGrain").reserve(len(pool))
+            engine.arena_for("HashtagGrain").reserve(n_tags)
+            engine.arena_for("HashtagGrain").resolve_rows(tag_keys)
+            engine.arena_for("TweetCounterGrain").resolve_rows(
+                np.asarray([COUNTER_KEY], dtype=np.int64))
+            inj = engine.make_injector("TweetDispatcherGrain", "dispatch",
+                                       pool)
+            for t in range(T):
+                keys_t, scores_t = payloads[t]
+                inj.inject({"keys": keys_t.astype(np.int32),
+                            "score": scores_t})
+                await engine.drain_queues()
+            await engine.flush()
+
+        plain = TensorEngine(config=TensorEngineConfig(auto_fusion_ticks=0))
+        await drive(plain)
+        auto = TensorEngine(config=_cfg(auto_fusion_ticks=3,
+                                        auto_fusion_window=4))
+        await drive(auto)
+        assert auto.autofuser.windows_run > 0, "twitter never autofused"
+        assert auto.autofuser.windows_rolled_back == 0
+
+        a_ref = plain.arena_for("HashtagGrain")
+        a_auto = auto.arena_for("HashtagGrain")
+        rows_ref = a_ref.resolve_rows(tag_keys)
+        rows_auto = a_auto.resolve_rows(tag_keys)
+        for col in ("total", "positive", "negative", "counted",
+                    "last_score"):
+            np.testing.assert_array_equal(
+                np.asarray(a_auto.state[col])[rows_auto],
+                np.asarray(a_ref.state[col])[rows_ref],
+                err_msg=f"HashtagGrain.{col} diverged under autofuse")
+        c_ref = plain.arena_for("TweetCounterGrain").read_row(COUNTER_KEY)
+        c_auto = auto.arena_for("TweetCounterGrain").read_row(COUNTER_KEY)
+        assert int(c_ref["hashtags"]) == int(c_auto["hashtags"])
+        assert plain.messages_processed == auto.messages_processed
+
+    run(main())

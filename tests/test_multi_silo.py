@@ -190,8 +190,6 @@ def test_adaptive_cache_maintainer_refreshes_and_invalidates(run):
     gone) drops before a message pays the wrong-silo forward hop."""
 
     async def main():
-        from orleans_tpu.core.grain import grain_id_for
-
         cluster = await TestingCluster(n_silos=3).start()
         try:
             await cluster.wait_for_liveness_convergence()
