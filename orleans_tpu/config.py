@@ -76,6 +76,14 @@ class TensorEngineConfig:
     target_tick_latency: float = 0.0
     tick_interval_min: float = 0.0002
     tick_interval_max: float = 0.05
+    # the rig's completion-observation floor (measure_sync_floor):
+    # subtracted from measured tick durations before the adaptive
+    # controller compares them to the budget.  On tunneled runtimes raw
+    # durations are floored at ~100ms — above any tight budget — which
+    # would pin the interval at min and starve batch growth; the
+    # controller must steer ENGINE latency, not measurement artifact.
+    # 0 (direct-attached rigs) = strict raw comparison.
+    observation_floor: float = 0.0
     # ring buffer of recent per-tick durations backing latency percentiles
     latency_window: int = 1024
     # tensor-path activation collection (reference: ActivationCollector

@@ -745,6 +745,9 @@ class TensorEngine:
         if budget <= 0:
             return
         cfg = self.config
+        # judge the ENGINE's latency, not the rig's observation channel
+        # (config.observation_floor; 0 on direct-attached hardware)
+        tick_duration = max(tick_duration - cfg.observation_floor, 0.0)
         if tick_duration + self._adaptive_interval > budget:
             self._adaptive_interval = max(cfg.tick_interval_min,
                                           self._adaptive_interval * 0.5)

@@ -363,6 +363,7 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
     cfg.target_tick_latency = budget
     cfg.tick_interval_max = budget * 0.5
     cfg.tick_interval_min = max(1e-4, budget / 50.0)
+    cfg.observation_floor = sync_floor  # controller judges net latency
     engine._adaptive_interval = budget / 4.0
 
     game_arena = engine.arena_for("GameGrain")
@@ -443,7 +444,8 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
         _jax.block_until_ready(game_arena.state["updates"])
         done = time.perf_counter()
         # feed the controller the tick SERVICE time (the engine loop
-        # does this from run_tick; the fused path bypasses it)
+        # does this from run_tick; the fused path bypasses it) — the
+        # controller itself nets out config.observation_floor, set above
         engine._adapt(done - svc0)
         if t >= warm_ticks:
             durations.append(done - window_start)
