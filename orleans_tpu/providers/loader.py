@@ -76,24 +76,42 @@ def _builtin_factories() -> Dict[str, Dict[str, Callable[..., Any]]]:
 
     def simple_stream(config: Dict[str, Any]):
         from orleans_tpu.streams.simple import SimpleMessageStreamProvider
+        if config.get("tensor_sinks"):
+            # only queue-backed providers have pulling agents to batch
+            # events into slabs — fail loudly instead of silently
+            # dropping the binding
+            raise ValueError(
+                "tensor_sinks requires a persistent stream provider "
+                "(type 'persistent' or 'persistent_sqlite'); the "
+                "'simple' provider delivers per event")
         return SimpleMessageStreamProvider()
+
+    def _bind_sinks(provider, config: Dict[str, Any]):
+        # stream→tensor bridge from config: {"tensor_sinks": {namespace:
+        # {"interface": type, "method": m, "key_field": "key"}}} — queue
+        # batches for these namespaces inject as vector-grain slabs
+        for ns, sink in dict(config.get("tensor_sinks", {})).items():
+            provider.bind_tensor_sink(
+                ns, sink["interface"], sink["method"],
+                key_field=sink.get("key_field", "key"))
+        return provider
 
     def persistent_stream(config: Dict[str, Any]):
         from orleans_tpu.streams.persistent import (
             InMemoryQueueAdapter,
             PersistentStreamProvider,
         )
-        return PersistentStreamProvider(
+        return _bind_sinks(PersistentStreamProvider(
             InMemoryQueueAdapter(n_queues=int(config.get("queues", 4))),
-            pull_period=float(config.get("pull_period", 0.05)))
+            pull_period=float(config.get("pull_period", 0.05))), config)
 
     def persistent_sqlite_stream(config):
         from orleans_tpu.plugins.sqlite_queue import SqliteQueueAdapter
         from orleans_tpu.streams.persistent import PersistentStreamProvider
-        return PersistentStreamProvider(
+        return _bind_sinks(PersistentStreamProvider(
             SqliteQueueAdapter(path=config.get("path", ":memory:"),
                                n_queues=int(config.get("queues", 4))),
-            pull_period=float(config.get("pull_period", 0.05)))
+            pull_period=float(config.get("pull_period", 0.05))), config)
 
     streams = {
         "simple": simple_stream,

@@ -294,3 +294,54 @@ def test_host_config_file_watch(run, tmp_path):
         await asyncio.wait_for(task, timeout=10.0)
 
     run(main())
+
+
+def test_stream_provider_tensor_sinks_from_config(run, tmp_path):
+    """The stream→tensor bridge binds from the provider config block
+    (`tensor_sinks`), so a hosted silo gets slab injection with no code
+    (hosting-exe path: host JSON → loader → bind_tensor_sink)."""
+
+    async def main():
+        import asyncio
+
+        import numpy as np
+
+        import tests.test_autofuse  # noqa: F401 — registers LwwGrain
+        from orleans_tpu.streams.core import StreamId
+
+        silo = Silo(name="sink-config-silo")
+        loader = ProviderLoader()
+        loader.load(silo, [
+            {"kind": "stream", "type": "persistent_sqlite", "name": "pq",
+             "path": str(tmp_path / "sink.db"), "queues": 1,
+             "pull_period": 0.01,
+             "tensor_sinks": {
+                 "lww-events": {"interface": "LwwGrain",
+                                "method": "put", "key_field": "key"}}},
+        ])
+        provider = silo.stream_providers["pq"]
+        assert "lww-events" in provider.tensor_sinks
+
+        await silo.start()
+        try:
+            sid = StreamId(provider="pq", namespace="lww-events", key=9)
+            n = 32
+            keys = np.arange(n, dtype=np.int64)
+            await provider.produce(sid, [
+                {"key": keys, "v": np.full(n, 4, np.int32)}])
+
+            async def delivered():
+                while sum(a.delivered
+                          for a in provider.manager.agents.values()) < 1:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(delivered(), timeout=10)
+            await silo.tensor_engine.flush()
+            arena = silo.tensor_engine.arena_for("LwwGrain")
+            rows = arena.resolve_rows(keys)
+            np.testing.assert_array_equal(
+                np.asarray(arena.state["count"])[rows], 1)
+        finally:
+            await silo.stop()
+
+    run(main())
