@@ -76,42 +76,24 @@ def _builtin_factories() -> Dict[str, Dict[str, Callable[..., Any]]]:
 
     def simple_stream(config: Dict[str, Any]):
         from orleans_tpu.streams.simple import SimpleMessageStreamProvider
-        if config.get("tensor_sinks"):
-            # only queue-backed providers have pulling agents to batch
-            # events into slabs — fail loudly instead of silently
-            # dropping the binding
-            raise ValueError(
-                "tensor_sinks requires a persistent stream provider "
-                "(type 'persistent' or 'persistent_sqlite'); the "
-                "'simple' provider delivers per event")
         return SimpleMessageStreamProvider()
-
-    def _bind_sinks(provider, config: Dict[str, Any]):
-        # stream→tensor bridge from config: {"tensor_sinks": {namespace:
-        # {"interface": type, "method": m, "key_field": "key"}}} — queue
-        # batches for these namespaces inject as vector-grain slabs
-        for ns, sink in dict(config.get("tensor_sinks", {})).items():
-            provider.bind_tensor_sink(
-                ns, sink["interface"], sink["method"],
-                key_field=sink.get("key_field", "key"))
-        return provider
 
     def persistent_stream(config: Dict[str, Any]):
         from orleans_tpu.streams.persistent import (
             InMemoryQueueAdapter,
             PersistentStreamProvider,
         )
-        return _bind_sinks(PersistentStreamProvider(
+        return PersistentStreamProvider(
             InMemoryQueueAdapter(n_queues=int(config.get("queues", 4))),
-            pull_period=float(config.get("pull_period", 0.05))), config)
+            pull_period=float(config.get("pull_period", 0.05)))
 
     def persistent_sqlite_stream(config):
         from orleans_tpu.plugins.sqlite_queue import SqliteQueueAdapter
         from orleans_tpu.streams.persistent import PersistentStreamProvider
-        return _bind_sinks(PersistentStreamProvider(
+        return PersistentStreamProvider(
             SqliteQueueAdapter(path=config.get("path", ":memory:"),
                                n_queues=int(config.get("queues", 4))),
-            pull_period=float(config.get("pull_period", 0.05))), config)
+            pull_period=float(config.get("pull_period", 0.05)))
 
     streams = {
         "simple": simple_stream,
@@ -180,7 +162,27 @@ class ProviderLoader:
             cfg = raw if isinstance(raw, ProviderConfiguration) \
                 else ProviderConfiguration.from_dict(raw)
             factory = _resolve_type(cfg.kind, cfg.type, self.registry)
-            instance = factory(dict(cfg.properties))
+            props = dict(cfg.properties)
+            # the stream→tensor bridge is bound HERE, once for every
+            # stream provider type (built-in, dotted user class, or
+            # register_type factory): popped before instantiation so a
+            # user class with an explicit signature isn't handed an
+            # unexpected kwarg, bound after when the instance supports
+            # it, and a loud error otherwise — never a silent drop
+            sinks = props.pop("tensor_sinks", None) \
+                if cfg.kind == "stream" else None
+            instance = factory(props)
+            if sinks:
+                if not hasattr(instance, "bind_tensor_sink"):
+                    raise ValueError(
+                        f"stream provider {cfg.name!r} (type "
+                        f"{cfg.type!r}) does not support tensor_sinks "
+                        f"— queue-backed providers with pulling agents "
+                        f"(e.g. 'persistent', 'persistent_sqlite') do")
+                for ns, sink in dict(sinks).items():
+                    instance.bind_tensor_sink(
+                        ns, sink["interface"], sink["method"],
+                        key_field=sink.get("key_field", "key"))
             if cfg.kind == "storage":
                 silo.add_storage_provider(cfg.name, instance)
             elif cfg.kind == "stream":

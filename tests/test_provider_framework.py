@@ -322,6 +322,14 @@ def test_stream_provider_tensor_sinks_from_config(run, tmp_path):
         provider = silo.stream_providers["pq"]
         assert "lww-events" in provider.tensor_sinks
 
+        # a provider type without pulling agents rejects the binding
+        # loudly — misconfiguration must never silently drop the bridge
+        with pytest.raises(ValueError, match="tensor_sinks"):
+            ProviderLoader().load(Silo(name="bad-sink-silo"), [
+                {"kind": "stream", "type": "simple", "name": "S",
+                 "tensor_sinks": {"x": {"interface": "LwwGrain",
+                                        "method": "put"}}}])
+
         await silo.start()
         try:
             sid = StreamId(provider="pq", namespace="lww-events", key=9)
