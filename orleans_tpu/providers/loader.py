@@ -16,6 +16,7 @@ provider's config dict passed to ``init``.
 
 from __future__ import annotations
 
+import asyncio
 import importlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -174,6 +175,14 @@ class ProviderLoader:
             instance = factory(props)
             if sinks:
                 if not hasattr(instance, "bind_tensor_sink"):
+                    close = getattr(instance, "close", None)
+                    if close is not None:  # free what __init__ acquired
+                        try:
+                            res = close()
+                            if asyncio.iscoroutine(res):
+                                res.close()  # sync context: discard
+                        except Exception:  # noqa: BLE001
+                            pass
                     raise ValueError(
                         f"stream provider {cfg.name!r} (type "
                         f"{cfg.type!r}) does not support tensor_sinks "
