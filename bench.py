@@ -460,11 +460,14 @@ def main() -> None:
                            "device-synced single-tick windows",
         }
 
-    async def _guard(section) -> dict:
+    async def _guard(section, timeout: float = 600.0) -> dict:
         """Auxiliary bench sections must never cost the round its
-        headline numbers: a failure publishes as an error entry."""
+        headline numbers: a failure (or a section overrunning its time
+        box on a degraded rig) publishes as an error entry."""
         try:
-            return await section()
+            return await asyncio.wait_for(section(), timeout=timeout)
+        except asyncio.TimeoutError:
+            return {"error": f"section exceeded its {timeout:.0f}s box"}
         except Exception as exc:  # noqa: BLE001 — published, not hidden
             import traceback
             tb = traceback.extract_tb(exc.__traceback__)
