@@ -55,7 +55,11 @@ async def _tensor_presence(n_players: int, n_games: int, n_ticks: int,
     # Median of 3 short passes: tunneled-runtime throughput varies
     # several-fold between moments, and a single 4-tick sample has been
     # observed anywhere in that range
-    engine2 = TensorEngine(config=TensorEngineConfig(auto_fusion_ticks=0))
+    # tick_interval=0: the accumulation pause models producer pacing,
+    # not engine cost — a max-throughput measurement runs without it
+    # (both comparison tiers get the same setting)
+    engine2 = TensorEngine(config=TensorEngineConfig(auto_fusion_ticks=0,
+                                                     tick_interval=0.0))
     await run_presence_load(engine2, n_players=n_players, n_games=n_games,
                             n_ticks=warmup_ticks)
     unfused_runs = []
@@ -72,7 +76,7 @@ async def _tensor_presence(n_players: int, n_games: int, n_ticks: int,
     # window, so the measured segment is exactly 1 re-detection tick +
     # whole windows (re-engagement threshold is 2 for a cached program)
     # and ends on a window boundary with nothing left to replay.
-    engine3 = TensorEngine()
+    engine3 = TensorEngine(config=TensorEngineConfig(tick_interval=0.0))
     w = engine3.config.auto_fusion_window
     auto = await run_presence_load(
         engine3, n_players=n_players, n_games=n_games,
