@@ -405,8 +405,18 @@ def main() -> None:
     parser.add_argument("--mean-followers", type=float, default=25.0)
     parser.add_argument("--ticks", type=int, default=20)
     parser.add_argument("--latency-ticks", type=int, default=100)
+    parser.add_argument("--chaos-smoke", action="store_true",
+                        help="run the seeded chaos smoke plan twice "
+                             "(reproducibility proof) and write the JSON "
+                             "fault/invariant report to CHAOS_SMOKE.json "
+                             "instead of benchmarking")
     args = parser.parse_args()
     _quiet()
+
+    if args.chaos_smoke:
+        # one output path: the chaos CLI owns printing + CHAOS_SMOKE.json
+        from orleans_tpu.chaos.report import main as chaos_main
+        sys.exit(chaos_main(["--seed", "1234", "--repeat", "2"]))
 
     if args.smoke:
         args.players, args.games, args.ticks = 10_000, 100, 5

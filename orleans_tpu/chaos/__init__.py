@@ -1,0 +1,49 @@
+"""Deterministic fault-injection plane + cluster-wide invariant checkers.
+
+The robustness subsystem: a seeded ``FaultPlan`` (chaos/plan.py) drives
+an ``Interposer`` (chaos/interposer.py) that wraps the runtime's failure
+seams — transport sends, storage writes, membership CAS ops, engine slab
+injections — without forking them, while a ``ChaosCluster``
+(chaos/cluster.py) runs scripted topology faults (partition/heal/
+kill/stall) and asserts the system's documented guarantees
+(chaos/invariants.py).  Every firing is recorded in a ``FaultTrace`` and
+mirrored through telemetry, so any run is replayable from (seed, plan)
+alone.  ``python -m orleans_tpu.chaos`` runs the canonical smoke plan
+and emits a JSON fault/invariant report (chaos/report.py).
+"""
+
+from orleans_tpu.chaos.cluster import ChaosCluster
+from orleans_tpu.chaos.interposer import Interposer
+from orleans_tpu.chaos.invariants import (
+    InvariantViolation,
+    check_arena_conservation,
+    check_at_least_once,
+    check_membership_convergence,
+    check_single_activation,
+    wait_for_at_least_once,
+)
+from orleans_tpu.chaos.plan import (
+    ChaosInjectedError,
+    FaultEvent,
+    FaultPlan,
+    FaultRule,
+    FaultTrace,
+    PlanStep,
+)
+
+__all__ = [
+    "ChaosCluster",
+    "ChaosInjectedError",
+    "FaultEvent",
+    "FaultPlan",
+    "FaultRule",
+    "FaultTrace",
+    "Interposer",
+    "InvariantViolation",
+    "PlanStep",
+    "check_arena_conservation",
+    "check_at_least_once",
+    "check_membership_convergence",
+    "check_single_activation",
+    "wait_for_at_least_once",
+]

@@ -1,0 +1,8 @@
+"""``python -m orleans_tpu.chaos`` — run the seeded chaos smoke plan and
+emit a JSON fault/invariant report (see chaos/report.py)."""
+
+import sys
+
+from orleans_tpu.chaos.report import main
+
+sys.exit(main())

@@ -1,0 +1,192 @@
+"""ChaosCluster: a TestingCluster that runs a FaultPlan against itself.
+
+Extends the in-process test cluster (testing/cluster.py) with the
+interposed fault plane: on start every seam is wrapped, and
+``run_plan()`` executes the plan's scripted steps (partition → heal →
+kill → ...) in order.  Silos started or restarted mid-run are wrapped as
+they join — the same chaos applies to replacement incarnations.
+
+The invariant surface (``check_invariants``) bundles the chaos-plane
+checkers so a scenario ends with one call that either returns a report
+or raises ``InvariantViolation`` with evidence.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+from orleans_tpu.chaos.interposer import Interposer
+from orleans_tpu.chaos.plan import FaultPlan, FaultTrace
+from orleans_tpu.chaos.invariants import (
+    check_membership_convergence,
+    check_single_activation,
+)
+from orleans_tpu.testing.cluster import TestingCluster
+
+
+class ChaosCluster(TestingCluster):
+
+    __test__ = False  # not a pytest collection target
+
+    def __init__(self, plan: Optional[FaultPlan] = None,
+                 n_silos: int = 3, telemetry=None, **kw) -> None:
+        super().__init__(n_silos=n_silos, **kw)
+        self.plan = plan if plan is not None else FaultPlan(seed=0)
+        if telemetry is None:
+            from orleans_tpu.telemetry import default_manager
+            telemetry = default_manager
+        self.trace = FaultTrace(telemetry=telemetry)
+        self.interposer = Interposer(self.plan, self.trace)
+
+    # ---- lifecycle --------------------------------------------------------
+
+    async def start(self) -> "ChaosCluster":
+        await super().start()
+        self.interposer.attach_cluster(self)
+        return self
+
+    async def start_additional_silo(self, name=None):
+        silo = await super().start_additional_silo(name)
+        # replacement/extra silos get the same seams wired; the shared
+        # in-proc fabric wrap (if any) already covers their sends
+        if self.interposer._originals:  # only once attach_cluster ran
+            self.interposer.attach_silo(silo)
+        return silo
+
+    async def stop(self) -> None:
+        # un-chaos BEFORE shutdown: graceful stop (deactivation writes,
+        # goodbye gossip, drain) must not run under still-armed fault
+        # rules — the scenario is over
+        self.interposer.heal_partition()
+        self.interposer.stalled.clear()
+        self.interposer.detach()
+        await super().stop()
+
+    # ---- silo addressing for plan steps -----------------------------------
+
+    def _resolve_silo(self, ref):
+        """Plan steps name silos by NAME (stable across kills) or by
+        index into the current ``self.silos`` order."""
+        if isinstance(ref, int):
+            return self.silos[ref]
+        for s in self.silos:
+            if s.name == ref:
+                return s
+        raise KeyError(f"no silo {ref!r} in cluster "
+                       f"({[s.name for s in self.silos]})")
+
+    def _resolve_group(self, group) -> set:
+        return {self._resolve_silo(r).address for r in group}
+
+    # ---- plan execution ---------------------------------------------------
+
+    async def run_plan(self) -> FaultTrace:
+        """Execute the plan's scripted steps in ``at`` order (sleeping the
+        gaps); rule-level faults keep firing through the interposer the
+        whole time.  Returns the trace."""
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        for i, step in enumerate(sorted(self.plan.steps,
+                                        key=lambda s: s.at)):
+            delay = step.at - (loop.time() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            await self._run_step(i, step)
+        return self.trace
+
+    async def _run_step(self, index: int, step) -> None:
+        args = dict(step.args)
+        detail: Dict[str, Any] = {}
+        sig_extra: tuple = ()
+        if step.action == "partition":
+            groups = [self._resolve_group(g) for g in args["groups"]]
+            self.interposer.set_partition(groups)
+            detail["groups"] = [sorted(map(str, g)) for g in groups]
+            # signature uses silo NAMES: addresses carry a process-wide
+            # generation counter that varies across runs of the same plan
+            sig_extra = (tuple(
+                tuple(sorted(self._resolve_silo(r).name for r in g))
+                for g in args["groups"]),)
+        elif step.action == "heal":
+            self.interposer.heal_partition()
+        elif step.action == "kill":
+            silo = self._resolve_silo(args["silo"])
+            detail["silo"] = silo.name
+            sig_extra = (silo.name,)
+            self.kill_silo(silo)
+        elif step.action == "stall":
+            silo = self._resolve_silo(args["silo"])
+            duration = args["duration"]
+            detail["silo"], detail["duration"] = silo.name, duration
+            sig_extra = (silo.name, duration)
+            self.interposer.stall_silo(silo.address)
+            addr = silo.address
+            asyncio.get_running_loop().call_later(
+                duration, self.interposer.unstall_silo, addr)
+        elif step.action in ("enable", "disable"):
+            self.interposer.set_rule_enabled(args["rule"],
+                                             step.action == "enable")
+            detail["rule"] = args["rule"]
+            sig_extra = (args["rule"],)
+        elif step.action == "call":
+            await args["fn"](self)
+        else:
+            raise ValueError(f"unknown plan step action {step.action!r}")
+        self.trace.record("plan", step.action, "plan", step.action, detail,
+                          sig=("plan", index, step.action) + sig_extra)
+
+    # ---- invariants -------------------------------------------------------
+
+    def live_silos(self) -> List:
+        from orleans_tpu.chaos.invariants import _active_silos
+        return _active_silos(self)
+
+    async def quiesce_engines(self, rounds: int = 300,
+                              poll: float = 0.01) -> None:
+        """Chaos-aware override: only ACTIVE silos' engines count — a
+        killed silo's engine is not part of the data plane anymore, and
+        waiting on its handoff fence would wedge the quiesce."""
+        last, stable = -1, 0
+        for _ in range(rounds):
+            live = self.live_silos()
+            for silo in live:
+                if silo.tensor_engine is not None:
+                    await silo.tensor_engine.flush()
+            await asyncio.sleep(poll)
+            total = sum(s.tensor_engine.messages_processed
+                        for s in live if s.tensor_engine is not None)
+            if total == last:
+                stable += 1
+                if stable >= 3:
+                    return
+            else:
+                stable = 0
+            last = total
+        raise TimeoutError("tensor data plane did not quiesce")
+
+    async def wait_for_liveness_convergence(self, timeout: float = 10.0
+                                            ) -> None:
+        """Chaos-aware override: silos the FAULTS killed (hard-kill step,
+        or a partitioned minority that saw its own DEAD row and stopped)
+        are expected to be declared dead, not to converge."""
+        await check_membership_convergence(self, timeout=timeout)
+
+    async def check_invariants(self, timeout: float = 10.0
+                               ) -> Dict[str, Any]:
+        """The always-applicable pair: membership convergence +
+        single-activation.  Arena conservation and stream at-least-once
+        need scenario knowledge (expected keys / produced events) — call
+        those checkers directly with it."""
+        report = {"membership_convergence":
+                  await check_membership_convergence(self, timeout=timeout)}
+        report["single_activation"] = check_single_activation(self)
+        return report
+
+    def chaos_snapshot(self) -> Dict[str, Any]:
+        return {
+            "plan": self.plan.describe(),
+            "trace_len": len(self.trace),
+            "signature": [list(s) for s in self.trace.signature()],
+            "interposer": self.interposer.snapshot(),
+        }

@@ -1,0 +1,354 @@
+"""Interposer: wraps the runtime's failure seams with a FaultPlan.
+
+Wrapping, not forking: the live objects keep their classes and state;
+their seam *methods* are replaced on the instance with chaos-aware
+wrappers that consult the plan's rules and fall through to the original
+bound method.  ``detach()`` restores every original, so a cluster can be
+un-chaosed mid-test.
+
+Seams (the ones the tentpole names):
+
+* transport — ``InProcTransport.send`` (fabric-wide) / ``TcpTransport.send``
+  (per silo): drop, delay, duplicate, reorder; plus scripted partitions and
+  per-silo network stalls (both sides of the cut dropped).
+* storage   — ``StorageProvider.write_state`` on every registered provider:
+  fail (raises ChaosInjectedError) or slow.
+* membership — ``InMemoryMembershipTable.update_row``: injected
+  CasConflictError (the table's own conflict type, so the oracle's CAS
+  retry discipline is what gets exercised).
+* engine    — ``TensorEngine.send_batch``: corrupt a seeded fraction of
+  slab rows with NaN (float columns) or near-overflow values (int
+  columns) before they enter the tick pipeline.
+
+First matching rule wins per event — order rules accordingly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from orleans_tpu.chaos.plan import (
+    ChaosInjectedError,
+    FaultPlan,
+    FaultTrace,
+    _RuleState,
+)
+
+
+class Interposer:
+
+    def __init__(self, plan: FaultPlan, trace: Optional[FaultTrace] = None,
+                 telemetry=None) -> None:
+        self.plan = plan
+        self.trace = trace if trace is not None \
+            else FaultTrace(telemetry=telemetry)
+        self._states: Dict[str, _RuleState] = {
+            r.name: _RuleState(r, plan.seed) for r in plan.rules}
+        self._originals: List[Tuple[Any, str, Any]] = []
+        self._wrapped: set = set()
+        # scripted topology faults
+        self.partition_groups: Optional[List[set]] = None
+        self.stalled: set = set()
+        # one-slot park buffer for the reorder action
+        self._parked: List[Tuple[Any, tuple]] = []
+        self.counters: Dict[str, int] = {
+            "transport_seen": 0, "transport_dropped": 0,
+            "transport_delayed": 0, "transport_duplicated": 0,
+            "transport_reordered": 0, "partition_dropped": 0,
+            "stall_dropped": 0,
+            "storage_seen": 0, "storage_failed": 0, "storage_slowed": 0,
+            "membership_seen": 0, "membership_conflicted": 0,
+            "engine_seen": 0, "engine_corrupted": 0,
+        }
+
+    # ---- rule machinery ---------------------------------------------------
+
+    def rule_state(self, name: str) -> _RuleState:
+        return self._states[name]
+
+    def set_rule_enabled(self, name: str, enabled: bool) -> None:
+        self._states[name].enabled = enabled
+
+    def _decide(self, seam: str, ctx: Any):
+        """First firing rule wins: returns (rule, match_index) or None."""
+        for state in self._states.values():
+            if state.rule.seam != seam:
+                continue
+            idx = state.decide(ctx)
+            if idx is not None:
+                return state.rule, idx
+        return None
+
+    def _record_rule(self, rule, idx: int, detail: Dict[str, Any]) -> None:
+        self.trace.record(
+            "rule", rule.name, rule.seam, rule.action, detail,
+            sig=(("rule", rule.name, rule.action, idx)
+                 if rule.pinned else None))
+
+    # ---- scripted topology -----------------------------------------------
+
+    def set_partition(self, groups: List[set]) -> None:
+        self.partition_groups = [set(g) for g in groups]
+
+    def heal_partition(self) -> None:
+        self.partition_groups = None
+
+    def stall_silo(self, address) -> None:
+        self.stalled.add(address)
+
+    def unstall_silo(self, address) -> None:
+        self.stalled.discard(address)
+
+    def _cut(self, sender, target) -> Optional[str]:
+        """Is the (sender → target) edge severed by a partition/stall?"""
+        if sender in self.stalled or target in self.stalled:
+            return "stall"
+        if self.partition_groups is not None:
+            for group in self.partition_groups:
+                if sender in group:
+                    return None if target in group else "partition"
+            # sender in no group (e.g. a silo started mid-partition):
+            # isolate it from every grouped silo
+            for group in self.partition_groups:
+                if target in group:
+                    return "partition"
+        return None
+
+    # ---- attach / detach --------------------------------------------------
+
+    def _wrap(self, obj: Any, attr: str, wrapper) -> None:
+        key = (id(obj), attr)
+        if key in self._wrapped:
+            return
+        self._wrapped.add(key)
+        original = getattr(obj, attr)
+        self._originals.append((obj, attr, original))
+        setattr(obj, attr, wrapper(original))
+
+    def detach(self) -> None:
+        """Restore every wrapped seam."""
+        for obj, attr, original in reversed(self._originals):
+            setattr(obj, attr, original)
+        self._originals.clear()
+        self._wrapped.clear()
+
+    def attach_cluster(self, cluster) -> None:
+        """Wire every seam of a TestingCluster-shaped object."""
+        from orleans_tpu.runtime.transport import InProcTransport
+        if isinstance(cluster.fabric, InProcTransport):
+            self.attach_inproc_fabric(cluster.fabric)
+        self.attach_membership_table(cluster.table)
+        for silo in cluster.silos:
+            self.attach_silo(silo)
+
+    def attach_silo(self, silo) -> None:
+        """Per-silo seams (storage, engine, tcp transport).  Idempotent —
+        call again for silos started mid-run."""
+        for name, provider in silo.storage_providers.items():
+            self.attach_storage(provider, name)
+        if silo.tensor_engine is not None:
+            self.attach_engine(silo.tensor_engine)
+        transport = getattr(silo, "_bound_transport", None)
+        inner = getattr(transport, "transport", None)
+        if inner is not None and hasattr(inner, "send"):  # TcpBoundTransport
+            self.attach_tcp_transport(inner)
+
+    # ---- transport seam ---------------------------------------------------
+
+    def attach_inproc_fabric(self, fabric) -> None:
+        self._wrap(fabric, "send", lambda original:
+                   lambda sender, msg, _o=original:
+                   self._transport_send(_o, sender, msg))
+
+    def attach_tcp_transport(self, transport) -> None:
+        sender = transport.silo.address
+        self._wrap(transport, "send", lambda original:
+                   lambda msg, _o=original, _s=sender:
+                   self._transport_send(_o, _s, msg, tcp=True))
+
+    def _transport_send(self, original, sender, msg, tcp: bool = False):
+        self.counters["transport_seen"] += 1
+
+        def forward(m):
+            # re-checked at CALL time, not decision time: a delayed or
+            # reorder-parked message fires from a timer, and a partition
+            # or stall imposed in the meantime must sever it too
+            cut_now = self._cut(sender, m.target_silo)
+            if cut_now is not None:
+                self.counters[f"{cut_now}_dropped"] += 1
+                return None
+            return original(m) if tcp else original(sender, m)
+
+        cut = self._cut(sender, msg.target_silo)
+        if cut is not None:
+            self.counters[f"{cut}_dropped"] += 1
+            return None
+        hit = self._decide("transport", msg)
+        if hit is None:
+            if self._parked:
+                # a reorder previously parked a message: let this one pass
+                # first, then flush the parked one behind it
+                parked, self._parked = self._parked, []
+                forward(msg)
+                for fwd, m in parked:
+                    fwd(m)
+                return None
+            return forward(msg)
+        rule, idx = hit
+        detail = {"target": msg.target_silo,
+                  "method": getattr(msg, "method_name", None)}
+        self._record_rule(rule, idx, detail)
+        if rule.action == "drop":
+            self.counters["transport_dropped"] += 1
+            return None
+        if rule.action == "delay":
+            self.counters["transport_delayed"] += 1
+            asyncio.get_running_loop().call_later(rule.delay, forward, msg)
+            return None
+        if rule.action == "duplicate":
+            self.counters["transport_duplicated"] += 1
+            forward(msg)
+            return forward(msg)
+        # reorder: park this message; it flushes behind the next passing
+        # message (or after rule.delay, whichever comes first — the timer
+        # guarantees a lone parked message still arrives)
+        self.counters["transport_reordered"] += 1
+        entry = (forward, msg)
+        self._parked.append(entry)
+
+        def flush_fallback() -> None:
+            if entry in self._parked:
+                self._parked.remove(entry)
+                forward(msg)
+
+        asyncio.get_running_loop().call_later(rule.delay, flush_fallback)
+        return None
+
+    # ---- storage seam -----------------------------------------------------
+
+    def attach_storage(self, provider, name: str = "?") -> None:
+        self._wrap(provider, "write_state", lambda original:
+                   lambda grain_type, grain_id, state, _o=original, _n=name:
+                   self._storage_write(_o, _n, grain_type, grain_id, state))
+
+    async def _storage_write(self, original, provider_name: str,
+                             grain_type: str, grain_id, state):
+        self.counters["storage_seen"] += 1
+        hit = self._decide("storage", (provider_name, grain_type, grain_id))
+        if hit is None:
+            return await original(grain_type, grain_id, state)
+        rule, idx = hit
+        self._record_rule(rule, idx, {"provider": provider_name,
+                                      "grain_type": grain_type,
+                                      "grain_id": grain_id})
+        if rule.action == "fail":
+            self.counters["storage_failed"] += 1
+            raise ChaosInjectedError(
+                f"chaos[{rule.name}]: injected storage write failure for "
+                f"{grain_type}/{grain_id}")
+        self.counters["storage_slowed"] += 1
+        await asyncio.sleep(rule.delay)
+        return await original(grain_type, grain_id, state)
+
+    # ---- membership seam --------------------------------------------------
+
+    def attach_membership_table(self, table) -> None:
+        self._wrap(table, "update_row", lambda original:
+                   lambda entry, etag, table_version, _o=original:
+                   self._membership_update(_o, entry, etag, table_version))
+
+    async def _membership_update(self, original, entry, etag, table_version):
+        from orleans_tpu.runtime.membership import CasConflictError
+        self.counters["membership_seen"] += 1
+        hit = self._decide("membership", entry)
+        if hit is None:
+            return await original(entry, etag, table_version)
+        rule, idx = hit
+        self._record_rule(rule, idx, {"silo": entry.silo,
+                                      "status": entry.status.value})
+        self.counters["membership_conflicted"] += 1
+        raise CasConflictError(
+            f"chaos[{rule.name}]: injected CAS conflict on {entry.silo}")
+
+    # ---- engine seam -------------------------------------------------------
+
+    def attach_engine(self, engine) -> None:
+        self._wrap(engine, "send_batch", lambda original:
+                   lambda interface, method, keys, args, want_results=False,
+                   _o=original:
+                   self._engine_send(_o, interface, method, keys, args,
+                                     want_results))
+
+    def _engine_send(self, original, interface, method, keys, args,
+                     want_results: bool):
+        self.counters["engine_seen"] += 1
+        type_name = interface if isinstance(interface, str) \
+            else interface.__name__
+        hit = self._decide("engine", (type_name, method))
+        if hit is not None:
+            rule, idx = hit
+            corrupted, n_rows = self._corrupt(rule, keys, args)
+            detail = {"type": type_name, "method": method,
+                      "corrupted_rows": n_rows}
+            if n_rows:
+                self.counters["engine_corrupted"] += 1
+                args = corrupted
+            else:
+                # honest evidence for replay: the rule fired but the slab
+                # had no eligible columns — no data was actually poisoned
+                detail["note"] = "no eligible columns"
+            self._record_rule(rule, idx, detail)
+        return original(interface, method, keys, args,
+                        want_results=want_results)
+
+    def _corrupt(self, rule, keys, args) -> Tuple[Any, int]:
+        """Copy-and-corrupt a seeded fraction of slab rows: NaN into float
+        columns (corrupt_nan) or near-max values into integer columns
+        (corrupt_overflow).  The caller's arrays are never mutated.
+        Returns (corrupted_args, rows_actually_poisoned) — 0 when no
+        column was eligible, so the trace can stay honest.  The row draw
+        happens unconditionally to keep the rule's RNG stream aligned
+        with its matched-event sequence."""
+        import jax
+
+        n = len(keys)
+        if n == 0:
+            return args, 0
+        state = self._states[rule.name]
+        k = max(1, int(n * rule.corrupt_fraction))
+        rows = np.asarray(sorted(state.rng.sample(range(n), min(k, n))))
+        touched = {"any": False}
+
+        def poison(leaf):
+            a = np.array(leaf)  # host copy (also detaches device arrays)
+            if a.ndim == 0 or a.shape[0] != n:
+                return leaf  # scalar / non-row-aligned column: leave it
+            if rule.action == "corrupt_nan" \
+                    and np.issubdtype(a.dtype, np.floating):
+                a[rows] = np.nan
+                touched["any"] = True
+                return a
+            if rule.action == "corrupt_overflow" \
+                    and np.issubdtype(a.dtype, np.integer):
+                a[rows] = np.iinfo(a.dtype).max - 1
+                touched["any"] = True
+                return a
+            return leaf
+
+        out = jax.tree_util.tree_map(poison, args)
+        return out, (len(rows) if touched["any"] else 0)
+
+    # ---- reporting ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "counters": dict(self.counters),
+            "rules": {name: {"matched": s.matched, "fired": s.fired,
+                             "enabled": s.enabled}
+                      for name, s in self._states.items()},
+            "partitioned": self.partition_groups is not None,
+            "stalled": [str(s) for s in self.stalled],
+        }

@@ -1,0 +1,166 @@
+"""Cluster-wide invariant checkers: the guarantees a chaos run asserts.
+
+Each checker returns a small report dict on success and raises
+``InvariantViolation`` (an AssertionError, so pytest renders it natively)
+with full evidence on failure.  The four documented guarantees:
+
+1. **Membership converges** after partitions heal / kills are detected —
+   every still-ACTIVE silo's view equals exactly the ACTIVE set
+   (reference: table-based MembershipOracle convergence).
+2. **No grain is doubly activated** — a host grain id has at most one
+   activation cluster-wide, and a vector-grain key is live in at most one
+   silo's arena (reference: the directory registration race,
+   Catalog.cs:533-563).
+3. **Arena population is conserved** across handoff — after the data
+   plane quiesces, the union of live arena keys over the cluster is
+   exactly the expected key set, with no key resident twice.
+4. **Stream delivery stays within the at-least-once window** — every
+   produced event is delivered at least once; duplicates are legal and
+   reported, silent loss is a violation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Optional
+
+
+class InvariantViolation(AssertionError):
+    """A documented cluster guarantee observed broken."""
+
+
+def _active_silos(cluster) -> List:
+    from orleans_tpu.runtime.silo import SiloStatus
+    return [s for s in cluster.silos if s.status == SiloStatus.ACTIVE]
+
+
+async def check_membership_convergence(cluster,
+                                       timeout: float = 10.0
+                                       ) -> Dict[str, Any]:
+    """Every ACTIVE silo's membership view must equal exactly the ACTIVE
+    set — killed/self-killed silos DECLARED dead by every survivor.
+    Unlike TestingCluster.wait_for_liveness_convergence this tolerates
+    silos that died *as a consequence of the faults* (a partitioned
+    minority voted dead kills itself on seeing its own DEAD row); they
+    simply stop counting as expected members."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    while True:
+        active = _active_silos(cluster)
+        expected = frozenset(s.address for s in active)
+        views = {s.name: frozenset(s.active_silos()) for s in active}
+        if active and all(v == expected for v in views.values()):
+            return {"ok": True, "active": len(active),
+                    "waited_s": round(time.monotonic() - t0, 3)}
+        if time.monotonic() > deadline:
+            raise InvariantViolation(
+                f"membership did not converge within {timeout}s: "
+                f"expected {sorted(map(str, expected))}, views "
+                f"{ {n: sorted(map(str, v)) for n, v in views.items()} }")
+        await asyncio.sleep(0.05)
+
+
+def check_single_activation(cluster) -> Dict[str, Any]:
+    """No host grain activated on two ACTIVE silos; no vector-grain key
+    live in two arenas of the same type."""
+    hosts: Dict[Any, List[str]] = defaultdict(list)
+    n_host = 0
+    for silo in _active_silos(cluster):
+        for gid, acts in silo.catalog.directory.by_grain.items():
+            # one entry PER activation: two activations of one grain on
+            # the SAME silo are just as much a violation as cross-silo
+            hosts[gid].extend([silo.name] * len(acts))
+            n_host += len(acts)
+    doubled = {str(g): names for g, names in hosts.items()
+               if len(names) > 1}
+    arena_keys: Dict[tuple, List[str]] = defaultdict(list)
+    n_rows = 0
+    for silo in _active_silos(cluster):
+        if silo.tensor_engine is None:
+            continue
+        for type_name, arena in silo.tensor_engine.arenas.items():
+            for k in arena.keys():
+                arena_keys[(type_name, int(k))].append(silo.name)
+                n_rows += 1
+    doubled_rows = {f"{t}:{k}": names
+                    for (t, k), names in arena_keys.items()
+                    if len(names) > 1}
+    if doubled or doubled_rows:
+        raise InvariantViolation(
+            f"double activation: host grains {doubled}, "
+            f"arena keys {doubled_rows}")
+    return {"ok": True, "host_activations": n_host, "arena_rows": n_rows}
+
+
+async def check_arena_conservation(cluster, type_name: str,
+                                   expected_keys: Iterable[int],
+                                   quiesce: bool = True) -> Dict[str, Any]:
+    """After the data plane quiesces, the union of live arena keys for
+    ``type_name`` across ACTIVE silos equals the expected set exactly —
+    no key lost in handoff, none resident twice."""
+    if quiesce:
+        await cluster.quiesce_engines()
+    expected = {int(k) for k in expected_keys}
+    seen: Dict[int, List[str]] = defaultdict(list)
+    for silo in _active_silos(cluster):
+        if silo.tensor_engine is None:
+            continue
+        arena = silo.tensor_engine.arenas.get(type_name)
+        if arena is None:
+            continue
+        for k in arena.keys():
+            seen[int(k)].append(silo.name)
+    missing = sorted(expected - set(seen))
+    extra = sorted(set(seen) - expected)
+    doubled = {k: names for k, names in seen.items() if len(names) > 1}
+    if missing or extra or doubled:
+        raise InvariantViolation(
+            f"arena population not conserved for {type_name!r}: "
+            f"missing={missing[:20]} ({len(missing)} total), "
+            f"extra={extra[:20]} ({len(extra)} total), doubled={doubled}")
+    return {"ok": True, "type": type_name, "population": len(seen)}
+
+
+def check_at_least_once(produced: Iterable, delivered: Iterable,
+                        allowed_missing: int = 0) -> Dict[str, Any]:
+    """Set/multiset form of the at-least-once contract: every produced
+    token appears among the delivered ones (≥ once); duplicates are legal
+    and counted.  ``allowed_missing`` admits the DOCUMENTED loss window
+    (poison-capped events a scenario knowingly produced)."""
+    produced = list(produced)
+    delivered = list(delivered)
+    counts: Dict[Any, int] = defaultdict(int)
+    for d in delivered:
+        counts[d] += 1
+    missing = [p for p in produced if counts.get(p, 0) == 0]
+    duplicates = sum(c - 1 for c in counts.values() if c > 1)
+    if len(missing) > allowed_missing:
+        raise InvariantViolation(
+            f"at-least-once violated: {len(missing)} of {len(produced)} "
+            f"produced events never delivered (allowed "
+            f"{allowed_missing}): {missing[:20]}")
+    return {"ok": True, "produced": len(produced),
+            "delivered": len(delivered), "duplicates": duplicates,
+            "missing": len(missing)}
+
+
+async def wait_for_at_least_once(produced: Iterable,
+                                 delivered_fn,
+                                 timeout: float = 15.0,
+                                 allowed_missing: int = 0
+                                 ) -> Dict[str, Any]:
+    """Poll ``delivered_fn()`` until the at-least-once contract holds (the
+    retry/backoff window legitimately takes time after faults) or the
+    window closes — the window IS the documented bound being checked."""
+    produced = list(produced)
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return check_at_least_once(produced, delivered_fn(),
+                                       allowed_missing=allowed_missing)
+        except InvariantViolation:
+            if time.monotonic() > deadline:
+                raise
+            await asyncio.sleep(0.05)

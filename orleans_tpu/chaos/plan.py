@@ -1,0 +1,255 @@
+"""FaultPlan DSL: seeded, schedulable fault injection.
+
+A plan is (seed, rules, steps):
+
+* **Rules** fire per event crossing an interposed seam — transport sends,
+  storage writes, membership CAS ops, engine slab injections
+  (chaos/interposer.py wraps the live objects; nothing is forked).  Every
+  probabilistic decision draws from a per-rule ``random.Random`` stream
+  derived from ``(plan.seed, rule.name)``, so the decision SEQUENCE for a
+  rule is a pure function of the seed and the order of matched events —
+  re-running a plan against the same event stream reproduces the same
+  faults (reference analog: MessageLossInjectionRate in the reference's
+  Dispatcher, generalized to a whole fault plane).
+
+* **Steps** are scripted cluster-level actions executed in order by
+  ``ChaosCluster.run_plan`` — partition the fabric, heal it, hard-kill or
+  network-stall a silo, enable/disable rules mid-run.  Steps are
+  deterministic by construction (no RNG, fixed order).
+
+Every firing is recorded in a ``FaultTrace`` and mirrored through
+``TelemetryManager.track_event("chaos.fault", ...)`` so a failed run is
+replayable from (seed, plan) alone.  ``FaultTrace.signature()`` is the
+deterministic projection used to assert reproducibility: plan steps always
+contribute; rule firings contribute when the rule is *pinned*
+(probability 1 and a finite ``count``) — an unpinned rule's firing count
+legitimately varies with timing-dependent traffic (membership probes),
+so those events are reported but excluded from the identity check.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: seam name → actions the interposer implements for it
+SEAM_ACTIONS: Dict[str, Tuple[str, ...]] = {
+    "transport": ("drop", "delay", "duplicate", "reorder"),
+    "storage": ("fail", "slow"),
+    "membership": ("cas_conflict",),
+    "engine": ("corrupt_nan", "corrupt_overflow"),
+}
+
+
+class ChaosInjectedError(RuntimeError):
+    """Raised by fault actions that fail an operation (storage ``fail``);
+    distinguishable from organic failures in logs and tests."""
+
+
+@dataclass
+class FaultRule:
+    """One seam-level fault rule.
+
+    ``match`` receives the seam context (transport: the Message; storage:
+    ``(provider_name, grain_type, grain_id)``; membership: the
+    MembershipEntry being written; engine: ``(type_name, method)``) and
+    gates which events the rule considers at all.  ``after``/``count``
+    index into the rule's *matched* event sequence: skip the first
+    ``after`` matches, then fire on up to ``count`` of the rest (None =
+    unbounded).  ``probability`` < 1 draws from the rule's seeded stream
+    per matched event."""
+
+    name: str
+    seam: str
+    action: str
+    probability: float = 1.0
+    match: Optional[Callable[[Any], bool]] = None
+    after: int = 0
+    count: Optional[int] = None
+    delay: float = 0.05          # delay/slow actions; reorder fallback flush
+    corrupt_fraction: float = 0.25  # engine corruption: fraction of rows
+    enabled: bool = True
+
+    def __post_init__(self) -> None:
+        actions = SEAM_ACTIONS.get(self.seam)
+        if actions is None:
+            raise ValueError(f"unknown seam {self.seam!r} "
+                             f"(one of {sorted(SEAM_ACTIONS)})")
+        if self.action not in actions:
+            raise ValueError(f"seam {self.seam!r} has no action "
+                             f"{self.action!r} (one of {actions})")
+
+    @property
+    def pinned(self) -> bool:
+        """True when the rule's firing sequence is deterministic given a
+        sufficient matched-event stream — these firings join the trace
+        signature."""
+        return self.probability >= 1.0 and self.count is not None
+
+
+@dataclass
+class PlanStep:
+    """One scripted cluster action at ``at`` seconds from run_plan start.
+
+    Actions (executed by ChaosCluster): ``partition`` (groups= lists of
+    silo names/indices), ``heal``, ``kill`` (silo=), ``stall`` (silo=,
+    duration= network blackhole), ``enable``/``disable`` (rule=),
+    ``call`` (fn= awaited with the cluster — an escape hatch for
+    scenario-specific work placed deterministically between faults)."""
+
+    at: float
+    action: str
+    args: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class FaultEvent:
+    seq: int
+    source: str           # "plan" | "rule"
+    name: str             # step action or rule name
+    seam: str             # "plan" for steps
+    action: str
+    detail: Dict[str, Any] = field(default_factory=dict)
+    #: deterministic projection for signature(); None = excluded
+    sig: Optional[Tuple] = None
+
+
+class FaultTrace:
+    """Ordered record of every fault firing in one run."""
+
+    def __init__(self, telemetry=None) -> None:
+        self.events: List[FaultEvent] = []
+        self.telemetry = telemetry
+
+    def record(self, source: str, name: str, seam: str, action: str,
+               detail: Optional[Dict[str, Any]] = None,
+               sig: Optional[Tuple] = None) -> FaultEvent:
+        ev = FaultEvent(seq=len(self.events), source=source, name=name,
+                        seam=seam, action=action, detail=detail or {},
+                        sig=sig)
+        self.events.append(ev)
+        if self.telemetry is not None:
+            self.telemetry.track_event(
+                "chaos.fault",
+                properties={"source": source, "name": name, "seam": seam,
+                            "action": action,
+                            **{k: str(v) for k, v in ev.detail.items()}})
+        return ev
+
+    def signature(self) -> Tuple[Tuple, ...]:
+        """The deterministic projection: identical across runs of the same
+        (seed, plan) against an equivalent workload.  Canonically SORTED
+        (by repr — entries are heterogeneous tuples): each source's own
+        firings stay ordered by their embedded index, while the
+        INTERLEAVING of independent sources (a timer-driven membership
+        write vs a plan step) is exactly the timing-dependent part that
+        must not decide signature equality."""
+        return tuple(sorted((ev.sig for ev in self.events
+                             if ev.sig is not None), key=repr))
+
+    def to_list(self) -> List[Dict[str, Any]]:
+        return [{"seq": ev.seq, "source": ev.source, "name": ev.name,
+                 "seam": ev.seam, "action": ev.action,
+                 "detail": {k: str(v) for k, v in ev.detail.items()}}
+                for ev in self.events]
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+
+class _RuleState:
+    """Per-run mutable state of one rule: its seeded decision stream and
+    matched/fired counters (the plan object itself stays immutable-ish so
+    one plan can drive many runs)."""
+
+    def __init__(self, rule: FaultRule, seed: int) -> None:
+        self.rule = rule
+        self.rng = random.Random(f"{seed}/{rule.name}")
+        self.matched = 0
+        self.fired = 0
+        self.enabled = rule.enabled
+
+    def decide(self, ctx: Any) -> Optional[int]:
+        """Consider one seam event; returns the match index when the rule
+        fires, else None.  The RNG draw happens for EVERY matched event
+        (fired or not) so the stream stays aligned with the matched-event
+        sequence regardless of after/count gating."""
+        rule = self.rule
+        if not self.enabled:
+            return None
+        if rule.match is not None and not rule.match(ctx):
+            return None
+        idx = self.matched
+        self.matched += 1
+        hit = True
+        if rule.probability < 1.0:
+            hit = self.rng.random() < rule.probability
+        if not hit or idx < rule.after:
+            return None
+        if rule.count is not None and self.fired >= rule.count:
+            return None
+        self.fired = self.fired + 1
+        return idx
+
+
+class FaultPlan:
+    """A seeded fault schedule: build with the fluent helpers, hand to a
+    ChaosCluster (or an Interposer directly)."""
+
+    def __init__(self, seed: int = 0,
+                 rules: Optional[List[FaultRule]] = None,
+                 steps: Optional[List[PlanStep]] = None) -> None:
+        self.seed = seed
+        self.rules: List[FaultRule] = list(rules or [])
+        self.steps: List[PlanStep] = list(steps or [])
+
+    # ---- fluent builders -------------------------------------------------
+
+    def rule(self, name: str, seam: str, action: str, **kw) -> "FaultPlan":
+        if any(r.name == name for r in self.rules):
+            raise ValueError(f"duplicate rule name {name!r}")
+        self.rules.append(FaultRule(name=name, seam=seam, action=action,
+                                    **kw))
+        return self
+
+    def step(self, at: float, action: str, **args) -> "FaultPlan":
+        self.steps.append(PlanStep(at=at, action=action, args=args))
+        return self
+
+    def partition(self, at: float, groups) -> "FaultPlan":
+        return self.step(at, "partition", groups=groups)
+
+    def heal(self, at: float) -> "FaultPlan":
+        return self.step(at, "heal")
+
+    def kill(self, at: float, silo) -> "FaultPlan":
+        return self.step(at, "kill", silo=silo)
+
+    def stall(self, at: float, silo, duration: float) -> "FaultPlan":
+        return self.step(at, "stall", silo=silo, duration=duration)
+
+    def enable(self, at: float, rule: str) -> "FaultPlan":
+        return self.step(at, "enable", rule=rule)
+
+    def disable(self, at: float, rule: str) -> "FaultPlan":
+        return self.step(at, "disable", rule=rule)
+
+    def call(self, at: float, fn) -> "FaultPlan":
+        return self.step(at, "call", fn=fn)
+
+    # ---- description (for the JSON report) -------------------------------
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "rules": [{
+                "name": r.name, "seam": r.seam, "action": r.action,
+                "probability": r.probability, "after": r.after,
+                "count": r.count, "pinned": r.pinned,
+            } for r in self.rules],
+            "steps": [{"at": s.at, "action": s.action,
+                       "args": {k: v for k, v in s.args.items()
+                                if k != "fn"}}
+                      for s in sorted(self.steps, key=lambda s: s.at)],
+        }

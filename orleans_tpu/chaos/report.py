@@ -1,0 +1,301 @@
+"""Chaos smoke runner: one seeded plan → one JSON fault/invariant report.
+
+``python -m orleans_tpu.chaos [--seed N] [--out PATH] [--repeat N]`` (or
+``bench.py --chaos-smoke``) runs the canonical short scenario on a
+3-silo ChaosCluster — storage flakes + injected CAS conflicts + one
+NaN-poisoned slab under live traffic, then partition → heal → hard-kill
+— checks all four invariants, and emits a JSON report alongside the
+BENCH_*.json artifacts.  The report carries the (seed, plan) pair and
+the deterministic trace signature, so a failing run is replayable
+exactly; ``--repeat 2`` re-runs the plan and asserts the signatures are
+identical (the reproducibility proof from the acceptance criteria).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict, List
+
+from orleans_tpu import Grain, StatefulGrain, grain_interface
+from orleans_tpu.core.grain import grain_class
+from orleans_tpu.streams.core import implicit_stream_subscription
+
+#: process-wide delivery registry for the smoke's stream consumers —
+#: survives consumer re-activation after a kill (what the at-least-once
+#: checker reads)
+DELIVERED: Dict[int, List[Any]] = {}
+
+
+@grain_interface
+class IChaosKv:
+    async def put(self, v) -> None: ...
+    async def save(self) -> None: ...
+    async def get(self): ...
+
+
+@grain_class(storage_provider="Default",
+             initial_state=lambda: {"v": None})
+class ChaosKvGrain(StatefulGrain, IChaosKv):
+    """Host-grain traffic source: exercises RPC + the storage write seam."""
+
+    async def put(self, v) -> None:
+        self.state["v"] = v
+
+    async def save(self) -> None:
+        await self.write_state()
+
+    async def get(self):
+        return self.state["v"]
+
+
+@grain_interface
+class IChaosStreamEater:
+    async def seen(self) -> list: ...
+
+
+@implicit_stream_subscription("chaos-events")
+@grain_class
+class ChaosStreamEater(Grain, IChaosStreamEater):
+    """Implicit subscriber on the smoke's stream namespace: implicit
+    subscriptions survive re-activation on another silo after a kill, so
+    delivery keeps flowing without a re-join step."""
+
+    async def on_stream_item(self, stream_id, item, seq) -> None:
+        DELIVERED.setdefault(int(stream_id.key), []).append(item)
+
+    async def seen(self) -> list:
+        return list(DELIVERED.get(int(self.grain_id.primary_key_int), []))
+
+
+def define_chaos_counter() -> None:
+    """Register the smoke's vector grain (lazy: keeps jax out of --help).
+    Idempotent across runs in one process."""
+    import jax.numpy as jnp
+
+    from orleans_tpu.tensor import Batch, VectorGrain, field, seg_sum
+    from orleans_tpu.tensor.vector_grain import (
+        batched_method,
+        vector_grain,
+        vector_type,
+    )
+
+    if vector_type("ChaosCounter") is not None:
+        return
+
+    @vector_grain
+    class ChaosCounter(VectorGrain):
+        total = field(jnp.float32, 0.0)
+        count = field(jnp.int32, 0)
+
+        @batched_method
+        @staticmethod
+        def poke(state, batch: Batch, n_rows: int):
+            live = (batch.rows >= 0)
+            return {
+                **state,
+                "total": state["total"] + seg_sum(batch.args["v"],
+                                                  batch.rows, n_rows),
+                "count": state["count"] + seg_sum(
+                    live.astype(jnp.int32), batch.rows, n_rows),
+            }, None, ()
+
+
+def smoke_plan(seed: int):
+    """The canonical smoke scenario: finite pinned fault rules (fully
+    deterministic trace signature), then partition → heal → hard-kill."""
+    from orleans_tpu.chaos.plan import FaultPlan
+
+    plan = FaultPlan(seed=seed)
+    # storage flake: fail the first 2 writes through Default, then recover
+    plan.rule("storage-flake", "storage", "fail", count=2,
+              match=lambda ctx: ctx[0] == "Default")
+    # membership CAS pressure: conflict 2 table writes (the oracle's CAS
+    # retry loops absorb them)
+    plan.rule("cas-conflict", "membership", "cas_conflict", count=2)
+    # engine slab corruption: one NaN-poisoned injection
+    plan.rule("nan-slab", "engine", "corrupt_nan", count=1,
+              corrupt_fraction=0.1,
+              match=lambda ctx: ctx == ("ChaosCounter", "poke"))
+    # isolate silo1 long enough for the majority side to declare it dead
+    # (a decisive split-brain outcome: silo1 sees its own DEAD row and
+    # stops), heal, then hard-kill silo3 and let the survivor detect it
+    plan.partition(0.2, [["silo1"], ["silo2", "silo3"]])
+    plan.heal(1.8)
+    plan.kill(2.4, "silo3")
+    return plan
+
+
+async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
+    """One full smoke run; returns the report dict (``ok`` = all four
+    invariants held).  Invariant violations are reported, not raised —
+    the caller (CLI / bench step) decides the exit code."""
+    import numpy as np
+
+    from orleans_tpu.chaos.cluster import ChaosCluster
+    from orleans_tpu.chaos.invariants import (
+        InvariantViolation,
+        check_arena_conservation,
+        check_single_activation,
+        check_membership_convergence,
+        wait_for_at_least_once,
+    )
+    from orleans_tpu.streams import InMemoryQueueAdapter
+    from orleans_tpu.streams.persistent import PersistentStreamProvider
+
+    define_chaos_counter()
+    t0 = time.monotonic()
+    queue_backing = InMemoryQueueAdapter.shared_backing()
+
+    def setup(silo):
+        silo.add_stream_provider("pq", PersistentStreamProvider(
+            InMemoryQueueAdapter(n_queues=4, backing=queue_backing),
+            pull_period=0.01, consumer_cache_ttl=0.1))
+
+    plan = smoke_plan(seed)
+    cluster = await ChaosCluster(plan=plan, n_silos=3,
+                                 silo_setup=setup).start()
+    stream_key = int(time.time() * 1000) % (1 << 30)
+    DELIVERED.pop(stream_key, None)
+    invariants: Dict[str, Any] = {}
+    try:
+        await cluster.wait_for_liveness_convergence()
+        factory = cluster.attach_client(0)
+
+        # -- workload under fault pressure (before + through the plan) --
+        kvs = [factory.get_grain(IChaosKv, i) for i in range(12)]
+        await asyncio.gather(*(r.put(i) for i, r in enumerate(kvs)))
+        # storage-flake fires here; saves must *surface* the failures,
+        # not corrupt anything — retry each until the flake window passes
+        flaked = 0
+        for r in kvs[:4]:
+            for _attempt in range(4):
+                try:
+                    await r.save()
+                    break
+                except Exception:
+                    flaked += 1
+                    await asyncio.sleep(0.01)
+
+        produced = list(range(20))
+        provider = cluster.silos[0].stream_provider("pq")
+        stream = provider.get_stream("chaos-events", stream_key)
+        await stream.on_next_batch(produced[:10])
+
+        keys = np.arange(64, dtype=np.int64)
+        engine0 = cluster.silos[0].tensor_engine
+        engine0.send_batch("ChaosCounter", "poke", keys,
+                           {"v": np.ones(64, np.float32)})  # nan-slab fires
+        await cluster.quiesce_engines()
+
+        # -- the scripted faults: partition → heal → hard-kill ----------
+        await cluster.run_plan()
+
+        # traffic AFTER the faults: the survivors must serve everything
+        # (re-attach through a live silo — the original client silo may
+        # be among the casualties)
+        factory = cluster.live_silos()[0].attach_client()
+        kvs = [factory.get_grain(IChaosKv, i) for i in range(12)]
+        await asyncio.gather(*(r.put(100 + i)
+                               for i, r in enumerate(kvs)))
+        stream = cluster.live_silos()[0].stream_provider("pq") \
+            .get_stream("chaos-events", stream_key)
+        await stream.on_next_batch(produced[10:])
+        # re-touch every vector key so rows lost with dead silos
+        # re-activate on the survivors (population conservation is about
+        # where keys LIVE, not about lossless state without a store)
+        live_engine = cluster.live_silos()[0].tensor_engine
+        live_engine.send_batch("ChaosCounter", "poke", keys,
+                               {"v": np.zeros(64, np.float32)})
+
+        # -- the four invariants ---------------------------------------
+        def _run(name, result):
+            invariants[name] = result
+
+        try:
+            _run("membership_convergence",
+                 await check_membership_convergence(cluster, timeout=10.0))
+        except InvariantViolation as exc:
+            _run("membership_convergence", {"ok": False, "error": str(exc)})
+        try:
+            _run("single_activation", check_single_activation(cluster))
+        except InvariantViolation as exc:
+            _run("single_activation", {"ok": False, "error": str(exc)})
+        try:
+            _run("arena_conservation",
+                 await check_arena_conservation(cluster, "ChaosCounter",
+                                                keys))
+        except InvariantViolation as exc:
+            _run("arena_conservation", {"ok": False, "error": str(exc)})
+        try:
+            _run("stream_at_least_once",
+                 await wait_for_at_least_once(
+                     produced,
+                     lambda: list(DELIVERED.get(stream_key, [])),
+                     timeout=15.0))
+        except InvariantViolation as exc:
+            _run("stream_at_least_once", {"ok": False, "error": str(exc)})
+    finally:
+        await cluster.stop()
+
+    ok = all(v.get("ok") for v in invariants.values()) \
+        and len(invariants) == 4
+    return {
+        "metric": "chaos_smoke",
+        "ok": ok,
+        "seed": seed,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+        "plan": plan.describe(),
+        "invariants": invariants,
+        "storage_flakes_surfaced": flaked,
+        "fault_trace": cluster.trace.to_list(),
+        "trace_signature": [list(s) for s in cluster.trace.signature()],
+        "interposer": cluster.interposer.snapshot(),
+    }
+
+
+def run_chaos_smoke(seed: int = 1234, repeat: int = 1) -> Dict[str, Any]:
+    """Run the smoke ``repeat`` times (fresh cluster + loop each) and
+    fold into one report; with repeat > 1 the trace signatures must be
+    identical across runs — the (seed, plan) replayability contract."""
+    runs = [asyncio.run(run_smoke(seed)) for _ in range(repeat)]
+    # surface the first FAILING run's evidence (invariants + trace), not
+    # blindly run 1's — ok=false with all-green evidence is undebuggable
+    primary = next((r for r in runs if not r["ok"]), runs[0])
+    report = dict(primary)
+    if repeat > 1:
+        sigs = [r["trace_signature"] for r in runs]
+        reproducible = all(s == sigs[0] for s in sigs)
+        report["runs"] = repeat
+        report["reproducible"] = reproducible
+        report["run_results"] = [
+            {"ok": r["ok"],
+             "invariants": {k: v.get("ok")
+                            for k, v in r["invariants"].items()}}
+            for r in runs]
+        report["ok"] = reproducible and all(r["ok"] for r in runs)
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m orleans_tpu.chaos",
+        description="run the seeded chaos smoke plan and emit a JSON "
+                    "fault/invariant report")
+    parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--out", default="CHAOS_SMOKE.json",
+                        help="report path ('-' = stdout only)")
+    parser.add_argument("--repeat", type=int, default=1,
+                        help="run the plan N times and assert identical "
+                             "trace signatures (reproducibility proof)")
+    args = parser.parse_args(argv)
+
+    report = run_chaos_smoke(seed=args.seed, repeat=args.repeat)
+    print(json.dumps(report))
+    if args.out != "-":
+        with open(args.out, "w") as f:
+            f.write(json.dumps(report, indent=1) + "\n")
+    return 0 if report["ok"] else 1

@@ -143,6 +143,21 @@ def _wants_kwargs(cls) -> bool:
                for p in params.values())
 
 
+#: strong refs to in-flight close() tasks of rejected providers (the
+#: event loop only holds tasks weakly)
+_pending_closes: set = set()
+
+
+def _reap_close(task) -> None:
+    _pending_closes.discard(task)
+    if not task.cancelled():
+        # close() failures during rejection are suppressed — same
+        # contract as the synchronous path's `except Exception: pass`;
+        # retrieving the exception keeps asyncio's unhandled-exception
+        # handler quiet
+        task.exception()
+
+
 class ProviderLoader:
     """Instantiate + register provider blocks on a silo
     (reference: ProviderLoader.LoadProviders + per-kind managers)."""
@@ -180,7 +195,21 @@ class ProviderLoader:
                         try:
                             res = close()
                             if asyncio.iscoroutine(res):
-                                res.close()  # sync context: discard
+                                # an async close() must actually RUN so
+                                # __init__-acquired resources release:
+                                # schedule it on the running loop when
+                                # one exists; only a loop-less context
+                                # discards (nothing could await it).
+                                # The task is pinned until done — the
+                                # loop holds tasks weakly, and a GC'd
+                                # pending task never closes anything.
+                                try:
+                                    task = asyncio.get_running_loop() \
+                                        .create_task(res)
+                                    _pending_closes.add(task)
+                                    task.add_done_callback(_reap_close)
+                                except RuntimeError:
+                                    res.close()
                         except Exception:  # noqa: BLE001
                             pass
                     raise ValueError(

@@ -334,10 +334,8 @@ class AdaptiveDirectoryCacheMaintainer:
         self._task = None
 
     def start(self) -> None:
-        import asyncio
-        import contextvars
-        self._task = asyncio.get_running_loop().create_task(
-            self._loop(), context=contextvars.Context())
+        from orleans_tpu.utils.async_utils import spawn_in_fresh_context
+        self._task = spawn_in_fresh_context(self._loop())
 
     def stop(self) -> None:
         if self._task is not None:

@@ -408,14 +408,13 @@ class LocalReminderService:
     # -- timers -------------------------------------------------------------
 
     def _start_local(self, entry: ReminderEntry) -> None:
-        import contextvars
+        from orleans_tpu.utils.async_utils import spawn_in_fresh_context
         self._stop_local(entry.grain_id, entry.name)
         # fresh context: a reminder registered from inside a grain turn must
         # NOT inherit that turn's call chain / activation (its ticks are new
         # top-level requests, not continuations — else deadlock detection
         # sees the registering grain in its own chain)
-        task = asyncio.get_running_loop().create_task(
-            self._run(entry), context=contextvars.Context())
+        task = spawn_in_fresh_context(self._run(entry))
         self.local[entry.key] = _LocalReminder(entry, task)
 
     def _stop_local(self, grain_id: GrainId, name: str) -> None:

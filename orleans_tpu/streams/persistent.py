@@ -288,9 +288,8 @@ class PullingAgent:
         self._sink_checked: set = set()
 
     def start(self) -> None:
-        import contextvars
-        self._task = asyncio.get_running_loop().create_task(
-            self._pull_loop(), context=contextvars.Context())
+        from orleans_tpu.utils.async_utils import spawn_in_fresh_context
+        self._task = spawn_in_fresh_context(self._pull_loop())
 
     def stop(self) -> None:
         if self._task is not None:
@@ -350,21 +349,36 @@ class PullingAgent:
                             # death — retrying only every pull_period would
                             # hit the poison cap in ~0.1s and drop events
                             # during ordinary failover
-                            retry_at = time.monotonic() + min(
-                                p.retry_backoff_initial * (2 ** (attempts - 1)),
-                                p.retry_backoff_max)
+                            retry_at = time.monotonic() \
+                                + p.retry_backoff(attempts)
                             break
                         if sink is not None and n > 1:
                             # poison isolation: a failing RUN retries one
-                            # message at a time, so only the malformed
-                            # event drops — never its good neighbors
+                            # message at a time, each through the NORMAL
+                            # max_delivery_attempts/backoff schedule —
+                            # a transient engine failure mid-isolation
+                            # must not drop healthy neighbors; only a
+                            # message that exhausts its own budget drops.
+                            # The backoff SLEEP budget is one message's
+                            # full schedule shared across the pass: a
+                            # non-transient whole-run failure degrades to
+                            # one attempt per message instead of
+                            # head-of-line-blocking this agent's queue
+                            # for n × the schedule
+                            budget = sum(
+                                p.retry_backoff(a) for a in
+                                range(1, p.max_delivery_attempts))
                             for mm in run:
-                                if not await self._deliver_slab(sink, [mm]):
+                                ok, budget = await self._deliver_isolated(
+                                    sink, mm, budget)
+                                if not ok:
                                     self.logger.warn(
                                         f"dropping seq={mm.seq} on "
                                         f"{mm.stream_id} (poison event "
                                         f"isolated from a {n}-message run "
-                                        f"after {attempts} attempts)")
+                                        f"after "
+                                        f"{p.max_delivery_attempts} "
+                                        f"attempts)")
                         else:
                             self.logger.warn(
                                 f"dropping seq={m.seq} on {m.stream_id} "
@@ -447,6 +461,26 @@ class PullingAgent:
         finally:
             _current_runtime.reset(token)
 
+    async def _deliver_isolated(self, sink: TensorSinkBinding,
+                                msg: QueueMessage,
+                                sleep_budget: float) -> Tuple[bool, float]:
+        """Isolation pass of a failed run: one message, up to
+        max_delivery_attempts through the normal backoff schedule — so a
+        transient mid-isolation cannot drop healthy neighbors — but the
+        backoff sleeps draw from ``sleep_budget`` (shared across the
+        pass); once it runs dry, remaining messages get their attempts
+        back-to-back.  Returns (delivered, remaining_budget)."""
+        p = self.provider
+        for attempt in range(1, p.max_delivery_attempts + 1):
+            if await self._deliver_slab(sink, [msg]):
+                return True, sleep_budget
+            if attempt < p.max_delivery_attempts:
+                delay = min(p.retry_backoff(attempt), sleep_budget)
+                if delay > 0:
+                    sleep_budget -= delay
+                    await asyncio.sleep(delay)
+        return False, sleep_budget
+
     async def _deliver_slab(self, sink: TensorSinkBinding,
                             run: List[QueueMessage]) -> bool:
         """Inject a run of sink-bound events as ONE vector-grain slab
@@ -527,13 +561,26 @@ class PullingAgent:
             args = {f: np.concatenate(vs) if len(vs) > 1 else vs[0]
                     for f, vs in cols.items()}
             engine.send_batch(sink.type_name, sink.method, slab_keys, args)
-            await engine.drain_queues()
-            return True
         except Exception as exc:  # noqa: BLE001 — retried by the pull loop
             self.logger.warn(
                 f"slab delivery of {len(run)} events to "
                 f"{sink.type_name}.{sink.method} failed: {exc!r}")
             return False
+        try:
+            await engine.drain_queues()
+        except Exception as exc:  # noqa: BLE001
+            # the slab already entered the engine's queues: its apply is
+            # now the engine loop's responsibility, so redelivering the
+            # run would double-apply non-idempotent updates (scatter_add
+            # counters) in a LIVE process — beyond the documented
+            # hard-kill at-least-once window.  Treat a post-send_batch
+            # drain failure as delivered-with-error: ack, surface loudly.
+            self.logger.error(
+                f"drain after slab delivery of {len(run)} events to "
+                f"{sink.type_name}.{sink.method} failed: {exc!r} — "
+                f"acking as delivered-with-error (the slab is in the "
+                f"engine; redelivery would double-apply)")
+        return True
 
     async def _deliver(self, msg: QueueMessage) -> bool:
         """Deliver one event to every subscriber.  Returns False when any
@@ -645,6 +692,14 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
         self.name = name
         self.balancer = self._balancer_cls(name)
         self.manager = PersistentStreamPullingManager(self)
+
+    def retry_backoff(self, attempt: int) -> float:
+        """Delay before retry N (1-based): exponential from
+        retry_backoff_initial capped at retry_backoff_max — ONE schedule
+        shared by the run-level retry head and the poison-isolation
+        pass, so their budgets cannot drift apart."""
+        return min(self.retry_backoff_initial * (2 ** (attempt - 1)),
+                   self.retry_backoff_max)
 
     async def register_subscription(self, handle) -> None:
         """Pub/sub registration plus rewind poke: a from_seq subscription

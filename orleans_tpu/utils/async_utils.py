@@ -20,6 +20,22 @@ from typing import Any, Awaitable, Callable, Deque, List, Optional, Tuple
 INFINITE_RETRIES = -1
 
 
+def spawn_in_fresh_context(coro) -> "asyncio.Task":
+    """Schedule ``coro`` as a task running in a FRESH contextvars.Context —
+    background loops (pulling agents, cache maintainers, reminder firings)
+    must not inherit the ambient grain-call context of whoever happened to
+    start them.  ``loop.create_task(..., context=...)`` only exists on
+    Python 3.11+; on 3.10 the task snapshots the context active at
+    construction, so constructing it inside ``Context().run`` is the
+    equivalent."""
+    import contextvars
+    loop = asyncio.get_running_loop()
+    try:
+        return loop.create_task(coro, context=contextvars.Context())
+    except TypeError:  # Python < 3.11: no context kwarg
+        return contextvars.Context().run(loop.create_task, coro)
+
+
 class FixedBackoff:
     """(reference: FixedBackoff in AsyncExecutorWithRetries.cs)"""
 

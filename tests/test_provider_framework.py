@@ -353,3 +353,49 @@ def test_stream_provider_tensor_sinks_from_config(run, tmp_path):
             await silo.stop()
 
     run(main())
+
+
+class _AsyncCloseStreamProvider:
+    """User stream provider whose async close() releases resources
+    acquired in __init__ — and which does NOT support tensor_sinks."""
+
+    instances: list = []
+
+    def __init__(self) -> None:
+        self.resource_open = True
+        type(self).instances.append(self)
+
+    def init(self, silo, name: str) -> None:
+        pass
+
+    async def close(self) -> None:
+        self.resource_open = False
+
+
+def test_rejected_provider_async_close_runs_on_loop(run):
+    """ADVICE regression: a provider rejected for unsupported
+    tensor_sinks must have its async close() actually EXECUTED (scheduled
+    on the running loop), not discarded — else __init__-acquired
+    resources leak."""
+
+    async def main():
+        _AsyncCloseStreamProvider.instances.clear()
+        with pytest.raises(ValueError, match="tensor_sinks"):
+            ProviderLoader().load(Silo(name="close-sched-silo"), [
+                {"kind": "stream",
+                 "type": f"{_AsyncCloseStreamProvider.__module__}:"
+                         f"{_AsyncCloseStreamProvider.__name__}",
+                 "name": "S",
+                 "tensor_sinks": {"x": {"interface": "LwwGrain",
+                                        "method": "put"}}}])
+        (instance,) = _AsyncCloseStreamProvider.instances
+        # the close coroutine is scheduled, not awaited inline — give the
+        # loop a beat to run it
+        for _ in range(5):
+            if not instance.resource_open:
+                break
+            await asyncio.sleep(0)
+        assert not instance.resource_open, \
+            "async close() never ran for the rejected provider"
+
+    run(main())

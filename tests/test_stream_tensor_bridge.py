@@ -236,3 +236,141 @@ def test_poison_event_isolated_from_slab_run(run, tmp_path):
             await cluster.stop()
 
     run(main())
+
+
+def test_transient_failure_during_isolation_keeps_neighbors(run, tmp_path):
+    """ADVICE regression: the poison-isolation pass must give each
+    isolated message the normal max_delivery_attempts/backoff budget —
+    a transient engine failure DURING isolation must not drop healthy
+    neighbors (the old single-attempt pass did)."""
+
+    async def main():
+        db = str(tmp_path / "bridge-transient.db")
+
+        def setup(silo):
+            provider = PersistentStreamProvider(
+                SqliteQueueAdapter(path=db, n_queues=1),
+                pull_period=0.005, consumer_cache_ttl=0.0,
+                max_delivery_attempts=2, retry_backoff_initial=0.01,
+                retry_backoff_max=0.02)
+            provider.bind_tensor_sink("lww-events", "LwwGrain", "put",
+                                      key_field="key")
+            silo.add_stream_provider("pq", provider)
+
+        cluster = await TestingCluster(n_silos=1,
+                                       silo_setup=setup).start()
+        try:
+            silo = cluster.silos[0]
+            provider = silo.stream_providers["pq"]
+            engine = silo.tensor_engine
+
+            # transient outage: the first 3 send_batch calls fail — the
+            # 2-attempt run burns calls 1-2, so isolation's FIRST
+            # message still hits the outage (call 3) and must retry
+            original = engine.send_batch
+            calls = {"n": 0}
+
+            def flaky(*a, **kw):
+                calls["n"] += 1
+                if calls["n"] <= 3:
+                    raise RuntimeError("transient engine outage")
+                return original(*a, **kw)
+
+            engine.send_batch = flaky
+
+            sid = StreamId(provider="pq", namespace="lww-events", key=4)
+            n = 8
+            keys = np.arange(n, dtype=np.int64)
+            # two good slabs with identical fields → ONE run of 2
+            await provider.produce(sid, [
+                {"key": keys, "v": np.full(n, 1, np.int32)},
+                {"key": keys, "v": np.full(n, 2, np.int32)},
+            ])
+
+            async def drained():
+                while sum(a.delivered
+                          for a in provider.manager.agents.values()) < 2:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(drained(), timeout=10)
+            await engine.flush()
+            value, count = _lww_rows(silo, keys)
+            # BOTH slabs delivered: the transient during isolation was
+            # retried, not treated as poison
+            np.testing.assert_array_equal(count, 2)
+            np.testing.assert_array_equal(value, 2)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_drain_failure_after_send_batch_is_not_redelivered(run, tmp_path):
+    """ADVICE regression: once send_batch accepted the slab, a failing
+    drain_queues must NOT return the run to the retry path — the slab is
+    already in the engine, and redelivery double-applies non-idempotent
+    updates in a live process."""
+
+    async def main():
+        db = str(tmp_path / "bridge-drain.db")
+
+        def setup(silo):
+            provider = PersistentStreamProvider(
+                SqliteQueueAdapter(path=db, n_queues=1),
+                pull_period=0.005, consumer_cache_ttl=0.0,
+                max_delivery_attempts=4, retry_backoff_initial=0.01,
+                retry_backoff_max=0.02)
+            provider.bind_tensor_sink("lww-events", "LwwGrain", "put",
+                                      key_field="key")
+            silo.add_stream_provider("pq", provider)
+
+        cluster = await TestingCluster(n_silos=1,
+                                       silo_setup=setup).start()
+        try:
+            silo = cluster.silos[0]
+            provider = silo.stream_providers["pq"]
+            engine = silo.tensor_engine
+
+            sends = {"n": 0}
+            original_send = engine.send_batch
+
+            def counting_send(*a, **kw):
+                sends["n"] += 1
+                return original_send(*a, **kw)
+
+            engine.send_batch = counting_send
+
+            original_drain = engine.drain_queues
+            drains = {"n": 0}
+
+            async def failing_drain(*a, **kw):
+                drains["n"] += 1
+                if drains["n"] == 1:
+                    raise RuntimeError("drain hiccup after send_batch")
+                return await original_drain(*a, **kw)
+
+            engine.drain_queues = failing_drain
+
+            sid = StreamId(provider="pq", namespace="lww-events", key=5)
+            n = 8
+            keys = np.arange(n, dtype=np.int64)
+            await provider.produce(sid, [
+                {"key": keys, "v": np.full(n, 7, np.int32)}])
+
+            async def drained():
+                while sum(a.delivered
+                          for a in provider.manager.agents.values()) < 1:
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(drained(), timeout=10)
+            await engine.flush()
+            value, count = _lww_rows(silo, keys)
+            # applied EXACTLY once: the drain failure did not trigger a
+            # redelivery of an already-submitted slab
+            assert sends["n"] == 1, f"slab re-sent {sends['n']} times"
+            np.testing.assert_array_equal(count, 1)
+            np.testing.assert_array_equal(value, 7)
+        finally:
+            await cluster.stop()
+
+    run(main())
