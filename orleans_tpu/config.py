@@ -99,6 +99,12 @@ class TensorEngineConfig:
     bucket_sizes: tuple = (256, 4096, 32768, 131072, 262144, 524288,
                            1 << 20)
     mesh_axis: str = "grains"
+    # cross-silo sender aggregation (tensor/router.py): slab fragments
+    # bound for one (destination, type, method) within a drain cycle
+    # merge into ONE wire frame, so receivers see stable batch sizes
+    # instead of compile-churning fragment sizes.  Off only for A/B
+    # measurement (bench.py --workload cluster publishes both sides).
+    slab_aggregation: bool = True
     # max parked optimistic miss-checks before a forced (synchronizing)
     # drain — bounds device memory pinned by deferred delivery checks
     miss_check_cap: int = 16

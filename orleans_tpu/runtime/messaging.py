@@ -177,6 +177,28 @@ from orleans_tpu.codec import default_manager as _codec  # noqa: E402
 _codec.register(Message, name="orleans.Message")
 
 
+#: the VectorRouter's one-way slab entry point (tensor/router.py) — the
+#: method whose messages ride the zero-copy slab wire format
+SLAB_METHOD = "inject_slab"
+
+
+def is_slab_message(msg: Message) -> bool:
+    """True for one-way cross-silo tensor slabs addressed to a peer's
+    vector_router system target.  The TCP transport ships these via the
+    zero-copy slab wire format (codec.encode_slab_frame) instead of the
+    token-stream codec, and bounces route back through the router's
+    backoff-reinject path instead of being dropped."""
+    from orleans_tpu.ids import SystemTargetCodes
+    return (msg.category == Category.APPLICATION
+            and msg.direction == Direction.ONE_WAY
+            and msg.method_name == SLAB_METHOD
+            and msg.target_grain is not None
+            and msg.target_grain.is_system_target
+            and msg.target_grain.type_code ==
+            int(SystemTargetCodes.VECTOR_ROUTER)
+            and len(msg.args) >= 4)
+
+
 class MessageCenter:
     """Per-silo message hub (reference: MessageCenter.cs:33).
 

@@ -483,6 +483,7 @@ class Silo:
             while True:
                 await asyncio.sleep(self.config.statistics_report_period)
                 snapshot = self.metrics.snapshot()
+                self.publish_data_plane_telemetry()
                 for pub in self.statistics_publishers.values():
                     try:
                         await pub.report(self.name, snapshot)
@@ -491,6 +492,25 @@ class Silo:
                                          code=2801)
         except asyncio.CancelledError:
             pass
+
+    def publish_data_plane_telemetry(self) -> None:
+        """Mirror the cross-silo data-plane counters (vector-router slab
+        aggregation + per-link transport frames/bytes) into the process
+        telemetry manager.  No-op without metric consumers."""
+        from orleans_tpu import telemetry
+        mgr = telemetry.default_manager
+        if not mgr.consumers:
+            return
+        if self.vector_router is not None \
+                and hasattr(self.vector_router, "snapshot"):
+            mgr.track_metrics(self.vector_router.snapshot(),
+                              {"silo": self.name}, prefix="router.")
+        snap = getattr(self._bound_transport, "snapshot", None)
+        if snap is not None:
+            for link, stats in snap().get("links", {}).items():
+                mgr.track_metrics(stats,
+                                  {"silo": self.name, "link": link},
+                                  prefix="transport.link.")
 
     # ================= membership view =====================================
 
@@ -590,6 +610,13 @@ class Silo:
                 if msg.direction != Direction.ONE_WAY:
                     self.message_center.send_message(
                         msg.create_response(exc, ResponseKind.ERROR))
+                else:
+                    # a one-way system call has no caller to surface the
+                    # failure to — log it, or e.g. a slab whose handler
+                    # raises vanishes without a trace
+                    self.logger.warn(
+                        f"one-way system call {name}.{msg.method_name} "
+                        f"failed: {exc!r}", code=2804, exc_info=True)
 
         asyncio.get_running_loop().create_task(run())
 
@@ -644,10 +671,17 @@ class Silo:
 
     def get_debug_dump(self) -> Dict[str, Any]:
         """(reference: Silo.GetDebugDump :1057)"""
-        return {
+        dump = {
             "address": str(self.address),
             "status": self.status.value,
             "activations": len(self.catalog.directory),
             "metrics": self.metrics.snapshot(),
             "ring_members": [str(s) for s in self.ring.members],
         }
+        if self.vector_router is not None \
+                and hasattr(self.vector_router, "snapshot"):
+            dump["vector_router"] = self.vector_router.snapshot()
+        snap = getattr(self._bound_transport, "snapshot", None)
+        if snap is not None:
+            dump["transport"] = snap()
+        return dump

@@ -27,7 +27,7 @@ from typing import Callable, Dict, Optional
 
 from orleans_tpu.codec import default_manager as codec
 from orleans_tpu.ids import SiloAddress
-from orleans_tpu.runtime.messaging import Message
+from orleans_tpu.runtime.messaging import Message, is_slab_message
 
 
 class TransportError(Exception):
@@ -147,8 +147,18 @@ class TcpTransport:
     remaining-TTL and rebased against the receiver's clock.
     """
 
-    MAGIC = 0x4F54  # "OT"
+    MAGIC = 0x4F54       # "OT" — token-stream codec frame
+    MAGIC_SLAB = 0x4F53  # "OS" — zero-copy slab frame (header + raw buffers)
     MAX_QUEUED_PER_DEST = 10_000  # (reference: queue-length overload limits)
+    # byte-aware backpressure: the count limit alone is unbounded memory
+    # when the queue holds multi-MB slabs — bound the bytes in flight per
+    # destination too and bounce through the same rejection path
+    MAX_QUEUED_BYTES_PER_DEST = 64 * 1024 * 1024
+    #: frames serialized per write/drain cycle of the batched sender loop
+    SENDER_BATCH_MAX = 256
+    #: queue-accounting estimate for non-slab control messages (their true
+    #: wire size is unknown until serialization; slabs are costed exactly)
+    CONTROL_MSG_COST = 1024
     CONNECT_RETRIES = 3
     CONNECT_BACKOFF = 0.05
 
@@ -162,6 +172,10 @@ class TcpTransport:
         self._queues: Dict[SiloAddress, asyncio.Queue] = {}
         self._senders: Dict[SiloAddress, asyncio.Task] = {}
         self._endpoints: Dict[SiloAddress, tuple] = {}
+        self._queue_bytes: Dict[SiloAddress, int] = {}
+        # per-link observability (frames/bytes/slabs out, bounces) —
+        # surfaced through snapshot() and the silo's telemetry publication
+        self.link_stats: Dict[SiloAddress, Dict[str, int]] = {}
         # accepted inbound connections: a hard kill must sever these too —
         # server.close() only stops NEW accepts, and a "dead" silo that
         # keeps reading from old sockets is a zombie peers never detect
@@ -190,6 +204,11 @@ class TcpTransport:
             while True:
                 header = await reader.readexactly(8)
                 magic, length = struct.unpack("<II", header)
+                if magic == self.MAGIC_SLAB:
+                    payload = await reader.readexactly(length)
+                    self.silo.message_center.deliver_local(
+                        self._decode_slab_message(payload))
+                    continue
                 if magic != self.MAGIC:
                     raise TransportError(f"bad frame magic {magic:#x}")
                 payload = await reader.readexactly(length)
@@ -209,6 +228,93 @@ class TcpTransport:
             self._accepted.discard(writer)
             writer.close()
 
+    # ---- slab wire format -------------------------------------------------
+
+    def _encode_slab_segments(self, msg: Message) -> list:
+        """Slab message → ``[header segment, raw buffer views...]``.
+
+        The payload arrays leave as memoryviews over the sender's own
+        buffers (zero copy); only the small routing header + pytree
+        skeleton + array manifest go through the codec."""
+        import numpy as np
+
+        from orleans_tpu.codec import encode_slab_frame, flatten_slab_tree
+        type_name, method, keys, args = msg.args[:4]
+        hops = int(msg.args[4]) if len(msg.args) > 4 else 0
+        retries = int(msg.args[5]) if len(msg.args) > 5 else 0
+        skeleton, arrays = flatten_slab_tree(args)
+        header = (type_name, method, hops, retries, msg.sending_silo,
+                  skeleton)
+        keys = np.ascontiguousarray(np.asarray(keys, dtype=np.int64))
+        return encode_slab_frame(codec, header, [keys] + arrays)
+
+    def _decode_slab_message(self, payload: bytes) -> Message:
+        """Slab frame body → the inject_slab Message the dispatcher
+        expects.  Arrays come back as frombuffer views over ``payload``
+        (no byte-level decode loop); a malformed header raises and costs
+        this connection, like any corrupt frame."""
+        from orleans_tpu.codec import (
+            SerializationError,
+            decode_slab_frame,
+            unflatten_slab_tree,
+        )
+        from orleans_tpu.ids import GrainId, SystemTargetCodes
+        from orleans_tpu.runtime.messaging import (
+            Category,
+            Direction,
+            SLAB_METHOD,
+        )
+        header, arrays = decode_slab_frame(codec, payload)
+        if (not isinstance(header, tuple) or len(header) != 6
+                or not arrays):
+            raise SerializationError(
+                f"malformed slab header: {type(header).__name__}")
+        type_name, method, hops, retries, sending_silo, skeleton = header
+        args = unflatten_slab_tree(skeleton, arrays[1:])
+        return Message(
+            category=Category.APPLICATION,
+            direction=Direction.ONE_WAY,
+            sending_silo=sending_silo,
+            target_silo=self.silo.address,
+            target_grain=GrainId.system_target(
+                int(SystemTargetCodes.VECTOR_ROUTER)),
+            method_name=SLAB_METHOD,
+            args=(type_name, method, arrays[0], args, hops, retries),
+        )
+
+    @staticmethod
+    def _wire_cost(msg: Message) -> int:
+        """Deterministic queue-accounting estimate of a message's wire
+        size — exact (buffer bytes) for slabs, nominal for control
+        frames.  Must return the same value at enqueue and dequeue."""
+        if not is_slab_message(msg):
+            return TcpTransport.CONTROL_MSG_COST
+        import jax
+        import numpy as np
+
+        cost = 512 + np.asarray(msg.args[2]).nbytes  # header + keys
+        for leaf in jax.tree_util.tree_leaves(msg.args[3]):
+            cost += getattr(leaf, "nbytes", 16)
+        return cost
+
+    def _link(self, target: SiloAddress) -> Dict[str, int]:
+        stats = self.link_stats.get(target)
+        if stats is None:
+            stats = self.link_stats[target] = {
+                "frames_sent": 0, "bytes_sent": 0, "slab_frames_sent": 0,
+                "drain_cycles": 0, "msgs_bounced": 0}
+        return stats
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Per-link counters + live queue byte depth (observability)."""
+        return {
+            "links": {str(t): dict(st) for t, st in self.link_stats.items()},
+            "queued_bytes": {str(t): b for t, b in self._queue_bytes.items()
+                             if b},
+        }
+
+    # ---- send side --------------------------------------------------------
+
     def send(self, msg: Message) -> None:
         if self.drop_predicate is not None and self.drop_predicate(msg):
             return
@@ -219,23 +325,47 @@ class TcpTransport:
             self._queues[target] = queue
             self._senders[target] = asyncio.get_running_loop().create_task(
                 self._sender_loop(target, queue))
+        cost = self._wire_cost(msg)
+        queued = self._queue_bytes.get(target, 0)
+        # the cap bounds the BACKLOG, not any single frame: a message is
+        # always admitted to an empty queue (an aggregated slab larger
+        # than the cap would otherwise bounce→reinject→re-merge→bounce
+        # forever and drop after the retry budget)
+        if queued > 0 and queued + cost > self.MAX_QUEUED_BYTES_PER_DEST:
+            self._bounce(msg, "send queue full (bytes in flight)")
+            return
         try:
-            queue.put_nowait(msg)
+            queue.put_nowait((msg, cost))
         except asyncio.QueueFull:
             # overload: bounce rather than buffer unboundedly (reference:
             # queue-length warnings + overload rejection, SURVEY §5)
             self._bounce(msg, "send queue full")
+            return
+        self._queue_bytes[target] = self._queue_bytes.get(target, 0) + cost
+
+    def _dequeued(self, target: SiloAddress, cost: int) -> None:
+        self._queue_bytes[target] = max(
+            0, self._queue_bytes.get(target, 0) - cost)
 
     def _bounce(self, msg: Message, reason: str) -> None:
         """Requests come back as transient rejections — like InProc's
         closed-socket analog — so the caller's resend machinery
         re-addresses instead of hanging for the full response timeout.
+        Bounced SLABS carry payload that must not be lost: they route
+        back through the vector router's backoff-reinject path, so a
+        transient link failure redelivers instead of dropping the data.
         Undeliverable RESPONSES are logged (the remote caller's own
         timeout/dead-silo break covers it — reference behavior), never
         dropped without a trace."""
         from orleans_tpu.runtime.messaging import Direction, RejectionType
         if self._closing:
             return  # own silo dying: nothing meaningful to bounce into
+        router = getattr(self.silo, "vector_router", None)
+        if (is_slab_message(msg) and router is not None
+                and hasattr(router, "reinject_bounced")):
+            self._link(msg.target_silo)["msgs_bounced"] += 1
+            router.reinject_bounced(msg, reason)
+            return
         if msg.direction == Direction.REQUEST:
             self.silo.message_center.deliver_local(msg.create_rejection(
                 RejectionType.TRANSIENT,
@@ -256,11 +386,14 @@ class TcpTransport:
             if target in live_set:
                 continue
             queue = self._queues.pop(target)
+            self._queue_bytes.pop(target, None)
             task = self._senders.pop(target, None)
             if task is not None:
                 task.cancel()
             while not queue.empty():
-                self._bounce(queue.get_nowait(), "silo declared dead")
+                item = queue.get_nowait()
+                if item is not None:
+                    self._bounce(item[0], "silo declared dead")
 
     async def _connect(self, endpoint) -> Optional[asyncio.StreamWriter]:
         for attempt in range(self.CONNECT_RETRIES):
@@ -271,19 +404,62 @@ class TcpTransport:
                 await asyncio.sleep(self.CONNECT_BACKOFF * (attempt + 1))
         return None
 
-    async def _sender_loop(self, target: SiloAddress,
-                           queue: asyncio.Queue) -> None:
-        """Single connection + FIFO per destination."""
+    def _frame_segments(self, msg: Message) -> Optional[list]:
+        """Serialize one message into its wire segments (frame header
+        included), or None if it was degraded/bounced locally."""
         import dataclasses
         import time
+        if is_slab_message(msg):
+            try:
+                parts = self._encode_slab_segments(msg)
+            except Exception as exc:  # noqa: BLE001 — a slab that cannot
+                # encode would fail identically on every retry, so the
+                # reinject path is wrong here; fail loudly instead
+                self.silo.logger.error(
+                    f"dropping unencodable slab frame to "
+                    f"{msg.target_silo}: {exc!r}", code=2904)
+                return None
+            total = sum(p.nbytes if isinstance(p, memoryview) else len(p)
+                        for p in parts)
+            return [struct.pack("<II", self.MAGIC_SLAB, total)] + parts
+        wire = dataclasses.replace(msg)
+        if wire.expiration is not None:
+            wire.expiration = max(0.0, wire.expiration - time.monotonic())
+        try:
+            payload = codec.serialize(wire)
+        except Exception as exc:  # noqa: BLE001
+            degraded = _degrade_unserializable(wire, exc)
+            if degraded is None:
+                from orleans_tpu.runtime.messaging import (
+                    Direction,
+                    RejectionType,
+                )
+                if msg.direction == Direction.REQUEST:
+                    self.silo.message_center.deliver_local(
+                        msg.create_rejection(
+                            RejectionType.UNRECOVERABLE,
+                            f"unserializable request: {exc!r}"))
+                return None
+            payload = codec.serialize(degraded)
+        return [struct.pack("<II", self.MAGIC, len(payload)), payload]
+
+    async def _sender_loop(self, target: SiloAddress,
+                           queue: asyncio.Queue) -> None:
+        """Single connection per destination; the whole queued backlog
+        drains per wakeup into ONE write/drain cycle (the reference's
+        SiloMessageSender batch-drains its per-destination queue rather
+        than writing messages singly — SURVEY §L1)."""
+        from collections import deque
         writer: Optional[asyncio.StreamWriter] = None
-        msg: Optional[Message] = None
+        pending: deque = deque()
+        written: list = []
         try:
             while True:
-                msg = None
-                msg = await queue.get()
-                if msg is None:
-                    break
+                pending.append(await queue.get())
+                # batch drain: everything already queued rides this cycle
+                while (len(pending) < self.SENDER_BATCH_MAX
+                       and not queue.empty()):
+                    pending.append(queue.get_nowait())
                 if writer is None or writer.is_closing():
                     endpoint = self._endpoints.get(
                         target, (target.host, target.port))
@@ -292,43 +468,57 @@ class TcpTransport:
                         # NOT a silent drop: bounce so callers resend via
                         # the (healing) directory; membership probes will
                         # declare the peer dead and prune this queue
-                        self._bounce(msg, "connect failed")
+                        while pending:
+                            msg, cost = pending.popleft()
+                            self._dequeued(target, cost)
+                            self._bounce(msg, "connect failed")
                         continue
-                wire = dataclasses.replace(msg)
-                if wire.expiration is not None:
-                    wire.expiration = max(0.0,
-                                          wire.expiration - time.monotonic())
-                try:
-                    payload = codec.serialize(wire)
-                except Exception as exc:  # noqa: BLE001
-                    degraded = _degrade_unserializable(wire, exc)
-                    if degraded is None:
-                        from orleans_tpu.runtime.messaging import (
-                            Direction,
-                            RejectionType,
-                        )
-                        if msg.direction == Direction.REQUEST:
-                            self.silo.message_center.deliver_local(
-                                msg.create_rejection(
-                                    RejectionType.UNRECOVERABLE,
-                                    f"unserializable request: {exc!r}"))
+                link = self._link(target)
+                bytes_out = frames_out = slabs_out = 0
+                written.clear()
+                while pending:
+                    msg, cost = pending.popleft()
+                    self._dequeued(target, cost)
+                    segments = self._frame_segments(msg)
+                    if segments is None:
                         continue
-                    payload = codec.serialize(degraded)
-                writer.write(struct.pack("<II", self.MAGIC, len(payload))
-                             + payload)
+                    for seg in segments:
+                        writer.write(seg)
+                    written.append(msg)
+                    frames_out += 1
+                    bytes_out += sum(
+                        s.nbytes if isinstance(s, memoryview) else len(s)
+                        for s in segments)
+                    if is_slab_message(msg):
+                        slabs_out += 1
                 try:
                     await writer.drain()
                 except ConnectionError:
-                    # peer died under an established connection: the frame
-                    # may or may not have landed — bounce so the caller's
-                    # resend machinery decides (at-least-once, like the
-                    # reference's resend-on-failure), never a silent drop
+                    # peer died under an established connection: the
+                    # cycle's frames may or may not have landed — bounce
+                    # so the callers' resend machinery decides (at-least-
+                    # once, like the reference's resend-on-failure),
+                    # never a silent drop
                     writer = None
-                    self._bounce(msg, "connection lost")
+                    for msg in written:
+                        self._bounce(msg, "connection lost")
+                    written.clear()
+                    continue
+                written.clear()
+                link["frames_sent"] += frames_out
+                link["bytes_sent"] += bytes_out
+                link["slab_frames_sent"] += slabs_out
+                link["drain_cycles"] += 1
         except asyncio.CancelledError:
-            # prune cancelled us mid-message (connect backoff / drain):
-            # the in-hand message must bounce like the queued ones
-            if msg is not None:
+            # prune cancelled us mid-cycle (connect backoff / drain): the
+            # in-hand messages must bounce like the queued ones.  Frames
+            # in `written` were handed to the socket but not drained —
+            # they may or may not have landed, so they bounce too (at-
+            # least-once, same contract as the connection-lost path)
+            for msg in written:
+                self._bounce(msg, "silo declared dead")
+            for msg, cost in pending:
+                self._dequeued(target, cost)
                 self._bounce(msg, "silo declared dead")
         finally:
             if writer is not None:
@@ -354,6 +544,7 @@ class TcpTransport:
             task.cancel()
         self._senders.clear()
         self._queues.clear()
+        self._queue_bytes.clear()
         for w in list(self._accepted):
             w.close()
         self._accepted.clear()
@@ -432,6 +623,9 @@ class TcpBoundTransport:
 
     def prune_dead(self, live) -> None:
         self.transport.prune_dead(live)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        return self.transport.snapshot()
 
     async def drain(self, timeout: float = 2.0) -> None:
         await self.transport.drain(timeout)

@@ -119,6 +119,19 @@ class TelemetryManager:
         for c in self._each(MetricTelemetryConsumer):
             c.track_metric(name, value, properties)
 
+    def track_metrics(self, values: Dict[str, float],
+                      properties: Optional[Dict[str, str]] = None,
+                      prefix: str = "") -> None:
+        """Batch form of track_metric — one snapshot dict fanned out under
+        a common prefix (used by the silo's data-plane counter publication:
+        router slab counters, per-link transport bytes/frames)."""
+        consumers = self._each(MetricTelemetryConsumer)
+        if not consumers:
+            return
+        for name, value in values.items():
+            for c in consumers:
+                c.track_metric(prefix + name, float(value), properties)
+
     def track_trace(self, message: str, severity: Severity = Severity.INFO,
                     properties: Optional[Dict[str, str]] = None) -> None:
         for c in self._each(TraceTelemetryConsumer):

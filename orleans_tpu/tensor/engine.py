@@ -660,6 +660,14 @@ class TensorEngine:
             if self.autofuser.flush_partial():
                 requeued = True
             if not requeued:
+                if self.router is not None \
+                        and getattr(self.router, "_retry_tasks", None):
+                    # parked cross-silo redelivery (bounced / over-
+                    # forwarded slabs awaiting backoff) is in-flight
+                    # work — full delivery waits it out; the retry
+                    # budget bounds this (drops are logged + counted)
+                    await asyncio.sleep(0.01)
+                    continue
                 break
             if self.router is not None \
                     and not self.router.handoff_settled():
@@ -1230,8 +1238,25 @@ class TensorEngine:
 
     # ================= stats ==============================================
 
+    def compile_count(self) -> int:
+        """Total step-program compilations (one per distinct input shape
+        per (type, method)).  The cross-silo health number: un-merged
+        slab arrivals show up here as churn — BENCH measured compile time
+        as THE dominant cost of the un-coalesced cross-silo run."""
+        total = 0
+        for step in self._step_cache.values():
+            size = getattr(step, "_cache_size", None)
+            if size is None:
+                continue
+            try:
+                total += int(size())
+            except Exception:  # noqa: BLE001 — jax-version-specific API
+                pass
+        return total
+
     def snapshot(self) -> Dict[str, Any]:
         return {
+            "compiles": self.compile_count(),
             "ticks": self.ticks_run,
             "rounds": self.rounds_run,
             "messages": self.messages_processed,

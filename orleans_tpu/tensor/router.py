@@ -62,6 +62,24 @@ def _host_args(args: Any) -> Any:
     return jax.tree_util.tree_map(np.asarray, args)
 
 
+def _merge_fragments(frags: List[Tuple[np.ndarray, Any]]
+                     ) -> Tuple[np.ndarray, Any]:
+    """Concatenate per-destination slab fragments into one (keys, args)
+    slab; scalar leaves broadcast to their fragment's row count first
+    (same discipline as engine._coalesce_host_batches)."""
+    keys = np.concatenate([k for k, _ in frags])
+
+    def cat(*leaves):
+        return np.concatenate(
+            [np.broadcast_to(np.asarray(x),
+                             (len(frags[i][0]),) + np.shape(x)[1:])
+             if np.ndim(x) == 0 else np.asarray(x)
+             for i, x in enumerate(leaves)])
+
+    args = jax.tree_util.tree_map(cat, *(a for _, a in frags))
+    return keys, args
+
+
 def _send_release(silo, target: SiloAddress, digest: Tuple[str, ...]) -> None:
     """One-way handoff_release to a peer's vector_router target."""
     from orleans_tpu.ids import GrainId, SystemTargetCodes
@@ -98,6 +116,23 @@ class VectorRouter:
         self.messages_dropped = 0
         self.slab_retry_limit = 8
         self._retry_tasks: Set[asyncio.Task] = set()
+        # -- sender-side slab aggregation ---------------------------------
+        # fragments produced within one drain cycle (one synchronous burst
+        # of the event loop) accumulate per (target, type, method) and
+        # flush as ONE merged slab, so the receiver sees a handful of
+        # stable-bucketed batch sizes instead of N compile-churning ones
+        # (the sender-side analog of engine._coalesce_host_batches; the
+        # reference batch-drains its per-destination send queues in
+        # SocketSender/SiloMessageSender rather than writing singly).
+        # Toggle (config.tensor.slab_aggregation) kept for A/B measurement
+        # — bench.py --workload cluster publishes both sides.
+        self.aggregate_slabs = bool(getattr(
+            silo.config.tensor, "slab_aggregation", True))
+        self._pending_slabs: Dict[Tuple, List[Tuple[np.ndarray, Any]]] = {}
+        self._flush_scheduled = False
+        self.slab_fragments = 0   # ship_slab calls (pre-merge)
+        self.slab_frames = 0      # one-way frames actually sent (post-merge)
+        self.slab_bounces = 0     # frames the transport bounced back to us
         # recurring-slab injector cache (see _inject_local)
         self._slab_injectors: Dict[Tuple, Any] = {}
         self._slab_key_counts: Dict[Tuple, int] = {}
@@ -313,15 +348,64 @@ class VectorRouter:
     def ship_slab(self, target: SiloAddress, type_name: str, method: str,
                   keys: np.ndarray, args: Any, hops: int = 0,
                   retries: int = 0) -> None:
-        """One (keys, args) slab → one one-way message to the peer's
-        router (the batched silo boundary; never per-message send_one).
-        ``retries`` rides the wire so the backoff budget accumulates
-        across silos — a slab ping-ponging between diverged ring views
-        still hits the drop limit instead of circulating forever."""
-        from orleans_tpu.ids import GrainId, SystemTargetCodes
-        from orleans_tpu.runtime.messaging import Category, Direction, Message
-        self.slabs_shipped += 1
+        """One (keys, args) slab fragment bound for ``target``'s router
+        (the batched silo boundary; never per-message send_one).
+
+        With aggregation on (default), fragments accumulate per
+        (target, type, method, hops, retries) and flush as ONE merged
+        frame at the end of the current drain cycle; with it off every
+        fragment is its own frame.  ``retries`` rides the wire so the
+        backoff budget accumulates across silos — a slab ping-ponging
+        between diverged ring views still hits the drop limit instead of
+        circulating forever."""
+        keys = np.asarray(keys, dtype=np.int64)
+        self.slab_fragments += 1
         self.messages_shipped += len(keys)
+        if not self.aggregate_slabs:
+            self._ship_frame(target, type_name, method, keys,
+                             _host_args(args), hops, retries)
+            return
+        bucket = (target, type_name, method, int(hops), int(retries))
+        self._pending_slabs.setdefault(bucket, []).append(
+            (keys, _host_args(args)))
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self.flush_slabs)
+
+    def flush_slabs(self) -> None:
+        """End-of-drain-cycle flush: one merged frame per pending
+        (destination, type, method) bucket."""
+        self._flush_scheduled = False
+        pending, self._pending_slabs = self._pending_slabs, {}
+        for (target, type_name, method, hops, retries), frags \
+                in pending.items():
+            if len(frags) == 1:
+                keys, args = frags[0]
+            else:
+                try:
+                    keys, args = _merge_fragments(frags)
+                except Exception:  # noqa: BLE001 — mismatched arg trees
+                    # cannot merge (should not happen within one (type,
+                    # method)); ship unmerged rather than lose payload
+                    for keys, args in frags:
+                        self._ship_frame(target, type_name, method, keys,
+                                         args, hops, retries)
+                    continue
+            self._ship_frame(target, type_name, method, keys, args,
+                             hops, retries)
+
+    def _ship_frame(self, target: SiloAddress, type_name: str, method: str,
+                    keys: np.ndarray, args: Any, hops: int,
+                    retries: int) -> None:
+        from orleans_tpu.ids import GrainId, SystemTargetCodes
+        from orleans_tpu.runtime.messaging import (
+            Category,
+            Direction,
+            Message,
+            SLAB_METHOD,
+        )
+        self.slabs_shipped += 1
+        self.slab_frames += 1
         msg = Message(
             category=Category.APPLICATION,
             direction=Direction.ONE_WAY,
@@ -330,11 +414,25 @@ class VectorRouter:
             target_silo=target,
             target_grain=GrainId.system_target(
                 int(SystemTargetCodes.VECTOR_ROUTER)),
-            method_name="inject_slab",
-            args=(type_name, method, np.asarray(keys, dtype=np.int64),
-                  _host_args(args), hops, retries),
+            method_name=SLAB_METHOD,
+            args=(type_name, method, keys, args, hops, retries),
         )
         self.silo.message_center.send_message(msg)
+
+    def reinject_bounced(self, msg, reason: str) -> None:
+        """The transport bounced a slab frame back (link down, byte/count
+        queue overflow): park the payload and retry with backoff instead
+        of dropping it — a transient link failure redelivers; only the
+        retry budget's exhaustion loses messages (and that is logged)."""
+        type_name, method, keys, args = msg.args[:4]
+        retries = int(msg.args[5]) if len(msg.args) > 5 else 0
+        self.slab_bounces += 1
+        self.silo.logger.warn(
+            f"slab frame for {type_name} to {msg.target_silo} bounced "
+            f"({reason}) — re-injecting with backoff", code=2914)
+        self._backoff_reinject(type_name, method,
+                               np.asarray(keys, dtype=np.int64), args,
+                               retries)
 
     def make_injector(self, type_name: str, method: str, keys: np.ndarray):
         """Cluster-aware steady-state injector: resolves the ownership
@@ -492,7 +590,7 @@ class VectorRouter:
                         f"handoff: evicted {evicted} {type_name} rows no "
                         f"longer owned here")
 
-    def snapshot(self) -> Dict[str, int]:
+    def snapshot(self) -> Dict[str, Any]:
         return {
             "slabs_shipped": self.slabs_shipped,
             "messages_shipped": self.messages_shipped,
@@ -500,6 +598,15 @@ class VectorRouter:
             "messages_received": self.messages_received,
             "slabs_requeued": self.slabs_requeued,
             "messages_dropped": self.messages_dropped,
+            "slab_fragments": self.slab_fragments,
+            "slab_frames": self.slab_frames,
+            "slab_bounces": self.slab_bounces,
+            # > 1 means sender aggregation is doing its job (fragments
+            # merged per destination per drain cycle) — THE health
+            # indicator for the cross-silo data plane
+            "slab_merge_ratio": round(
+                self.slab_fragments / self.slab_frames, 3)
+            if self.slab_frames else 0.0,
         }
 
 

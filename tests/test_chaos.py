@@ -392,3 +392,87 @@ def test_delayed_message_respects_partition_imposed_meanwhile():
         assert fabric.delivered == [("A", "B")]
 
     asyncio.run(main())
+
+
+@pytest.mark.chaos
+def test_chaos_transport_seams_fire_on_merged_slab_frames(run):
+    """The fault-injection plane must not silently bypass the aggregated
+    slab fast path: a transport drop rule matched on inject_slab frames
+    fires on MERGED frames (post-aggregation), the dropped payload is
+    visible as a delivery shortfall, and duplicate/delay actions reach
+    the same seam."""
+    from orleans_tpu.runtime.messaging import is_slab_message
+    from orleans_tpu.testing.cluster import TestingCluster
+    from tests.test_vector_router import RouteCounter  # noqa: F401
+
+    async def main():
+        plan = FaultPlan(seed=9)
+        plan.rule("slab_drop", "transport", "drop", count=1,
+                  match=is_slab_message)
+        interposer = Interposer(plan, FaultTrace())
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            a = cluster.silos[0]
+            interposer.attach_cluster(cluster)
+            n, parts = 400, 4
+            keys = np.arange(n, dtype=np.int64)
+            for i in range(parts):  # one burst → ONE merged frame out
+                lo, hi = i * n // parts, (i + 1) * n // parts
+                a.tensor_engine.send_batch(
+                    "RouteCounter", "add", keys[lo:hi],
+                    {"v": np.ones(hi - lo, np.float32)})
+            await cluster.quiesce_engines()
+            snap = a.vector_router.snapshot()
+            assert snap["slab_merge_ratio"] > 1.0  # aggregation was live
+            # the rule saw and dropped exactly one MERGED frame
+            assert interposer.counters["transport_dropped"] == 1
+            # the dropped frame's whole merged payload went missing —
+            # proof the seam cut the aggregated path, not a fragment
+            received = sum(s.vector_router.messages_received
+                           for s in cluster.silos)
+            shipped = a.vector_router.messages_shipped
+            assert shipped - received > n // parts
+        finally:
+            interposer.detach()
+            await cluster.stop()
+
+    run(main())
+
+
+@pytest.mark.chaos
+def test_chaos_duplicate_slab_frames_double_deliver(run):
+    """Duplicate action on the slab seam: the merged frame delivers
+    twice (at-least-once semantics surface as doubled counts) — the
+    interposer's transport actions compose with the new wire path."""
+    from orleans_tpu.runtime.messaging import is_slab_message
+    from orleans_tpu.testing.cluster import TestingCluster
+    from tests.test_vector_router import (  # noqa: F401
+        RouteCounter,
+        arena_rows,
+    )
+
+    async def main():
+        plan = FaultPlan(seed=5)
+        plan.rule("slab_dup", "transport", "duplicate", count=1,
+                  match=is_slab_message)
+        interposer = Interposer(plan, FaultTrace())
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            a = cluster.silos[0]
+            interposer.attach_cluster(cluster)
+            n = 200
+            keys = np.arange(n, dtype=np.int64)
+            a.tensor_engine.send_batch(
+                "RouteCounter", "add", keys,
+                {"v": np.ones(n, np.float32)})
+            await cluster.quiesce_engines()
+            assert interposer.counters["transport_duplicated"] == 1
+            rows = arena_rows(cluster, "RouteCounter")
+            # remote rows saw the frame twice, local rows once
+            counts = {int(r["count"]) for _, r in rows.values()}
+            assert 2 in counts, f"duplicate never delivered: {counts}"
+        finally:
+            interposer.detach()
+            await cluster.stop()
+
+    run(main())

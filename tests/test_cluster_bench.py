@@ -1,0 +1,44 @@
+"""The clustered bench tier (bench.py --workload cluster): plumbing test.
+
+Runs the bench's cluster runner in-process at tiny sizes and pins the
+published contract: cross-silo ``msgs_per_sec``, per-link ``bytes_sent``,
+``slab_merge_ratio`` > 1 under aggregation, exact delivery, and the
+receiver-compile A/B direction (aggregation ⇒ no more compiles than the
+un-aggregated run).  The full smoke invocation is
+``python bench.py --workload cluster --smoke``.
+"""
+
+import pytest
+
+import bench
+
+
+@pytest.mark.cluster
+def test_cluster_bench_tier_publishes_contract_fields(run):
+    stats = run(bench._cluster_presence(
+        n_players=1_000, n_games=10, n_ticks=4, aggregate=True,
+        warm_ticks=4))
+    # the acceptance contract: these exact fields, with a live merge
+    for key in ("msgs_per_sec", "links", "slab_merge_ratio",
+                "receiver_compiles", "bytes_sent"):
+        assert key in stats, key
+    assert stats["msgs_per_sec"] > 0
+    assert stats["slab_merge_ratio"] > 1.0, stats
+    assert stats["delivery_exact"], stats
+    assert stats["bytes_sent"] > 0
+    assert any(link["bytes_sent"] > 0 for link in stats["links"].values())
+
+
+@pytest.mark.cluster
+@pytest.mark.slow
+def test_cluster_bench_aggregation_reduces_receiver_compiles(run):
+    """The A/B the tentpole exists for: with sender aggregation the
+    receivers compile fewer step programs than with raw fragment churn."""
+    agg = run(bench._cluster_presence(
+        n_players=1_000, n_games=10, n_ticks=6, aggregate=True,
+        warm_ticks=4))
+    raw = run(bench._cluster_presence(
+        n_players=1_000, n_games=10, n_ticks=6, aggregate=False,
+        warm_ticks=4))
+    assert agg["receiver_compiles"] < raw["receiver_compiles"], (agg, raw)
+    assert raw["slab_merge_ratio"] == 1.0

@@ -631,3 +631,202 @@ def test_fence_defers_batch_with_fanout_unexpanded(run):
             await cluster.stop()
 
     run(main())
+
+
+def test_sender_aggregation_merges_fragments_per_destination(run):
+    """Tentpole: slab fragments produced within one drain cycle merge
+    into ONE frame per (destination, type, method) — delivery stays
+    exact, and the merge ratio (the health indicator) exceeds 1."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            a = cluster.silos[0]
+            n, parts = 400, 8
+            keys = np.arange(n, dtype=np.int64)
+            # 8 fragments submitted in one synchronous burst
+            for i in range(parts):
+                lo, hi = i * n // parts, (i + 1) * n // parts
+                a.tensor_engine.send_batch(
+                    "RouteCounter", "add", keys[lo:hi],
+                    {"v": np.ones(hi - lo, np.float32)})
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")
+            assert set(rows) == set(range(n))
+            assert all(int(r["count"]) == 1 for _, r in rows.values())
+            snap = a.vector_router.snapshot()
+            # fragments merged: far fewer frames than fragments
+            assert snap["slab_fragments"] > snap["slab_frames"]
+            assert snap["slab_merge_ratio"] > 1.0
+            # one merged frame per remote destination for the burst
+            remote_silos = len(cluster.silos) - 1
+            assert snap["slab_frames"] <= remote_silos
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_aggregation_toggle_off_ships_fragments_unmerged(run):
+    """The A/B toggle (config.tensor.slab_aggregation=False) bypasses
+    the merge: every fragment is its own frame, delivery still exact."""
+    from orleans_tpu.config import SiloConfig
+
+    def cfg(name):
+        c = SiloConfig(name=name)
+        c.liveness.probe_period = 0.1
+        c.liveness.probe_timeout = 0.1
+        c.liveness.num_missed_probes_limit = 2
+        c.liveness.table_refresh_timeout = 0.2
+        c.tensor.slab_aggregation = False
+        return c
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2,
+                                       config_factory=cfg).start()
+        try:
+            a = cluster.silos[0]
+            n, parts = 400, 4
+            keys = np.arange(n, dtype=np.int64)
+            for i in range(parts):
+                lo, hi = i * n // parts, (i + 1) * n // parts
+                a.tensor_engine.send_batch(
+                    "RouteCounter", "add", keys[lo:hi],
+                    {"v": np.ones(hi - lo, np.float32)})
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")
+            assert set(rows) == set(range(n))
+            assert all(int(r["count"]) == 1 for _, r in rows.values())
+            snap = a.vector_router.snapshot()
+            assert snap["slab_frames"] == snap["slab_fragments"]
+            assert snap["slab_merge_ratio"] == 1.0
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_merged_fragments_preserve_scalar_leaf_broadcast(run):
+    """Fragments whose args carry scalar leaves merge by broadcasting
+    each scalar to its fragment's row count (different scalars per
+    fragment must NOT bleed into each other's rows)."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            a, b = cluster.silos
+            n = 200
+            keys = np.arange(n, dtype=np.int64)
+            # two fragments with DIFFERENT scalar payloads, one burst
+            a.tensor_engine.send_batch(
+                "RouteCounter", "add", keys[:n // 2],
+                {"v": np.float32(1.0)})
+            a.tensor_engine.send_batch(
+                "RouteCounter", "add", keys[n // 2:],
+                {"v": np.float32(3.0)})
+            await settle(cluster)
+            rows = arena_rows(cluster, "RouteCounter")
+            assert set(rows) == set(range(n))
+            for k, (_, r) in rows.items():
+                want = 1.0 if k < n // 2 else 3.0
+                assert float(r["total"]) == want, (k, float(r["total"]))
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_bounced_slab_reinjects_with_backoff_and_redelivers(run):
+    """Satellite fix: a slab frame the transport bounces (transient link
+    failure) must NOT lose its payload — it re-enters through
+    _backoff_reinject and redelivers once the link heals."""
+    from orleans_tpu.config import SiloConfig
+
+    def patient(name):
+        # the severed window must stay a TRANSPORT event: probes ride the
+        # same link, and test-default liveness would declare the peer
+        # dead (ring change) before the first bounce even fires
+        cfg = SiloConfig(name=name)
+        cfg.liveness.probe_timeout = 5.0
+        cfg.liveness.probe_period = 5.0
+        cfg.liveness.num_missed_probes_limit = 20
+        return cfg
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2, transport="tcp",
+                                       config_factory=patient).start()
+        try:
+            a, b = cluster.silos
+            transport = a._bound_transport.transport
+            # sever the link: point the peer's endpoint at a dead port and
+            # drop the established connection, so the next send reconnects
+            # into a refused socket and the frame bounces
+            transport.register_endpoint(b.address, "127.0.0.1", 1)
+            stale = transport._senders.pop(b.address, None)
+            if stale is not None:
+                stale.cancel()
+            transport._queues.pop(b.address, None)
+            transport._queue_bytes.pop(b.address, None)
+            n = 300
+            keys = np.arange(n, dtype=np.int64)
+            a.tensor_engine.send_batch(
+                "RouteCounter", "add", keys,
+                {"v": np.ones(n, np.float32)})
+            # let the frame bounce + park at least once
+            for _ in range(100):
+                await asyncio.sleep(0.02)
+                if a.vector_router.slab_bounces > 0:
+                    break
+            assert a.vector_router.slab_bounces > 0, \
+                "transport never routed the bounce through the router"
+            # heal the link: the parked slab's backoff retry must deliver
+            transport.register_endpoint(b.address, b.address.host,
+                                        b.address.port)
+            deadline = asyncio.get_running_loop().time() + 10
+            while True:
+                await settle(cluster)
+                rows = arena_rows(cluster, "RouteCounter")
+                if set(rows) == set(range(n)) and \
+                        all(int(r["count"]) == 1 for _, r in rows.values()):
+                    break
+                assert asyncio.get_running_loop().time() < deadline, \
+                    f"only {len(rows)} rows redelivered"
+                await asyncio.sleep(0.05)
+            assert a.vector_router.messages_dropped == 0
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_data_plane_telemetry_publication(run):
+    """Router slab counters + per-link transport frames/bytes mirror into
+    the telemetry manager (snapshot() AND telemetry surfacing)."""
+    from orleans_tpu import telemetry
+    from orleans_tpu.telemetry import InMemoryTelemetryConsumer
+
+    async def main():
+        consumer = InMemoryTelemetryConsumer()
+        telemetry.default_manager.add(consumer)
+        cluster = await TestingCluster(n_silos=2, transport="tcp").start()
+        try:
+            a = cluster.silos[0]
+            n = 200
+            a.tensor_engine.send_batch(
+                "RouteCounter", "add", np.arange(n, dtype=np.int64),
+                {"v": np.ones(n, np.float32)})
+            await settle(cluster)
+            for s in cluster.silos:
+                s.publish_data_plane_telemetry()
+            names = {m[0] for m in consumer.metrics}
+            assert "router.slab_merge_ratio" in names
+            assert "router.slabs_shipped" in names
+            assert "transport.link.bytes_sent" in names
+            sent = [m for m in consumer.metrics
+                    if m[0] == "transport.link.bytes_sent"]
+            assert any(v > 0 for _, v, _, _ in sent)
+        finally:
+            telemetry.default_manager.remove(consumer)
+            await cluster.stop()
+
+    run(main())
