@@ -309,6 +309,272 @@ async def _cluster_presence(n_players: int, n_games: int, n_ticks: int,
         await cluster.stop()
 
 
+_DEGRADED_TYPES: dict = {}
+
+
+def _degraded_grains():
+    """Register the degraded-tier load grain (idempotent; lazy so jax and
+    the grain registry stay out of --help).  Random placement: grains
+    must be reachable-by-address even when their ring-hash directory
+    owner is the partitioned silo."""
+    if _DEGRADED_TYPES:
+        return _DEGRADED_TYPES["iface"]
+    from orleans_tpu import Grain, grain_interface
+    from orleans_tpu.core.grain import grain_class, placement
+    from orleans_tpu.placement import RandomPlacement
+
+    @grain_interface
+    class IDegradedWork:
+        async def work(self, delay: float) -> int: ...
+
+    @placement(RandomPlacement())
+    @grain_class
+    class DegradedWorkGrain(Grain, IDegradedWork):
+        async def work(self, delay: float) -> int:
+            if delay > 0:
+                await asyncio.sleep(delay)
+            return 1
+
+    _DEGRADED_TYPES["iface"] = IDegradedWork
+    return IDegradedWork
+
+
+def _degraded_config_factory(backoff_enabled: bool):
+    from orleans_tpu.config import SiloConfig
+
+    def cfg(name: str) -> SiloConfig:
+        c = SiloConfig(name=name)
+        c.tensor.enabled = False  # host-path tier: the per-message call
+        # paths (dispatcher, resend machinery, breakers) are under test
+        c.liveness.probe_period = 0.1
+        c.liveness.probe_timeout = 0.1
+        c.liveness.num_missed_probes_limit = 2
+        c.liveness.table_refresh_timeout = 0.2
+        c.liveness.iam_alive_table_publish = 0.5
+        # suspicion happens (feeds breakers) but death is never declared:
+        # the scenario is partition + HEAL with full recovery, not a kill
+        c.liveness.num_votes_for_death = 99
+        c.messaging.response_timeout = 0.8
+        c.messaging.max_resend_count = 3
+        c.resilience.backoff_enabled = backoff_enabled
+        c.resilience.backoff_base = 0.01
+        c.resilience.backoff_cap = 0.08
+        c.resilience.retry_budget_capacity = 16.0
+        c.resilience.retry_budget_fill = 0.1
+        c.resilience.breaker_failure_threshold = 3
+        c.resilience.breaker_reset_timeout = 0.4
+        c.resilience.shed_queue_soft = 32
+        c.resilience.shed_queue_hard = 128
+        c.resilience.shed_ttl_reference = 0.8
+        c.resilience.shed_sample_period = 0.005
+        return c
+
+    return cfg
+
+
+async def _degraded_scenario(smoke: bool, backoff_enabled: bool,
+                             seed: int = 20260804) -> dict:
+    """One run of the overload-containment scenario: closed-loop load
+    through three phases — pre-fault, fault (scripted partition of one
+    silo + an overload burst at the survivors), post-heal — measuring
+    goodput, shed ratio, p99, breaker transitions (from the FaultTrace),
+    retry amplification, and dead-letter accounting."""
+    import numpy as np
+
+    from orleans_tpu.chaos.cluster import ChaosCluster
+    from orleans_tpu.chaos.invariants import (
+        InvariantViolation,
+        check_dead_letter_accounting,
+    )
+    from orleans_tpu.chaos.plan import FaultPlan
+    from orleans_tpu.runtime.messaging import RejectionType
+    from orleans_tpu.runtime.runtime_client import (
+        RejectionError,
+        RequestTimeoutError,
+    )
+
+    iface = _degraded_grains()
+    pre_w, fault_w, post_w = (1.2, 1.6, 1.2) if smoke else (4.0, 5.0, 4.0)
+    recover_wait = 1.0
+    # burst is sized to push ONE survivor silo's mailbox depth past
+    # shed_queue_hard briefly (full shed), then drain within a fraction
+    # of the fault window — graceful degradation, not a blackout
+    n_grains, workers_per_grain, burst = (16, 2, 110) if smoke \
+        else (32, 3, 160)
+
+    plan = FaultPlan(seed=seed)
+    plan.partition(0.0, [["silo1", "silo2"], ["silo3"]])
+    plan.heal(fault_w)
+    cluster = await ChaosCluster(
+        plan=plan, n_silos=3,
+        config_factory=_degraded_config_factory(backoff_enabled)).start()
+    loop = asyncio.get_event_loop()
+    try:
+        await cluster.wait_for_liveness_convergence()
+        factory = cluster.attach_client(0)
+        refs = [factory.get_grain(iface, i) for i in range(n_grains)]
+        await asyncio.gather(*(r.work(0.0) for r in refs))  # activate
+
+        async def drive(duration: float) -> dict:
+            """Closed-loop load window over every grain; returns goodput
+            + failure breakdown + latency percentiles of successes."""
+            stats = {"ok": 0, "shed": 0, "transient": 0, "timeout": 0,
+                     "expired": 0, "other": 0}
+            lat: list = []
+            stop = loop.time() + duration
+
+            async def worker(ref):
+                while loop.time() < stop:
+                    t0 = loop.time()
+                    try:
+                        await ref.work(0.002)
+                        stats["ok"] += 1
+                        lat.append(loop.time() - t0)
+                    except RequestTimeoutError:
+                        stats["timeout"] += 1
+                    except RejectionError as exc:
+                        if exc.rejection == RejectionType.OVERLOADED:
+                            stats["shed"] += 1
+                        elif exc.rejection == RejectionType.TRANSIENT:
+                            stats["transient"] += 1
+                        elif exc.rejection == RejectionType.EXPIRED:
+                            stats["expired"] += 1
+                        else:
+                            stats["other"] += 1
+                    except Exception:  # noqa: BLE001 — tallied, not fatal
+                        stats["other"] += 1
+
+            await asyncio.gather(*(worker(r) for r in refs
+                                   for _ in range(workers_per_grain)))
+            offered = sum(v for k, v in stats.items())
+            d = np.asarray(lat) if lat else np.asarray([0.0])
+            return {
+                "goodput_per_sec": round(stats["ok"] / duration, 1),
+                "offered": offered,
+                "shed_ratio": round(stats["shed"] / max(1, offered), 4),
+                "p50_s": round(float(np.percentile(d, 50)), 4),
+                "p99_s": round(float(np.percentile(d, 99)), 4),
+                **stats,
+            }
+
+        def resend_totals() -> tuple:
+            sent = sum(s.metrics.requests_sent for s in cluster.silos)
+            resent = sum(s.metrics.requests_resent for s in cluster.silos)
+            return sent, resent
+
+        pre = await drive(pre_w)
+
+        # fault phase: scripted partition (plan → FaultTrace) + an
+        # overload burst hammering a few survivor-hosted grains so the
+        # shed controller engages alongside the breakers
+        plan_task = asyncio.ensure_future(cluster.run_plan())
+        await asyncio.sleep(0.05)  # partition step is at t=0
+        # concentrate the burst on ONE survivor silo so its silo-wide
+        # depth definitely crosses the shed watermarks
+        hot = [r for r in refs
+               if cluster.find_silo_hosting(r.grain_id)
+               is cluster.silos[0]][:2] or \
+              [r for r in refs
+               if cluster.find_silo_hosting(r.grain_id)
+               is cluster.silos[1]][:2]
+        sent0, resent0 = resend_totals()
+        burst_futs = [asyncio.ensure_future(r.work(0.01))
+                      for _ in range(burst) for r in hot]
+        fault = await drive(fault_w - 0.1)
+        await asyncio.gather(*burst_futs, return_exceptions=True)
+        await plan_task  # heal step has fired
+        sent1, resent1 = resend_totals()
+
+        # recovery: breakers close (probes + first successes), shed level
+        # decays with the queues
+        await asyncio.sleep(recover_wait)
+        post = await drive(post_w)
+
+        breaker_events = [
+            {"silo": e.detail.get("silo"), "target": e.detail.get("target"),
+             "to": e.action, "from": e.detail.get("from"),
+             "reason": e.detail.get("reason")}
+            for e in cluster.trace.events if e.seam == "breaker"]
+        try:
+            accounting = check_dead_letter_accounting(cluster)
+        except InvariantViolation as exc:
+            accounting = {"ok": False, "error": str(exc)}
+        recovery_ratio = (post["goodput_per_sec"]
+                          / max(1e-9, pre["goodput_per_sec"]))
+        fault_sent = max(1, sent1 - sent0)
+        # resends spring from retryable failures (transient/timeout), so
+        # the per-FAILED-call ratio is the clean amplification number —
+        # the per-request one dilutes it with healthy survivor traffic
+        fault_failed = max(1, fault["transient"] + fault["timeout"])
+        return {
+            "backoff_and_budget": backoff_enabled,
+            "seed": seed,
+            "phases": {"pre": pre, "fault": fault, "post_heal": post},
+            "recovery_ratio": round(recovery_ratio, 3),
+            "recovered_within_10pct": recovery_ratio >= 0.9,
+            "retry_amplification_fault_phase": round(
+                (resent1 - resent0) / fault_sent, 4),
+            "resends_per_failed_call": round(
+                (resent1 - resent0) / fault_failed, 4),
+            "fault_phase_requests": fault_sent,
+            "fault_phase_failed_calls": fault_failed,
+            "fault_phase_resends": resent1 - resent0,
+            "breaker_transitions": breaker_events,
+            "breaker_opened": any(e["to"] == "open"
+                                  for e in breaker_events),
+            "breaker_closed_after_heal": any(e["to"] == "closed"
+                                             for e in breaker_events),
+            "shed_total": sum(s.metrics.requests_shed
+                              for s in cluster.silos),
+            "retries_denied": sum(s.metrics.retries_denied
+                                  for s in cluster.silos),
+            "breaker_fast_fails": sum(s.metrics.breaker_fast_fails
+                                      for s in cluster.silos),
+            "dead_letters": {s.name: s.dead_letters.snapshot()
+                             for s in cluster.silos},
+            "dead_letter_accounting": accounting,
+            "plan": plan.describe(),
+        }
+    finally:
+        await cluster.stop()
+
+
+async def _degraded_tier(smoke: bool) -> dict:
+    """The degraded bench tier: the containment scenario WITH the
+    backoff+budget discipline, plus the A/B against the disabled
+    configuration — the retry-amplification number is the one that
+    regresses if immediate resends ever creep back in."""
+    resilient = await _degraded_scenario(smoke, backoff_enabled=True)
+    baseline = await _degraded_scenario(smoke, backoff_enabled=False)
+    amp_on = resilient["resends_per_failed_call"]
+    amp_off = baseline["resends_per_failed_call"]
+    return {
+        "metric": "degraded_goodput_per_sec",
+        "value": resilient["phases"]["fault"]["goodput_per_sec"],
+        "unit": "req/s",
+        "engine": "3-silo ChaosCluster (host path), scripted partition + "
+                  "overload burst + heal; adaptive shed + per-destination "
+                  "breakers + jittered retry budgets active",
+        **resilient,
+        "ab_backoff_disabled": {
+            "retry_amplification_fault_phase":
+                baseline["retry_amplification_fault_phase"],
+            "resends_per_failed_call": amp_off,
+            "fault_phase_requests": baseline["fault_phase_requests"],
+            "fault_phase_failed_calls": baseline["fault_phase_failed_calls"],
+            "fault_phase_resends": baseline["fault_phase_resends"],
+            "retries_denied": baseline["retries_denied"],
+            "phases": baseline["phases"],
+            "recovery_ratio": baseline["recovery_ratio"],
+        },
+        # headline A/B: resends each failing call costs the cluster —
+        # immediate-resend baseline vs backoff+budget containment
+        "amplification_ab": {"backoff_and_budget": amp_on,
+                             "disabled": amp_off},
+        "amplification_reduction_x": round(amp_off / max(amp_on, 1e-9), 2),
+    }
+
+
 async def _helloworld_bench(n_grains: int = 2000, n_rounds: int = 5,
                             latency_calls: int = 2000) -> dict:
     """The PR1 config (reference: Samples/HelloWorld — one silo, RPC
@@ -512,7 +778,8 @@ def main() -> None:
                         help="small sizes for a quick correctness pass")
     parser.add_argument("--workload",
                         choices=("presence", "chirper", "gpstracker",
-                                 "twitter", "helloworld", "cluster"),
+                                 "twitter", "helloworld", "cluster",
+                                 "degraded"),
                         default="presence")
     parser.add_argument("--no-slab-aggregation", action="store_true",
                         help="cluster workload: disable the sender-side "
@@ -909,11 +1176,21 @@ def main() -> None:
                 out["no_aggregation"] = ab
         return out
 
+    async def run_degraded() -> dict:
+        return await _degraded_tier(args.smoke)
+
     runners = {"presence": run, "chirper": run_chirper,
                "gpstracker": run_gps, "twitter": run_twitter,
-               "helloworld": run_hello, "cluster": run_cluster}
+               "helloworld": run_hello, "cluster": run_cluster,
+               "degraded": run_degraded}
     result = asyncio.run(runners[args.workload]())
     print(json.dumps(result))
+    if args.workload == "degraded" and args.smoke:
+        # CI artifact alongside CHAOS_SMOKE.json: the containment
+        # scenario's goodput/shed/breaker/amplification evidence (the
+        # smoke tier only — a full-size run must not clobber it)
+        with open("DEGRADED_SMOKE.json", "w") as f:
+            f.write(json.dumps(result, indent=1) + "\n")
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ from orleans_tpu.chaos.invariants import (
     InvariantViolation,
     check_arena_conservation,
     check_at_least_once,
+    check_dead_letter_accounting,
     check_membership_convergence,
     check_single_activation,
     wait_for_at_least_once,
@@ -43,6 +44,7 @@ __all__ = [
     "PlanStep",
     "check_arena_conservation",
     "check_at_least_once",
+    "check_dead_letter_accounting",
     "check_membership_convergence",
     "check_single_activation",
     "wait_for_at_least_once",

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 from orleans_tpu.chaos.interposer import Interposer
 from orleans_tpu.chaos.plan import FaultPlan, FaultTrace
 from orleans_tpu.chaos.invariants import (
+    check_dead_letter_accounting,
     check_membership_convergence,
     check_single_activation,
 )
@@ -174,13 +175,15 @@ class ChaosCluster(TestingCluster):
 
     async def check_invariants(self, timeout: float = 10.0
                                ) -> Dict[str, Any]:
-        """The always-applicable pair: membership convergence +
-        single-activation.  Arena conservation and stream at-least-once
+        """The always-applicable set: membership convergence,
+        single-activation, and dead-letter accounting (nothing vanishes
+        without a record).  Arena conservation and stream at-least-once
         need scenario knowledge (expected keys / produced events) — call
         those checkers directly with it."""
         report = {"membership_convergence":
                   await check_membership_convergence(self, timeout=timeout)}
         report["single_activation"] = check_single_activation(self)
+        report["dead_letter_accounting"] = check_dead_letter_accounting(self)
         return report
 
     def chaos_snapshot(self) -> Dict[str, Any]:

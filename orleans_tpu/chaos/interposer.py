@@ -49,6 +49,9 @@ class Interposer:
             r.name: _RuleState(r, plan.seed) for r in plan.rules}
         self._originals: List[Tuple[Any, str, Any]] = []
         self._wrapped: set = set()
+        # (listener list, callback) pairs for runtime-event mirrors
+        # (breaker transitions, dead-letter records) — removed on detach
+        self._listeners: List[Tuple[list, Any]] = []
         # scripted topology faults
         self.partition_groups: Optional[List[set]] = None
         self.stalled: set = set()
@@ -134,6 +137,10 @@ class Interposer:
             setattr(obj, attr, original)
         self._originals.clear()
         self._wrapped.clear()
+        for listeners, cb in self._listeners:
+            if cb in listeners:
+                listeners.remove(cb)
+        self._listeners.clear()
 
     def attach_cluster(self, cluster) -> None:
         """Wire every seam of a TestingCluster-shaped object."""
@@ -155,6 +162,41 @@ class Interposer:
         inner = getattr(transport, "transport", None)
         if inner is not None and hasattr(inner, "send"):  # TcpBoundTransport
             self.attach_tcp_transport(inner)
+        self.attach_resilience(silo)
+
+    def attach_resilience(self, silo) -> None:
+        """Mirror the containment plane's runtime events into the trace:
+        circuit-breaker transitions and dead-letter records.  Recorded
+        with ``sig=None`` — like unpinned rules, their exact counts ride
+        timing-dependent traffic, so they are evidence in the trace but
+        excluded from the reproducibility signature.  Idempotent."""
+        board = getattr(silo, "breakers", None)
+        if board is not None \
+                and ("breaker", id(board)) not in self._wrapped:
+            self._wrapped.add(("breaker", id(board)))
+
+            def on_breaker(target, old, new, reason, _name=silo.name):
+                self.trace.record(
+                    "runtime", f"breaker.{_name}", "breaker", new,
+                    {"silo": _name, "target": str(target), "from": old,
+                     "reason": reason})
+
+            board.on_transition.append(on_breaker)
+            self._listeners.append((board.on_transition, on_breaker))
+        ring = getattr(silo, "dead_letters", None)
+        if ring is not None \
+                and ("dead_letters", id(ring)) not in self._wrapped:
+            self._wrapped.add(("dead_letters", id(ring)))
+
+            def on_dead_letter(entry, _name=silo.name):
+                self.trace.record(
+                    "runtime", f"dead_letter.{_name}", "dead_letter",
+                    entry["reason"],
+                    {"silo": _name, "detail": entry["detail"],
+                     "method": entry["method"]})
+
+            ring.on_record.append(on_dead_letter)
+            self._listeners.append((ring.on_record, on_dead_letter))
 
     # ---- transport seam ---------------------------------------------------
 

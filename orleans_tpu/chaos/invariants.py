@@ -123,6 +123,59 @@ async def check_arena_conservation(cluster, type_name: str,
     return {"ok": True, "type": type_name, "population": len(seen)}
 
 
+def check_dead_letter_accounting(cluster) -> Dict[str, Any]:
+    """Nothing vanishes without a dead-letter record.
+
+    Every terminal drop site increments BOTH a metrics counter and a
+    reason-coded dead-letter record; this checker asserts the two ledgers
+    agree on every ACTIVE silo (a future drop path that bypasses the
+    accounting shows up as a mismatch), and that the ring's own totals
+    are internally consistent."""
+    from orleans_tpu.resilience import (
+        REASON_BREAKER_OPEN,
+        REASON_EXPIRED,
+        REASON_MAILBOX_OVERFLOW,
+        REASON_RETRY_BUDGET,
+        REASON_SHED,
+        REASON_UNDELIVERABLE,
+    )
+    mismatches: Dict[str, Dict[str, Any]] = {}
+    totals = {"dead_letters": 0, "silos": 0}
+    for silo in _active_silos(cluster):
+        ring = silo.dead_letters
+        m = silo.metrics
+        pairs = {
+            REASON_EXPIRED: m.expired_dropped,
+            REASON_SHED: m.requests_shed,
+            REASON_MAILBOX_OVERFLOW: m.mailbox_overflows,
+            REASON_BREAKER_OPEN: m.breaker_fast_fails,
+            REASON_RETRY_BUDGET: m.retries_denied,
+            REASON_UNDELIVERABLE: m.undeliverable_dropped,
+        }
+        bad = {reason: {"metric": count, "ring": ring.count(reason)}
+               for reason, count in pairs.items()
+               if count != ring.count(reason)}
+        # retained is bounded by both ledgers (== in steady state; < only
+        # right after a live-reload capacity increase)
+        if ring.total != sum(ring.by_reason.values()) \
+                or len(ring.entries) > min(ring.total, ring.capacity):
+            bad["_ring"] = {"total": ring.total,
+                            "by_reason_sum": sum(ring.by_reason.values()),
+                            "retained": len(ring.entries)}
+        unknown = set(ring.by_reason) - set(pairs)
+        if unknown:
+            bad["_unknown_reasons"] = sorted(unknown)
+        if bad:
+            mismatches[silo.name] = bad
+        totals["dead_letters"] += ring.total
+        totals["silos"] += 1
+    if mismatches:
+        raise InvariantViolation(
+            f"dead-letter accounting mismatch (drops without records, or "
+            f"records without counters): {mismatches}")
+    return {"ok": True, **totals}
+
+
 def check_at_least_once(produced: Iterable, delivered: Iterable,
                         allowed_missing: int = 0) -> Dict[str, Any]:
     """Set/multiset form of the at-least-once contract: every produced

@@ -4,7 +4,7 @@
 ``bench.py --chaos-smoke``) runs the canonical short scenario on a
 3-silo ChaosCluster — storage flakes + injected CAS conflicts + one
 NaN-poisoned slab under live traffic, then partition → heal → hard-kill
-— checks all four invariants, and emits a JSON report alongside the
+— checks all five invariants, and emits a JSON report alongside the
 BENCH_*.json artifacts.  The report carries the (seed, plan) pair and
 the deterministic trace signature, so a failing run is replayable
 exactly; ``--repeat 2`` re-runs the plan and asserts the signatures are
@@ -128,7 +128,7 @@ def smoke_plan(seed: int):
 
 
 async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
-    """One full smoke run; returns the report dict (``ok`` = all four
+    """One full smoke run; returns the report dict (``ok`` = all five
     invariants held).  Invariant violations are reported, not raised —
     the caller (CLI / bench step) decides the exit code."""
     import numpy as np
@@ -137,6 +137,7 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
     from orleans_tpu.chaos.invariants import (
         InvariantViolation,
         check_arena_conservation,
+        check_dead_letter_accounting,
         check_single_activation,
         check_membership_convergence,
         wait_for_at_least_once,
@@ -209,7 +210,7 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
         live_engine.send_batch("ChaosCounter", "poke", keys,
                                {"v": np.zeros(64, np.float32)})
 
-        # -- the four invariants ---------------------------------------
+        # -- the five invariants ---------------------------------------
         def _run(name, result):
             invariants[name] = result
 
@@ -236,11 +237,16 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
                      timeout=15.0))
         except InvariantViolation as exc:
             _run("stream_at_least_once", {"ok": False, "error": str(exc)})
+        try:
+            _run("dead_letter_accounting",
+                 check_dead_letter_accounting(cluster))
+        except InvariantViolation as exc:
+            _run("dead_letter_accounting", {"ok": False, "error": str(exc)})
     finally:
         await cluster.stop()
 
     ok = all(v.get("ok") for v in invariants.values()) \
-        and len(invariants) == 4
+        and len(invariants) == 5
     return {
         "metric": "chaos_smoke",
         "ok": ok,

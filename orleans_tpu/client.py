@@ -50,15 +50,56 @@ from orleans_tpu.runtime.runtime_client import (
 class GrainClient:
     """(reference: GrainClient.Initialize + OutsideRuntimeClient)"""
 
-    def __init__(self, response_timeout: float = 30.0) -> None:
+    def __init__(self, response_timeout: float = 30.0,
+                 control_timeout: float = 10.0,
+                 max_resend_count: int = 3,
+                 backoff_enabled: bool = True,
+                 backoff_base: float = 0.02, backoff_cap: float = 1.0,
+                 retry_budget_capacity: float = 32.0,
+                 retry_budget_fill: float = 0.1) -> None:
+        from orleans_tpu.resilience import BackoffPolicy, RetryBudget
         self.client_id = GrainId.client(uuid.uuid4())
         self.response_timeout = response_timeout
+        # gateway control-frame reply wait (hoisted from the old
+        # hard-coded 10.0 so tests/chaos plans can tighten it)
+        self.control_timeout = control_timeout
         self.callbacks: Dict[int, CallbackData] = {}
         self.factory = GrainFactory()
         self._gateways: List[Any] = []  # Gateway handles (round-robin pool)
         self._gw_cycle = None
         self._observers: Dict[GrainId, Any] = {}
         self._connected = False
+        # transient-resend containment, parity with the silo side
+        # (runtime_client.py): bounded resends through gateway FAILOVER
+        # with full-jitter backoff and a token-bucket retry budget —
+        # the client edge must not be a retry-storm source either
+        self.max_resend_count = max_resend_count
+        self.backoff_enabled = backoff_enabled
+        # seeded per client identity: concurrent clients bounced by the
+        # same fault must not draw identical "jitter"
+        import zlib
+        self.backoff = BackoffPolicy(
+            base=backoff_base, cap=backoff_cap,
+            seed=zlib.crc32(str(self.client_id).encode()))
+        self.retry_budget = RetryBudget(capacity=retry_budget_capacity,
+                                        fill_rate=retry_budget_fill,
+                                        enabled=backoff_enabled)
+        self.requests_resent = 0
+        self.retries_denied = 0
+
+    @classmethod
+    def from_config(cls, config) -> "GrainClient":
+        """Build from a ``ClientConfig`` (orleans_tpu.config) — the knobs
+        there and this constructor's kwargs are the same surface."""
+        return cls(
+            response_timeout=config.response_timeout,
+            control_timeout=config.control_timeout,
+            max_resend_count=config.max_resend_count,
+            backoff_enabled=config.backoff_enabled,
+            backoff_base=config.backoff_base,
+            backoff_cap=config.backoff_cap,
+            retry_budget_capacity=config.retry_budget_capacity,
+            retry_budget_fill=config.retry_budget_fill)
 
     # ================= connection =========================================
 
@@ -71,11 +112,13 @@ class GrainClient:
         for gw in gateways:
             if isinstance(gw, (tuple, list)):
                 handle = await TcpGatewayHandle.open(
-                    gw[0], int(gw[1]), self.client_id, self._on_message)
+                    gw[0], int(gw[1]), self.client_id, self._on_message,
+                    control_timeout=self.control_timeout)
             elif isinstance(gw, str):
                 host, _, port = gw.rpartition(":")
                 handle = await TcpGatewayHandle.open(
-                    host, int(port), self.client_id, self._on_message)
+                    host, int(port), self.client_id, self._on_message,
+                    control_timeout=self.control_timeout)
             else:
                 gateway = gw.system_targets.get("gateway")
                 if gateway is None:
@@ -129,6 +172,7 @@ class GrainClient:
                      method: MethodInfo, args, timeout: Optional[float] = None
                      ) -> Optional[asyncio.Future]:
         timeout = timeout if timeout is not None else self.response_timeout
+        self.retry_budget.on_request()
         msg = Message(
             category=Category.APPLICATION,
             direction=Direction.ONE_WAY if method.one_way else Direction.REQUEST,
@@ -192,9 +236,33 @@ class GrainClient:
         asyncio.get_running_loop().create_task(self._invoke_observer(msg))
 
     def _receive_response(self, msg: Message) -> None:
-        cb = self.callbacks.pop(msg.id, None)
+        cb = self.callbacks.get(msg.id)
         if cb is None or cb.future.done():
+            self.callbacks.pop(msg.id, None)
             return
+        if (msg.response_kind == ResponseKind.REJECTION
+                and msg.rejection_type == RejectionType.TRANSIENT
+                and cb.resend_count < self.max_resend_count
+                and not cb.message.is_expired()):
+            # parity with the silo's resend machinery: bounded transient
+            # resends through gateway FAILOVER (the round-robin pool skips
+            # dead gateways) with backoff, instead of the old instant
+            # RejectionError on the first TRANSIENT
+            if self.retry_budget.try_spend():
+                cb.resend_count += 1
+                cb.message.resend_count = cb.resend_count
+                self.requests_resent += 1
+                delay = (self.backoff.delay(cb.resend_count)
+                         if self.backoff_enabled else 0.0)
+                if delay <= 0.0:
+                    self._resubmit(msg.id, cb.resend_count)
+                else:
+                    asyncio.get_running_loop().call_later(
+                        delay, self._resubmit, msg.id, cb.resend_count)
+                return
+            self.retries_denied += 1
+            msg.rejection_info += "; client retry budget exhausted"
+        self.callbacks.pop(msg.id, None)
         if cb.timeout_handle is not None:
             cb.timeout_handle.cancel()
         if msg.response_kind == ResponseKind.REJECTION:
@@ -207,6 +275,29 @@ class GrainClient:
             cb.future.set_exception(exc)
         else:
             cb.future.set_result(msg.result)
+
+    def _resubmit(self, message_id: int, expected_resend: int) -> None:
+        """Resend a transiently rejected request through the (possibly
+        different) next live gateway.  The callback may have resolved or
+        timed out during the backoff — only a still-pending one at the
+        same resend generation goes back out; with no live gateway left
+        the call fails now rather than idling out its timeout."""
+        cb = self.callbacks.get(message_id)
+        if cb is None or cb.future.done() \
+                or cb.resend_count != expected_resend:
+            return
+        if cb.message.is_expired():
+            return  # the timeout timer surfaces the failure
+        try:
+            self._next_gateway().submit(cb.message)
+        except (RuntimeError, ConnectionError) as exc:
+            self.callbacks.pop(message_id, None)
+            if cb.timeout_handle is not None:
+                cb.timeout_handle.cancel()
+            if not cb.future.done():
+                cb.future.set_exception(RejectionError(
+                    RejectionType.UNRECOVERABLE,
+                    f"resend failed: {exc}"))
 
     async def _invoke_observer(self, msg: Message) -> None:
         obj = self._observers.get(msg.target_grain)
@@ -258,10 +349,11 @@ class TcpGatewayHandle:
     disconnect_client."""
 
     def __init__(self, host: str, port: int, client_id: GrainId,
-                 on_message) -> None:
+                 on_message, control_timeout: float = 10.0) -> None:
         self.host, self.port = host, port
         self.client_id = client_id
         self._on_message = on_message
+        self.control_timeout = control_timeout
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pump: Optional[asyncio.Task] = None
@@ -273,8 +365,10 @@ class TcpGatewayHandle:
 
     @classmethod
     async def open(cls, host: str, port: int, client_id: GrainId,
-                   on_message) -> "TcpGatewayHandle":
-        self = cls(host, port, client_id, on_message)
+                   on_message,
+                   control_timeout: float = 10.0) -> "TcpGatewayHandle":
+        self = cls(host, port, client_id, on_message,
+                   control_timeout=control_timeout)
         self._reader, self._writer = await asyncio.open_connection(host, port)
         self._control_waiters = asyncio.Queue()
         write_gateway_frame(self._writer, {"op": "hello",
@@ -365,7 +459,7 @@ class TcpGatewayHandle:
         await self._control_waiters.put(waiter)
         write_gateway_frame(self._writer, record)
         await self._writer.drain()
-        return await asyncio.wait_for(waiter, timeout=10.0)
+        return await asyncio.wait_for(waiter, timeout=self.control_timeout)
 
     async def register_observer(self, client_id: GrainId,
                                 observer_id: GrainId) -> None:

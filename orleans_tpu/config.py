@@ -27,6 +27,9 @@ class LivenessConfig:
     num_probed_silos: int = 3             # NumProbedSilos
     num_votes_for_death: int = 2          # NumVotesForDeathDeclaration
     probe_period: float = 1.0
+    # per-peer gossip RPC timeout (also bounds the shutdown goodbye wait);
+    # hoisted from the hard-coded 1.0 so chaos plans/tests can tighten it
+    gossip_timeout: float = 1.0
 
 
 @dataclass
@@ -50,6 +53,45 @@ class MessagingConfig:
     max_resend_count: int = 3             # MaxResendCount
     deadlock_detection: bool = True       # PerformDeadlockDetection
     max_enqueued_requests: int = 5000     # LimitManager MaxEnqueuedRequests
+
+
+@dataclass
+class ResilienceConfig:
+    """Overload containment & failure isolation knobs (orleans_tpu/
+    resilience.py + limits.ShedController).  No single reference analog —
+    the reference had binary LoadShedding and immediate transient resends;
+    this is the SRE retry-budget / breaker / adaptive-shed discipline
+    layered over the same call paths."""
+
+    # transient-resend backoff (exponential, full jitter); disabling is
+    # the A/B baseline bench.py --workload degraded measures against
+    backoff_enabled: bool = True
+    backoff_base: float = 0.02
+    backoff_cap: float = 1.0
+    # token-bucket retry budget per silo: first attempts deposit
+    # retry_budget_fill tokens, each resend withdraws 1.0 — caps
+    # cluster-wide retry amplification at ~fill rate in steady state
+    retry_budget_capacity: float = 64.0
+    retry_budget_fill: float = 0.1
+    # per-destination circuit breakers (consulted before enqueue for
+    # APPLICATION traffic; system/membership traffic always flows)
+    breaker_enabled: bool = True
+    breaker_failure_threshold: int = 5
+    breaker_reset_timeout: float = 1.0
+    breaker_half_open_probes: int = 1
+    # adaptive admission control (limits.ShedController): shed level rises
+    # linearly from queue_soft to queue_hard pending turns; at level L a
+    # request sheds when its remaining TTL < L * shed_ttl_reference
+    # (read-only requests at 2x the threshold — lower priority)
+    shed_enabled: bool = True
+    shed_queue_soft: int = 1000
+    shed_queue_hard: int = 5000
+    shed_ttl_reference: float = 30.0
+    shed_sample_period: float = 0.02
+    shed_stall_level: float = 0.5
+    shed_stall_window: float = 2.0
+    # bounded dead-letter ring (counters are exact and unbounded)
+    dead_letter_capacity: int = 512
 
 
 @dataclass
@@ -169,6 +211,7 @@ class SiloConfig:
     directory: DirectoryConfig = field(default_factory=DirectoryConfig)
     collection: CollectionConfig = field(default_factory=CollectionConfig)
     messaging: MessagingConfig = field(default_factory=MessagingConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     reminders: RemindersConfig = field(default_factory=RemindersConfig)
     tensor: TensorEngineConfig = field(default_factory=TensorEngineConfig)
     extra: Dict[str, Any] = field(default_factory=dict)
@@ -196,3 +239,15 @@ class ClientConfig:
 
     response_timeout: float = 30.0
     gateway_list: list = field(default_factory=list)
+    # gateway control-frame reply wait (handshake-adjacent ops: observer
+    # registration etc.); hoisted from the hard-coded 10.0 in the TCP
+    # gateway handle so tests/chaos plans can tighten it
+    control_timeout: float = 10.0
+    # client-side transient-resend containment (parity with the silo's
+    # ResilienceConfig backoff/budget knobs)
+    max_resend_count: int = 3
+    backoff_enabled: bool = True
+    backoff_base: float = 0.02
+    backoff_cap: float = 1.0
+    retry_budget_capacity: float = 32.0
+    retry_budget_fill: float = 0.1

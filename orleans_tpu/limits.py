@@ -13,8 +13,9 @@ connection limits; the tensor engine consults it for per-tick batch caps.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 
 @dataclass(frozen=True)
@@ -108,3 +109,120 @@ class LoadSheddingGate:
             self.shed_count += 1
             return False
         return True
+
+
+class ShedController:
+    """Adaptive admission control: the graded replacement for the binary
+    OVERLOADED gate (reference: LoadShedding was a single on/off CPU
+    threshold; this is the CoDel-style graded discipline the SRE
+    retry-budget literature pairs with it).
+
+    Inputs:
+      * **queue depth** — sampled through ``depth_fn`` (the silo wires the
+        cluster-wide pending-turn count) and memoized for
+        ``sample_period`` seconds so per-message admission stays O(1).
+      * **event-loop stalls** — the watchdog calls ``note_stall`` when its
+        timer fires late; a recent stall floors the shed level at
+        ``stall_level`` for ``stall_window`` seconds (queue depth alone
+        cannot see a wedged loop).
+
+    Output is a shed **level** in [0, 1]: 0 below ``queue_soft``, rising
+    linearly to 1 at ``queue_hard``.  At level L an application request
+    is shed when its remaining TTL is under ``L * ttl_reference`` —
+    shortest-remaining-TTL first (they are the cheapest to shed: they
+    would burn queue time and then expire anyway), with read-only calls
+    treated as lower priority (shed at twice the TTL threshold).  At
+    L >= 1 every sheddable request sheds.  System/membership traffic is
+    never consulted — the dispatcher only gates APPLICATION requests.
+    """
+
+    def __init__(self, enabled: bool = True,
+                 queue_soft: int = 1000, queue_hard: int = 5000,
+                 ttl_reference: float = 30.0,
+                 sample_period: float = 0.02,
+                 stall_level: float = 0.5, stall_window: float = 2.0,
+                 depth_fn: Optional[Callable[[], int]] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.enabled = enabled
+        self.queue_soft = queue_soft
+        self.queue_hard = queue_hard
+        self.ttl_reference = ttl_reference
+        self.sample_period = sample_period
+        self.stall_level = stall_level
+        self.stall_window = stall_window
+        self.depth_fn = depth_fn
+        self.clock = clock
+        self.shed_count = 0
+        self.admitted_count = 0
+        self.stall_count = 0
+        self._stall_until = 0.0
+        self._sampled_at = -1e9
+        self._sampled_depth = 0
+
+    # -- signals ------------------------------------------------------------
+
+    def note_stall(self, drift: float) -> None:
+        """Watchdog-reported event-loop stall: shed aggressively for a
+        window — depth sampling was blind while the loop was wedged."""
+        self.stall_count += 1
+        self._stall_until = self.clock() + self.stall_window
+
+    def current_depth(self) -> int:
+        now = self.clock()
+        if self.depth_fn is not None \
+                and now - self._sampled_at >= self.sample_period:
+            self._sampled_depth = self.depth_fn()
+            self._sampled_at = now
+        return self._sampled_depth
+
+    @property
+    def level(self) -> float:
+        """Shed level in [0, 1]."""
+        if not self.enabled:
+            return 0.0
+        depth = self.current_depth()
+        if self.queue_hard <= self.queue_soft:
+            lvl = 1.0 if depth > self.queue_hard else 0.0
+        else:
+            lvl = (depth - self.queue_soft) / (self.queue_hard
+                                               - self.queue_soft)
+            lvl = min(1.0, max(0.0, lvl))
+        if self.clock() < self._stall_until:
+            lvl = max(lvl, self.stall_level)
+        return lvl
+
+    @property
+    def degraded(self) -> bool:
+        return self.level > 0.0
+
+    # -- admission ----------------------------------------------------------
+
+    def should_shed(self, remaining_ttl: Optional[float],
+                    read_only: bool = False,
+                    level: Optional[float] = None) -> bool:
+        """Decide one APPLICATION request.  Deterministic given (level,
+        remaining TTL): no RNG, so a chaos run replays identically.
+        Pass ``level`` to decide and record against ONE sample (the
+        property re-samples and could disagree across two reads)."""
+        lvl = self.level if level is None else level
+        if lvl <= 0.0:
+            self.admitted_count += 1
+            return False
+        if lvl >= 1.0:
+            self.shed_count += 1
+            return True
+        threshold = lvl * self.ttl_reference * (2.0 if read_only else 1.0)
+        if remaining_ttl is not None and remaining_ttl < threshold:
+            self.shed_count += 1
+            return True
+        self.admitted_count += 1
+        return False
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"enabled": self.enabled, "level": round(self.level, 4),
+                "degraded": self.degraded,
+                "depth": self._sampled_depth,
+                "queue_soft": self.queue_soft, "queue_hard": self.queue_hard,
+                "shed_count": self.shed_count,
+                "admitted_count": self.admitted_count,
+                "stall_count": self.stall_count}

@@ -1,0 +1,351 @@
+"""Overload containment & failure isolation primitives.
+
+The detection half of robustness (timeouts, membership death votes, the
+chaos plane) tells the runtime *that* something broke; this module is the
+containment half — the policies that stop a local failure from amplifying
+into a cluster-wide one:
+
+* ``BackoffPolicy`` — exponential backoff with FULL jitter for transient
+  resends (the SRE retry discipline; reference analog: the reference
+  resends immediately, which is exactly the retry-storm amplifier this
+  replaces).  Seeded, so chaos runs replay the same delay sequence.
+* ``RetryBudget`` — a token bucket capping cluster-wide retry
+  amplification per silo: first-attempt requests deposit a fraction of a
+  token, every resend withdraws one.  Under partition the budget drains
+  and further retries fail fast instead of storming the fabric.
+* ``CircuitBreaker`` / ``BreakerBoard`` — per-destination-silo breakers:
+  closed → open on consecutive failures/timeouts, half-open probes after
+  a reset window, closed again on a successful round trip.  Membership
+  suspicion trips a breaker directly (``trip``).
+* ``DeadLetterRing`` — bounded per-silo ring of every message the runtime
+  terminally dropped/shed/rejected, with reason codes.  Nothing vanishes
+  without a record (chaos invariant: check_dead_letter_accounting).
+
+The adaptive admission controller (``ShedController``) lives in
+``orleans_tpu.limits`` next to the limit registry it extends.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+
+# ---- dead-letter reason codes (stable strings — they appear in telemetry,
+# ---- snapshots, and the chaos accounting invariant) -----------------------
+
+REASON_EXPIRED = "expired"                    # TTL elapsed in transit/queue
+REASON_SHED = "shed_overload"                 # adaptive admission shed
+REASON_MAILBOX_OVERFLOW = "mailbox_overflow"  # per-activation hard limit
+REASON_BREAKER_OPEN = "breaker_open"          # fast-failed before enqueue
+REASON_RETRY_BUDGET = "retry_budget_exhausted"
+REASON_UNDELIVERABLE = "undeliverable"        # response/one-way with no path
+
+DEAD_LETTER_REASONS = (
+    REASON_EXPIRED, REASON_SHED, REASON_MAILBOX_OVERFLOW,
+    REASON_BREAKER_OPEN, REASON_RETRY_BUDGET, REASON_UNDELIVERABLE,
+)
+
+
+class BackoffPolicy:
+    """Exponential backoff with full jitter: ``uniform(0, min(cap,
+    base * 2**attempt))`` (the AWS-architecture-blog "full jitter"
+    variant — decorrelates synchronized retriers, which is the point:
+    a partition bounces every caller at the same instant).
+
+    Seeded per instance so a fixed (seed, call sequence) replays the
+    same delays — the chaos plane's determinism contract.
+    """
+
+    def __init__(self, base: float = 0.02, cap: float = 1.0,
+                 seed: int = 0) -> None:
+        self.base = base
+        self.cap = cap
+        self._rng = random.Random(seed)
+
+    def delay(self, attempt: int) -> float:
+        """Delay before resend number ``attempt`` (1-based)."""
+        ceiling = min(self.cap, self.base * (2 ** max(0, attempt - 1)))
+        return self._rng.uniform(0.0, ceiling)
+
+
+class RetryBudget:
+    """Token-bucket retry budget (SRE retry-budget discipline).
+
+    Every first-attempt request deposits ``fill_rate`` tokens (clamped at
+    ``capacity``); every retry withdraws 1.0.  Steady state thus allows
+    retries for at most a ``fill_rate`` fraction of traffic — a partition
+    cannot turn N in-flight requests into N * max_resend_count resends.
+    """
+
+    def __init__(self, capacity: float = 64.0, fill_rate: float = 0.1,
+                 enabled: bool = True) -> None:
+        self.capacity = capacity
+        self.fill_rate = fill_rate
+        self.enabled = enabled
+        self.tokens = capacity
+        self.spent = 0
+        self.denied = 0
+
+    def on_request(self) -> None:
+        self.tokens = min(self.capacity, self.tokens + self.fill_rate)
+
+    def try_spend(self) -> bool:
+        if not self.enabled:
+            return True
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            self.spent += 1
+            return True
+        self.denied += 1
+        return False
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"tokens": round(self.tokens, 3), "capacity": self.capacity,
+                "fill_rate": self.fill_rate, "spent": self.spent,
+                "denied": self.denied}
+
+
+# ---- circuit breakers -----------------------------------------------------
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """One destination's breaker (closed → open → half-open → closed).
+
+    ``allow()`` is the pre-enqueue gate; ``record_success`` /
+    ``record_failure`` are fed by the transport (drain outcome, connect
+    failure) and the RPC layer (response vs timeout).  ``trip`` forces
+    open — membership suspicion uses it so a suspect silo fails fast
+    before its probes even finish dying.
+    """
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout: float = 1.0, half_open_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[Callable[[str, str, str], None]]
+                 = None) -> None:
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self.half_open_probes = half_open_probes
+        self.clock = clock
+        self.on_transition = on_transition
+        self.state = BREAKER_CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+        self._probes_left = 0
+        self.opened_count = 0
+        self.rejected_count = 0
+
+    def _set_state(self, new: str, reason: str) -> None:
+        if new == self.state:
+            return
+        old, self.state = self.state, new
+        if new == BREAKER_OPEN:
+            self.opened_at = self.clock()
+            self.opened_count += 1
+        if self.on_transition is not None:
+            self.on_transition(old, new, reason)
+
+    def allow(self) -> bool:
+        if self.state == BREAKER_CLOSED:
+            return True
+        if self.state == BREAKER_OPEN:
+            if self.clock() - self.opened_at >= self.reset_timeout:
+                self._set_state(BREAKER_HALF_OPEN, "reset timeout elapsed")
+                self._probes_left = self.half_open_probes
+            else:
+                self.rejected_count += 1
+                return False
+        # half-open: admit a bounded number of probes
+        if self._probes_left > 0:
+            self._probes_left -= 1
+            return True
+        self.rejected_count += 1
+        return False
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        if self.state != BREAKER_CLOSED:
+            self._set_state(BREAKER_CLOSED, "probe succeeded")
+
+    def record_failure(self, reason: str = "failure") -> None:
+        self.consecutive_failures += 1
+        if self.state == BREAKER_HALF_OPEN:
+            self._set_state(BREAKER_OPEN, f"probe failed: {reason}")
+        elif (self.state == BREAKER_CLOSED
+              and self.consecutive_failures >= self.failure_threshold):
+            self._set_state(
+                BREAKER_OPEN,
+                f"{self.consecutive_failures} consecutive failures "
+                f"({reason})")
+
+    def trip(self, reason: str) -> None:
+        """Force open regardless of counters (membership suspicion)."""
+        self.consecutive_failures = max(self.consecutive_failures,
+                                        self.failure_threshold)
+        self._set_state(BREAKER_OPEN, reason)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "opened_count": self.opened_count,
+                "rejected_count": self.rejected_count}
+
+
+class BreakerBoard:
+    """Per-silo registry of per-destination breakers.
+
+    Listeners (``on_transition``) receive ``(target, old, new, reason)``
+    — the silo mirrors transitions into telemetry and the chaos plane
+    mirrors them into the FaultTrace.  Success recording is cheap-path
+    aware: no breaker object is allocated for a destination that has
+    never failed.
+    """
+
+    def __init__(self, enabled: bool = True, failure_threshold: int = 5,
+                 reset_timeout: float = 1.0, half_open_probes: int = 1,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.enabled = enabled
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self.half_open_probes = half_open_probes
+        self.clock = clock
+        self._breakers: Dict[Any, CircuitBreaker] = {}
+        self.on_transition: List[Callable[[Any, str, str, str], None]] = []
+        self.fast_fails = 0
+
+    def _breaker(self, target: Any) -> CircuitBreaker:
+        br = self._breakers.get(target)
+        if br is None:
+            br = CircuitBreaker(
+                failure_threshold=self.failure_threshold,
+                reset_timeout=self.reset_timeout,
+                half_open_probes=self.half_open_probes,
+                clock=self.clock,
+                on_transition=lambda old, new, reason, _t=target:
+                self._notify(_t, old, new, reason))
+            self._breakers[target] = br
+        return br
+
+    def _notify(self, target: Any, old: str, new: str, reason: str) -> None:
+        for cb in list(self.on_transition):
+            cb(target, old, new, reason)
+
+    def allow(self, target: Any) -> bool:
+        if not self.enabled:
+            return True
+        br = self._breakers.get(target)
+        if br is None:
+            return True
+        ok = br.allow()
+        if not ok:
+            self.fast_fails += 1
+        return ok
+
+    def state(self, target: Any) -> str:
+        br = self._breakers.get(target)
+        return br.state if br is not None else BREAKER_CLOSED
+
+    def record_success(self, target: Any) -> None:
+        br = self._breakers.get(target)
+        if br is not None:
+            br.record_success()
+
+    def record_failure(self, target: Any, reason: str = "failure") -> None:
+        if not self.enabled:
+            return
+        self._breaker(target).record_failure(reason)
+
+    def trip(self, target: Any, reason: str) -> None:
+        if not self.enabled:
+            return
+        self._breaker(target).trip(reason)
+
+    def forget(self, target: Any) -> None:
+        """Drop a destination's breaker (silo declared dead — its traffic
+        re-addresses; a future incarnation starts clean)."""
+        self._breakers.pop(target, None)
+
+    def configure(self, enabled: Optional[bool] = None,
+                  failure_threshold: Optional[int] = None,
+                  reset_timeout: Optional[float] = None,
+                  half_open_probes: Optional[int] = None) -> None:
+        """Apply new settings to the board AND every existing breaker —
+        live config reload must not leave already-failed destinations on
+        the old thresholds."""
+        if enabled is not None:
+            self.enabled = enabled
+        if failure_threshold is not None:
+            self.failure_threshold = failure_threshold
+        if reset_timeout is not None:
+            self.reset_timeout = reset_timeout
+        if half_open_probes is not None:
+            self.half_open_probes = half_open_probes
+        for br in self._breakers.values():
+            br.failure_threshold = self.failure_threshold
+            br.reset_timeout = self.reset_timeout
+            br.half_open_probes = self.half_open_probes
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"enabled": self.enabled, "fast_fails": self.fast_fails,
+                "targets": {str(t): br.snapshot()
+                            for t, br in self._breakers.items()}}
+
+
+# ---- dead letters ---------------------------------------------------------
+
+class DeadLetterRing:
+    """Bounded ring of terminally dropped messages + per-reason counters.
+
+    The ring holds the most recent ``capacity`` records (evidence for
+    debugging); the counters are exact and unbounded (the accounting the
+    chaos invariant checks against the metrics ledger).  ``on_record``
+    listeners let the chaos plane mirror drops into the FaultTrace.
+    """
+
+    def __init__(self, capacity: int = 512) -> None:
+        self.capacity = capacity
+        self.entries: Deque[Dict[str, Any]] = deque(maxlen=capacity)
+        self.by_reason: Dict[str, int] = {}
+        self.total = 0
+        self.on_record: List[Callable[[Dict[str, Any]], None]] = []
+
+    def record(self, msg: Any, reason: str, detail: str = "") -> Dict[str, Any]:
+        entry = {
+            "reason": reason,
+            "detail": detail,
+            "message": repr(msg),
+            "category": getattr(getattr(msg, "category", None), "name", "?"),
+            "direction": getattr(getattr(msg, "direction", None), "name", "?"),
+            "target": str(getattr(msg, "target_silo", None)),
+            "method": getattr(msg, "method_name", ""),
+            "time": time.monotonic(),
+        }
+        self.entries.append(entry)
+        self.by_reason[reason] = self.by_reason.get(reason, 0) + 1
+        self.total += 1
+        for cb in list(self.on_record):
+            cb(entry)
+        return entry
+
+    def count(self, reason: str) -> int:
+        return self.by_reason.get(reason, 0)
+
+    def resize(self, capacity: int) -> None:
+        """Live-reload path: re-bound the ring, keeping the newest
+        records; counters are unaffected (they are exact by contract)."""
+        if capacity == self.capacity:
+            return
+        self.capacity = capacity
+        self.entries = deque(self.entries, maxlen=capacity)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"total": self.total, "capacity": self.capacity,
+                "retained": len(self.entries),
+                "by_reason": dict(self.by_reason)}

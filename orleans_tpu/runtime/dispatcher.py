@@ -10,12 +10,18 @@ Dispatcher.cs:38 — ReceiveMessage :78, ReceiveRequest :265, reentrancy gate
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Optional
 
 from orleans_tpu.core.grain import registry as type_registry
 from orleans_tpu.ids import GrainId
 from orleans_tpu.runtime.activation import ActivationData, ActivationState
 from orleans_tpu.runtime.catalog import DuplicateActivationError
+from orleans_tpu.resilience import (
+    REASON_EXPIRED,
+    REASON_MAILBOX_OVERFLOW,
+    REASON_SHED,
+)
 from orleans_tpu.runtime.messaging import (
     Category,
     Direction,
@@ -72,15 +78,36 @@ class Dispatcher:
                                                "injected rejection"))
             return
         if msg.is_expired():
+            # NON-retryable: a TRANSIENT rejection here made callers burn
+            # resend budget re-sending a request that can never succeed
+            # (its TTL is the caller's own deadline).  Late resends of an
+            # already-expired message die here too.
             self.metrics.expired_dropped += 1
+            self.silo.dead_letters.record(
+                msg, REASON_EXPIRED,
+                f"expired in transit (resend {msg.resend_count})")
             if msg.direction == Direction.REQUEST:
                 self._respond(msg.create_rejection(
-                    RejectionType.TRANSIENT, "request expired in transit"))
+                    RejectionType.EXPIRED, "request expired in transit"))
             return
-        # piggybacked directory-cache invalidations
+        # piggybacked directory-cache invalidations — processed even for
+        # messages the admission gate below sheds: stale routes during an
+        # overload episode would amplify the very pressure being shed
         # (reference: InsideGrainClient.cs:298-308)
         for addr in msg.cache_invalidation:
             self.silo.grain_directory.invalidate_cache_entry(addr)
+        # adaptive admission control: shed APPLICATION grain requests by
+        # shed level (queue depth + watchdog stall driven) — never
+        # system/membership traffic, never responses, never client
+        # deliveries (limits.ShedController; replaces the binary
+        # OVERLOADED-only gate)
+        if (msg.category == Category.APPLICATION
+                and msg.direction in (Direction.REQUEST, Direction.ONE_WAY)
+                and msg.target_grain is not None
+                and not msg.target_grain.is_system_target
+                and not msg.target_grain.is_client
+                and self._should_shed(msg)):
+            return
 
         if msg.target_grain is not None and msg.target_grain.is_system_target:
             self.silo.invoke_system_target(msg)
@@ -131,8 +158,33 @@ class Dispatcher:
         overload = act.enqueue_or_start(msg, self.runtime_client.invoke)
         if overload is not None:
             self.metrics.rejections_sent += 1
+            self.metrics.mailbox_overflows += 1
+            self.silo.dead_letters.record(msg, REASON_MAILBOX_OVERFLOW,
+                                          overload)
             self._respond(msg.create_rejection(RejectionType.OVERLOADED,
                                                overload))
+
+    def _should_shed(self, msg: Message) -> bool:
+        """Consult the shed controller for one sheddable request; on shed,
+        reject OVERLOADED (non-retryable — push-back, not retry fuel) and
+        dead-letter the message.  The level is sampled ONCE so the
+        recorded evidence is the level that actually shed."""
+        controller = self.silo.shed_controller
+        level = controller.level
+        remaining = (None if msg.expiration is None
+                     else msg.expiration - time.monotonic())
+        if not controller.should_shed(remaining, msg.is_read_only,
+                                      level=level):
+            return False
+        self.metrics.rejections_sent += 1
+        self.metrics.requests_shed += 1
+        self.silo.dead_letters.record(
+            msg, REASON_SHED, f"shed at level {level:.3f}")
+        if msg.direction == Direction.REQUEST:
+            self._respond(msg.create_rejection(
+                RejectionType.OVERLOADED,
+                f"shed under overload (level {level:.3f})"))
+        return True
 
     def _bridge_to_engine(self, vt, msg: Message) -> None:
         engine = self.silo.tensor_engine

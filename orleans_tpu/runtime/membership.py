@@ -356,6 +356,13 @@ class MembershipOracle:
     async def try_suspect_or_kill(self, victim: SiloAddress) -> None:
         """(reference: MembershipOracle.TryToSuspectOrKill :915)"""
         now = time.time()
+        # suspicion feeds the failure-isolation plane: trip the victim's
+        # circuit breaker NOW so application calls fail fast (TRANSIENT,
+        # re-addressable) instead of burning response timeouts while the
+        # death-vote protocol runs its course
+        breakers = getattr(self.silo, "breakers", None)
+        if breakers is not None:
+            breakers.trip(victim, "membership suspicion")
         for _ in range(5):
             snapshot, version = await self.table.read_all()
             row = snapshot.get(victim)
@@ -431,9 +438,9 @@ class MembershipOracle:
         for peer in list(self.view):
             if self.view.get(peer) in (SiloStatus.ACTIVE, SiloStatus.JOINING):
                 try:
-                    await self.silo.system_rpc(peer, "membership",
-                                               "notify_table_changed", (),
-                                               timeout=1.0)
+                    await self.silo.system_rpc(
+                        peer, "membership", "notify_table_changed", (),
+                        timeout=self.config.gossip_timeout)
                 except Exception:
                     pass
 

@@ -28,6 +28,7 @@ from enum import IntEnum
 from typing import Any, Dict, List, Optional, Tuple
 
 from orleans_tpu.ids import ActivationAddress, ActivationId, GrainId, SiloAddress
+from orleans_tpu.resilience import REASON_BREAKER_OPEN
 
 
 class Category(IntEnum):
@@ -54,6 +55,10 @@ class RejectionType(IntEnum):
     DUPLICATE_REQUEST = 3
     UNRECOVERABLE = 4
     GATEWAY_TOO_BUSY = 5
+    # request TTL elapsed before it could run — NON-retryable: a resend
+    # of an expired request can never succeed, it only burns retry
+    # budget (rebuild addition; the reference rejected these TRANSIENT)
+    EXPIRED = 6
 
 
 class ResponseKind(IntEnum):
@@ -220,6 +225,11 @@ class MessageCenter:
         self._drop_fn = None
         self.on_silo_dead = None        # callback(SiloAddress) from oracle
         self.metrics = None             # wired by Silo (MessagingStats)
+        # failure-isolation plane (wired by Silo): per-destination circuit
+        # breakers consulted BEFORE enqueue, and the dead-letter ring that
+        # records every breaker fast-fail
+        self.breakers = None
+        self.dead_letters = None
 
     def send_message(self, msg: Message) -> None:
         if msg.sending_silo is None:
@@ -231,8 +241,33 @@ class MessageCenter:
         if msg.target_silo is None or msg.target_silo == self.my_address:
             msg.target_silo = self.my_address
             self.deliver_local(msg)
-        else:
-            self.transport.send(msg)
+            return
+        # circuit-breaker gate: APPLICATION requests/one-ways to a broken
+        # peer fail fast as TRANSIENT (re-addressable via the resend
+        # machinery) instead of sitting on the full response timeout.
+        # System/membership traffic ALWAYS flows — probes are how the
+        # breaker's underlying fault gets detected and healed — responses
+        # always flow (they are the remote caller's only hope), and
+        # tensor SLABS always flow: their payload rides the vector
+        # router's own bounce→backoff→reinject discipline, which
+        # redelivers rather than drops.
+        if (self.breakers is not None
+                and msg.category == Category.APPLICATION
+                and msg.direction != Direction.RESPONSE
+                and not is_slab_message(msg)
+                and not self.breakers.allow(msg.target_silo)):
+            if self.metrics is not None:
+                self.metrics.breaker_fast_fails += 1
+            if self.dead_letters is not None:
+                self.dead_letters.record(
+                    msg, REASON_BREAKER_OPEN,
+                    f"circuit open to {msg.target_silo}")
+            if msg.direction == Direction.REQUEST:
+                self.deliver_local(msg.create_rejection(
+                    RejectionType.TRANSIENT,
+                    f"circuit breaker open to {msg.target_silo}"))
+            return
+        self.transport.send(msg)
 
     def deliver_local(self, msg: Message) -> None:
         if self.metrics is not None:

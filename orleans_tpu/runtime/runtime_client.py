@@ -19,6 +19,7 @@ from orleans_tpu.codec import default_manager as codec
 from orleans_tpu.core import context as ctx
 from orleans_tpu.core.grain import InterfaceInfo, MethodInfo
 from orleans_tpu.ids import GrainId, SiloAddress
+from orleans_tpu.resilience import REASON_RETRY_BUDGET
 from orleans_tpu.runtime.messaging import (
     Category,
     Direction,
@@ -53,6 +54,10 @@ class CallbackData:
     message: Message
     timeout_handle: Any = None
     resend_count: int = 0
+    # the destination of the LAST attempt — the resend machinery nulls
+    # message.target_silo for re-addressing, but a timeout firing in the
+    # backoff window must still charge the silo that failed to answer
+    last_target: Any = None
 
 
 class InsideRuntimeClient:
@@ -68,6 +73,20 @@ class InsideRuntimeClient:
         self.max_resend_count = self.MAX_RESEND_COUNT
         self.logger = silo.logger
         self.resend_on_transient = True
+        # transient-resend containment (orleans_tpu/resilience.py): the
+        # backoff policy is owned here; the token-bucket retry budget and
+        # breaker board are silo-wide (wired by Silo).  Seeded per silo
+        # NAME: stable across runs (chaos replay) yet different silo to
+        # silo — a shared seed would re-synchronize the simultaneous
+        # retriers full jitter exists to decorrelate.
+        import zlib
+
+        from orleans_tpu.resilience import BackoffPolicy
+        r = silo.config.resilience
+        self.backoff_enabled = r.backoff_enabled
+        self.backoff = BackoffPolicy(
+            base=r.backoff_base, cap=r.backoff_cap,
+            seed=zlib.crc32(silo.name.encode()))
 
     # wired lazily by Silo
     @property
@@ -129,6 +148,9 @@ class InsideRuntimeClient:
         if sending_grain is not None and sending_grain not in chain:
             chain = chain + (sending_grain,)
 
+        # retry-budget deposit: first attempts earn the fraction of a
+        # token that funds later resends (resilience.RetryBudget)
+        self.silo.retry_budget.on_request()
         msg = Message(
             category=Category.APPLICATION,
             direction=Direction.ONE_WAY if method.one_way else Direction.REQUEST,
@@ -166,6 +188,13 @@ class InsideRuntimeClient:
         if cb is None:
             return
         self.silo.metrics.requests_timed_out += 1
+        # a timeout against a specific destination feeds its breaker —
+        # "consecutive failures/timeouts" is the closed→open criterion.
+        # target_silo is None while a resend awaits re-addressing; the
+        # stashed last attempt target is the silo that failed to answer.
+        target = cb.message.target_silo or cb.last_target
+        if target is not None and target != self.silo.address:
+            self.silo.breakers.record_failure(target, "request timeout")
         if not cb.future.done():
             cb.future.set_exception(RequestTimeoutError(
                 f"request {cb.message} timed out after "
@@ -182,12 +211,29 @@ class InsideRuntimeClient:
             if (msg.rejection_type == RejectionType.TRANSIENT
                     and self.resend_on_transient
                     and cb.message.category == Category.APPLICATION
-                    and cb.resend_count < self.max_resend_count):
+                    and cb.resend_count < self.max_resend_count
+                    and not cb.message.is_expired()):
                 # re-addressing is only meaningful for grain calls; a
                 # ping/system request addressed to a SPECIFIC silo must
                 # fail fast (a re-addressed probe could answer from the
-                # local oracle and fake the target alive)
-                # transparent resend with re-addressing
+                # local oracle and fake the target alive).
+                # An EXPIRED message never resends (the rejection would
+                # come straight back) and neither does a caller whose
+                # silo-wide retry budget is drained — that is the
+                # token-bucket cap on cluster-wide resend amplification
+                # (resilience.RetryBudget).
+                if not self.silo.retry_budget.try_spend():
+                    self.silo.metrics.retries_denied += 1
+                    self.silo.dead_letters.record(
+                        cb.message, REASON_RETRY_BUDGET,
+                        f"after {cb.resend_count} resends: "
+                        f"{msg.rejection_info}")
+                    self._fail_rejected(msg, cb,
+                                        "; retry budget exhausted")
+                    return
+                # transparent resend with re-addressing, after an
+                # exponential full-jitter backoff — immediate resends are
+                # the retry-storm amplifier under partition
                 # (reference: CallbackData.DoResend / Message resend)
                 cb.resend_count += 1
                 cb.message.resend_count = cb.resend_count
@@ -196,20 +242,27 @@ class InsideRuntimeClient:
                     # or every resend re-resolves the same stale address
                     self.silo.grain_directory.cache.invalidate(
                         cb.message.target_grain)
+                cb.last_target = cb.message.target_silo or cb.last_target
                 cb.message.target_silo = None
                 cb.message.target_activation = None
                 self.silo.metrics.requests_resent += 1
-                self.dispatcher.send_message(cb.message)
+                delay = (self.backoff.delay(cb.resend_count)
+                         if self.backoff_enabled else 0.0)
+                if delay <= 0.0:
+                    self.dispatcher.send_message(cb.message)
+                else:
+                    asyncio.get_running_loop().call_later(
+                        delay, self._resend_after_backoff, msg.id,
+                        cb.resend_count)
                 return
-            self.callbacks.pop(msg.id, None)
-            self._cancel_timer(cb)
-            if not cb.future.done():
-                cb.future.set_exception(RejectionError(
-                    msg.rejection_type or RejectionType.UNRECOVERABLE,
-                    msg.rejection_info))
+            self._fail_rejected(msg, cb)
             return
         self.callbacks.pop(msg.id, None)
         self._cancel_timer(cb)
+        # a real reply from the destination closes/holds its breaker
+        if msg.sending_silo is not None \
+                and msg.sending_silo != self.silo.address:
+            self.silo.breakers.record_success(msg.sending_silo)
         if cb.future.done():
             return
         if msg.response_kind == ResponseKind.ERROR:
@@ -218,6 +271,30 @@ class InsideRuntimeClient:
             cb.future.set_exception(exc)
         else:
             cb.future.set_result(msg.result)
+
+    def _fail_rejected(self, msg: Message, cb: CallbackData,
+                       info_suffix: str = "") -> None:
+        self.callbacks.pop(msg.id, None)
+        self._cancel_timer(cb)
+        if not cb.future.done():
+            cb.future.set_exception(RejectionError(
+                msg.rejection_type or RejectionType.UNRECOVERABLE,
+                msg.rejection_info + info_suffix))
+
+    def _resend_after_backoff(self, message_id: int, expected_resend: int
+                              ) -> None:
+        """Timer body of a backed-off resend: the callback may have been
+        resolved or timed out while we slept — only a still-pending
+        callback at the SAME resend generation goes back out."""
+        cb = self.callbacks.get(message_id)
+        if cb is None or cb.future.done() \
+                or cb.resend_count != expected_resend:
+            return
+        if cb.message.is_expired():
+            # the backoff outlived the caller's deadline: let the
+            # response-timeout timer surface the failure, don't resend
+            return
+        self.dispatcher.send_message(cb.message)
 
     @staticmethod
     def _cancel_timer(cb: CallbackData) -> None:

@@ -110,6 +110,35 @@ class Silo:
         self.logger = TraceLogger(f"silo.{self.name}")
         self.metrics = SiloMetrics()
 
+        # overload containment & failure isolation plane (PR: resilience)
+        # — built BEFORE the components that consult it
+        from orleans_tpu.limits import ShedController
+        from orleans_tpu.resilience import (
+            BreakerBoard,
+            DeadLetterRing,
+            RetryBudget,
+        )
+        r = self.config.resilience
+        self.dead_letters = DeadLetterRing(r.dead_letter_capacity)
+        self.breakers = BreakerBoard(
+            enabled=r.breaker_enabled,
+            failure_threshold=r.breaker_failure_threshold,
+            reset_timeout=r.breaker_reset_timeout,
+            half_open_probes=r.breaker_half_open_probes)
+        self.breakers.on_transition.append(self._on_breaker_transition)
+        self.retry_budget = RetryBudget(
+            capacity=r.retry_budget_capacity,
+            fill_rate=r.retry_budget_fill,
+            enabled=r.backoff_enabled)
+        self.shed_controller = ShedController(
+            enabled=r.shed_enabled,
+            queue_soft=r.shed_queue_soft, queue_hard=r.shed_queue_hard,
+            ttl_reference=r.shed_ttl_reference,
+            sample_period=r.shed_sample_period,
+            stall_level=r.shed_stall_level,
+            stall_window=r.shed_stall_window,
+            depth_fn=self._pending_request_depth)
+
         # construction order mirrors reference Silo ctor :151-337
         self.ring = VirtualBucketsRing(
             self.address, self.config.directory.buckets_per_silo)
@@ -137,6 +166,8 @@ class Silo:
         self.max_forward_count = self.config.messaging.max_forward_count
 
         self.message_center.dispatcher = self.dispatcher
+        self.message_center.breakers = self.breakers
+        self.message_center.dead_letters = self.dead_letters
 
         # providers (reference: StorageProviderManager; Silo.cs:478-484)
         self.storage_providers: Dict[str, StorageProvider] = \
@@ -455,6 +486,26 @@ class Silo:
         self.max_forward_count = m.max_forward_count
         self.catalog.age_limit = self.config.collection.default_age_limit
         self.grain_directory.cache.max_size = self.config.directory.cache_size
+        r = self.config.resilience
+        self.runtime_client.backoff_enabled = r.backoff_enabled
+        self.runtime_client.backoff.base = r.backoff_base
+        self.runtime_client.backoff.cap = r.backoff_cap
+        self.retry_budget.capacity = r.retry_budget_capacity
+        self.retry_budget.fill_rate = r.retry_budget_fill
+        self.retry_budget.enabled = r.backoff_enabled
+        self.breakers.configure(
+            enabled=r.breaker_enabled,
+            failure_threshold=r.breaker_failure_threshold,
+            reset_timeout=r.breaker_reset_timeout,
+            half_open_probes=r.breaker_half_open_probes)
+        sc = self.shed_controller
+        sc.enabled = r.shed_enabled
+        sc.queue_soft, sc.queue_hard = r.shed_queue_soft, r.shed_queue_hard
+        sc.ttl_reference = r.shed_ttl_reference
+        sc.sample_period = r.shed_sample_period
+        sc.stall_level = r.shed_stall_level
+        sc.stall_window = r.shed_stall_window
+        self.dead_letters.resize(r.dead_letter_capacity)
         if self.watchdog is not None and self.config.watchdog_period > 0:
             self.watchdog.period = self.config.watchdog_period
         if self.load_publisher is not None \
@@ -493,6 +544,40 @@ class Silo:
         except asyncio.CancelledError:
             pass
 
+    # ================= resilience plane ====================================
+
+    def _pending_request_depth(self) -> int:
+        """Silo-wide pending-turn count (sum of activation mailbox
+        depths) — the shed controller's queue-depth signal.  Sampled
+        (memoized) by the controller, not per message."""
+        return sum(len(a.waiting)
+                   for a in self.catalog.directory.by_activation.values())
+
+    def _on_breaker_transition(self, target, old: str, new: str,
+                               reason: str) -> None:
+        self.logger.warn(
+            f"circuit breaker {self.address}->{target}: {old} -> {new} "
+            f"({reason})", code=2910)
+        from orleans_tpu import telemetry
+        if telemetry.default_manager.consumers:
+            telemetry.default_manager.track_event(
+                "breaker.transition",
+                properties={"silo": self.name, "target": str(target),
+                            "from": old, "to": new, "reason": reason})
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The silo's resilience/containment snapshot: shed level +
+        ``degraded`` flag, breaker states, retry budget, dead-letter
+        accounting.  (``get_debug_dump`` embeds this; chaos invariants
+        and the degraded bench tier read it.)"""
+        return {
+            "degraded": self.shed_controller.degraded,
+            "shed": self.shed_controller.snapshot(),
+            "breakers": self.breakers.snapshot(),
+            "retry_budget": self.retry_budget.snapshot(),
+            "dead_letters": self.dead_letters.snapshot(),
+        }
+
     def publish_data_plane_telemetry(self) -> None:
         """Mirror the cross-silo data-plane counters (vector-router slab
         aggregation + per-link transport frames/bytes) into the process
@@ -511,6 +596,17 @@ class Silo:
                 mgr.track_metrics(stats,
                                   {"silo": self.name, "link": link},
                                   prefix="transport.link.")
+        # containment-plane counters: dead letters by reason, shed level,
+        # breaker fast-fails — the operator-visible overload ledger
+        dl = self.dead_letters.snapshot()
+        mgr.track_metrics({"total": dl["total"], **dl["by_reason"]},
+                          {"silo": self.name}, prefix="dead_letter.")
+        mgr.track_metrics(
+            {"level": self.shed_controller.level,
+             "shed_count": self.shed_controller.shed_count,
+             "breaker_fast_fails": self.breakers.fast_fails,
+             "retries_denied": self.retry_budget.denied},
+            {"silo": self.name}, prefix="overload.")
 
     # ================= membership view =====================================
 
@@ -537,6 +633,9 @@ class Silo:
         self.ring.remove_silo(addr)
         self.grain_directory.on_silo_dead(addr)
         self.runtime_client.break_outstanding_messages_to_dead_silo(addr)
+        # a dead silo's breaker is moot (its traffic re-addresses; a
+        # replacement incarnation is a different SiloAddress)
+        self.breakers.forget(addr)
 
     def _on_ring_changed(self) -> None:
         if self.status != SiloStatus.ACTIVE:
@@ -677,6 +776,7 @@ class Silo:
             "activations": len(self.catalog.directory),
             "metrics": self.metrics.snapshot(),
             "ring_members": [str(s) for s in self.ring.members],
+            "resilience": self.snapshot(),
         }
         if self.vector_router is not None \
                 and hasattr(self.vector_router, "snapshot"):

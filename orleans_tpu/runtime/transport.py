@@ -43,6 +43,8 @@ class InProcTransport:
 
     def __init__(self, wire_fidelity: bool = True) -> None:
         self._inboxes: Dict[SiloAddress, Callable[[Message], None]] = {}
+        # address → Silo, for breaker/dead-letter feedback to the sender
+        self._silos: Dict[SiloAddress, Any] = {}
         self.wire_fidelity = wire_fidelity
         # deterministic fault injection: drop predicate applied per message
         self.drop_predicate: Optional[Callable[[Message], bool]] = None
@@ -50,29 +52,49 @@ class InProcTransport:
 
     def attach(self, silo) -> "BoundTransport":
         self._inboxes[silo.address] = silo.message_center.deliver_local
+        self._silos[silo.address] = silo
         return BoundTransport(self, silo.address)
 
     def detach(self, address: SiloAddress) -> None:
         self._inboxes.pop(address, None)
+        self._silos.pop(address, None)
 
     def send(self, sender: SiloAddress, msg: Message) -> None:
         if self.drop_predicate is not None and self.drop_predicate(msg):
             return
         deliver = self._inboxes.get(msg.target_silo)
+        sender_silo = self._silos.get(sender)
         if deliver is None:
             # closed-socket analog: the connection refuses immediately, so
             # requests bounce back as transient rejections — the caller's
             # resend machinery re-addresses via the (by now healed)
             # directory instead of hanging for the full response timeout
             # (reference: socket send failure → rejection, not a black hole)
+            from orleans_tpu.resilience import REASON_UNDELIVERABLE
             from orleans_tpu.runtime.messaging import Direction, RejectionType
+            breakers = getattr(sender_silo, "breakers", None)
+            if breakers is not None:
+                # a refused connection is a link failure: feed the
+                # sender's per-destination breaker
+                breakers.record_failure(msg.target_silo, "unreachable")
             back = self._inboxes.get(sender)
             if back is not None and msg.direction == Direction.REQUEST:
                 rejection = msg.create_rejection(
                     RejectionType.TRANSIENT,
                     f"target silo {msg.target_silo} unreachable")
                 asyncio.get_running_loop().call_soon(back, rejection)
+            elif getattr(sender_silo, "dead_letters", None) is not None:
+                # one-ways/responses to a vanished peer have no bounce
+                # path — account the drop instead of black-holing it
+                sender_silo.metrics.undeliverable_dropped += 1
+                sender_silo.dead_letters.record(
+                    msg, REASON_UNDELIVERABLE,
+                    f"target silo {msg.target_silo} unreachable")
             return
+        # NOTE: a delivered message is NOT breaker "success" — only a
+        # round trip is (runtime_client.receive_response).  A wedged
+        # peer's inbox still accepts writes; counting delivery as health
+        # would reset the timeout-fed failure streak forever.
         self.messages_carried += 1
         if self.wire_fidelity:
             try:
@@ -371,9 +393,23 @@ class TcpTransport:
                 RejectionType.TRANSIENT,
                 f"target silo {msg.target_silo} unreachable: {reason}"))
         else:
+            from orleans_tpu.resilience import REASON_UNDELIVERABLE
+            if getattr(self.silo, "dead_letters", None) is not None:
+                self.silo.metrics.undeliverable_dropped += 1
+                self.silo.dead_letters.record(msg, REASON_UNDELIVERABLE,
+                                              reason)
             self.silo.logger.warn(
                 f"dropping undeliverable {msg.direction.name} to "
                 f"{msg.target_silo}: {reason}")
+
+    def _record_link_failure(self, target: SiloAddress, reason: str) -> None:
+        """Feed the per-destination circuit breaker from link failures
+        (guarded: the transport also runs under bare test harnesses).
+        Successes are NOT recorded here — only a round trip through
+        runtime_client.receive_response closes a breaker."""
+        breakers = getattr(self.silo, "breakers", None)
+        if breakers is not None:
+            breakers.record_failure(target, reason)
 
     def prune_dead(self, live) -> None:
         """Drop sender tasks/queues for destinations no longer in the live
@@ -468,6 +504,7 @@ class TcpTransport:
                         # NOT a silent drop: bounce so callers resend via
                         # the (healing) directory; membership probes will
                         # declare the peer dead and prune this queue
+                        self._record_link_failure(target, "connect failed")
                         while pending:
                             msg, cost = pending.popleft()
                             self._dequeued(target, cost)
@@ -500,11 +537,15 @@ class TcpTransport:
                     # once, like the reference's resend-on-failure),
                     # never a silent drop
                     writer = None
+                    self._record_link_failure(target, "connection lost")
                     for msg in written:
                         self._bounce(msg, "connection lost")
                     written.clear()
                     continue
                 written.clear()
+                # a successful drain is NOT breaker success: a wedged
+                # peer's socket still accepts bytes.  Breakers close on
+                # round trips (responses / ping replies), never on writes.
                 link["frames_sent"] += frames_out
                 link["bytes_sent"] += bytes_out
                 link["slab_frames_sent"] += slabs_out

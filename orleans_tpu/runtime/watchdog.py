@@ -66,6 +66,12 @@ class Watchdog:
                     self.logger.warn(
                         f"event loop stalled {drift:.3f}s past the "
                         f"{self.period}s watchdog period", code=3001)
+                    # feed the adaptive admission controller: queue-depth
+                    # sampling was blind while the loop was wedged, so a
+                    # stall floors the shed level for a recovery window
+                    controller = getattr(self.silo, "shed_controller", None)
+                    if controller is not None:
+                        controller.note_stall(drift)
                 self.check_participants()
         except asyncio.CancelledError:
             pass

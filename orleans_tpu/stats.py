@@ -67,6 +67,15 @@ class SiloMetrics:
         self.requests_sent = 0
         self.requests_resent = 0
         self.requests_timed_out = 0
+        # overload-containment ledger (PR: resilience plane).  Each of
+        # these counters is paired with a dead-letter record at the drop
+        # site; the chaos invariant check_dead_letter_accounting asserts
+        # the two ledgers agree.
+        self.requests_shed = 0          # adaptive admission shed
+        self.mailbox_overflows = 0      # per-activation hard-limit rejects
+        self.breaker_fast_fails = 0     # pre-enqueue breaker rejections
+        self.retries_denied = 0         # retry-budget-exhausted failures
+        self.undeliverable_dropped = 0  # responses/one-ways with no path
         self.turns_executed = 0
         self.turns_faulted = 0
         self.turn_latency = Histogram()

@@ -218,25 +218,42 @@ def test_tcp_client_batch_edge(run):
                         {"game": games,
                          "score": np.ones(n, np.float32),
                          "tick": np.full(n, t + 1, np.int32)})
+
+                def totals():
+                    """(heartbeats, updates) landed cluster-wide."""
+                    hb = upd = 0
+                    for silo in cluster.silos:
+                        arenas = silo.tensor_engine.arenas
+                        pa = arenas.get("PresenceGrain")
+                        if pa is not None and len(pa.keys()):
+                            rows, _ = pa.lookup_rows(pa.keys())
+                            hb += int(np.asarray(
+                                pa.state["heartbeats"])[rows].sum())
+                        ga = arenas.get("GameGrain")
+                        if ga is not None and len(ga.keys()):
+                            rows, _ = ga.lookup_rows(ga.keys())
+                            upd += int(np.asarray(
+                                ga.state["updates"])[rows].sum())
+                    return hb, upd
+
+                # event-driven wait: the client's frames are STILL ON THE
+                # SOCKET when send_batch returns, so an immediate quiesce
+                # can observe a stable (empty) data plane before any slab
+                # arrives and pass control to the assertions early — the
+                # flake this test used to carry.  Wait for the expected
+                # deliveries first, then quiesce to settle stragglers.
+                deadline = asyncio.get_running_loop().time() + 60
+                while totals() != (3 * n, 3 * n):
+                    assert asyncio.get_running_loop().time() < deadline, \
+                        f"only {totals()} of {(3 * n, 3 * n)} landed"
+                    for silo in cluster.silos:
+                        await silo.tensor_engine.flush()
+                    await asyncio.sleep(0.02)
                 await cluster.quiesce_engines()
 
-                # exactness: every heartbeat landed, across both silos
-                total_hb = 0
-                total_upd = 0
-                for silo in cluster.silos:
-                    arenas = silo.tensor_engine.arenas
-                    pa = arenas.get("PresenceGrain")
-                    if pa is not None and len(pa.keys()):
-                        rows, _ = pa.lookup_rows(pa.keys())
-                        total_hb += int(np.asarray(
-                            pa.state["heartbeats"])[rows].sum())
-                    ga = arenas.get("GameGrain")
-                    if ga is not None and len(ga.keys()):
-                        rows, _ = ga.lookup_rows(ga.keys())
-                        total_upd += int(np.asarray(
-                            ga.state["updates"])[rows].sum())
-                assert total_hb == 3 * n
-                assert total_upd == 3 * n
+                # exactness: every heartbeat landed exactly once (the wait
+                # above proves >=; quiesce + re-check proves ==)
+                assert totals() == (3 * n, 3 * n)
 
                 # the per-message path carried NO vector traffic: no
                 # grain turns were executed anywhere for these batches
