@@ -39,6 +39,8 @@ class ChaosCluster(TestingCluster):
             telemetry = default_manager
         self.trace = FaultTrace(telemetry=telemetry)
         self.interposer = Interposer(self.plan, self.trace)
+        # populated by check_invariants on the first violation
+        self.last_flight_dump: Optional[Dict[str, Any]] = None
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -179,12 +181,30 @@ class ChaosCluster(TestingCluster):
         single-activation, and dead-letter accounting (nothing vanishes
         without a record).  Arena conservation and stream at-least-once
         need scenario knowledge (expected keys / produced events) — call
-        those checkers directly with it."""
-        report = {"membership_convergence":
-                  await check_membership_convergence(self, timeout=timeout)}
-        report["single_activation"] = check_single_activation(self)
-        report["dead_letter_accounting"] = check_dead_letter_accounting(self)
-        return report
+        those checkers directly with it.
+
+        A violation snapshots every silo's flight recorder into
+        ``last_flight_dump`` (correlated spans + dead letters + breaker
+        transitions) before re-raising — the crash evidence travels with
+        the failure."""
+        try:
+            report = {"membership_convergence":
+                      await check_membership_convergence(self,
+                                                         timeout=timeout)}
+            report["single_activation"] = check_single_activation(self)
+            report["dead_letter_accounting"] = \
+                check_dead_letter_accounting(self)
+            return report
+        except AssertionError:  # InvariantViolation is an AssertionError
+            self.last_flight_dump = self.flight_recorder_dump(
+                "invariant violation")
+            raise
+
+    def flight_recorder_dump(self, reason: str = "") -> Dict[str, Any]:
+        """Per-silo flight-recorder dumps — DEAD silos included: their
+        in-memory rings are exactly the crash evidence the recorder
+        exists to preserve."""
+        return {s.name: s.flight_dump(reason) for s in self.silos}
 
     def chaos_snapshot(self) -> Dict[str, Any]:
         return {

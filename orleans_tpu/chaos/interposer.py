@@ -38,6 +38,21 @@ from orleans_tpu.chaos.plan import (
 )
 
 
+def _ambient_trace_id() -> Optional[str]:
+    """Trace id of the request whose turn/task the seam fired inside
+    (storage writes and engine injections run under the caller's ambient
+    RequestContext; orleans_tpu/spans.py).  Tagging faults with it maps
+    an injected fault to the exact request it hit."""
+    from orleans_tpu.spans import current_trace
+    t = current_trace()
+    return t.get("trace_id") if t else None
+
+
+def _message_trace_id(msg: Any) -> Optional[str]:
+    from orleans_tpu.spans import trace_id_of
+    return trace_id_of(msg)
+
+
 class Interposer:
 
     def __init__(self, plan: FaultPlan, trace: Optional[FaultTrace] = None,
@@ -193,7 +208,8 @@ class Interposer:
                     "runtime", f"dead_letter.{_name}", "dead_letter",
                     entry["reason"],
                     {"silo": _name, "detail": entry["detail"],
-                     "method": entry["method"]})
+                     "method": entry["method"],
+                     "trace_id": entry.get("trace_id")})
 
             ring.on_record.append(on_dead_letter)
             self._listeners.append((ring.on_record, on_dead_letter))
@@ -241,7 +257,8 @@ class Interposer:
             return forward(msg)
         rule, idx = hit
         detail = {"target": msg.target_silo,
-                  "method": getattr(msg, "method_name", None)}
+                  "method": getattr(msg, "method_name", None),
+                  "trace_id": _message_trace_id(msg)}
         self._record_rule(rule, idx, detail)
         if rule.action == "drop":
             self.counters["transport_dropped"] += 1
@@ -285,7 +302,8 @@ class Interposer:
         rule, idx = hit
         self._record_rule(rule, idx, {"provider": provider_name,
                                       "grain_type": grain_type,
-                                      "grain_id": grain_id})
+                                      "grain_id": grain_id,
+                                      "trace_id": _ambient_trace_id()})
         if rule.action == "fail":
             self.counters["storage_failed"] += 1
             raise ChaosInjectedError(
@@ -334,7 +352,8 @@ class Interposer:
             rule, idx = hit
             corrupted, n_rows = self._corrupt(rule, keys, args)
             detail = {"type": type_name, "method": method,
-                      "corrupted_rows": n_rows}
+                      "corrupted_rows": n_rows,
+                      "trace_id": _ambient_trace_id()}
             if n_rows:
                 self.counters["engine_corrupted"] += 1
                 args = corrupted

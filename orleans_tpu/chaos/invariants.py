@@ -131,27 +131,17 @@ def check_dead_letter_accounting(cluster) -> Dict[str, Any]:
     agree on every ACTIVE silo (a future drop path that bypasses the
     accounting shows up as a mismatch), and that the ring's own totals
     are internally consistent."""
-    from orleans_tpu.resilience import (
-        REASON_BREAKER_OPEN,
-        REASON_EXPIRED,
-        REASON_MAILBOX_OVERFLOW,
-        REASON_RETRY_BUDGET,
-        REASON_SHED,
-        REASON_UNDELIVERABLE,
-    )
+    from orleans_tpu.resilience import REASON_COUNTER_ATTR
     mismatches: Dict[str, Dict[str, Any]] = {}
     totals = {"dead_letters": 0, "silos": 0}
     for silo in _active_silos(cluster):
         ring = silo.dead_letters
         m = silo.metrics
-        pairs = {
-            REASON_EXPIRED: m.expired_dropped,
-            REASON_SHED: m.requests_shed,
-            REASON_MAILBOX_OVERFLOW: m.mailbox_overflows,
-            REASON_BREAKER_OPEN: m.breaker_fast_fails,
-            REASON_RETRY_BUDGET: m.retries_denied,
-            REASON_UNDELIVERABLE: m.undeliverable_dropped,
-        }
+        # the reason → counter mapping is shared with the tracing-plane
+        # lint (tests assert every reason ALSO has a span status): one
+        # source of truth for all three ledgers
+        pairs = {reason: getattr(m, attr)
+                 for reason, attr in REASON_COUNTER_ATTR.items()}
         bad = {reason: {"metric": count, "ring": ring.count(reason)}
                for reason, count in pairs.items()
                if count != ring.count(reason)}

@@ -242,6 +242,14 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
                  check_dead_letter_accounting(cluster))
         except InvariantViolation as exc:
             _run("dead_letter_accounting", {"ok": False, "error": str(exc)})
+
+        # flight-recorder evidence: every silo's ring (dead silos too —
+        # their in-memory spans ARE the crash evidence), correlated by
+        # trace id against the fault trace so an injected fault maps to
+        # the exact request it hit
+        flight = cluster.flight_recorder_dump("chaos smoke")
+        trace_correlation = correlate_faults_with_spans(
+            cluster.trace.to_list(), flight)
     finally:
         await cluster.stop()
 
@@ -258,7 +266,32 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
         "fault_trace": cluster.trace.to_list(),
         "trace_signature": [list(s) for s in cluster.trace.signature()],
         "interposer": cluster.interposer.snapshot(),
+        "flight_recorder": flight,
+        # the tracing-plane acceptance evidence: ≥1 injected fault's
+        # FaultTrace entry shares a trace_id with the spans of the
+        # request it affected
+        "trace_correlation": trace_correlation,
     }
+
+
+def correlate_faults_with_spans(fault_events: List[Dict[str, Any]],
+                                flight: Dict[str, Dict[str, Any]]
+                                ) -> Dict[str, Any]:
+    """Cross-reference FaultTrace entries' trace_id tags with the trace
+    ids present in the flight-recorder dumps: the injected-fault ↔
+    affected-request mapping the tracing plane exists to provide."""
+    fault_tids = {str(e["detail"].get("trace_id")) for e in fault_events}
+    fault_tids -= {"None", ""}
+    span_tids: set = set()
+    for dump in flight.values():
+        # normalize to strings: trace ids are ints in-memory but reach
+        # the FaultTrace detail str()-ed (FaultTrace.to_list)
+        span_tids.update(str(k) for k in dump.get("traces", {}))
+    shared = sorted(fault_tids & span_tids)
+    return {"ok": bool(shared),
+            "shared_trace_ids": shared[:8],
+            "fault_trace_ids": len(fault_tids),
+            "span_trace_ids": len(span_tids)}
 
 
 def run_chaos_smoke(seed: int = 1234, repeat: int = 1) -> Dict[str, Any]:

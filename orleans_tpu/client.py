@@ -21,6 +21,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from orleans_tpu import spans as _spans
 from orleans_tpu.core import context as ctx
 from orleans_tpu.core.factory import GrainFactory
 from orleans_tpu.core.grain import InterfaceInfo, MethodInfo, get_interface
@@ -56,7 +57,9 @@ class GrainClient:
                  backoff_enabled: bool = True,
                  backoff_base: float = 0.02, backoff_cap: float = 1.0,
                  retry_budget_capacity: float = 32.0,
-                 retry_budget_fill: float = 0.1) -> None:
+                 retry_budget_fill: float = 0.1,
+                 trace_enabled: bool = True,
+                 trace_sample_rate: float = 0.01) -> None:
         from orleans_tpu.resilience import BackoffPolicy, RetryBudget
         self.client_id = GrainId.client(uuid.uuid4())
         self.response_timeout = response_timeout
@@ -86,6 +89,13 @@ class GrainClient:
                                         enabled=backoff_enabled)
         self.requests_resent = 0
         self.retries_denied = 0
+        # client-edge tracing: the out-of-cluster client is a trace
+        # INGRESS — it mints trace ids (head-sampled) that ride the
+        # exported RequestContext through the gateway (orleans_tpu/spans)
+        self.spans = _spans.SpanRecorder(
+            f"client:{str(self.client_id)[-8:]}", enabled=trace_enabled,
+            sample_rate=trace_sample_rate,
+            seed=zlib.crc32(str(self.client_id).encode()))
 
     @classmethod
     def from_config(cls, config) -> "GrainClient":
@@ -99,7 +109,9 @@ class GrainClient:
             backoff_base=config.backoff_base,
             backoff_cap=config.backoff_cap,
             retry_budget_capacity=config.retry_budget_capacity,
-            retry_budget_fill=config.retry_budget_fill)
+            retry_budget_fill=config.retry_budget_fill,
+            trace_enabled=config.trace_enabled,
+            trace_sample_rate=config.trace_sample_rate)
 
     # ================= connection =========================================
 
@@ -173,6 +185,18 @@ class GrainClient:
                      ) -> Optional[asyncio.Future]:
         timeout = timeout if timeout is not None else self.response_timeout
         self.retry_budget.on_request()
+        # trace ingress: ambient (a test/driver that set one) or freshly
+        # minted + head-sampled; the send span's id rides the exported
+        # context so the gateway/silo hops parent under it
+        trace = self.spans.ingress()
+        span = None
+        if trace is not None and trace.get("sampled"):
+            span = self.spans.start(f"send {method.name}", "client.send",
+                                    trace, method=method.name,
+                                    target=str(target_grain))
+        request_context = ctx.RequestContext.export()
+        if trace is not None:
+            request_context = self.spans.inject(request_context, trace, span)
         msg = Message(
             category=Category.APPLICATION,
             direction=Direction.ONE_WAY if method.one_way else Direction.REQUEST,
@@ -184,16 +208,17 @@ class GrainClient:
             args=tuple(codec.deep_copy(a) for a in args),
             is_read_only=method.read_only,
             is_always_interleave=method.always_interleave,
-            request_context=ctx.RequestContext.export(),
+            request_context=request_context,
             expiration=time.monotonic() + timeout,
         )
         gateway = self._next_gateway()
         if method.one_way:
             gateway.submit(msg)
+            self.spans.finish(span, one_way=True)
             return None
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
-        cb = CallbackData(future=future, message=msg)
+        cb = CallbackData(future=future, message=msg, span=span)
         cb.timeout_handle = loop.call_later(timeout, self._on_timeout, msg.id)
         self.callbacks[msg.id] = cb
         gateway.submit(msg)
@@ -202,6 +227,10 @@ class GrainClient:
     def _on_timeout(self, message_id: int) -> None:
         cb = self.callbacks.pop(message_id, None)
         if cb is not None and not cb.future.done():
+            self.spans.close_hop(
+                cb.span, cb.message, f"send {cb.message.method_name}",
+                "client.send", _spans.STATUS_TIMEOUT,
+                resends=cb.resend_count)
             cb.future.set_exception(RequestTimeoutError(
                 f"client request {cb.message} timed out"))
 
@@ -252,6 +281,10 @@ class GrainClient:
                 cb.resend_count += 1
                 cb.message.resend_count = cb.resend_count
                 self.requests_resent += 1
+                self.spans.event(
+                    f"resend {cb.message.method_name}", "resend",
+                    _spans.trace_of(cb.message), resend=cb.resend_count,
+                    rejection=msg.rejection_info)
                 delay = (self.backoff.delay(cb.resend_count)
                          if self.backoff_enabled else 0.0)
                 if delay <= 0.0:
@@ -266,14 +299,25 @@ class GrainClient:
         if cb.timeout_handle is not None:
             cb.timeout_handle.cancel()
         if msg.response_kind == ResponseKind.REJECTION:
+            self.spans.close_hop(
+                cb.span, cb.message, f"send {cb.message.method_name}",
+                "client.send", _spans.STATUS_REJECTED,
+                rejection=(msg.rejection_type.name if msg.rejection_type
+                           else "?"),
+                info=msg.rejection_info, resends=cb.resend_count)
             cb.future.set_exception(RejectionError(
                 msg.rejection_type or RejectionType.UNRECOVERABLE,
                 msg.rejection_info))
         elif msg.response_kind == ResponseKind.ERROR:
+            self.spans.close_hop(
+                cb.span, cb.message, f"send {cb.message.method_name}",
+                "client.send", _spans.STATUS_ERROR,
+                error=repr(msg.result), resends=cb.resend_count)
             exc = msg.result if isinstance(msg.result, BaseException) \
                 else RuntimeError(str(msg.result))
             cb.future.set_exception(exc)
         else:
+            self.spans.finish(cb.span, resends=cb.resend_count)
             cb.future.set_result(msg.result)
 
     def _resubmit(self, message_id: int, expected_resend: int) -> None:
@@ -295,6 +339,11 @@ class GrainClient:
             if cb.timeout_handle is not None:
                 cb.timeout_handle.cancel()
             if not cb.future.done():
+                self.spans.close_hop(
+                    cb.span, cb.message, f"send {cb.message.method_name}",
+                    "client.send", _spans.STATUS_ERROR,
+                    error=f"resend failed: {exc}",
+                    resends=cb.resend_count)
                 cb.future.set_exception(RejectionError(
                     RejectionType.UNRECOVERABLE,
                     f"resend failed: {exc}"))

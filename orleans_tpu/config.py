@@ -95,6 +95,24 @@ class ResilienceConfig:
 
 
 @dataclass
+class TracingConfig:
+    """Distributed-tracing plane knobs (orleans_tpu/spans.py).  No single
+    reference analog — the reference's Message.AddTimestamp per-hop
+    breadcrumbs generalized to Dapper-style causal spans with head
+    sampling and a crash flight recorder."""
+
+    enabled: bool = True
+    # head-based sampling rate decided at client/gateway ingress; spans
+    # ending in error/timeout/any dead-letter drop record ALWAYS
+    sample_rate: float = 0.01
+    # bounded per-silo ring of recent completed spans (the crash flight
+    # recorder dumped on chaos invariant failure / degraded snapshot)
+    flight_recorder_capacity: int = 256
+    # recent circuit-breaker transitions retained for the dump
+    breaker_transition_capacity: int = 64
+
+
+@dataclass
 class RemindersConfig:
     """(reference: GlobalConfiguration reminder service section :84)"""
 
@@ -212,6 +230,7 @@ class SiloConfig:
     collection: CollectionConfig = field(default_factory=CollectionConfig)
     messaging: MessagingConfig = field(default_factory=MessagingConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
     reminders: RemindersConfig = field(default_factory=RemindersConfig)
     tensor: TensorEngineConfig = field(default_factory=TensorEngineConfig)
     extra: Dict[str, Any] = field(default_factory=dict)
@@ -251,3 +270,8 @@ class ClientConfig:
     backoff_cap: float = 1.0
     retry_budget_capacity: float = 32.0
     retry_budget_fill: float = 0.1
+    # client-edge tracing (parity with the silo's TracingConfig): the
+    # client is a trace INGRESS — it mints trace ids head-sampled at
+    # this rate; error/timeout spans record regardless
+    trace_enabled: bool = True
+    trace_sample_rate: float = 0.01

@@ -66,6 +66,20 @@ class RequestContext:
     def import_(data: Optional[Dict[str, Any]]) -> None:
         _request_context.set(dict(data) if data else None)
 
+    # -- scoped import (tracing plane: the dispatcher's engine bridge
+    # -- restores the ambient context after enqueueing a vector call) ------
+
+    @staticmethod
+    def push(data: Optional[Dict[str, Any]]) -> contextvars.Token:
+        """Import ``data`` and return a token restoring the previous
+        ambient context via :meth:`pop` — a bounded scope, unlike
+        :meth:`import_` which replaces the context for the task."""
+        return _request_context.set(dict(data) if data else None)
+
+    @staticmethod
+    def pop(token: contextvars.Token) -> None:
+        _request_context.reset(token)
+
 
 def current_call_chain() -> Tuple[GrainId, ...]:
     return _call_chain.get()

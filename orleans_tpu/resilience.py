@@ -48,6 +48,26 @@ DEAD_LETTER_REASONS = (
     REASON_BREAKER_OPEN, REASON_RETRY_BUDGET, REASON_UNDELIVERABLE,
 )
 
+#: reason code → SiloMetrics counter attribute.  Every terminal drop site
+#: increments the counter AND records a dead letter AND (via the silo's
+#: on_record hook) emits a drop span — reason accounting lives in ONE
+#: mapping so the chaos invariant (check_dead_letter_accounting) and the
+#: tracing lint (tests/test_tracing_spans.py) both read it.
+REASON_COUNTER_ATTR: Dict[str, str] = {
+    REASON_EXPIRED: "expired_dropped",
+    REASON_SHED: "requests_shed",
+    REASON_MAILBOX_OVERFLOW: "mailbox_overflows",
+    REASON_BREAKER_OPEN: "breaker_fast_fails",
+    REASON_RETRY_BUDGET: "retries_denied",
+    REASON_UNDELIVERABLE: "undeliverable_dropped",
+}
+
+#: the reserved RequestContext key the tracing plane's context rides
+#: under (orleans_tpu/spans.py).  Defined HERE so the dead-letter ring
+#: can tag entries with trace ids without importing the spans module
+#: (spans imports this module's reason codes).
+TRACE_CONTEXT_KEY = "@trace"
+
 
 class BackoffPolicy:
     """Exponential backoff with full jitter: ``uniform(0, min(cap,
@@ -317,6 +337,8 @@ class DeadLetterRing:
         self.on_record: List[Callable[[Dict[str, Any]], None]] = []
 
     def record(self, msg: Any, reason: str, detail: str = "") -> Dict[str, Any]:
+        rc = getattr(msg, "request_context", None)
+        trace = rc.get(TRACE_CONTEXT_KEY) if isinstance(rc, dict) else None
         entry = {
             "reason": reason,
             "detail": detail,
@@ -325,6 +347,10 @@ class DeadLetterRing:
             "direction": getattr(getattr(msg, "direction", None), "name", "?"),
             "target": str(getattr(msg, "target_silo", None)),
             "method": getattr(msg, "method_name", ""),
+            # causal thread into the tracing plane: which request's drop
+            # this is (None when the message carried no trace context)
+            "trace_id": (trace.get("trace_id")
+                         if isinstance(trace, dict) else None),
             "time": time.monotonic(),
         }
         self.entries.append(entry)

@@ -199,6 +199,7 @@ class Catalog:
                 grain_id=act.grain_id,
                 provider=provider,
                 initial_state=act.class_info.initial_state,
+                recorder=self.silo.spans,  # storage IO as dependency spans
             )
             instance._storage = bridge
             if provider is not None:

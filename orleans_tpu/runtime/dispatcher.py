@@ -21,6 +21,7 @@ from orleans_tpu.resilience import (
     REASON_EXPIRED,
     REASON_MAILBOX_OVERFLOW,
     REASON_SHED,
+    TRACE_CONTEXT_KEY as _TRACE_KEY,
 )
 from orleans_tpu.runtime.messaging import (
     Category,
@@ -115,6 +116,13 @@ class Dispatcher:
         if msg.target_grain is not None and msg.target_grain.is_client:
             self.silo.deliver_to_client(msg)
             return
+        if self.silo.spans.enabled and msg.request_context is not None:
+            # tracing breadcrumb for SAMPLED traces only: the turn span
+            # retro-derives its queue-wait hop from receipt time
+            # (runtime_client.invoke); unsampled hops skip even this
+            trace = msg.request_context.get(_TRACE_KEY)
+            if trace is not None and trace.get("sampled"):
+                msg.add_timestamp("dispatch.recv")
         asyncio.get_running_loop().create_task(self._receive_request(msg))
 
     async def _receive_request(self, msg: Message) -> None:
@@ -207,7 +215,17 @@ class Dispatcher:
             self._respond_error(msg, AttributeError(
                 f"{vt.name} has no batched method {msg.method_name!r}"))
             return
-        fut = engine.send_one(msg.target_grain, minfo, msg.args)
+        # tracing: the enqueue captures the AMBIENT trace (engine.py), so
+        # scope the message's carried context around the bridge — the
+        # executing tick then links back to this request's trace
+        from orleans_tpu.core.context import RequestContext
+        ctx_token = RequestContext.push(msg.request_context) \
+            if self.silo.spans.enabled else None
+        try:
+            fut = engine.send_one(msg.target_grain, minfo, msg.args)
+        finally:
+            if ctx_token is not None:
+                RequestContext.pop(ctx_token)
         if fut is None or msg.direction == Direction.ONE_WAY:
             return
 
@@ -312,6 +330,11 @@ class Dispatcher:
                 f"exceeded max forward count ({reason})"))
             return
         self.metrics.messages_forwarded += 1
+        from orleans_tpu import spans as _spans
+        self.silo.spans.event(f"forward {msg.method_name}", "forward",
+                              _spans.trace_of(msg), reason=reason,
+                              forward_count=msg.forward_count,
+                              target=str(msg.target_silo))
         if msg.target_silo == self.silo.address:
             msg.target_silo = None
         if msg.target_silo is None:

@@ -26,9 +26,10 @@ import struct
 import time
 from typing import Any, Callable, Dict, Optional
 
+from orleans_tpu import spans as _spans
 from orleans_tpu.codec import default_manager as codec
 from orleans_tpu.ids import ActivationAddress, ActivationId, GrainId
-from orleans_tpu.runtime.messaging import Message
+from orleans_tpu.runtime.messaging import Direction, Message
 
 #: gateway wire framing: 4-byte magic + 4-byte length, codec payload.
 #: Payloads are either a Message or a control dict {"op": ...}.
@@ -110,6 +111,24 @@ class Gateway:
         arrived over a real socket (they were just deserialized)."""
         if self.wire_fidelity and not already_wired:
             msg = codec.deserialize(codec.serialize(msg))
+        rec = self.silo.spans
+        if rec.enabled and msg.direction != Direction.RESPONSE:
+            trace = _spans.trace_of(msg)
+            if trace is None:
+                # a client that doesn't trace (old/raw edge): THIS is the
+                # trace ingress — mint the context here so every hop
+                # behind the gateway is still attributable
+                trace = rec.begin_trace()
+                if trace is not None:
+                    span = rec.start(f"gateway {msg.method_name}",
+                                     "gateway.ingress", trace,
+                                     client=str(msg.sending_grain))
+                    msg.request_context = rec.inject(msg.request_context,
+                                                     trace, span)
+                    rec.finish(span)
+            else:
+                rec.event(f"gateway {msg.method_name}", "gateway.forward",
+                          trace, client=str(msg.sending_grain))
         if msg.target_silo is None:
             # gateway addresses the message like any in-silo send
             self.silo.dispatcher.send_message(msg)

@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
+from orleans_tpu import spans as _spans
 from orleans_tpu.codec import default_manager as codec
 from orleans_tpu.core import context as ctx
 from orleans_tpu.core.grain import InterfaceInfo, MethodInfo
@@ -58,6 +59,17 @@ class CallbackData:
     # message.target_silo for re-addressing, but a timeout firing in the
     # backoff window must still charge the silo that failed to answer
     last_target: Any = None
+    # the open send-hop span closed when this callback resolves
+    # (orleans_tpu/spans.py; None when tracing is off/untraced)
+    span: Any = None
+
+
+def _send_kind(msg: Message) -> str:
+    """Span kind of a send hop, recoverable from the message alone (the
+    retroactive-failure path has no open span to read it from): hosted
+    clients send under a client grain id."""
+    g = msg.sending_grain
+    return "client.send" if g is not None and g.is_client else "grain.send"
 
 
 class InsideRuntimeClient:
@@ -151,6 +163,23 @@ class InsideRuntimeClient:
         # retry-budget deposit: first attempts earn the fraction of a
         # token that funds later resends (resilience.RetryBudget)
         self.silo.retry_budget.on_request()
+        # tracing: continue the ambient trace (this send happens inside a
+        # turn) or mint one — a hosted client's send IS a trace ingress.
+        # The send span's id rides the exported context so the receiving
+        # hop parents under it (orleans_tpu/spans.py).
+        rec = self.silo.spans
+        trace = rec.ingress()
+        span = None
+        if trace is not None and trace.get("sampled"):
+            # attrs are only materialized for sampled traces — the
+            # unsampled path pays id propagation, nothing else
+            span = rec.start(f"send {method.name}",
+                             "grain.send" if sender is not None
+                             else "client.send", trace,
+                             method=method.name, target=str(target_grain))
+        request_context = ctx.RequestContext.export()
+        if trace is not None:
+            request_context = rec.inject(request_context, trace, span)
         msg = Message(
             category=Category.APPLICATION,
             direction=Direction.ONE_WAY if method.one_way else Direction.REQUEST,
@@ -166,17 +195,18 @@ class InsideRuntimeClient:
             args=tuple(codec.deep_copy(a) for a in args),
             is_read_only=method.read_only,
             is_always_interleave=method.always_interleave,
-            request_context=ctx.RequestContext.export(),
+            request_context=request_context,
             call_chain=chain,
             expiration=time.monotonic() + timeout,
         )
         self.silo.metrics.requests_sent += 1
         if method.one_way:
             self.dispatcher.send_message(msg)
+            rec.finish(span, one_way=True)
             return None
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
-        cb = CallbackData(future=future, message=msg)
+        cb = CallbackData(future=future, message=msg, span=span)
         cb.timeout_handle = loop.call_later(timeout, self._on_timeout, msg.id)
         self.callbacks[msg.id] = cb
         self.dispatcher.send_message(msg)
@@ -188,6 +218,10 @@ class InsideRuntimeClient:
         if cb is None:
             return
         self.silo.metrics.requests_timed_out += 1
+        self.silo.spans.close_hop(
+            cb.span, cb.message, f"send {cb.message.method_name}",
+            _send_kind(cb.message), _spans.STATUS_TIMEOUT,
+            resends=cb.resend_count)
         # a timeout against a specific destination feeds its breaker —
         # "consecutive failures/timeouts" is the closed→open criterion.
         # target_silo is None while a resend awaits re-addressing; the
@@ -246,6 +280,10 @@ class InsideRuntimeClient:
                 cb.message.target_silo = None
                 cb.message.target_activation = None
                 self.silo.metrics.requests_resent += 1
+                self.silo.spans.event(
+                    f"resend {cb.message.method_name}", "resend",
+                    _spans.trace_of(cb.message), resend=cb.resend_count,
+                    rejection=msg.rejection_info)
                 delay = (self.backoff.delay(cb.resend_count)
                          if self.backoff_enabled else 0.0)
                 if delay <= 0.0:
@@ -266,16 +304,27 @@ class InsideRuntimeClient:
         if cb.future.done():
             return
         if msg.response_kind == ResponseKind.ERROR:
+            self.silo.spans.close_hop(
+                cb.span, cb.message, f"send {cb.message.method_name}",
+                _send_kind(cb.message), _spans.STATUS_ERROR,
+                error=repr(msg.result), resends=cb.resend_count)
             exc = msg.result if isinstance(msg.result, BaseException) \
                 else RuntimeError(str(msg.result))
             cb.future.set_exception(exc)
         else:
+            self.silo.spans.finish(cb.span, resends=cb.resend_count)
             cb.future.set_result(msg.result)
 
     def _fail_rejected(self, msg: Message, cb: CallbackData,
                        info_suffix: str = "") -> None:
         self.callbacks.pop(msg.id, None)
         self._cancel_timer(cb)
+        self.silo.spans.close_hop(
+            cb.span, cb.message, f"send {cb.message.method_name}",
+            _send_kind(cb.message), _spans.STATUS_REJECTED,
+            rejection=(msg.rejection_type.name if msg.rejection_type
+                       else "?"),
+            info=msg.rejection_info + info_suffix, resends=cb.resend_count)
         if not cb.future.done():
             cb.future.set_exception(RejectionError(
                 msg.rejection_type or RejectionType.UNRECOVERABLE,
@@ -330,6 +379,33 @@ class InsideRuntimeClient:
         token = ctx.set_current_activation(act)
         ctx.set_call_chain(msg.call_chain + (msg.target_grain,))
         ctx.RequestContext.import_(msg.request_context)
+        # tracing: the activation-turn span, parented under the sender's
+        # carried send span; the time between dispatcher receipt and turn
+        # start surfaces as a sibling queue-wait span.  The turn span's
+        # id becomes the ambient context so nested sends (and storage
+        # dependency spans) parent under THIS turn.
+        rec = self.silo.spans
+        trace = None
+        if rec.enabled and msg.request_context is not None:
+            trace = msg.request_context.get(_spans.TRACE_KEY)
+        turn_span = None
+        if trace is not None and trace.get("sampled"):
+            turn_span = rec.start(f"turn {msg.method_name}",
+                                  "activation.turn", trace,
+                                  grain=str(msg.target_grain),
+                                  method=msg.method_name,
+                                  resend=msg.resend_count,
+                                  forwards=msg.forward_count)
+            recv_ts = next((t for tag, t in reversed(msg.timestamps)
+                            if tag == "dispatch.recv"), None)
+            if recv_ts is not None:
+                rec.event(f"queue wait {msg.method_name}", "dispatch.queue",
+                          trace, start=recv_ts,
+                          duration=turn_span.start - recv_ts)
+            # re-point the ambient context at THIS turn's span so nested
+            # sends and storage dependency spans parent under it
+            ctx.RequestContext.set(_spans.TRACE_KEY,
+                                   rec.child_context(trace, turn_span))
         try:
             method = getattr(act.grain_instance, msg.method_name, None)
             if method is None:
@@ -337,11 +413,15 @@ class InsideRuntimeClient:
                     f"{act.class_info.cls.__name__} has no method "
                     f"{msg.method_name!r}")
             result = await method(*msg.args)
+            rec.finish(turn_span)
             if msg.direction != Direction.ONE_WAY:
                 response = msg.create_response(codec.deep_copy(result))
                 self.silo.message_center.send_message(response)
         except Exception as exc:  # noqa: BLE001 — user faults flow to caller
             self.silo.metrics.turns_faulted += 1
+            rec.close_hop(turn_span, msg, f"turn {msg.method_name}",
+                          "activation.turn", _spans.STATUS_ERROR,
+                          error=repr(exc))
             if msg.direction != Direction.ONE_WAY:
                 response = msg.create_response(exc, ResponseKind.ERROR)
                 self.silo.message_center.send_message(response)

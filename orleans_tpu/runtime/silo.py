@@ -110,6 +110,17 @@ class Silo:
         self.logger = TraceLogger(f"silo.{self.name}")
         self.metrics = SiloMetrics()
 
+        # distributed tracing plane (orleans_tpu/spans.py): hop spans +
+        # batched engine-tick spans + the crash flight recorder.  Built
+        # FIRST — the resilience plane's dead-letter hook and every
+        # runtime component record through it.
+        from orleans_tpu.spans import SpanRecorder
+        tr = self.config.tracing
+        self.spans = SpanRecorder(
+            self.name, enabled=tr.enabled, sample_rate=tr.sample_rate,
+            flight_capacity=tr.flight_recorder_capacity,
+            breaker_capacity=tr.breaker_transition_capacity)
+
         # overload containment & failure isolation plane (PR: resilience)
         # — built BEFORE the components that consult it
         from orleans_tpu.limits import ShedController
@@ -120,6 +131,9 @@ class Silo:
         )
         r = self.config.resilience
         self.dead_letters = DeadLetterRing(r.dead_letter_capacity)
+        # every terminal drop leaves an ALWAYS-ON span (third ledger next
+        # to the metrics counter and the dead-letter record)
+        self.dead_letters.on_record.append(self._on_dead_letter)
         self.breakers = BreakerBoard(
             enabled=r.breaker_enabled,
             failure_threshold=r.breaker_failure_threshold,
@@ -506,6 +520,11 @@ class Silo:
         sc.stall_level = r.shed_stall_level
         sc.stall_window = r.shed_stall_window
         self.dead_letters.resize(r.dead_letter_capacity)
+        tr = self.config.tracing
+        self.spans.configure(
+            enabled=tr.enabled, sample_rate=tr.sample_rate,
+            flight_capacity=tr.flight_recorder_capacity,
+            breaker_capacity=tr.breaker_transition_capacity)
         if self.watchdog is not None and self.config.watchdog_period > 0:
             self.watchdog.period = self.config.watchdog_period
         if self.load_publisher is not None \
@@ -553,11 +572,21 @@ class Silo:
         return sum(len(a.waiting)
                    for a in self.catalog.directory.by_activation.values())
 
+    def _on_dead_letter(self, entry: Dict[str, Any]) -> None:
+        """DeadLetterRing fan-out → an always-on drop span, so the flight
+        recorder can correlate every terminal drop with the hops of the
+        request it killed (entries carry the trace id)."""
+        self.spans.drop(entry["reason"], detail=entry.get("detail", ""),
+                        trace_id=entry.get("trace_id"),
+                        method=entry.get("method", ""),
+                        target=entry.get("target", ""))
+
     def _on_breaker_transition(self, target, old: str, new: str,
                                reason: str) -> None:
         self.logger.warn(
             f"circuit breaker {self.address}->{target}: {old} -> {new} "
             f"({reason})", code=2910)
+        self.spans.note_breaker(target, old, new, reason)
         from orleans_tpu import telemetry
         if telemetry.default_manager.consumers:
             telemetry.default_manager.track_event(
@@ -570,13 +599,30 @@ class Silo:
         ``degraded`` flag, breaker states, retry budget, dead-letter
         accounting.  (``get_debug_dump`` embeds this; chaos invariants
         and the degraded bench tier read it.)"""
-        return {
+        out = {
             "degraded": self.shed_controller.degraded,
             "shed": self.shed_controller.snapshot(),
             "breakers": self.breakers.snapshot(),
             "retry_budget": self.retry_budget.snapshot(),
             "dead_letters": self.dead_letters.snapshot(),
+            "tracing": self.spans.snapshot(),
         }
+        if out["degraded"]:
+            # a degraded silo self-reports its crash evidence: the
+            # correlated spans + dead letters + breaker transitions the
+            # operator needs to attribute the degradation
+            out["flight_recorder"] = self.flight_dump("snapshot degraded")
+        return out
+
+    def flight_dump(self, reason: str = "") -> Dict[str, Any]:
+        """The flight-recorder evidence bundle: recent spans grouped by
+        trace, joined with this silo's dead letters (trace-tagged) and
+        recent breaker transitions.  Chaos invariant failures and
+        degraded snapshots trigger it; callable any time."""
+        return self.spans.flight.dump(
+            reason=reason,
+            dead_letters=list(self.dead_letters.entries),
+            breaker_transitions=list(self.spans.breaker_transitions))
 
     def publish_data_plane_telemetry(self) -> None:
         """Mirror the cross-silo data-plane counters (vector-router slab

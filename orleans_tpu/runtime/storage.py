@@ -64,18 +64,54 @@ class StorageProvider:
 
 class GrainStateStorageBridge:
     """Per-activation storage facade injected into StatefulGrain
-    (reference: GrainStateStorageBridge.cs)."""
+    (reference: GrainStateStorageBridge.cs).
+
+    When a ``SpanRecorder`` is attached (catalog passes the silo's),
+    every provider call emits a *dependency span* under the ambient
+    trace — storage IO becomes an attributable hop of the request whose
+    turn triggered it (orleans_tpu/spans.py)."""
 
     def __init__(self, grain_type: str, grain_id: GrainId,
                  provider: Optional[StorageProvider],
-                 initial_state: Optional[Callable[[], Any]] = None) -> None:
+                 initial_state: Optional[Callable[[], Any]] = None,
+                 recorder: Any = None) -> None:
         self.grain_type = grain_type
         self.grain_id = grain_id
         self.provider = provider
         self._initial_state = initial_state
+        self._spans = recorder
         self.grain_state = GrainState()
         if initial_state is not None:
             self.grain_state.data = initial_state()
+
+    async def _provider_call(self, op: str, call) -> None:
+        """Run one provider coroutine under a dependency span (ambient
+        trace; failures always record — see SpanRecorder.finish)."""
+        if self._spans is None or not self._spans.enabled:
+            await call()
+            return
+        from orleans_tpu import spans as _spans
+        trace = _spans.current_trace()
+        span = None
+        if trace is not None and trace.get("sampled"):
+            span = self._spans.start(
+                f"storage.{op} {self.grain_type}", "dependency", trace,
+                provider=getattr(self.provider, "name", "?"),
+                grain=str(self.grain_id))
+        try:
+            await call()
+        except Exception as exc:
+            # failures record even for unsampled traces (retroactively)
+            if span is not None:
+                self._spans.finish(span, _spans.STATUS_ERROR,
+                                   error=repr(exc))
+            else:
+                self._spans.event(
+                    f"storage.{op} {self.grain_type}", "dependency", trace,
+                    status=_spans.STATUS_ERROR, error=repr(exc),
+                    provider=getattr(self.provider, "name", "?"))
+            raise
+        self._spans.finish(span)
 
     @property
     def state(self) -> Any:
@@ -92,8 +128,9 @@ class GrainStateStorageBridge:
     async def read_state(self) -> None:
         if self.provider is None:
             return
-        await self.provider.read_state(self.grain_type, self.grain_id,
-                                       self.grain_state)
+        await self._provider_call(
+            "read", lambda: self.provider.read_state(
+                self.grain_type, self.grain_id, self.grain_state))
         if not self.grain_state.record_exists and self._initial_state is not None:
             self.grain_state.data = self._initial_state()
 
@@ -102,14 +139,16 @@ class GrainStateStorageBridge:
             raise RuntimeError(
                 f"grain type {self.grain_type} has no storage provider "
                 f"configured (reference: [StorageProvider] attribute missing)")
-        await self.provider.write_state(self.grain_type, self.grain_id,
-                                        self.grain_state)
+        await self._provider_call(
+            "write", lambda: self.provider.write_state(
+                self.grain_type, self.grain_id, self.grain_state))
 
     async def clear_state(self) -> None:
         if self.provider is None:
             raise RuntimeError(
                 f"grain type {self.grain_type} has no storage provider configured")
-        await self.provider.clear_state(self.grain_type, self.grain_id,
-                                        self.grain_state)
+        await self._provider_call(
+            "clear", lambda: self.provider.clear_state(
+                self.grain_type, self.grain_id, self.grain_state))
         if self._initial_state is not None:
             self.grain_state.data = self._initial_state()

@@ -14,7 +14,8 @@ from __future__ import annotations
 
 import enum
 import time
-from typing import Any, Dict, List, Optional
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
 
 
 class Severity(enum.IntEnum):
@@ -96,6 +97,17 @@ class EventTelemetryConsumer(TelemetryConsumer):
         raise NotImplementedError
 
 
+class SpanTelemetryConsumer(TelemetryConsumer):
+    """Completed tracing-plane spans (orleans_tpu/spans.py) — hop spans,
+    batched engine-tick spans, and always-on drop spans fan out here as
+    plain dicts (Span.to_dict()).  No reference analog: the reference
+    predates distributed tracing consumers; this is the Dapper-style
+    export surface the rebuild adds."""
+
+    def track_span(self, span: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+
 class TelemetryManager:
     """Fan-out hub; silos and clients publish through one of these
     (reference: the TelemetryConsumers list managed by TraceLogger +
@@ -160,6 +172,10 @@ class TelemetryManager:
         for c in self._each(EventTelemetryConsumer):
             c.track_event(name, properties, metrics)
 
+    def track_span(self, span: Dict[str, Any]) -> None:
+        for c in self._each(SpanTelemetryConsumer):
+            c.track_span(span)
+
     def flush(self) -> None:
         for c in self.consumers:
             c.flush()
@@ -175,41 +191,58 @@ class InMemoryTelemetryConsumer(MetricTelemetryConsumer,
                                 ExceptionTelemetryConsumer,
                                 RequestTelemetryConsumer,
                                 EventTelemetryConsumer,
-                                DependencyTelemetryConsumer):
+                                DependencyTelemetryConsumer,
+                                SpanTelemetryConsumer):
     """Captures everything — the test-facing consumer (the reference tests
     against TraceTelemetryConsumer file/console sinks; in-process capture
-    is the idiomatic pytest analog)."""
+    is the idiomatic pytest analog).
 
-    def __init__(self) -> None:
-        self.metrics: List[tuple] = []
-        self.traces: List[tuple] = []
-        self.exceptions: List[tuple] = []
-        self.requests: List[tuple] = []
-        self.events: List[tuple] = []
-        self.dependencies: List[tuple] = []
+    Every capture list is a BOUNDED deque (``capture_limit`` newest
+    records per kind): a consumer left registered through a long bench or
+    chaos run must not grow memory without limit.  Evictions count in
+    ``dropped`` so a test that overflows its window finds out."""
+
+    def __init__(self, capture_limit: int = 10_000) -> None:
+        self.capture_limit = capture_limit
+        self.metrics: Deque[tuple] = deque(maxlen=capture_limit)
+        self.traces: Deque[tuple] = deque(maxlen=capture_limit)
+        self.exceptions: Deque[tuple] = deque(maxlen=capture_limit)
+        self.requests: Deque[tuple] = deque(maxlen=capture_limit)
+        self.events: Deque[tuple] = deque(maxlen=capture_limit)
+        self.dependencies: Deque[tuple] = deque(maxlen=capture_limit)
+        self.spans: Deque[Dict[str, Any]] = deque(maxlen=capture_limit)
+        self.dropped = 0  # records evicted across all kinds
+
+    def _append(self, sink: Deque, record) -> None:
+        if len(sink) == sink.maxlen:
+            self.dropped += 1
+        sink.append(record)
 
     def track_metric(self, name, value, properties=None) -> None:
-        self.metrics.append((name, value, properties, time.time()))
+        self._append(self.metrics, (name, value, properties, time.time()))
 
     def track_trace(self, message, severity=Severity.INFO,
                     properties=None) -> None:
-        self.traces.append((message, severity, properties))
+        self._append(self.traces, (message, severity, properties))
 
     def track_exception(self, exc, properties=None, metrics=None) -> None:
-        self.exceptions.append((exc, properties, metrics))
+        self._append(self.exceptions, (exc, properties, metrics))
 
     def track_request(self, name, start_time, duration, response_code,
                       success) -> None:
-        self.requests.append((name, start_time, duration, response_code,
-                              success))
+        self._append(self.requests, (name, start_time, duration,
+                                     response_code, success))
 
     def track_event(self, name, properties=None, metrics=None) -> None:
-        self.events.append((name, properties, metrics))
+        self._append(self.events, (name, properties, metrics))
 
     def track_dependency(self, name, command, start_time, duration,
                          success) -> None:
-        self.dependencies.append((name, command, start_time, duration,
-                                  success))
+        self._append(self.dependencies, (name, command, start_time,
+                                         duration, success))
+
+    def track_span(self, span) -> None:
+        self._append(self.spans, span)
 
 
 default_manager = TelemetryManager()

@@ -89,6 +89,11 @@ class PendingBatch:
     # the whole batch through any registered fan-out (expansion is
     # key-based, not row-based), so expanding again would double-deliver
     no_fanout: bool = False
+    # tracing: the trace context of the request that enqueued this batch
+    # (host-path bridged calls only — captured from the ambient
+    # RequestContext at enqueue).  The executing tick links its BATCHED
+    # span back to this trace; never one span per message
+    trace: Optional[Dict[str, Any]] = None
 
     def __len__(self) -> int:
         for c in (self.rows, self.keys_host, self.keys_dev):
@@ -286,6 +291,21 @@ class TensorEngine:
         self._task: Optional[asyncio.Task] = None
         self._running = False
         self._wake: Optional[asyncio.Event] = None
+        # tracing (orleans_tpu/spans.py): per-tick accumulators for the
+        # BATCHED tick span — distinct request traces executed this tick
+        # and per-(type, method) message counts
+        self._tick_traces: List[Dict[str, Any]] = []
+        self._tick_counts: Dict[str, int] = defaultdict(int)
+
+    def _span_recorder(self):
+        """The owning silo's SpanRecorder when tracing is on; None for
+        standalone engines (benches) or tracing disabled — every tracing
+        hook gates on this so the hot path pays one attribute check."""
+        silo = self.silo
+        if silo is None:
+            return None
+        rec = getattr(silo, "spans", None)
+        return rec if rec is not None and rec.enabled else None
 
     def _apply_mesh(self, mesh: Optional[jax.sharding.Mesh]) -> None:
         self.mesh = mesh
@@ -426,16 +446,27 @@ class TensorEngine:
         router calls this for partitions it has already proven local)."""
         future = asyncio.get_running_loop().create_future() \
             if want_results else None
+        # tracing: carry the enqueuer's ambient trace so the executing
+        # tick's batched span can link back to the request (spans.py).
+        # Only SAMPLED traces are worth carrying — link events exist
+        # only for them, so unsampled ones would ride for nothing.
+        trace = None
+        if self._span_recorder() is not None:
+            from orleans_tpu.spans import current_trace
+            t = current_trace()
+            if t is not None and t.get("sampled"):
+                trace = t
         if (isinstance(keys, jnp.ndarray) and keys.dtype == jnp.int32
                 and not want_results):
             # device keys resolve optimistically (unseen keys re-delivered
             # later) — that cannot retroactively fix an already-resolved
             # result future, so want_results forces the host path
-            batch = PendingBatch(args=args, keys_dev=keys, future=future)
+            batch = PendingBatch(args=args, keys_dev=keys, future=future,
+                                 trace=trace)
         else:
             batch = PendingBatch(args=args,
                                  keys_host=np.asarray(keys, dtype=np.int64),
-                                 future=future)
+                                 future=future, trace=trace)
         self.queues[(type_name, method)].append(batch)
         self._wake_up()
         return future
@@ -687,6 +718,13 @@ class TensorEngine:
             # window — counters/latency are accounted by the window run
             return
         t0 = time.perf_counter()
+        rec = self._span_recorder()
+        if rec is not None:
+            self._tick_traces = []
+            self._tick_counts = defaultdict(int)
+            span_msgs0 = self.messages_processed
+            span_compiles0 = self.compile_count()
+            span_start = time.monotonic()
         self.tick_number += 1
         self.ticks_run += 1
         stages = self._tick_stages = defaultdict(float)
@@ -734,6 +772,17 @@ class TensorEngine:
         self.last_tick_stages = dict(stages)
         self.tick_seconds += dt
         self.tick_durations.append(dt)
+        if rec is not None:
+            # ONE batched span per tick (batch size, per-type counts,
+            # compile events) + link events into the sampled traces it
+            # executed — never per-message device spans (stats.py note)
+            rec.tick_span(
+                tick=self.tick_number, start=span_start, duration=dt,
+                messages=self.messages_processed - span_msgs0,
+                rounds=rounds, per_method=dict(self._tick_counts),
+                compiles=self.compile_count() - span_compiles0,
+                traces=self._tick_traces)
+            self._tick_traces = []
         self._adapt(dt)
 
     def tick_interval(self) -> float:
@@ -1089,6 +1138,15 @@ class TensorEngine:
         arena = self.arena_for(type_name)
         stages = self._tick_stages
         t_res = time.perf_counter()
+        if self._span_recorder() is not None:
+            # tick-span accounting BEFORE coalescing (the merge keeps the
+            # payloads but not the per-batch trace contexts)
+            total = 0
+            for b in batches:
+                if b.trace is not None:
+                    self._tick_traces.append(b.trace)
+                total += len(b)
+            self._tick_counts[f"{type_name}.{method}"] += total
         batches = self._coalesce_host_batches(batches)
 
         # re-resolve if any batch's resolution itself grew/repacked the

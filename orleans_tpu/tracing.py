@@ -25,25 +25,55 @@ class TraceLogger:
         self._log = logging.getLogger(f"orleans_tpu.{name}")
         self._log.setLevel(level)
         self._bulk: Dict[Tuple[int, int], Tuple[float, int]] = {}
+        # instance knobs (module constants are the defaults) so tests and
+        # chatty components can tighten the window without global effect
+        self.bulk_limit = BULK_LIMIT
+        self.bulk_window = BULK_WINDOW
+        self._last_prune = time.monotonic()
 
     def child(self, suffix: str) -> "TraceLogger":
         return TraceLogger(f"{self._log.name.removeprefix('orleans_tpu.')}."
                            f"{suffix}")
+
+    def _summarize(self, level: int, code: int, count: int) -> None:
+        """Closing summary for an expired window: the suppressed-message
+        count must not vanish with the window roll."""
+        if count > self.bulk_limit:
+            self._log.log(level, "[code %d] suppressed %d messages in the "
+                          "last %ds bulk window", code,
+                          count - self.bulk_limit, int(self.bulk_window))
+
+    def _prune(self, now: float) -> None:
+        """Drop (level, code) entries whose window expired — emitting
+        their suppression summaries — so ``_bulk`` cannot grow without
+        bound across a long-lived silo's error-code population.  Runs at
+        most once per window."""
+        if now - self._last_prune < self.bulk_window:
+            return
+        self._last_prune = now
+        for key, (start, count) in list(self._bulk.items()):
+            if now - start > self.bulk_window:
+                self._summarize(key[0], key[1], count)
+                del self._bulk[key]
 
     def _throttled(self, level: int, code: int) -> bool:
         """(reference: TraceLogger bulk throttling :90-102)"""
         if code == 0:
             return False
         now = time.monotonic()
+        self._prune(now)
         start, count = self._bulk.get((level, code), (now, 0))
-        if now - start > BULK_WINDOW:
+        if now - start > self.bulk_window:
+            # window rolled for a still-active code: surface what the old
+            # window swallowed before resetting the counter
+            self._summarize(level, code, count)
             start, count = now, 0
         count += 1
         self._bulk[(level, code)] = (start, count)
-        if count == BULK_LIMIT + 1:
+        if count == self.bulk_limit + 1:
             self._log.log(level, "[code %d] further messages suppressed for "
-                          "%ds (bulk limit)", code, int(BULK_WINDOW))
-        return count > BULK_LIMIT
+                          "%ds (bulk limit)", code, int(self.bulk_window))
+        return count > self.bulk_limit
 
     def _emit(self, level: int, msg: str, code: int, exc_info=None) -> None:
         if self._throttled(level, code):

@@ -28,7 +28,7 @@ def test_telemetry_fanout_by_kind():
     mgr.track_event("activated", {"grain": "g"})
     mgr.track_dependency("storage", "write", 0.0, 0.002, True)
     assert sink.metrics[0][:2] == ("m", 1.5)
-    assert sink.traces == [("hello", Severity.WARNING, None)]
+    assert list(sink.traces) == [("hello", Severity.WARNING, None)]
     assert isinstance(sink.exceptions[0][0], ValueError)
     assert sink.requests[0][0] == "IHello.say_hello"
     assert sink.events[0][0] == "activated"

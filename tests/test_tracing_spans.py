@@ -1,0 +1,449 @@
+"""Distributed tracing plane (orleans_tpu/spans.py): span model, trace
+propagation over RequestContext, batched engine-tick spans, the flight
+recorder, and the three-ledger drop lint; plus the satellite fixes —
+TraceLogger bulk-summary/prune and bounded telemetry capture."""
+
+import asyncio
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from orleans_tpu import Grain, grain_interface
+from orleans_tpu.client import GrainClient
+from orleans_tpu.core.context import RequestContext
+from orleans_tpu.core.grain import grain_class
+from orleans_tpu.config import SiloConfig
+from orleans_tpu.resilience import (
+    DEAD_LETTER_REASONS,
+    REASON_COUNTER_ATTR,
+    REASON_EXPIRED,
+    REASON_SHED,
+)
+from orleans_tpu.spans import (
+    DEAD_LETTER_SPAN_STATUS,
+    STATUS_ERROR,
+    STATUS_OK,
+    SpanRecorder,
+    TRACE_KEY,
+)
+from orleans_tpu.stats import SiloMetrics
+from orleans_tpu.testing.cluster import TestingCluster
+
+
+# ---------------------------------------------------------------------------
+# lint: every dead-letter reason code keeps THREE ledgers in sync — a
+# SiloMetrics counter, a DeadLetterRing reason code, and a span status
+# (extends check_dead_letter_accounting's two-ledger invariant)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.tracing
+def test_dead_letter_reasons_have_counter_and_span_status():
+    metrics = SiloMetrics()
+    for reason in DEAD_LETTER_REASONS:
+        attr = REASON_COUNTER_ATTR.get(reason)
+        assert attr is not None, f"{reason}: no SiloMetrics counter mapping"
+        assert hasattr(metrics, attr), \
+            f"{reason}: SiloMetrics has no attribute {attr!r}"
+        assert isinstance(getattr(metrics, attr), int)
+        assert reason in DEAD_LETTER_SPAN_STATUS, \
+            f"{reason}: no span status mapping"
+    # no stale mappings for reasons that no longer exist, and statuses
+    # stay distinguishable per reason
+    assert set(REASON_COUNTER_ATTR) == set(DEAD_LETTER_REASONS)
+    assert set(DEAD_LETTER_SPAN_STATUS) == set(DEAD_LETTER_REASONS)
+    statuses = list(DEAD_LETTER_SPAN_STATUS.values())
+    assert len(statuses) == len(set(statuses))
+
+
+# ---------------------------------------------------------------------------
+# span recorder: head sampling, always-on failures, drop spans
+# ---------------------------------------------------------------------------
+
+@pytest.mark.tracing
+def test_sampling_discards_ok_keeps_errors_and_drops():
+    class _Msg:
+        request_context = None
+
+    rec = SpanRecorder("t", sample_rate=0.0, seed=1)
+    trace = rec.begin_trace()
+    assert trace is not None and not trace["sampled"]
+    # unsampled traces open NO hop spans (the hot-path cost envelope)...
+    span = rec.start("a", "client.send", trace)
+    assert span is None
+    rec.close_hop(span, _Msg(), "a", "client.send", STATUS_OK)
+    assert rec.recorded == 0
+
+    # ...but failures record ALWAYS, retroactively, against the carried
+    # trace context
+    msg = _Msg()
+    msg.request_context = {TRACE_KEY: trace}
+    rec.close_hop(None, msg, "b", "client.send", STATUS_ERROR, error="boom")
+    assert rec.recorded == 1
+    failed = rec.flight.spans[-1]
+    assert failed.trace_id == trace["trace_id"]
+    assert failed.status == STATUS_ERROR
+
+    rec.drop(REASON_SHED, detail="d", trace_id=trace["trace_id"])
+    assert rec.drop_spans == 1 and rec.recorded == 2
+    statuses = [s.status for s in rec.flight.spans]
+    assert DEAD_LETTER_SPAN_STATUS[REASON_SHED] in statuses
+
+    # unsampled-OK events allocate nothing
+    rec.event("e", "forward", trace)
+    assert rec.recorded == 2
+
+    disabled = SpanRecorder("off", enabled=False)
+    assert disabled.begin_trace() is None
+    assert disabled.start("x", "k", {"trace_id": "t", "sampled": True}) is None
+
+
+@pytest.mark.tracing
+def test_sampled_trace_records_and_force_sample():
+    rec = SpanRecorder("t", sample_rate=1.0, seed=1)
+    trace = rec.begin_trace()
+    assert trace["sampled"]
+    span = rec.start("a", "client.send", trace)
+    rec.finish(span)
+    assert rec.recorded == 1
+    forced = SpanRecorder("t2", sample_rate=0.0).begin_trace(
+        force_sample=True)
+    assert forced["sampled"]
+
+
+@pytest.mark.tracing
+def test_flight_recorder_ring_bound_and_dump_correlation():
+    rec = SpanRecorder("t", sample_rate=1.0, flight_capacity=4, seed=2)
+    traces = [rec.begin_trace() for _ in range(6)]
+    for t in traces:
+        rec.finish(rec.start("hop", "client.send", t))
+    assert len(rec.flight.spans) == 4 and rec.flight.dropped == 2
+    kept_tid = traces[-1]["trace_id"]
+    dead_letters = [{"reason": REASON_EXPIRED, "trace_id": kept_tid,
+                     "detail": "x"},
+                    {"reason": REASON_EXPIRED, "trace_id": "unrelated",
+                     "detail": "y"}]
+    dump = rec.flight.dump("test", dead_letters=dead_letters,
+                           breaker_transitions=[{"target": "s", "to": "open"}])
+    assert dump["reason"] == "test"
+    assert kept_tid in dump["traces"]
+    assert dump["traces"][kept_tid]["dead_letters"][0]["detail"] == "x"
+    assert dump["dead_letters_untraced"][0]["detail"] == "y"
+    assert dump["breaker_transitions"][0]["to"] == "open"
+
+
+# ---------------------------------------------------------------------------
+# satellite: TraceLogger bulk-throttle summary + prune
+# ---------------------------------------------------------------------------
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.messages = []
+
+    def emit(self, record):
+        self.messages.append(record.getMessage())
+
+
+@pytest.mark.tracing
+def test_trace_logger_bulk_summary_on_window_roll():
+    from orleans_tpu.tracing import TraceLogger
+    logger = TraceLogger("test.bulk.roll")
+    logger.bulk_window = 0.05
+    cap = _Capture()
+    logger._log.addHandler(cap)
+    logger._log.propagate = False
+    try:
+        for _ in range(9):
+            logger.warn("spam", code=42)
+        # limit=5 pass + 1 "further messages suppressed" notice
+        assert len(cap.messages) == 6
+        time.sleep(0.06)
+        logger.warn("spam again", code=42)  # window rolled
+        summaries = [m for m in cap.messages if "suppressed 4 messages" in m]
+        assert summaries, cap.messages  # 9 - 5 = 4 swallowed, now surfaced
+        assert any("spam again" in m for m in cap.messages)
+    finally:
+        logger._log.removeHandler(cap)
+
+
+@pytest.mark.tracing
+def test_trace_logger_prunes_stale_bulk_entries():
+    from orleans_tpu.tracing import TraceLogger
+    logger = TraceLogger("test.bulk.prune")
+    logger.bulk_window = 0.05
+    cap = _Capture()
+    logger._log.addHandler(cap)
+    logger._log.propagate = False
+    try:
+        for code in range(100, 130):
+            for _ in range(7):
+                logger.warn("noise", code=code)
+        assert len(logger._bulk) == 30
+        time.sleep(0.06)
+        logger.warn("other", code=999)  # triggers the prune sweep
+        assert len(logger._bulk) == 1  # only the live (999) entry survives
+        # every pruned over-limit code surfaced its suppression summary
+        summaries = [m for m in cap.messages if "suppressed 2 messages" in m]
+        assert len(summaries) == 30
+    finally:
+        logger._log.removeHandler(cap)
+
+
+# ---------------------------------------------------------------------------
+# satellite: bounded InMemoryTelemetryConsumer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.tracing
+def test_inmemory_consumer_capture_is_bounded():
+    from orleans_tpu.telemetry import InMemoryTelemetryConsumer
+    sink = InMemoryTelemetryConsumer(capture_limit=5)
+    for i in range(8):
+        sink.track_metric(f"m{i}", float(i))
+    assert len(sink.metrics) == 5
+    assert sink.dropped == 3
+    assert sink.metrics[0][0] == "m3"  # newest retained
+    sink.track_span({"span_id": "s"})
+    assert list(sink.spans) == [{"span_id": "s"}]
+
+
+# ---------------------------------------------------------------------------
+# RequestContext + trace propagation: client → gateway → silo →
+# cross-silo forward → resend; cleared between turns
+# ---------------------------------------------------------------------------
+
+@grain_interface
+class ICtxEcho:
+    async def who(self) -> dict: ...
+    async def leak(self) -> None: ...
+    async def read_leak(self): ...
+
+
+@grain_class
+class CtxEchoGrain(Grain, ICtxEcho):
+    async def who(self) -> dict:
+        t = RequestContext.get(TRACE_KEY)
+        return {"k": RequestContext.get("k"),
+                "trace_id": t.get("trace_id") if t else None,
+                "sampled": bool(t and t.get("sampled"))}
+
+    async def leak(self) -> None:
+        RequestContext.set("leaked", "x")
+
+    async def read_leak(self):
+        return RequestContext.get("leaked")
+
+
+async def _key_hosted_on(cluster, silo, start: int = 0) -> int:
+    """Activate candidate grains until one lands on ``silo`` (default
+    placement is hash-based, so the host follows the key)."""
+    factory = cluster.silos[0].attach_client()
+    for key in range(start, start + 64):
+        ref = factory.get_grain(ICtxEcho, key)
+        await ref.who()
+        if cluster.find_silo_hosting(ref.grain_id) is silo:
+            return key
+    raise AssertionError("no key hashed to the target silo in 64 tries")
+
+
+@pytest.mark.tracing
+def test_request_context_survives_client_gateway_cross_silo_resend(run):
+    from orleans_tpu.runtime.messaging import Category, Direction
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        client = None
+        try:
+            # pick a key hosted on silos[1], so the external client's
+            # calls via silos[0]'s gateway must cross silos
+            key = await _key_hosted_on(cluster, cluster.silos[1])
+
+            client = await GrainClient(trace_sample_rate=1.0).connect(
+                cluster.silos[0])
+            ref = client.get_grain(ICtxEcho, key)
+            RequestContext.set("k", "v")
+            RequestContext.set(TRACE_KEY, {"trace_id": "fixed-tid",
+                                           "span_id": "", "sampled": True})
+            got = await ref.who()
+            # app context AND trace ids survive client → gateway →
+            # silo0 → cross-silo hop to silo1
+            assert got["k"] == "v"
+            assert got["trace_id"] == "fixed-tid"
+            assert got["sampled"] is True
+
+            # resend leg: reject the next request once at the hosting
+            # silo; the client's transparent resend must re-carry the
+            # same exported context
+            original = cluster.silos[1].dispatcher._should_inject_error
+            fired = {"n": 0}
+
+            def inject_once(msg):
+                if (msg.category == Category.APPLICATION
+                        and msg.direction == Direction.REQUEST
+                        and msg.method_name == "who" and fired["n"] == 0):
+                    fired["n"] += 1
+                    return True
+                return False
+
+            cluster.silos[1].dispatcher._should_inject_error = inject_once
+            try:
+                got = await ref.who()
+            finally:
+                cluster.silos[1].dispatcher._should_inject_error = original
+            assert fired["n"] == 1
+            assert client.requests_resent == 1
+            assert got["k"] == "v" and got["trace_id"] == "fixed-tid"
+
+            # context set INSIDE a turn must not leak into the next turn
+            # on the same activation
+            RequestContext.clear()
+            await ref.leak()
+            assert await ref.read_leak() is None
+
+            # and with no ambient trace the client mints one per request
+            # (ingress): the grain still sees SOME trace id, not ours
+            got = await ref.who()
+            assert got["trace_id"] not in (None, "fixed-tid")
+        finally:
+            if client is not None:
+                await client.close()
+            await cluster.stop()
+
+    run(main())
+
+
+@pytest.mark.tracing
+def test_cross_silo_trace_spans_reach_both_silos(run):
+    """A sampled request through the cluster leaves spans on both the
+    sending and executing silo under ONE trace id, including the turn
+    and queue-wait hops."""
+
+    async def main():
+        def cfg(name):
+            c = SiloConfig(name=name)
+            c.tracing.sample_rate = 1.0
+            return c
+
+        cluster = await TestingCluster(n_silos=2,
+                                       config_factory=cfg).start()
+        try:
+            key = await _key_hosted_on(cluster, cluster.silos[1],
+                                       start=1000)
+            f0 = cluster.silos[0].attach_client()
+            got = await f0.get_grain(ICtxEcho, key).who()
+            tid = got["trace_id"]
+            assert tid
+            kinds0 = {s.kind for s in cluster.silos[0].spans.flight.spans
+                      if s.trace_id == tid}
+            kinds1 = {s.kind for s in cluster.silos[1].spans.flight.spans
+                      if s.trace_id == tid}
+            assert "client.send" in kinds0
+            assert "activation.turn" in kinds1
+            assert "dispatch.queue" in kinds1
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# batched engine-tick spans
+# ---------------------------------------------------------------------------
+
+def _define_span_counter():
+    import jax.numpy as jnp
+
+    from orleans_tpu.tensor import Batch, VectorGrain, field, seg_sum
+    from orleans_tpu.tensor.vector_grain import (
+        batched_method,
+        vector_grain,
+        vector_type,
+    )
+
+    if vector_type("SpanCounter") is not None:
+        return
+
+    @vector_grain
+    class SpanCounter(VectorGrain):
+        total = field(jnp.float32, 0.0)
+
+        @batched_method
+        @staticmethod
+        def poke(state, batch: Batch, n_rows: int):
+            return {
+                "total": state["total"] + seg_sum(batch.args["v"],
+                                                  batch.rows, n_rows),
+            }, None, ()
+
+
+@pytest.mark.tracing
+def test_engine_tick_spans_are_batched_and_linked(run):
+    from orleans_tpu.runtime.silo import Silo
+
+    async def main():
+        _define_span_counter()
+        silo = Silo(name="tick-span")
+        await silo.start()
+        try:
+            engine = silo.tensor_engine
+            RequestContext.set(TRACE_KEY, {"trace_id": "tick-tid",
+                                           "span_id": "", "sampled": True})
+            n = 64
+            engine.send_batch("SpanCounter", "poke",
+                              np.arange(n, dtype=np.int64),
+                              {"v": np.ones(n, np.float32)})
+            await engine.flush()
+            RequestContext.clear()
+            spans = list(silo.spans.flight.spans)
+            ticks = [s for s in spans if s.kind == "engine.tick"]
+            links = [s for s in spans if s.kind == "engine.tick.link"]
+            # BATCHED: one span per executing tick, never per message
+            assert ticks and len(ticks) < n
+            executed = [t for t in ticks if t.attrs["messages"] > 0]
+            assert sum(t.attrs["messages"] for t in executed) >= n
+            assert any("SpanCounter.poke" in t.attrs["per_method"]
+                       for t in executed)
+            # the tick is the shared child of the request that rode it
+            assert links and links[0].trace_id == "tick-tid"
+            tick_ids = {t.span_id for t in ticks}
+            assert links[0].attrs["tick_span_id"] in tick_ids
+        finally:
+            await silo.stop(graceful=False)
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# dead letters ↔ drop spans ↔ flight dump correlation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.tracing
+def test_dead_letter_emits_drop_span_and_dump_correlates(run):
+    from orleans_tpu.runtime.messaging import Category, Direction, Message
+    from orleans_tpu.runtime.silo import Silo
+
+    async def main():
+        silo = Silo(name="drop-span")
+        await silo.start()
+        try:
+            msg = Message(
+                category=Category.APPLICATION, direction=Direction.REQUEST,
+                method_name="work",
+                request_context={TRACE_KEY: {"trace_id": "drop-tid",
+                                             "span_id": "abc",
+                                             "sampled": False}},
+                expiration=time.monotonic() - 1.0)  # already expired
+            silo.dead_letters.record(msg, REASON_EXPIRED, "expired in test")
+            assert silo.dead_letters.entries[-1]["trace_id"] == "drop-tid"
+            # the drop span recorded even though the trace was UNSAMPLED
+            drops = [s for s in silo.spans.flight.spans if s.kind == "drop"]
+            assert drops and drops[-1].trace_id == "drop-tid"
+            assert drops[-1].status == DEAD_LETTER_SPAN_STATUS[REASON_EXPIRED]
+            dump = silo.flight_dump("test")
+            assert "drop-tid" in dump["traces"]
+            assert dump["traces"]["drop-tid"]["dead_letters"], dump
+        finally:
+            await silo.stop(graceful=False)
+
+    run(main())
