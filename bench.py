@@ -575,6 +575,201 @@ async def _degraded_tier(smoke: bool) -> dict:
     }
 
 
+async def _collection_scenario(n_grains: int, hot: int, budget_s: float,
+                               chunk_rows: int, synchronous: bool) -> dict:
+    """One run of the collection scenario: activate ``n_grains`` Presence
+    grains with a store attached, settle into a hot-subset steady state,
+    let the tick-interleaved collector evict the idle majority (with
+    columnar write-back), and measure (a) the worst per-tick collection
+    stall, (b) throughput before vs after eviction, (c) reactivation
+    correctness.  ``synchronous=True`` zeroes the pause budget — the
+    whole sweep drains in ONE tick, the stop-the-world baseline."""
+    import numpy as np
+
+    import samples.presence  # noqa: F401 — registers the vector grains
+    from orleans_tpu.config import TensorEngineConfig
+    from orleans_tpu.tensor import MemoryVectorStore, TensorEngine
+
+    # idle_ticks covers activation + warm + the pre window (~40 ticks),
+    # so the idle majority first becomes eligible inside the collect
+    # phase — never under a measured throughput window
+    idle_ticks, every = 60, 16
+    cfg = TensorEngineConfig(
+        tick_interval=0.0,
+        auto_fusion_ticks=0,  # unfused ticks: per-tick stalls observable
+        collection_idle_ticks=idle_ticks,
+        collection_every_ticks=every,
+        collection_pause_budget_s=0.0 if synchronous else budget_s,
+        collection_chunk_rows=chunk_rows,
+        # isolate COLLECTION pauses: evicting ~90% of the arena would
+        # cross the fragmentation threshold and trigger the (deliberate,
+        # separately-knobbed) full repack mid-measurement
+        compact_fragmentation_threshold=0.0)
+    engine = TensorEngine(config=cfg, store=MemoryVectorStore())
+    keys = np.arange(n_grains, dtype=np.int64)
+    games = (keys % max(1, n_grains // 100)).astype(np.int32)
+    hot_keys = keys[:hot]
+
+    def payload(ks, tick: int) -> dict:
+        return {"game": games[:len(ks)],
+                "score": np.ones(len(ks), np.float32),
+                "tick": np.full(len(ks), tick, np.int32)}
+
+    async def drive(injector, n_ticks: int, collect_stalls=None) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_ticks):
+            injector.inject(payload(injector.keys, engine.tick_number))
+            engine.run_tick()
+            if collect_stalls is not None:
+                collect_stalls.append(
+                    engine.last_tick_stages.get("collect", 0.0))
+        await engine.flush()
+        return time.perf_counter() - t0
+
+    # activate everything (the cold start is untimed)
+    all_inj = engine.make_injector("PresenceGrain", "heartbeat", keys)
+    await drive(all_inj, 4)
+    arena = engine.arena_for("PresenceGrain")
+
+    # warm the collection machinery on a sacrificial key range OUTSIDE
+    # the measured window: the idle-mask kernel and the pow2 scatter/
+    # gather programs compile on first use, and those one-time stalls
+    # must not masquerade as steady-state collection pauses
+    warm = np.arange(n_grains, n_grains + chunk_rows, dtype=np.int64)
+    arena.resolve_rows(warm, tick=0)
+    arena.select_idle_rows(0)
+    engine.arena_for("GameGrain").select_idle_rows(0)
+    arena.deactivate_idle_rows(arena.lookup_rows(warm)[0], 10**9,
+                               write_back=True)
+    live0, gen0 = arena.live_count, arena.generation
+
+    # pre-eviction steady state on the hot subset (idle_ticks shields it
+    # from the collector: the cold majority is not yet old enough).
+    # Warm first — the hot batch size compiles its own step program, and
+    # that one-time cost must not deflate the pre-eviction rate the
+    # post-eviction rate is compared against
+    hot_inj = engine.make_injector("PresenceGrain", "heartbeat", hot_keys)
+    await drive(hot_inj, 16)  # same tick count as the measured window:
+    # the miss-check drain pads its counter stack to the window's shape
+    msgs0 = engine.messages_processed
+    pre_s = await drive(hot_inj, 16)
+    pre_rate = (engine.messages_processed - msgs0) / pre_s
+
+    # collection phase: keep the hot traffic flowing while sweeps evict
+    # the idle majority between ticks; record the collect stage of every
+    # tick — the pause the budget must bound
+    stalls: list = []
+    evicted0 = arena.evicted_count
+    for _ in range(40):
+        await drive(hot_inj, 8, collect_stalls=stalls)
+        if arena.evicted_count > evicted0 and not engine.collector.active():
+            break
+    evicted = arena.evicted_count - evicted0
+
+    # post-eviction steady state: same hot subset, no recompile storm
+    msgs1 = engine.messages_processed
+    post_s = await drive(hot_inj, 16)
+    post_rate = (engine.messages_processed - msgs1) / post_s
+
+    # reactivation round-trip: an evicted grain's state came back through
+    # the store (columnar write-back → read_many at activation)
+    probe = int(keys[-1])
+    engine.send_batch("PresenceGrain", "heartbeat",
+                      np.array([probe], dtype=np.int64),
+                      {"game": np.zeros(1, np.int32),
+                       "score": np.ones(1, np.float32),
+                       "tick": np.zeros(1, np.int32)})
+    await engine.flush()
+    restored_hb = int(np.asarray(
+        arena.state["heartbeats"])[arena.resolve_rows(
+            np.array([probe], dtype=np.int64))[0]])
+    stall = np.asarray(stalls) if stalls else np.zeros(1)
+    return {
+        "synchronous": synchronous,
+        "grains": n_grains,
+        "hot_grains": hot,
+        "evicted": evicted,
+        "pause_budget_s": 0.0 if synchronous else budget_s,
+        "chunk_rows": chunk_rows,
+        "max_collect_stall_s": round(float(stall.max()), 4),
+        "collect_stall_p99_s": round(float(np.percentile(stall, 99)), 4),
+        "collector": {k: v for k, v in engine.collector.snapshot().items()
+                      if k != "last_slices"},
+        "pre_evict_msgs_per_sec": round(pre_rate, 1),
+        "post_evict_msgs_per_sec": round(post_rate, 1),
+        "post_vs_pre": round(post_rate / max(1e-9, pre_rate), 3),
+        "generation_preserved": arena.generation == gen0,
+        "live_before_collection": live0,
+        "live_after": arena.live_count,
+        "reactivated_with_state": restored_hb > 1,
+    }
+
+
+async def _collection_tier(smoke: bool, synchronous_only: bool) -> dict:
+    """The collection bench tier: incremental (pause-budgeted) eviction
+    of the idle majority under live hot traffic, A/B'd against the
+    synchronous stop-the-world drain (``--synchronous-collection`` runs
+    only that side, the ``--no-slab-aggregation`` pattern).  The smoke
+    tier ASSERTS bounded pauses so CI catches a pause regression without
+    the 4M probe."""
+    if smoke:
+        n_grains, hot, budget, chunk = 60_000, 6_000, 0.01, 1_024
+    else:
+        n_grains, hot, budget, chunk = 500_000, 50_000, 0.02, 16_384
+    if synchronous_only:
+        sync = await _collection_scenario(n_grains, hot, budget, chunk,
+                                          synchronous=True)
+        return {"metric": "collection_max_stall_s",
+                "value": sync["max_collect_stall_s"],
+                "unit": "s", "engine": "synchronous (stop-the-world) "
+                "collection baseline", **sync}
+    incr = await _collection_scenario(n_grains, hot, budget, chunk,
+                                      synchronous=False)
+    sync = await _collection_scenario(n_grains, hot, budget, chunk,
+                                      synchronous=True)
+    # the stop-the-world stall vs the incremental p99 slice (the sync
+    # baseline's sweep IS one slice, so its max is its p99; the
+    # incremental p99 is the steady pause — one host GC outlier in a
+    # 50-slice run must not decide the A/B)
+    reduction = (sync["max_collect_stall_s"]
+                 / max(1e-9, incr["collect_stall_p99_s"]))
+    # bounded: the budget is checked between chunks, so a slice may
+    # overshoot by one chunk's write-back — judge the p99 against a 3x
+    # envelope (the max is published; a single host GC outlier must not
+    # flake CI)
+    bounded = incr["collect_stall_p99_s"] <= 3.0 * budget
+    out = {
+        "metric": "collection_evict_max_pause_s",
+        "value": incr["max_collect_stall_s"],
+        "unit": "s",
+        "engine": "free-list arena + tick-interleaved collector "
+                  "(device victim selection, columnar write-back, "
+                  f"{budget * 1000:.0f}ms pause budget); A/B vs the "
+                  "synchronous stop-the-world drain",
+        **incr,
+        "bounded_pause": bounded,
+        "synchronous_baseline": {
+            "max_collect_stall_s": sync["max_collect_stall_s"],
+            "evicted": sync["evicted"],
+            "post_vs_pre": sync["post_vs_pre"],
+        },
+        "pause_reduction_x": round(reduction, 1),
+    }
+    if smoke:
+        # the CI contract: incremental pauses are bounded and the
+        # stop-the-world stall shrank by >= 10x at smoke scale
+        if not bounded:
+            raise RuntimeError(
+                f"collection smoke: incremental p99 stall "
+                f"{incr['collect_stall_p99_s']}s exceeds the bounded-"
+                f"pause envelope (budget {budget}s)")
+        if reduction < 10.0:
+            raise RuntimeError(
+                f"collection smoke: pause reduction {reduction:.1f}x "
+                f"< 10x vs the synchronous baseline")
+    return out
+
+
 async def _helloworld_bench(n_grains: int = 2000, n_rounds: int = 5,
                             latency_calls: int = 2000) -> dict:
     """The PR1 config (reference: Samples/HelloWorld — one silo, RPC
@@ -846,12 +1041,17 @@ def main() -> None:
     parser.add_argument("--workload",
                         choices=("presence", "chirper", "gpstracker",
                                  "twitter", "helloworld", "cluster",
-                                 "degraded"),
+                                 "degraded", "collection"),
                         default="presence")
     parser.add_argument("--no-slab-aggregation", action="store_true",
                         help="cluster workload: disable the sender-side "
                              "slab aggregation fast path (the A/B toggle; "
                              "the default run publishes both sides)")
+    parser.add_argument("--synchronous-collection", action="store_true",
+                        help="collection workload: run ONLY the "
+                             "stop-the-world (zero pause budget) baseline "
+                             "(the A/B toggle; the default run publishes "
+                             "both sides)")
     parser.add_argument("--target-latency", type=float, default=None,
                         help="publish ONE latency-bounded presence "
                              "operating point at this p99 budget (seconds) "
@@ -952,9 +1152,14 @@ def main() -> None:
     async def _scale_probe() -> dict:
         """SURVEY §5 scaling claim (O(1M) activations/silo,
         ActivationCollector.cs:37) pushed 4x: Presence at 4M grains on
-        one chip — activation at scale, fused steady state, bulk
-        deactivation + shard compaction (generation bump), and the
-        re-activation re-trace afterwards."""
+        one chip — activation at scale, fused steady state, then
+        INCREMENTAL deactivation of the idle half (free-list arena:
+        device-side victim selection, pause-budgeted slices, no repack,
+        generation preserved) and the post-eviction steady state.  The
+        old stop-the-world path (evict → full shard compaction →
+        generation bump → re-resolution/recompile storm) measured 20.5s
+        of stall at this scale; the headline numbers here are the max
+        slice pause and the post-eviction throughput."""
         import numpy as np
 
         from orleans_tpu.tensor import TensorEngine
@@ -968,13 +1173,40 @@ def main() -> None:
             n_ticks=6, window=3)
         arena = engine.arena_for("PresenceGrain")
         mirror = "dense" if arena.dense_index() is not None else "sorted"
+        gen0 = arena.generation
+        # age the first half out: touch only the second half at a later
+        # tick, then sweep with a cutoff between the two
+        engine.tick_number += 100
+        keep = np.arange(n_players // 2, n_players, dtype=np.int64)
+        arena.resolve_rows(keep, tick=engine.tick_number)
+        # keep every game hot too: the probe measures evicting the idle
+        # PLAYER half, not the fan-in destinations
+        engine.arena_for("GameGrain").resolve_rows(
+            np.arange(n_games, dtype=np.int64), tick=engine.tick_number)
+        budget = engine.config.collection_pause_budget_s
+        chunk = engine.config.collection_chunk_rows
+        # warm the collection path outside the timed window (first-use
+        # jit compiles of the idle-mask kernel + pow2 scatters must not
+        # read as eviction pauses); the warmed rows are part of the idle
+        # half and simply leave a chunk early
+        arena.select_idle_rows(0)
+        arena.deactivate_idle_rows(
+            np.arange(min(chunk, n_players // 8), dtype=np.int64),
+            10**9, write_back=False)
         t0 = time.perf_counter()
-        evicted = arena.evict_keys(
-            np.arange(n_players // 2, dtype=np.int64), write_back=False)
-        evict_s = time.perf_counter() - t0
-        # the evicted half re-activates and the program re-traces against
-        # the compacted layout — collection under pressure must not
-        # degrade the steady state
+        selected = engine.collector.start_sweep(engine.tick_number - 50,
+                                                write_back=False)
+        pauses = [time.perf_counter() - t0]  # selection counts as a stall
+        evicted = 0
+        while engine.collector.active():
+            t1 = time.perf_counter()
+            evicted += engine.collector.run_slice(budget, chunk)
+            pauses.append(time.perf_counter() - t1)
+        evict_total = time.perf_counter() - t0
+        p = np.asarray(pauses)
+        # the evicted half's slots return to the free lists in place —
+        # nothing moved, so the surviving half's cached rows, the device
+        # mirror and compiled programs for it stay valid
         post = await run_presence_load_fused(
             engine, n_players=n_players, n_games=n_games,
             n_ticks=3, window=3)
@@ -984,8 +1216,17 @@ def main() -> None:
             "device_mirror": mirror,
             "arena_capacity": arena.capacity,
             "evicted_half_count": evicted,
-            "evict_compact_seconds": round(evict_s, 3),
-            "post_repack_msgs_per_sec": round(post["messages_per_sec"], 1),
+            "victims_selected": selected,
+            "evict_total_seconds": round(evict_total, 3),
+            "evict_pause_p99_s": round(float(np.percentile(p, 99)), 4),
+            "evict_max_pause_s": round(float(p.max()), 4),
+            "evict_slices": len(pauses) - 1,
+            "pause_budget_s": budget,
+            "generation_preserved": arena.generation == gen0,
+            "arena_fragmentation": round(arena.fragmentation(), 4),
+            "post_evict_msgs_per_sec": round(post["messages_per_sec"], 1),
+            "post_vs_pre": round(post["messages_per_sec"]
+                                 / max(1e-9, stats["messages_per_sec"]), 3),
         }
 
     async def _stream_fed_presence() -> dict:
@@ -1254,10 +1495,14 @@ def main() -> None:
     async def run_degraded() -> dict:
         return await _degraded_tier(args.smoke)
 
+    async def run_collection() -> dict:
+        return await _collection_tier(args.smoke,
+                                      args.synchronous_collection)
+
     runners = {"presence": run, "chirper": run_chirper,
                "gpstracker": run_gps, "twitter": run_twitter,
                "helloworld": run_hello, "cluster": run_cluster,
-               "degraded": run_degraded}
+               "degraded": run_degraded, "collection": run_collection}
     result = asyncio.run(runners[args.workload]())
     print(json.dumps(result))
     if args.workload == "degraded" and args.smoke:

@@ -152,6 +152,24 @@ class TensorEngineConfig:
     # 0 disables automatic sweeps (collect_idle() remains callable).
     collection_idle_ticks: int = 0
     collection_every_ticks: int = 64
+    # incremental collection (the reference collector never stalls the
+    # message pump — ActivationCollector.cs:37): a sweep's victims drain
+    # in bounded chunks interleaved between ticks, each slice capped at
+    # this host-pause budget (seconds).  <= 0 runs the whole sweep in one
+    # slice — the synchronous stop-the-world baseline the collection
+    # bench A/Bs against (bench.py --synchronous-collection).
+    # Live-reloadable.
+    collection_pause_budget_s: float = 0.005
+    # victims written back per chunk: bounds both a single chunk's stall
+    # (the budget is checked between chunks) and the device→host gather
+    # size of one columnar write-back.  Live-reloadable.
+    collection_chunk_rows: int = 65536
+    # freed/high-water fragmentation ratio above which deactivation still
+    # triggers a full per-shard repack (rows move, generation bumps —
+    # the expensive path free-list reuse otherwise avoids).  <= 0 or > 1
+    # disables threshold compaction (grow/reshard still repack).
+    # Live-reloadable.
+    compact_fragmentation_threshold: float = 0.75
     # padded host-batch buckets: a batch compiles at the smallest bucket
     # ≥ its size, so the ladder bounds both compile count and padding
     # waste (the old 65536 → 1M jump made a 200k-message batch pay 5×

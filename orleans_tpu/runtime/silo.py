@@ -525,6 +525,13 @@ class Silo:
             enabled=tr.enabled, sample_rate=tr.sample_rate,
             flight_capacity=tr.flight_recorder_capacity,
             breaker_capacity=tr.breaker_transition_capacity)
+        # collection knobs: the engine reads pause budget/chunk/cadence
+        # off the live dataclass every tick, but each arena copied the
+        # compaction threshold at creation — re-push it
+        if self.tensor_engine is not None:
+            thr = self.config.tensor.compact_fragmentation_threshold
+            for arena in self.tensor_engine.arenas.values():
+                arena.compact_fragmentation = thr
         if self.watchdog is not None and self.config.watchdog_period > 0:
             self.watchdog.period = self.config.watchdog_period
         if self.load_publisher is not None \
@@ -619,10 +626,13 @@ class Silo:
         trace, joined with this silo's dead letters (trace-tagged) and
         recent breaker transitions.  Chaos invariant failures and
         degraded snapshots trigger it; callable any time."""
+        slices = list(self.tensor_engine.collector.last_slices) \
+            if self.tensor_engine is not None else None
         return self.spans.flight.dump(
             reason=reason,
             dead_letters=list(self.dead_letters.entries),
-            breaker_transitions=list(self.spans.breaker_transitions))
+            breaker_transitions=list(self.spans.breaker_transitions),
+            collection_slices=slices)
 
     def publish_data_plane_telemetry(self) -> None:
         """Mirror the cross-silo data-plane counters (vector-router slab
@@ -653,6 +663,22 @@ class Silo:
              "breaker_fast_fails": self.breakers.fast_fails,
              "retries_denied": self.retry_budget.denied},
             {"silo": self.name}, prefix="overload.")
+        # activation-collection gauges: per-slice pause + per-arena
+        # fragmentation (the incremental collector also emits
+        # collect.pause_s live per slice; this is the periodic rollup)
+        if self.tensor_engine is not None:
+            col = self.tensor_engine.collector
+            mgr.track_metrics(
+                {"pause_p99_s": col.pause_p99_s(),
+                 "max_pause_s": col.max_pause_s,
+                 "rows_evicted": col.rows_evicted,
+                 "sweeps_completed": col.sweeps_completed,
+                 "write_back_failures": col.write_back_failures},
+                {"silo": self.name}, prefix="collect.")
+            for name, arena in self.tensor_engine.arenas.items():
+                mgr.track_metric("arena.fragmentation",
+                                 arena.fragmentation(),
+                                 {"silo": self.name, "arena": name})
 
     # ================= membership view =====================================
 

@@ -191,7 +191,8 @@ class FlightRecorder:
 
     def dump(self, reason: str = "",
              dead_letters: Optional[Iterable[Dict[str, Any]]] = None,
-             breaker_transitions: Optional[Iterable[Dict[str, Any]]] = None
+             breaker_transitions: Optional[Iterable[Dict[str, Any]]] = None,
+             collection_slices: Optional[Iterable[Dict[str, Any]]] = None
              ) -> Dict[str, Any]:
         """The correlated evidence bundle: spans grouped by trace, each
         trace joined with its dead letters; tick spans and unattributable
@@ -222,6 +223,9 @@ class FlightRecorder:
             "untraced_spans": untraced[-32:],
             "dead_letters_untraced": orphans[-32:],
             "breaker_transitions": list(breaker_transitions or []),
+            # recent incremental-collection slices (engine.collect):
+            # a crash mid-sweep names what the collector was doing
+            "collection_slices": list(collection_slices or [])[-32:],
         }
 
 
@@ -432,6 +436,28 @@ class SpanRecorder:
                        tick_span_id=span.span_id, tick=tick,
                        batch_messages=messages, compiles=compiles)
         span.attrs["linked_traces"] = len(seen)
+        self._commit(span)
+        return span
+
+    def collect_span(self, tick: int, duration: float, evicted: int,
+                     remaining: int, sweep_done: bool,
+                     failed: bool = False) -> Span:
+        """ONE batched span per collection SLICE (engine.collect) — the
+        incremental activation collector's pause evidence: how long this
+        slice stalled the tick, how many rows it evicted, how much of
+        the sweep remains.  Batched like tick spans (never one span per
+        evicted row); always recorded so a pause-budget overrun is
+        visible in the flight recorder even at sample_rate 0."""
+        self.started += 1
+        span = Span(
+            trace_id="", span_id=new_id(), parent_id=None,
+            name=f"collect tick {tick}", kind="engine.collect",
+            silo=self.name, sampled=True,
+            start=time.monotonic() - duration, duration=duration,
+            status=STATUS_ERROR if failed else STATUS_OK,
+            attrs={"tick": tick, "evicted": evicted,
+                   "remaining": remaining, "sweep_done": sweep_done,
+                   "write_back_failed": failed})
         self._commit(span)
         return span
 

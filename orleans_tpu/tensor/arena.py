@@ -39,6 +39,25 @@ def _touch_kernel(last_use_dev, rows, tick):
     return last_use_dev.at[rows].max(tick, mode="drop")
 
 
+@jax.jit
+def _idle_mask_kernel(last_use_dev, last_use_host, live, cutoff):
+    """Victim selection stays on device: merge both use clocks with one
+    vectorized compare; only the boolean victim mask (1 byte/row) crosses
+    to the host — never the full clock columns or any state field."""
+    return live & (jnp.maximum(last_use_dev, last_use_host) < cutoff)
+
+
+def _pow2_pad(rows: np.ndarray, fill: int) -> np.ndarray:
+    """Pad an index vector to the next power of two with ``fill`` —
+    data-dependent row counts would otherwise compile one eager device
+    gather/scatter per distinct length; pow2 padding bounds the compile
+    set to O(log n).  ``fill`` is row 0 for gathers (result sliced back
+    to the real length) or ``capacity`` for mode="drop" scatters."""
+    pad = np.full(1 << max(0, len(rows) - 1).bit_length(), fill, np.int32)
+    pad[:len(rows)] = rows
+    return pad
+
+
 def _hash_keys_u64(keys: np.ndarray) -> np.ndarray:
     """Vectorized splitmix64 matching hashing.stable_hash_u64, so host row
     assignment and any device-side bucketing agree."""
@@ -107,10 +126,31 @@ class GrainArena:
         # bumped whenever rows move (growth/repack); consumers holding
         # resolved row vectors must re-resolve on mismatch
         self.generation = 0
+        # bumped whenever rows are FREED without moving (free-list
+        # deactivation preserves the generation — surviving rows stay
+        # put, so caches over live keys remain valid).  Consumers holding
+        # resolved rows check BOTH: a generation mismatch means rows
+        # moved (full re-resolve); an epoch-only mismatch means some rows
+        # were freed — a cheap liveness re-check suffices, and only
+        # caches that actually reference an evicted key pay a re-resolve.
+        self.eviction_epoch = 0
 
         # host-side directory partition: key → row
         self._key_of_row = np.full(self.capacity, -1, dtype=np.int64)
         self._shard_next = np.zeros(self.n_shards, dtype=np.int64)
+        # per-shard free lists (LIFO): rows freed by deactivation are
+        # reused in place by later activations instead of repacking the
+        # block — the tensor-path analog of the reference collector's
+        # non-stalling, in-place deactivation (ActivationCollector.cs:37).
+        # Slots on a free list always hold init-valued state columns and
+        # zeroed use clocks (reset at free time), so reuse needs no
+        # per-activation scrub.
+        self._free: list = [np.empty(0, dtype=np.int64)
+                            for _ in range(self.n_shards)]
+        # freed/high-water ratio above which a full repack still runs
+        # (engine.arena_for overrides from TensorEngineConfig; <= 0 or
+        # > 1 disables threshold compaction)
+        self.compact_fragmentation = 0.75
         self._sorted_keys = np.empty(0, dtype=np.int64)
         self._sorted_rows = np.empty(0, dtype=np.int32)
         self._dirty = False
@@ -365,19 +405,33 @@ class GrainArena:
         if len(keys) and int(keys.max()) >= 2**31 - 1:
             self.has_wide_keys = True
         shards = (_hash_keys_u64(keys) % np.uint64(self.n_shards)).astype(np.int64)
-        # check capacity per shard; grow if any block would overflow
+        # capacity per shard counts free-list slots as available — freed
+        # rows are reused in place before the bump pointer advances, so
+        # steady churn (activate/evict cycles) never grows the arena
         counts = np.bincount(shards, minlength=self.n_shards)
-        while np.any(self._shard_next + counts > self.shard_capacity):
-            self._grow()
+        free_counts = np.array([len(f) for f in self._free], dtype=np.int64)
+        while np.any(self._shard_next + np.maximum(counts - free_counts, 0)
+                     > self.shard_capacity):
+            self._grow()  # remaps the free lists; free_counts unchanged
         for s in range(self.n_shards):
             ks = keys[shards == s]
             if len(ks) == 0:
                 continue
-            start = int(self._shard_next[s])
-            base = s * self.shard_capacity
-            rows = np.arange(start, start + len(ks)) + base
+            parts = []
+            reuse = min(len(ks), len(self._free[s]))
+            if reuse:
+                # LIFO: most-recently-freed slots first (their columns
+                # are the likeliest still resident in device cache)
+                parts.append(self._free[s][-reuse:])
+                self._free[s] = self._free[s][:-reuse]
+            fresh = len(ks) - reuse
+            if fresh:
+                start = int(self._shard_next[s])
+                base = s * self.shard_capacity
+                parts.append(np.arange(start, start + fresh) + base)
+                self._shard_next[s] += fresh
+            rows = np.concatenate(parts) if len(parts) > 1 else parts[0]
             self._key_of_row[rows] = ks
-            self._shard_next[s] += len(ks)
         self.live_count += len(keys)
         self._dirty = True
         if self.store is not None:
@@ -435,6 +489,11 @@ class GrainArena:
         self.capacity = new_capacity
         self._key_of_row = new_key_of_row
         self.last_use_tick = new_last_use
+        # free slots ride along: row s*old_per + off → s*new_per + off
+        # (the fresh columns are init-valued everywhere non-live, so the
+        # remapped slots keep the clean-on-free invariant)
+        self._free = [s * new_per + (f - s * old_per)
+                      for s, f in enumerate(self._free)]
         self._dirty = True
         self.generation += 1
 
@@ -447,30 +506,85 @@ class GrainArena:
     # -- collection (reference: ActivationCollector.cs:37) -------------------
 
     def rows_to_host(self, rows: np.ndarray) -> Dict[str, np.ndarray]:
-        """Gather the given rows' state columns to host, one d2h per field."""
-        idx = jnp.asarray(rows, dtype=jnp.int32)
-        return {name: np.asarray(col[idx])
-                for name, col in self.state.items()}
+        """Gather the given rows' state columns to host.  All gathers
+        dispatch first, then ONE ``jax.device_get`` fetches the whole
+        tree — the per-field d2h round-trips (each paying a completion
+        observation on tunneled runtimes) collapse into one.  Gathers
+        are pow2-padded (row 0 repeated, sliced off after the fetch) so
+        data-dependent row counts reuse O(log n) compiled gathers."""
+        n = len(rows)
+        idx = jnp.asarray(_pow2_pad(rows, 0))
+        host = jax.device_get({name: col[idx]
+                               for name, col in self.state.items()})
+        return {name: col[:n] for name, col in host.items()}
+
+    def fragmentation(self) -> float:
+        """Worst per-shard freed/high-water ratio (0.0 = no holes).  The
+        threshold trigger for full compaction — with in-place free-list
+        reuse fragmentation is a capacity-reclaim concern, not a
+        correctness one."""
+        hw = np.maximum(self._shard_next, 1).astype(np.float64)
+        free = np.array([len(f) for f in self._free], dtype=np.float64)
+        return float((free / hw).max()) if self.n_shards else 0.0
+
+    def select_idle_rows(self, older_than_tick: int) -> np.ndarray:
+        """Victim selection for collection: one vectorized compare over
+        the merged use clocks ON DEVICE (reference bucket test:
+        ActivationCollector.cs:37); only the boolean victim mask crosses
+        to the host.  Returns victim row ids (host int64)."""
+        # settle BEFORE computing victims: a settle-triggered replay may
+        # grow/repack this arena, which would invalidate victim row ids
+        self._settle_owner_chain()
+        live = self._key_of_row >= 0
+        if not live.any():
+            return np.empty(0, dtype=np.int64)
+        cutoff = int(np.clip(older_than_tick, -2**31 + 1, 2**31 - 1))
+        host_clock = np.clip(self.last_use_tick, 0, 2**31 - 1) \
+            .astype(np.int32)
+        mask = _idle_mask_kernel(self.last_use_dev,
+                                 jnp.asarray(host_clock),
+                                 jnp.asarray(live), jnp.int32(cutoff))
+        return np.flatnonzero(np.asarray(mask)).astype(np.int64)
+
+    def deactivate_idle_rows(self, rows: np.ndarray, older_than_tick: int,
+                             write_back: bool = True) -> int:
+        """Deactivate the subset of ``rows`` still live and still idle —
+        the re-validated chunk step of incremental collection.  Rows
+        touched (either clock) since their sweep selected them are
+        spared; rows re-used by a different key stay eligible only if
+        that key is itself idle past the cutoff (evicting an idle row is
+        always permitted)."""
+        # settle first: a settle-triggered replay may grow/repack this
+        # arena, and the liveness/idleness re-validation below must run
+        # against the post-settle layout
+        self._settle_owner_chain()
+        rows = np.asarray(rows, dtype=np.int64)
+        rows = rows[(rows >= 0) & (rows < self.capacity)]
+        rows = rows[self._key_of_row[rows] >= 0]
+        if len(rows) == 0:
+            return 0
+        dev = np.asarray(self.last_use_dev[
+            jnp.asarray(_pow2_pad(rows, 0))])[:len(rows)]
+        idle = np.maximum(self.last_use_tick[rows],
+                          dev.astype(np.int64)) < older_than_tick
+        return self._deactivate_rows(rows[idle], write_back)
 
     def collect(self, older_than_tick: int, write_back: bool = True) -> int:
-        """Deactivate rows idle since before ``older_than_tick`` and compact
-        the freed space — the tensor-path activation collector: the
-        reference buckets activations by last-use quantum and deactivates
-        whole buckets (reference: ActivationCollector.cs:37, age-based
+        """Deactivate rows idle since before ``older_than_tick`` — the
+        tensor-path activation collector: the reference buckets
+        activations by last-use quantum and deactivates whole buckets
+        (reference: ActivationCollector.cs:37, age-based
         DeactivateActivations Catalog.cs:836); here the bucket test is one
-        vectorized compare over ``last_use_tick``.
+        vectorized compare over the merged use clocks.  Freed rows return
+        to the per-shard free lists in place — no repack, generation
+        preserved (full compaction only past ``compact_fragmentation``).
 
         With a store and ``write_back``, victim rows are written through
         the storage bridge first, so a later message to an evicted grain
         re-activates it with its state (the deactivate→storage→reactivate
         cycle of the reference).  Returns the number of rows evicted."""
-        # settle BEFORE computing victims: a settle-triggered replay may
-        # grow/repack this arena, which would invalidate victim row ids
-        self._settle_owner_chain()
-        live = self._key_of_row >= 0
-        victims = np.nonzero(
-            live & (self.effective_last_use() < older_than_tick))[0]
-        return self._deactivate_rows(victims, write_back)
+        return self._deactivate_rows(
+            self.select_idle_rows(older_than_tick), write_back)
 
     def evict_keys(self, keys: np.ndarray, write_back: bool = True) -> int:
         """Deactivate specific keys (write-back first when a store is
@@ -484,37 +598,77 @@ class GrainArena:
         return self._deactivate_rows(rows[found], write_back)
 
     def _deactivate_rows(self, victims: np.ndarray, write_back: bool) -> int:
-        """Shared deactivation tail (collect + evict_keys): write-back,
-        free, compact."""
+        """Shared deactivation tail (collect + evict_keys +
+        deactivate_idle_rows): write-back FIRST — victims are freed only
+        after the store acks, so an injected storage fault mid-chunk
+        leaves them live for the retry — then return the slots to the
+        per-shard free lists in place.  Rows do not move: the generation
+        is preserved (cached resolved rows over SURVIVING keys stay
+        valid, no re-resolution/recompile storm) and only
+        ``eviction_epoch`` bumps so caches re-check liveness cheaply.
+        Full compaction runs only past the fragmentation threshold."""
+        # NOTE: callers settle the owner chain BEFORE computing victims
+        # (select_idle_rows / evict_keys / deactivate_idle_rows) — a
+        # settle here would be too late: its replay could repack the
+        # arena and stale the victim row ids already in hand
+        victims = np.asarray(victims, dtype=np.int64)
         if len(victims) == 0:
             return 0
         keys = self._key_of_row[victims]
         if write_back and self.store is not None:
-            host = self.rows_to_host(victims)
-            rows_list = [{n: host[n][i] for n in host}
-                         for i in range(len(victims))]
-            self.store.write_many(self.info.name, keys.tolist(), rows_list)
+            # columnar fast path: the gathered columns go to the store
+            # as-is — no O(victims) list-of-dicts construction here
+            self.store.write_many_columnar(
+                self.info.name, keys.tolist(), self.rows_to_host(victims))
         self._key_of_row[victims] = -1
         self.live_count -= len(victims)
         self.evicted_count += len(victims)
+        self._free_rows(victims)
+        self.eviction_epoch += 1
         self._dirty = True
-        self._compact()
+        if 0.0 < self.compact_fragmentation <= 1.0 \
+                and self.fragmentation() > self.compact_fragmentation:
+            self._compact()
         return len(victims)
+
+    def _free_rows(self, victims: np.ndarray) -> None:
+        """Return freed slots to their shard's free list and scrub them:
+        state columns back to field inits (a reused slot must never leak
+        the evicted grain's state; restore-from-store happens at
+        activation), both use clocks zeroed."""
+        shards = victims // self.shard_capacity
+        order = np.argsort(shards, kind="stable")
+        victims = victims[order]
+        bounds = np.searchsorted(shards[order], np.arange(self.n_shards + 1))
+        for s in range(self.n_shards):
+            part = victims[bounds[s]:bounds[s + 1]]
+            if len(part):
+                self._free[s] = np.concatenate([self._free[s], part])
+        # out-of-range fill + mode="drop": the padding lanes scatter
+        # nowhere
+        idx = jnp.asarray(_pow2_pad(victims, self.capacity))
+        for name, f in self.info.state_fields.items():
+            self.state[name] = self.state[name].at[idx].set(
+                jnp.full(f.shape, f.init, dtype=f.dtype), mode="drop")
+        self.last_use_dev = self.last_use_dev.at[idx].set(0, mode="drop")
+        self.last_use_tick[victims] = 0
 
     def _compact(self) -> None:
         """Repack each shard block so live rows are contiguous from the
-        block base (freed slots return to the allocator's bump pointer).
-        Rows move → generation bump; holders re-resolve."""
+        block base (free lists clear; the bump pointer resets to the live
+        count).  Rows move → generation bump; holders re-resolve.  Runs
+        on explicit call or when fragmentation crosses the threshold —
+        never on the ordinary deactivation path."""
         old_rows = np.nonzero(self._key_of_row >= 0)[0]
         shards = old_rows // self.shard_capacity
-        new_rows = np.empty_like(old_rows)
-        next_free = np.zeros(self.n_shards, dtype=np.int64)
-        for s in range(self.n_shards):
-            in_s = shards == s
-            k = int(in_s.sum())
-            base = s * self.shard_capacity
-            new_rows[in_s] = base + np.arange(k)
-            next_free[s] = k
+        # vectorized per-shard repack: old_rows is ascending, so each
+        # shard's members are contiguous — their rank within the shard is
+        # the global index minus the shard's cumulative start
+        next_free = np.bincount(shards, minlength=self.n_shards) \
+            .astype(np.int64)
+        starts = np.concatenate(([0], np.cumsum(next_free)[:-1]))
+        new_rows = (shards * self.shard_capacity
+                    + np.arange(len(old_rows)) - starts[shards])
 
         keys = self._key_of_row[old_rows]
         last_use = self.last_use_tick[old_rows]
@@ -523,6 +677,8 @@ class GrainArena:
         self.last_use_tick.fill(0)
         self.last_use_tick[new_rows] = last_use
         self._shard_next = next_free
+        self._free = [np.empty(0, dtype=np.int64)
+                      for _ in range(self.n_shards)]
 
         idx = jnp.asarray(old_rows, dtype=jnp.int32)
         dst = jnp.asarray(new_rows, dtype=jnp.int32)
@@ -558,6 +714,8 @@ class GrainArena:
         self.capacity = per_shard * self.n_shards
         self._key_of_row = np.full(self.capacity, -1, dtype=np.int64)
         self._shard_next = np.zeros(self.n_shards, dtype=np.int64)
+        self._free = [np.empty(0, dtype=np.int64)
+                      for _ in range(self.n_shards)]
         self.last_use_tick = np.zeros(self.capacity, dtype=np.int64)
         self.live_count = 0
         self._dirty = True
@@ -599,10 +757,8 @@ class GrainArena:
         if len(live_rows) == 0:
             return 0
         keys = self._key_of_row[live_rows]
-        host = self.rows_to_host(live_rows)
-        rows_list = [{n: host[n][i] for n in host}
-                     for i in range(len(live_rows))]
-        self.store.write_many(self.info.name, keys.tolist(), rows_list)
+        self.store.write_many_columnar(self.info.name, keys.tolist(),
+                                       self.rows_to_host(live_rows))
         return len(live_rows)
 
     def restore_from_store(self) -> int:

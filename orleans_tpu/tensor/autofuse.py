@@ -45,7 +45,7 @@ class _PatternState:
     program applying every stream per tick, in canonical order."""
 
     __slots__ = ("key", "sig", "prev_top", "static_keys", "rows",
-                 "keys_host", "generation", "static_args")
+                 "keys_host", "generation", "epoch", "static_args")
 
     def __init__(self, key: Tuple[str, str], sig: Tuple,
                  args: Dict[str, Any], b) -> None:
@@ -56,6 +56,7 @@ class _PatternState:
         self.rows = b.rows
         self.keys_host = b.keys_host
         self.generation = b.generation
+        self.epoch = b.epoch
         self.static_args: Dict[str, Any] = {}
 
 
@@ -86,6 +87,7 @@ class AutoFuser:
         self._chain_snapshot: Optional[Dict[str, Dict]] = None
         self._chain_counters: Optional[Tuple[int, int, int]] = None
         self._chain_generations: Dict[str, int] = {}
+        self._chain_epochs: Dict[str, int] = {}
         # caches / stats
         self._programs: Dict[Tuple, Any] = {}
         self._disabled: Dict[Tuple, int] = {}   # sig → ring version at ban
@@ -199,7 +201,11 @@ class AutoFuser:
                     or b.mask is not None or not isinstance(args, dict)):
                 return None
             arena = self.engine.arenas.get(key[0])
-            if arena is None or b.generation != arena.generation:
+            if arena is None or b.generation != arena.generation \
+                    or b.epoch != arena.eviction_epoch:
+                # stale rows (repack OR free-list eviction since
+                # resolution): not fusable this tick — the injector
+                # revalidates on its next inject and detection resumes
                 return None
             psig = (key[0], key[1], self._keys_digest(b.keys_host),
                     b.generation, tuple(sorted(args)))
@@ -359,7 +365,12 @@ class AutoFuser:
         # the pattern state is intact — no orphan window can exist
         if prog._compiled is None or any(
                 engine.arena_for(n).generation != g
-                for n, g in prog._generations.items()):
+                for n, g in prog._generations.items()) or any(
+                engine.arena_for(n).eviction_epoch != e
+                for n, e in prog._epochs.items()):
+            # epoch mismatch counts too: free-list eviction leaves rows
+            # in place but stales the program's baked directory mirror —
+            # prepare() below re-traces against the post-eviction layout
             self._settle_chain()
             if self._program is None or not self._patterns:
                 # the settle rolled back and reset detection: the
@@ -397,6 +408,9 @@ class AutoFuser:
                                     engine.messages_processed)
             self._chain_generations = {
                 n: engine.arena_for(n).generation for n in prog._touched}
+            self._chain_epochs = {
+                n: engine.arena_for(n).eviction_epoch
+                for n in prog._touched}
 
         prog.run(stackeds if prog._is_multi() else stackeds[0],
                  static_args=statics if prog._is_multi() else statics[0])
@@ -437,10 +451,12 @@ class AutoFuser:
         snapshot = self._chain_snapshot
         counters = self._chain_counters
         generations = self._chain_generations
+        epochs = self._chain_epochs
         self._chain_prog = None
         self._chain_snapshot = None
         self._chain_counters = None
         self._chain_generations = {}
+        self._chain_epochs = {}
         misses = prog.verify()
         n_ticks = sum(len(w) for w in windows)
         if misses == 0:
@@ -460,14 +476,20 @@ class AutoFuser:
         # arena.  A generation mismatch here is therefore a bug, not an
         # operating condition.
         if any(engine.arena_for(n).generation != g
-               for n, g in generations.items()):
+               for n, g in generations.items()) or any(
+               engine.arena_for(n).eviction_epoch != e
+               for n, e in epochs.items()):
             # a hard invariant, not an operating condition — raise (not
             # assert: -O must not turn this into restoring an
-            # old-generation snapshot over a repacked arena)
+            # old-generation snapshot over a repacked arena).  Eviction
+            # epochs are covered too: every deactivation path settles
+            # the owner chain BEFORE freeing rows, so a mid-chain
+            # eviction equally means the snapshot discipline was
+            # bypassed (the snapshot holds pre-eviction columns).
             raise RuntimeError(
-                "autofuse: arena repacked mid-chain — a row move "
-                "bypassed _settle_owner_chain; rollback snapshot is "
-                "unrestorable")
+                "autofuse: arena repacked or evicted mid-chain — a row "
+                "move/free bypassed _settle_owner_chain; rollback "
+                "snapshot is unrestorable")
         self.windows_rolled_back += 1
         for n, cols in snapshot.items():
             engine.arena_for(n).state = cols
@@ -512,7 +534,8 @@ class AutoFuser:
                 args={**pat.static_args, **per_tick},
                 rows=pat.rows,
                 keys_host=pat.keys_host,
-                generation=pat.generation))
+                generation=pat.generation,
+                epoch=pat.epoch))
         return True
 
     def snapshot(self) -> Dict[str, int]:

@@ -85,6 +85,11 @@ class PendingBatch:
     mask: Optional[jnp.ndarray] = None         # bool[m] device (None = all)
     future: Optional[asyncio.Future] = None    # resolves to results[m]
     generation: int = -1                       # arena generation rows assume
+    # arena eviction_epoch the rows assume: free-list deactivation frees
+    # rows WITHOUT moving survivors (generation preserved), so cached
+    # rows are valid only while both match — an epoch-only mismatch
+    # falls back to host re-resolution (which re-activates evicted keys)
+    epoch: int = -1
     # miss-check redeliveries set this: the original pass already expanded
     # the whole batch through any registered fan-out (expansion is
     # key-based, not row-based), so expanding again would double-deliver
@@ -232,6 +237,182 @@ def _stack_counts(*xs):
     return jnp.stack(xs)
 
 
+class IncrementalCollector:
+    """Chunked, tick-interleaved activation collection with a bounded
+    pause budget — the tensor-path realization of the reference
+    collector's central property: deactivation is a BACKGROUND cost,
+    never a message-pump stall (reference: ActivationCollector.cs:37,
+    Catalog.cs:836).
+
+    A *sweep* selects every arena's idle victims once (on device — one
+    vectorized compare, only the victim mask crosses to the host) and
+    parks them as a work list.  *Slices* then drain the list in
+    ``collection_chunk_rows`` chunks between ticks, each slice capped at
+    ``collection_pause_budget_s`` of host wall time; each chunk
+    re-validates liveness/idleness before freeing, so rows touched since
+    selection are spared.  Victims are freed only after their columnar
+    write-back acks — an injected storage fault leaves them live for the
+    retry (next slice re-attempts; a synchronous drain propagates).
+    """
+
+    def __init__(self, engine: "TensorEngine") -> None:
+        self.engine = engine
+        # work list: [arena, cutoff, write_back, generation, rows]
+        self._pending: deque = deque()
+        self.sweeps_started = 0
+        self.sweeps_completed = 0
+        self.slices_run = 0
+        self.rows_evicted = 0
+        self.victims_dropped_stale = 0  # generation moved mid-sweep
+        self.write_back_failures = 0
+        self._last_write_error: Optional[BaseException] = None
+        # recent slice records: telemetry + the flight-recorder dump
+        self.last_slices: deque = deque(maxlen=64)
+        self.pause_seconds: deque = deque(maxlen=256)
+        self.max_pause_s = 0.0
+
+    def active(self) -> bool:
+        return bool(self._pending)
+
+    def pending_rows(self) -> int:
+        return sum(len(e[4]) for e in self._pending)
+
+    def start_sweep(self, cutoff: int, write_back: bool = True) -> int:
+        """Select victims across all arenas (device compare, mask-only
+        transfer) and park them for sliced draining.  No-op while a
+        previous sweep is still draining.  Returns rows selected."""
+        if self._pending:
+            return 0
+        selected = 0
+        for arena in self.engine.arenas.values():
+            victims = arena.select_idle_rows(cutoff)
+            if len(victims):
+                self._pending.append(
+                    [arena, cutoff, write_back, arena.generation, victims])
+                selected += len(victims)
+        if selected:
+            self.sweeps_started += 1
+        return selected
+
+    def run_slice(self, budget_s: float, chunk_rows: int) -> int:
+        """Drain chunks until the pause budget is spent or the sweep is
+        done.  ``budget_s <= 0`` = unbounded (the synchronous baseline).
+        Returns rows evicted this slice."""
+        if not self._pending:
+            return 0
+        t0 = time.perf_counter()
+        chunk_rows = max(1, int(chunk_rows))
+        freed = 0
+        failed = False
+        while self._pending:
+            entry = self._pending[0]
+            arena, cutoff, write_back, gen, rows = entry
+            if arena.generation != gen:
+                # rows moved since selection (grow/reshard/threshold
+                # compaction): the ids are meaningless now — drop the
+                # remainder (counted); the next cadence sweep (or the
+                # explicit collect_idle re-sweep loop) re-selects
+                self.victims_dropped_stale += len(rows)
+                self._pending.popleft()
+                continue
+            chunk, entry[4] = rows[:chunk_rows], rows[chunk_rows:]
+            if len(entry[4]) == 0:
+                self._pending.popleft()
+            else:
+                self._pending[0] = entry
+            try:
+                freed += arena.deactivate_idle_rows(chunk, cutoff,
+                                                    write_back)
+            except Exception as exc:  # noqa: BLE001 — storage faults
+                # (chaos seam included) must not kill the tick loop:
+                # nothing in this chunk was freed (write-back precedes
+                # freeing) — park it back at the FRONT and retry next
+                # slice; a synchronous drain() propagates instead
+                self.write_back_failures += 1
+                self._last_write_error = exc
+                if len(entry[4]):
+                    entry[4] = np.concatenate([chunk, entry[4]])
+                    self._pending[0] = entry
+                else:
+                    entry[4] = chunk
+                    self._pending.appendleft(entry)
+                failed = True
+                break
+            if budget_s > 0 and time.perf_counter() - t0 >= budget_s:
+                break
+        dt = time.perf_counter() - t0
+        self.slices_run += 1
+        self.rows_evicted += freed
+        self.pause_seconds.append(dt)
+        self.max_pause_s = max(self.max_pause_s, dt)
+        done = not self._pending
+        if done:
+            self.sweeps_completed += 1
+        self._record_slice(dt, freed, done, failed)
+        return freed
+
+    def drain(self, chunk_rows: int) -> int:
+        """Synchronously finish the in-progress sweep (explicit
+        ``collect_idle`` and quiesce points).  A write-back failure
+        propagates here — silent infinite retry is a tick-loop luxury."""
+        total = 0
+        while self._pending:
+            before = self.write_back_failures
+            total += self.run_slice(0.0, chunk_rows)
+            if self.write_back_failures > before:
+                raise self._last_write_error
+        return total
+
+    def _record_slice(self, dt: float, freed: int, done: bool,
+                      failed: bool) -> None:
+        engine = self.engine
+        record = {
+            "tick": engine.tick_number,
+            "seconds": round(dt, 6),
+            "evicted": freed,
+            "remaining": self.pending_rows(),
+            "sweep_done": done,
+            "write_back_failed": failed,
+        }
+        self.last_slices.append(record)
+        rec = engine._span_recorder()
+        if rec is not None:
+            rec.collect_span(tick=engine.tick_number, duration=dt,
+                             evicted=freed,
+                             remaining=record["remaining"],
+                             sweep_done=done, failed=failed)
+        from orleans_tpu import telemetry
+        mgr = telemetry.default_manager
+        if mgr.consumers:
+            mgr.track_metric("collect.pause_s", dt)
+            if done:
+                for name, arena in engine.arenas.items():
+                    mgr.track_metric("arena.fragmentation",
+                                     arena.fragmentation(),
+                                     {"arena": name})
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "sweeps_started": self.sweeps_started,
+            "sweeps_completed": self.sweeps_completed,
+            "slices_run": self.slices_run,
+            "rows_evicted": self.rows_evicted,
+            "victims_dropped_stale": self.victims_dropped_stale,
+            "pending_rows": self.pending_rows(),
+            "write_back_failures": self.write_back_failures,
+            "pause_p99_s": self.pause_p99_s(),
+            "max_pause_s": self.max_pause_s,
+            "last_slices": list(self.last_slices),
+        }
+
+    def pause_p99_s(self) -> float:
+        """p99 over the recent slice pauses (cheap enough for periodic
+        telemetry publication without building a full snapshot)."""
+        if not self.pause_seconds:
+            return 0.0
+        return float(np.percentile(np.asarray(self.pause_seconds), 99))
+
+
 class TensorEngine:
 
     def __init__(self, silo=None, config: Optional[TensorEngineConfig] = None,
@@ -248,6 +429,9 @@ class TensorEngine:
         self._apply_mesh(mesh)
 
         self.arenas: Dict[str, GrainArena] = {}
+        # incremental activation collection: sweeps select on device,
+        # slices drain between ticks under the configured pause budget
+        self.collector = IncrementalCollector(self)
         self.queues: Dict[Tuple[str, str], List[PendingBatch]] = defaultdict(list)
         self.tick_number = 0
         self.ticks_run = 0
@@ -331,6 +515,8 @@ class TensorEngine:
             arena = GrainArena(info, capacity=self.initial_capacity,
                                n_shards=self.n_shards, sharding=self.sharding,
                                store=self.store)
+            arena.compact_fragmentation = \
+                self.config.compact_fragmentation_threshold
             # row moves (growth/compaction/reshard) must settle this
             # engine's auto-fusion chain FIRST — see
             # GrainArena._settle_owner_chain
@@ -344,10 +530,27 @@ class TensorEngine:
                      write_back: bool = True) -> int:
         """Deactivate rows idle for > max_idle_ticks across all arenas
         (the age-based collector sweep, reference:
-        ActivationCollector.cs:37).  Runs between ticks."""
+        ActivationCollector.cs:37) and return the count — the explicit,
+        run-to-completion entry point (tests, management RPC, quiesce).
+        Any in-progress incremental sweep drains first; the tick loop
+        instead drains the same pipeline in pause-budgeted slices."""
+        chunk = self.config.collection_chunk_rows
+        self.collector.drain(chunk)
         cutoff = self.tick_number - max_idle_ticks
-        return sum(a.collect(cutoff, write_back=write_back)
-                   for a in self.arenas.values())
+        total = 0
+        while True:
+            # re-sweep until nothing is selected: a mid-drain threshold
+            # compaction bumps the generation and drops that sweep's
+            # remaining victim ids — the explicit API must still run to
+            # completion, so the survivors are re-selected (they are
+            # still idle; compaction preserves last-use)
+            if self.collector.start_sweep(cutoff,
+                                          write_back=write_back) == 0:
+                return total
+            evicted = self.collector.drain(chunk)
+            total += evicted
+            if evicted == 0:
+                return total
 
     async def reshard(self, mesh: Optional[jax.sharding.Mesh]) -> None:
         """Re-lay every arena over a new mesh — the data-plane elasticity
@@ -729,11 +932,20 @@ class TensorEngine:
         self.ticks_run += 1
         stages = self._tick_stages = defaultdict(float)
         self._in_tick = True
-        if (self.config.collection_idle_ticks
-                and self.config.collection_every_ticks > 0
-                and self.tick_number % self.config.collection_every_ticks == 0):
-            self.collect_idle(self.config.collection_idle_ticks)
-            stages["collect"] += time.perf_counter() - t0
+        cfg = self.config
+        if cfg.collection_idle_ticks and cfg.collection_every_ticks > 0:
+            # incremental collection: the cadence tick SELECTS victims
+            # (device compare, mask-only transfer); every tick thereafter
+            # drains one pause-budgeted slice until the sweep finishes.
+            # The tick never stalls past the budget + one chunk.
+            if (not self.collector.active()
+                    and self.tick_number % cfg.collection_every_ticks == 0):
+                self.collector.start_sweep(
+                    self.tick_number - cfg.collection_idle_ticks)
+            if self.collector.active():
+                self.collector.run_slice(cfg.collection_pause_budget_s,
+                                         cfg.collection_chunk_rows)
+                stages["collect"] += time.perf_counter() - t0
         if len(self._pending_checks) >= self.config.miss_check_cap:
             # bound device memory pinned by parked optimistic checks
             self._drain_checks()
@@ -845,11 +1057,15 @@ class TensorEngine:
         (reference: CallbackData resend, Dispatcher rerouting) and keeps
         the hot path free of host synchronization."""
         args = b.args
-        if b.rows is not None and b.generation == arena.generation:
+        if b.rows is not None and b.generation == arena.generation \
+                and b.epoch == arena.eviction_epoch:
             return b.rows, args
         if b.keys_host is not None:
-            # pre-resolved rows gone stale (arena growth repacked rows) fall
-            # through to here too, re-resolving from the kept keys
+            # pre-resolved rows gone stale fall through to here too,
+            # re-resolving from the kept keys: a generation mismatch
+            # means growth repacked rows; an epoch mismatch means rows
+            # were freed since resolution — re-resolution re-activates
+            # any evicted key (through the store) before applying
             rows = arena.resolve_rows(b.keys_host, tick=self.tick_number)
             return rows.astype(np.int32), args  # numpy → host-pad path
         keys = b.keys_wide if b.keys_wide is not None else b.keys_dev
@@ -1039,14 +1255,17 @@ class TensorEngine:
         pays one cheap call."""
         arena = self.arenas.get(type_name)
         gen = arena.generation if arena is not None else -1
+        epoch = arena.eviction_epoch if arena is not None else -1
         out: List[PendingBatch] = []
         for b in batches:
             if b.keys_host is None:
                 out.append(b)  # device keys: the miss path owns routing
                 continue
-            if b.rows is not None and b.generation == gen:
-                # injector fast path: rows resolved under this generation,
-                # and evictions always bump it — still-valid rows imply
+            if b.rows is not None and b.generation == gen \
+                    and b.epoch == epoch:
+                # injector fast path: rows resolved under this generation
+                # AND eviction epoch — handoff evicts strays by bumping
+                # the epoch (rows stay put), so still-valid rows imply
                 # still-owned keys
                 out.append(b)
                 continue
@@ -1111,7 +1330,8 @@ class TensorEngine:
             safe: List[PendingBatch] = []
             for b in batches:
                 if b.keys_host is not None and (
-                        b.rows is None or b.generation != arena.generation):
+                        b.rows is None or b.generation != arena.generation
+                        or b.epoch != arena.eviction_epoch):
                     _, found = arena.lookup_rows(b.keys_host)
                     if not found.all():
                         # park in a side list (re-queued at tick end) so
@@ -1329,6 +1549,9 @@ class TensorEngine:
             "arenas": {name: a.live_count for name, a in self.arenas.items()},
             "evicted": sum(a.evicted_count for a in self.arenas.values()),
             "restored": sum(a.restored_count for a in self.arenas.values()),
+            "collection": self.collector.snapshot(),
+            "fragmentation": {name: round(a.fragmentation(), 4)
+                              for name, a in self.arenas.items()},
         }
 
 
@@ -1351,13 +1574,18 @@ class BatchInjector:
         self._keys_dev = jnp.asarray(keys.astype(np.int32)) \
             if len(keys) and keys.max() < KEY_SENTINEL and keys.min() >= 0 \
             else None
+        self.rows = None
+        self._rows_host = None  # host mirror for cheap epoch revalidation
+        self.generation = -2
+        self.epoch = -2
         self._refresh()
         self.n = len(keys)
 
     def _refresh(self) -> None:
+        arena = self._arena
         router = self.engine.router
         if router is not None and not router.handoff_settled():
-            _, found = self._arena.lookup_rows(self.keys)
+            _, found = arena.lookup_rows(self.keys)
             if not found.all():
                 # handoff fence: eagerly activating unseen keys here could
                 # read the store before the previous owner's write-back.
@@ -1366,22 +1594,39 @@ class BatchInjector:
                 self.rows = None
                 self.generation = -2  # never matches: retry next inject
                 return
-        rows = self._arena.resolve_rows(self.keys,
-                                        tick=self.engine.tick_number)
+        if (self.rows is not None and self.generation == arena.generation
+                and self.epoch != arena.eviction_epoch):
+            # epoch-only staleness: rows were FREED somewhere in the
+            # arena but none moved.  If every cached key still resolves
+            # to ITS CACHED ROW, the cached device rows are exactly
+            # right — one host searchsorted + compare re-validates, no
+            # device transfer, no re-resolution storm (THE 4M-eviction
+            # cost this free-list path removes).  Liveness alone is NOT
+            # enough: a key evicted and later re-activated lands in a
+            # different slot (its old one may now hold another grain),
+            # so the rows must match, not just exist.
+            rows, found = arena.lookup_rows(self.keys)
+            if found.all() and np.array_equal(rows, self._rows_host):
+                self.epoch = arena.eviction_epoch
+                return
+        rows = arena.resolve_rows(self.keys, tick=self.engine.tick_number)
+        self._rows_host = rows.astype(np.int32)
         self.rows = jnp.asarray(rows)
-        self.generation = self._arena.generation
+        self.generation = arena.generation
+        self.epoch = arena.eviction_epoch
 
     def inject(self, args: Any, want_results: bool = False
                ) -> Optional[asyncio.Future]:
-        if self.generation != self._arena.generation:
-            # arena growth repacked rows — re-resolve the cached set
+        if self.generation != self._arena.generation \
+                or self.epoch != self._arena.eviction_epoch:
+            # rows repacked (generation) or freed (epoch) — revalidate
             self._refresh()
         future = asyncio.get_running_loop().create_future() \
             if want_results else None
         self.engine.queues[(self.type_name, self.method)].append(
             PendingBatch(args=args, rows=self.rows, future=future,
                          keys_host=self.keys, keys_dev=self._keys_dev,
-                         generation=self.generation))
+                         generation=self.generation, epoch=self.epoch))
         self.engine._wake_up()
         return future
 

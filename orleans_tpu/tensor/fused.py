@@ -111,6 +111,12 @@ class FusedTickProgram:
     def _finish_init(self) -> None:
         self.n_msgs = sum(len(s.keys) for s in self.sources)
         self._generations: Dict[str, int] = {}
+        # eviction epochs of touched arenas at trace time: the window
+        # bakes each arena's directory mirror in as trace constants, so
+        # rows FREED since the trace (free-list deactivation — no
+        # generation bump) would leave emits resolving to dead slots;
+        # prepare() re-traces on mismatch, same as a repack
+        self._epochs: Dict[str, int] = {}
         self._touched: List[str] = []
         self._compiled: Callable | None = None
         self._totals = None  # device [miss, delivered] since last verify
@@ -250,6 +256,7 @@ class FusedTickProgram:
     def _note_arena(self, name: str, arena) -> None:
         if name not in self._generations:
             self._generations[name] = arena.generation
+            self._epochs[name] = arena.eviction_epoch
             self._touched.append(name)
 
     # -- compile + run -------------------------------------------------------
@@ -274,6 +281,8 @@ class FusedTickProgram:
         def reset_discovery() -> None:
             self._generations = {s.type_name: s.arena.generation
                                  for s in self.sources}
+            self._epochs = {s.type_name: s.arena.eviction_epoch
+                            for s in self.sources}
             self._touched = []
             for s in self.sources:
                 if s.type_name not in self._touched:
@@ -342,7 +351,9 @@ class FusedTickProgram:
         stackeds, statics = self._as_lists(stacked_args, static_args)
         if self._compiled is None or any(
                 engine.arena_for(n).generation != g
-                for n, g in self._generations.items()):
+                for n, g in self._generations.items()) or any(
+                engine.arena_for(n).eviction_epoch != e
+                for n, e in self._epochs.items()):
             for s in self.sources:
                 s.rows = jnp.asarray(s.arena.resolve_rows(s.keys))
             examples = [

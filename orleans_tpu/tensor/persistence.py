@@ -44,6 +44,21 @@ class VectorStore:
                    rows: List[Dict[str, np.ndarray]]) -> None:
         raise NotImplementedError
 
+    def write_many_columnar(self, type_name: str, keys: List[int],
+                            columns: Dict[str, np.ndarray]) -> None:
+        """Columnar bulk write: ``columns[field][i]`` is row i's value for
+        ``keys[i]`` — the shape eviction/checkpoint naturally produces
+        (one gathered [n, ...] array per state field).  Per-grain record
+        granularity is preserved by the store, but the bridge no longer
+        builds an O(n) list of per-row dicts on the hot write-back path;
+        stores that can slice columns directly override this.  The base
+        implementation adapts to ``write_many`` for custom stores."""
+        n = len(keys)
+        self.write_many(
+            type_name, keys,
+            [{name: col[i] for name, col in columns.items()}
+             for i in range(n)])
+
     def delete_many(self, type_name: str, keys: Iterable[int]) -> None:
         raise NotImplementedError
 
@@ -77,6 +92,14 @@ class MemoryVectorStore(VectorStore):
         for k, row in zip(keys, rows):
             self._store[(type_name, int(k))] = \
                 {n: np.asarray(v).copy() for n, v in row.items()}
+
+    def write_many_columnar(self, type_name, keys, columns):
+        # slice the gathered columns directly — np basic slicing copies,
+        # so each record owns its values without the per-row dict pass
+        cols = {n: np.ascontiguousarray(c) for n, c in columns.items()}
+        for i, k in enumerate(keys):
+            self._store[(type_name, int(k))] = \
+                {n: c[i].copy() for n, c in cols.items()}
 
     def delete_many(self, type_name, keys):
         for k in keys:
@@ -114,6 +137,13 @@ class FileVectorStore(VectorStore):
         for k, row in zip(keys, rows):
             tmp = os.path.join(d, f".{int(k)}.tmp.npz")  # savez appends .npz
             np.savez(tmp, **{n: np.asarray(v) for n, v in row.items()})
+            os.replace(tmp, os.path.join(d, f"{int(k)}.npz"))
+
+    def write_many_columnar(self, type_name, keys, columns):
+        d = self._dir(type_name)
+        for i, k in enumerate(keys):
+            tmp = os.path.join(d, f".{int(k)}.tmp.npz")
+            np.savez(tmp, **{n: c[i] for n, c in columns.items()})
             os.replace(tmp, os.path.join(d, f"{int(k)}.npz"))
 
     def delete_many(self, type_name, keys):
@@ -185,6 +215,29 @@ class StorageProviderVectorStore(VectorStore):
                 self._etags[ek] = probe.etag
             state = GrainState(
                 data={n: np.asarray(v) for n, v in row.items()},
+                etag=self._etags[ek], record_exists=True)
+            _drive(self.provider.write_state(
+                type_name, self._grain_id(type_name, k), state))
+            self._etags[ek] = state.etag
+            known.add(int(k))
+
+    def write_many_columnar(self, type_name, keys, columns):
+        """Per-grain records through the host provider, sliced straight
+        from the gathered columns (no intermediate row-dict list).  The
+        provider contract is per-grain, so the write loop remains — the
+        CAS etag discipline is per record — but each GrainState's data
+        dict is built once, from column views."""
+        from orleans_tpu.runtime.storage import GrainState
+        known = self._known.setdefault(type_name, set())
+        for i, k in enumerate(keys):
+            ek = (type_name, int(k))
+            if ek not in self._etags:
+                probe = GrainState()
+                _drive(self.provider.read_state(
+                    type_name, self._grain_id(type_name, k), probe))
+                self._etags[ek] = probe.etag
+            state = GrainState(
+                data={n: np.asarray(c[i]) for n, c in columns.items()},
                 etag=self._etags[ek], record_exists=True)
             _drive(self.provider.write_state(
                 type_name, self._grain_id(type_name, k), state))

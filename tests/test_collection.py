@@ -1,0 +1,615 @@
+"""Incremental activation collection: free-list arena, chunked
+write-back, bounded eviction pauses.
+
+The reference deactivates idle grains continuously without ever stalling
+the message pump (reference: ActivationCollector.cs:37, Catalog.cs:836).
+The tensor-path analog here must give the same guarantees at arena
+granularity:
+
+- deactivation frees rows IN PLACE (per-shard free lists): survivors do
+  not move, the arena generation is preserved, and cached resolved rows
+  over surviving keys stay valid — no re-resolution/recompile storm;
+- ``eviction_epoch`` invalidates caches that might reference a freed
+  row, with a cheap liveness re-check on the injector fast path;
+- collection drains in pause-budgeted slices between ticks (chunked
+  columnar write-back), and victims are never freed before the store
+  acks — an injected storage fault leaves them live for the retry;
+- full compaction still runs past the fragmentation threshold (and on
+  grow/reshard, where it always did).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orleans_tpu.config import TensorEngineConfig
+from orleans_tpu.tensor import MemoryVectorStore, TensorEngine
+from orleans_tpu.tensor.arena import _hash_keys_u64
+
+import tests.test_tensor_engine  # noqa: F401 — registers AccumGrain
+
+
+def _add(engine, keys, v=1.0):
+    engine.send_batch("AccumGrain", "add",
+                      np.asarray(keys, dtype=np.int64),
+                      {"v": np.full(len(keys), v, np.float32)})
+
+
+# ---- free-list allocator -------------------------------------------------
+
+
+def test_eviction_preserves_generation_and_bumps_epoch(run):
+    async def go():
+        store = MemoryVectorStore()
+        engine = TensorEngine(store=store, initial_capacity=64)
+        _add(engine, range(16), v=2.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+        gen0, epoch0 = arena.generation, arena.eviction_epoch
+
+        engine.tick_number += 100
+        arena.resolve_rows(np.arange(8, dtype=np.int64),
+                           tick=engine.tick_number)
+        assert engine.collect_idle(max_idle_ticks=50) == 8
+        # THE tentpole property: no rows moved, so no generation bump —
+        # surviving caches, device mirrors and compiled programs for the
+        # survivors stay valid
+        assert arena.generation == gen0
+        assert arena.eviction_epoch > epoch0
+        # survivors still resolve to the same rows and hold their state
+        assert float(arena.read_row(3)["total"]) == 2.0
+
+    run(go())
+
+
+def test_freed_slots_reused_in_place(run):
+    """Churn (activate → evict → activate new keys) reuses freed slots:
+    capacity stays flat and the reused slot starts from field inits, not
+    the evicted grain's stale state."""
+
+    async def go():
+        engine = TensorEngine(initial_capacity=64)
+        _add(engine, range(32), v=9.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+        arena.compact_fragmentation = 0.0  # isolate free-list reuse
+        cap0, gen0 = arena.capacity, arena.generation
+        rows_before = set(
+            arena.resolve_rows(np.arange(32, dtype=np.int64)).tolist())
+
+        engine.tick_number += 100
+        assert engine.collect_idle(50, write_back=False) == 32
+
+        # new keys land in the freed slots — same rows, no growth
+        _add(engine, range(100, 132), v=1.0)
+        await engine.flush()
+        rows_after = set(
+            arena.resolve_rows(np.arange(100, 132, dtype=np.int64)).tolist())
+        assert rows_after == rows_before
+        assert arena.capacity == cap0
+        assert arena.generation == gen0
+        # the reused slot must NOT leak the evicted grain's 9.0
+        assert float(arena.read_row(100)["total"]) == 1.0
+        assert int(arena.read_row(100)["count"]) == 1
+
+    run(go())
+
+
+def test_free_list_survives_grow(run):
+    """Freed slots remap across growth (row ids shift with the per-shard
+    block layout) and remain reusable afterwards."""
+
+    async def go():
+        engine = TensorEngine(initial_capacity=32)
+        _add(engine, range(24), v=5.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+        engine.tick_number += 100
+        arena.resolve_rows(np.arange(8, dtype=np.int64),
+                           tick=engine.tick_number)
+        assert engine.collect_idle(50, write_back=False) == 16
+        free_before = sum(len(f) for f in arena._free)
+        assert free_before == 16
+
+        # activation burst past bump+free space forces growth
+        _add(engine, range(1000, 1060), v=1.0)
+        await engine.flush()
+        assert arena.capacity > 32
+        # survivors kept state through the repack
+        assert float(arena.read_row(3)["total"]) == 5.0
+        assert float(arena.read_row(1005)["total"]) == 1.0
+        # every key resolves to exactly one row in its home shard
+        keys = arena.keys()
+        rows = arena.resolve_rows(keys)
+        assert len(set(rows.tolist())) == len(keys)
+
+    run(go())
+
+
+def test_fragmentation_threshold_triggers_compact(run):
+    async def go():
+        engine = TensorEngine(initial_capacity=64)
+        engine.config.compact_fragmentation_threshold = 0.5
+        _add(engine, range(40), v=1.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+        arena.compact_fragmentation = 0.5
+        gen0 = arena.generation
+
+        engine.tick_number += 100
+        arena.resolve_rows(np.arange(4, dtype=np.int64),
+                           tick=engine.tick_number)
+        # evicting 36 of 40 pushes freed/high-water past 0.5 → repack
+        assert engine.collect_idle(50, write_back=False) == 36
+        assert arena.generation > gen0          # rows moved
+        assert arena.fragmentation() == 0.0     # holes reclaimed
+        assert sum(len(f) for f in arena._free) == 0
+        assert float(arena.read_row(2)["total"]) == 1.0  # survivors intact
+
+    run(go())
+
+
+def test_compact_vectorized_layout_under_mesh(run):
+    """Explicit compaction repacks every shard block contiguously (the
+    vectorized argsort/cumsum path must match the per-shard semantics)."""
+    import jax
+    from jax.sharding import Mesh
+
+    async def go():
+        mesh = Mesh(np.array(jax.devices("cpu")[:8]), ("grains",))
+        engine = TensorEngine(mesh=mesh, initial_capacity=128)
+        _add(engine, range(64), v=3.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+        engine.tick_number += 100
+        keep = np.arange(0, 64, 2, dtype=np.int64)
+        arena.resolve_rows(keep, tick=engine.tick_number)
+        assert engine.collect_idle(50, write_back=False) == 32
+
+        arena._compact()
+        # live rows contiguous from each block base, in their home shard
+        rows = arena.resolve_rows(keep)
+        shards = rows // arena.shard_capacity
+        expected = (_hash_keys_u64(keep) % np.uint64(8)).astype(np.int64)
+        np.testing.assert_array_equal(shards, expected)
+        for s in range(8):
+            in_s = np.sort(rows[shards == s]) - s * arena.shard_capacity
+            np.testing.assert_array_equal(in_s, np.arange(len(in_s)))
+        assert float(arena.read_row(4)["total"]) == 3.0
+
+    run(go())
+
+
+# ---- cache validity across eviction --------------------------------------
+
+
+def test_injector_survives_foreign_eviction_without_reresolve(run):
+    """An injector whose keys were NOT evicted keeps its cached device
+    rows across another key's eviction — the cheap epoch re-check, not a
+    full re-resolution (the 4M recompile-storm fix)."""
+
+    async def go():
+        store = MemoryVectorStore()
+        engine = TensorEngine(store=store, initial_capacity=64)
+        _add(engine, range(16), v=1.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+
+        hot = np.arange(8, dtype=np.int64)
+        inj = engine.make_injector("AccumGrain", "add", hot)
+        cached_rows = inj.rows
+        engine.tick_number += 100
+        arena.resolve_rows(hot, tick=engine.tick_number)
+        assert engine.collect_idle(50) == 8  # keys 8..15 evicted
+
+        inj.inject({"v": np.ones(8, np.float32)})
+        await engine.flush()
+        # same device array object: no re-resolve, no re-upload
+        assert inj.rows is cached_rows
+        assert inj.generation == arena.generation
+        assert inj.epoch == arena.eviction_epoch
+        assert float(arena.read_row(0)["total"]) == 2.0
+
+    run(go())
+
+
+def test_injector_over_evicted_key_reactivates_through_store(run):
+    async def go():
+        store = MemoryVectorStore()
+        engine = TensorEngine(store=store, initial_capacity=64)
+        keys = np.arange(4, dtype=np.int64)
+        inj = engine.make_injector("AccumGrain", "add", keys)
+        inj.inject({"v": np.full(4, 3.0, np.float32)})
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+
+        engine.tick_number += 100
+        assert engine.collect_idle(50) == 4  # the injector's own keys
+        assert len(store.list_keys("AccumGrain")) == 4
+
+        inj.inject({"v": np.ones(4, np.float32)})
+        await engine.flush()
+        # full re-resolve: reactivation read the written-back state
+        assert float(arena.read_row(2)["total"]) == 4.0
+        assert arena.restored_count == 4
+
+    run(go())
+
+
+def test_injector_key_reactivated_in_different_slot(run):
+    """Evict an injector's key, let ANOTHER key reuse its slot, then
+    reactivate the original key elsewhere: the injector's epoch
+    revalidation must detect the row move (liveness alone is not
+    enough) and re-resolve — never write into the usurper's row."""
+
+    async def go():
+        store = MemoryVectorStore()
+        engine = TensorEngine(store=store, initial_capacity=64)
+        arena = engine.arena_for("AccumGrain")
+        arena.compact_fragmentation = 0.0
+        keys = np.arange(4, dtype=np.int64)
+        inj = engine.make_injector("AccumGrain", "add", keys)
+        inj.inject({"v": np.full(4, 2.0, np.float32)})
+        await engine.flush()
+        old_rows = arena.resolve_rows(keys).copy()
+
+        # evict the injector's keys, then let keys 100..103 LIFO-reuse
+        # their slots, then reactivate the originals (new slots)
+        engine.tick_number += 100
+        assert engine.collect_idle(50) == 4
+        _add(engine, range(100, 104), v=7.0)
+        await engine.flush()
+        usurped = arena.resolve_rows(np.arange(100, 104, dtype=np.int64))
+        assert set(usurped.tolist()) == set(old_rows.tolist())
+        arena.resolve_rows(keys, tick=engine.tick_number)  # reactivate
+
+        inj.inject({"v": np.ones(4, np.float32)})
+        await engine.flush()
+        # the usurpers' state is untouched, the originals got the adds
+        for k in range(100, 104):
+            assert float(arena.read_row(k)["total"]) == 7.0
+        for k in range(4):
+            assert float(arena.read_row(k)["total"]) == 3.0  # 2 + 1
+
+    run(go())
+
+
+def test_collect_idle_completes_across_threshold_compaction(run):
+    """A mid-drain threshold compaction drops that sweep's remaining
+    victim ids (generation moved) — the explicit collect_idle API must
+    re-sweep and still evict EVERY eligible row before returning."""
+
+    async def go():
+        engine = TensorEngine(store=MemoryVectorStore(),
+                              initial_capacity=64)
+        engine.config.collection_chunk_rows = 32
+        _add(engine, range(1000), v=1.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+        assert arena.compact_fragmentation == 0.75  # threshold active
+        engine.tick_number += 100
+        assert engine.collect_idle(50) == 1000
+        assert arena.live_count == 0
+        assert engine.collector.victims_dropped_stale > 0  # compaction hit
+
+    run(go())
+
+
+def test_evict_while_pending_batch_targets_victim(run):
+    """A batch already queued (device keys, resolved optimistically)
+    whose destination is evicted before the miss-check settles must
+    round-trip through the store — state written back at eviction is
+    visible to the redelivered message."""
+
+    async def go():
+        store = MemoryVectorStore()
+        engine = TensorEngine(store=store, initial_capacity=64)
+        _add(engine, range(8), v=5.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+
+        # queue (do not flush) a device-key batch to key 7, then evict 7
+        engine.send_batch("AccumGrain", "add",
+                          jnp.asarray(np.array([7], np.int32)),
+                          {"v": np.ones(1, np.float32)})
+        engine.tick_number += 100
+        arena.resolve_rows(np.arange(7, dtype=np.int64),
+                           tick=engine.tick_number)
+        assert engine.collect_idle(50) == 1
+
+        await engine.flush()  # miss-path redelivery reactivates key 7
+        assert float(arena.read_row(7)["total"]) == 6.0  # 5 persisted + 1
+        assert arena.restored_count == 1
+
+    run(go())
+
+
+def test_write_back_false_discards_state(run):
+    async def go():
+        store = MemoryVectorStore()
+        engine = TensorEngine(store=store, initial_capacity=64)
+        _add(engine, range(4), v=7.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+        engine.tick_number += 100
+        assert engine.collect_idle(50, write_back=False) == 4
+        assert len(store.list_keys("AccumGrain")) == 0
+        # reactivation restarts from field inits
+        _add(engine, [2], v=1.0)
+        await engine.flush()
+        assert float(arena.read_row(2)["total"]) == 1.0
+
+    run(go())
+
+
+# ---- chunked write-back & faults ------------------------------------------
+
+
+class _FlakyStore(MemoryVectorStore):
+    """Fails the first N columnar writes — the chaos storage seam's
+    ``fail`` action as seen by the tensor bridge."""
+
+    def __init__(self):
+        super().__init__()
+        self.fail_next = 0
+        self.columnar_writes = 0
+
+    def write_many_columnar(self, type_name, keys, columns):
+        self.columnar_writes += 1
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise IOError("chaos: injected storage write failure")
+        super().write_many_columnar(type_name, keys, columns)
+
+
+def test_storage_fault_mid_chunk_keeps_victims_live(run):
+    """Victims are freed only after write-back acks: a storage fault
+    leaves them live (and their state intact) for the retry — the
+    tick-interleaved collector parks the chunk and retries next slice."""
+
+    async def go():
+        store = _FlakyStore()
+        engine = TensorEngine(store=store, initial_capacity=64)
+        _add(engine, range(12), v=4.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+        live0 = arena.live_count
+        engine.tick_number += 100
+
+        store.fail_next = 2
+        engine.collector.start_sweep(engine.tick_number - 50)
+        evicted = engine.collector.run_slice(0.0, chunk_rows=4)
+        # the first chunk failed: nothing freed by it, slice aborted
+        assert engine.collector.write_back_failures == 1
+        assert arena.live_count == live0 - evicted
+        assert engine.collector.active()
+        # state still readable (nothing was freed before the ack)
+        assert float(arena.read_row(0)["total"]) == 4.0
+
+        # fault clears → retry drains the remainder, nothing lost
+        store.fail_next = 0
+        while engine.collector.active():
+            engine.collector.run_slice(0.0, chunk_rows=4)
+        assert arena.live_count == 0
+        assert len(store.list_keys("AccumGrain")) == 12
+        # every record carries the written-back state
+        _add(engine, [11], v=1.0)
+        await engine.flush()
+        assert float(arena.read_row(11)["total"]) == 5.0
+
+    run(go())
+
+
+def test_synchronous_collect_propagates_storage_fault(run):
+    async def go():
+        store = _FlakyStore()
+        engine = TensorEngine(store=store, initial_capacity=64)
+        _add(engine, range(6), v=1.0)
+        await engine.flush()
+        engine.tick_number += 100
+        store.fail_next = 10**9  # permanent fault
+        with pytest.raises(IOError):
+            engine.collect_idle(50)
+        # nothing freed, nothing lost
+        assert engine.arena_for("AccumGrain").live_count == 6
+
+    run(go())
+
+
+def test_chaos_seam_fault_through_provider_bridge(run):
+    """The chaos interposer's storage seam (StorageProvider.write_state)
+    sits under StorageProviderVectorStore: an injected write failure
+    during chunked write-back must leave the victims live."""
+    from orleans_tpu.chaos.interposer import Interposer
+    from orleans_tpu.chaos.plan import FaultPlan
+    from orleans_tpu.providers.memory_storage import MemoryStorage
+    from orleans_tpu.tensor import StorageProviderVectorStore
+
+    async def go():
+        plan = FaultPlan(seed=7)
+        plan.rule("wb-fault", "storage", "fail", count=1)
+        interposer = Interposer(plan)
+        provider = MemoryStorage()
+        interposer.attach_storage(provider, "mem")
+        engine = TensorEngine(store=StorageProviderVectorStore(provider),
+                              initial_capacity=64)
+        _add(engine, range(5), v=2.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+        arena.compact_fragmentation = 0.0  # keep the sweep's row ids live
+        engine.tick_number += 100
+
+        engine.collector.start_sweep(engine.tick_number - 50)
+        engine.collector.run_slice(0.0, chunk_rows=2)
+        assert engine.collector.write_back_failures == 1
+        assert arena.live_count > 0  # faulted chunk stayed live
+        # retry succeeds once the rule's budget is spent
+        while engine.collector.active():
+            engine.collector.run_slice(0.0, chunk_rows=2)
+        assert arena.live_count == 0
+        assert interposer.counters["storage_failed"] == 1
+
+    run(go())
+
+
+# ---- incremental pipeline / bounded pauses --------------------------------
+
+
+def test_tick_interleaved_collection_bounded_slices(run):
+    """The automatic (tick-loop) path drains a sweep across MULTIPLE
+    ticks — per-slice chunking really interleaves with traffic — and
+    hot rows stay live throughout."""
+
+    async def go():
+        cfg = TensorEngineConfig(collection_idle_ticks=10,
+                                 collection_every_ticks=8,
+                                 collection_pause_budget_s=1e-9,
+                                 collection_chunk_rows=16)
+        engine = TensorEngine(config=cfg, store=MemoryVectorStore(),
+                              initial_capacity=256)
+        _add(engine, range(128), v=1.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+
+        hot = np.arange(8, dtype=np.int64)
+        inj = engine.make_injector("AccumGrain", "add", hot)
+        engine.tick_number += 100
+        evicted_by_tick = []
+        for _ in range(24):
+            inj.inject({"v": np.ones(8, np.float32)})
+            engine.run_tick()
+            evicted_by_tick.append(arena.evicted_count)
+        await engine.flush()
+
+        assert arena.evicted_count == 120  # the idle majority went
+        assert arena.live_count == 8
+        # the sweep spanned several ticks (budget ~0 → one chunk/slice)
+        progress_ticks = sum(1 for a, b in zip(evicted_by_tick,
+                                               evicted_by_tick[1:])
+                             if b > a)
+        assert progress_ticks >= 3
+        assert engine.collector.slices_run >= 3
+        assert float(arena.read_row(0)["total"]) >= 24.0
+
+    run(go())
+
+
+def test_collect_slice_spans_and_flight_dump(run):
+    """Each slice emits ONE batched engine.collect span; the flight
+    recorder dump carries the recent collection slices."""
+    from orleans_tpu.spans import SpanRecorder
+
+    async def go():
+        engine = TensorEngine(store=MemoryVectorStore(),
+                              initial_capacity=64)
+
+        class _SiloStub:
+            spans = SpanRecorder("collect-test", enabled=True,
+                                 sample_rate=0.0)
+
+        engine.silo = _SiloStub()
+        _add(engine, range(10), v=1.0)
+        await engine.flush()
+        engine.arena_for("AccumGrain").compact_fragmentation = 0.0
+        engine.tick_number += 100
+        engine.collector.start_sweep(engine.tick_number - 50)
+        while engine.collector.active():
+            engine.collector.run_slice(0.0, chunk_rows=4)
+
+        rec = _SiloStub.spans
+        collect_spans = [s for s in rec.flight.spans
+                         if s.kind == "engine.collect"]
+        assert len(collect_spans) == engine.collector.slices_run
+        assert collect_spans[-1].attrs["sweep_done"] is True
+        assert sum(s.attrs["evicted"] for s in collect_spans) == 10
+
+        dump = rec.flight.dump(
+            reason="test",
+            collection_slices=engine.collector.last_slices)
+        assert len(dump["collection_slices"]) == engine.collector.slices_run
+        assert dump["collection_slices"][-1]["sweep_done"] is True
+
+    run(go())
+
+
+def test_collection_telemetry_gauges(run):
+    from orleans_tpu import telemetry
+
+    async def go():
+        consumer = telemetry.InMemoryTelemetryConsumer()
+        telemetry.default_manager.add(consumer)
+        try:
+            engine = TensorEngine(store=MemoryVectorStore(),
+                                  initial_capacity=64)
+            _add(engine, range(10), v=1.0)
+            await engine.flush()
+            engine.tick_number += 100
+            assert engine.collect_idle(50) == 10
+            names = {m[0] for m in consumer.metrics}
+            assert "collect.pause_s" in names
+            assert "arena.fragmentation" in names
+        finally:
+            telemetry.default_manager.remove(consumer)
+
+    run(go())
+
+
+def test_columnar_write_back_per_grain_records(run):
+    """write_many_columnar preserves per-grain record granularity: each
+    key's record round-trips individually through read_many."""
+
+    async def go():
+        store = MemoryVectorStore()
+        engine = TensorEngine(store=store, initial_capacity=64)
+        keys = np.arange(6, dtype=np.int64)
+        engine.send_batch("AccumGrain", "add", keys,
+                          {"v": np.arange(6, dtype=np.float32)})
+        await engine.flush()
+        engine.tick_number += 100
+        assert engine.collect_idle(50) == 6
+        rows = store.read_many("AccumGrain", [0, 3, 5])
+        assert float(rows[3]["total"]) == 3.0
+        assert float(rows[5]["total"]) == 5.0
+        assert int(rows[0]["count"]) == 1
+
+    run(go())
+
+
+def test_autofused_pattern_survives_foreign_eviction(run):
+    """Auto-fusion over a hot key set keeps running across an eviction
+    of OTHER keys: the epoch change re-traces the window program (the
+    baked directory mirror is stale) but the pattern re-engages and the
+    result stays exact."""
+
+    async def go():
+        cfg = TensorEngineConfig(auto_fusion_ticks=4, auto_fusion_window=4,
+                                 tick_interval=0.0)
+        engine = TensorEngine(config=cfg, store=MemoryVectorStore(),
+                              initial_capacity=64)
+        _add(engine, range(16), v=1.0)
+        await engine.flush()
+        arena = engine.arena_for("AccumGrain")
+        gen0 = arena.generation
+
+        hot = np.arange(8, dtype=np.int64)
+        inj = engine.make_injector("AccumGrain", "add", hot)
+        for _ in range(24):
+            inj.inject({"v": np.ones(8, np.float32)})
+            engine.run_tick()
+        await engine.flush()
+        assert engine.autofuser.windows_run >= 1
+
+        # evict the idle half mid-steady-state
+        engine.tick_number += 100
+        arena.resolve_rows(hot, tick=engine.tick_number)
+        assert engine.collect_idle(50) == 8
+        assert arena.generation == gen0  # no repack happened
+
+        for _ in range(24):
+            inj.inject({"v": np.ones(8, np.float32)})
+            engine.run_tick()
+        await engine.flush()
+        # exactness: every tick's adds landed exactly once
+        assert float(arena.read_row(0)["total"]) == 1.0 + 48.0
+
+    run(go())
