@@ -113,6 +113,31 @@ class TracingConfig:
 
 
 @dataclass
+class MetricsConfig:
+    """Unified metrics plane knobs (orleans_tpu/metrics.py registry +
+    tensor/ledger.py device latency ledger).  No single reference analog
+    — the reference's CounterStatistic groups generalized to a typed,
+    catalogued, cluster-mergeable registry with an ON-DEVICE latency
+    histogram.  Live-reloadable like TracingConfig (silo.update_config
+    re-pushes ledger enable/bucket changes into the running engine)."""
+
+    enabled: bool = True
+    # on-device per-(type, method) latency ledger: messages are stamped
+    # with their injection tick and tick-delta latencies accumulate into
+    # log2-bucket histograms ON the device — only the small bucket-count
+    # array ever crosses d2h (at the publish cadence), never per message
+    ledger_enabled: bool = True
+    # log2 buckets per (type, method) histogram: bucket 0 = completed in
+    # the inject tick, bucket k = [2**(k-1), 2**k) ticks; 16 covers
+    # deltas up to 16k ticks before the overflow bucket absorbs
+    ledger_buckets: int = 16
+    # ticks between device→host ledger fetches when the periodic
+    # collection (load publisher / stats loop) asks for a snapshot; an
+    # explicit ledger.snapshot() always fetches
+    publish_interval_ticks: int = 32
+
+
+@dataclass
 class RemindersConfig:
     """(reference: GlobalConfiguration reminder service section :84)"""
 
@@ -249,6 +274,7 @@ class SiloConfig:
     messaging: MessagingConfig = field(default_factory=MessagingConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
     reminders: RemindersConfig = field(default_factory=RemindersConfig)
     tensor: TensorEngineConfig = field(default_factory=TensorEngineConfig)
     extra: Dict[str, Any] = field(default_factory=dict)

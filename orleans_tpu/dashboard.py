@@ -1,0 +1,272 @@
+"""Cluster metrics dashboard: one merged view of every silo's registry.
+
+``python -m orleans_tpu.dashboard`` renders the unified metrics plane —
+one-cluster throughput, queue depths, circuit-breaker states, dead
+letters, and latency percentiles (device-ledger ticks + host turn
+latency) — as a JSON one-shot or a ``--watch`` refresh loop.
+
+Sources:
+
+* ``--demo`` (default when no files are given): boots a small live
+  in-process cluster (testing/cluster.TestingCluster), drives a burst of
+  traffic through both planes, and renders the merged view — the
+  zero-setup "what does the dashboard look like" path, and exactly what
+  the test drives;
+* ``--file SNAP.json ...``: offline mode — each file holds one silo's
+  ``collect_metrics()`` snapshot (or a previously saved view); the
+  dashboard merges and renders them.  A deployment can dump these from
+  ``silo.snapshot()["metrics"]`` however it likes (the chaos report and
+  bench artifacts already embed them).
+
+The view itself comes from ``cluster_view(silos)`` — importable, so any
+host process (bench, chaos driver, admin tooling) can render its own
+live cluster without the CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+from orleans_tpu.metrics import (
+    histogram_percentiles,
+    merge_snapshots,
+)
+
+
+def _counter_total(merged: Dict[str, Any], name: str) -> float:
+    return sum(merged.get("counters", {}).get(name, {}).values())
+
+
+def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
+                        silos_info: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
+    """Build the dashboard view from per-silo registry snapshots (the
+    merged half; ``silos_info`` adds the live per-silo rows when the
+    caller has them)."""
+    merged = merge_snapshots(snapshots)
+    latency: Dict[str, Any] = {}
+    for lk, hist in merged.get("histograms", {}) \
+                          .get("engine.latency_ticks", {}).items():
+        method = lk.split("=", 1)[1] if "=" in lk else (lk or "all")
+        latency[method] = {"total": hist["total"],
+                           **{k: round(v, 3) for k, v in
+                              histogram_percentiles(hist).items()}}
+    # host.turn_latency_s is emitted unlabeled today; merge across any
+    # label sets a future emission adds rather than keeping just one
+    turn = merged.get("histograms", {}).get("host.turn_latency_s", {})
+    host_latency: Dict[str, float] = {}
+    if turn:
+        hists = list(turn.values())
+        folded = {"base": hists[0]["base"],
+                  "counts": list(hists[0]["counts"])}
+        for h in hists[1:]:
+            if h["base"] != folded["base"] \
+                    or len(h["counts"]) != len(folded["counts"]):
+                continue  # mismatched layout: never silently zip-truncate
+            folded["counts"] = [a + b for a, b in
+                                zip(folded["counts"], h["counts"])]
+        host_latency = {k: round(v, 6) for k, v in
+                        histogram_percentiles(folded).items()}
+    dead = {name.split(".", 1)[1]: int(total) for name, total in
+            ((n, _counter_total(merged, n))
+             for n in merged.get("counters", {}) if n.startswith(
+                 "dead_letter.")) if total}
+    view = {
+        "cluster": {
+            "throughput": {
+                "engine_messages": int(
+                    _counter_total(merged, "engine.messages_processed")),
+                "engine_ticks": int(_counter_total(merged, "engine.ticks")),
+                "engine_tick_seconds": round(
+                    _counter_total(merged, "engine.tick_seconds"), 4),
+                "host_requests": int(
+                    _counter_total(merged, "host.requests_sent")),
+                "cross_silo_messages": int(
+                    _counter_total(merged, "router.messages_received")),
+            },
+            "latency_ticks": latency,
+            "host_turn_latency_s": host_latency,
+            "dead_letters": dead,
+            "overload": {
+                "shed_count": int(
+                    _counter_total(merged, "overload.shed_count")),
+                "breaker_fast_fails": int(
+                    _counter_total(merged, "overload.breaker_fast_fails")),
+                "retries_denied": int(
+                    _counter_total(merged, "overload.retries_denied")),
+            },
+        },
+        "silos": silos_info or {},
+        "merged_metrics": merged,
+    }
+    msgs = view["cluster"]["throughput"]["engine_messages"]
+    secs = view["cluster"]["throughput"]["engine_tick_seconds"]
+    view["cluster"]["throughput"]["engine_msgs_per_sec"] = round(
+        msgs / secs, 1) if secs > 0 else 0.0
+    return view
+
+
+def cluster_view(silos: List[Any]) -> Dict[str, Any]:
+    """The live view over in-process silos: fresh registry snapshots
+    merged, plus per-silo status rows (queue depth, breaker states,
+    shed level, activation counts)."""
+    snaps = []
+    info: Dict[str, Any] = {}
+    for silo in silos:
+        # an explicit dashboard view always refreshes the device ledger
+        # (one small d2h per silo — the periodic publish path stays on
+        # its cadence gate)
+        snaps.append(silo.collect_metrics(force_ledger=True))
+        breakers = silo.breakers.snapshot()
+        states: Dict[str, int] = {}
+        for t in breakers.get("targets", {}).values():
+            states[t["state"]] = states.get(t["state"], 0) + 1
+        eng = silo.tensor_engine
+        info[silo.name] = {
+            "status": silo.status.value,
+            "degraded": silo.shed_controller.degraded,
+            "shed_level": round(silo.shed_controller.level, 4),
+            "queue_depth": silo._pending_request_depth(),
+            "activations": len(silo.catalog.directory),
+            "tensor_rows": (sum(a.live_count for a in eng.arenas.values())
+                            if eng is not None else 0),
+            "breaker_states": states,
+        }
+    return view_from_snapshots(snaps, info)
+
+
+def render_text(view: Dict[str, Any]) -> str:
+    """Human one-screen rendering of a dashboard view."""
+    c = view["cluster"]
+    lines = ["== orleans-tpu cluster =="]
+    t = c["throughput"]
+    lines.append(
+        f"engine: {t['engine_messages']} msgs over {t['engine_ticks']} "
+        f"ticks ({t['engine_msgs_per_sec']} msg/s of tick time); "
+        f"host rpc: {t['host_requests']}; "
+        f"cross-silo: {t['cross_silo_messages']}")
+    if c["latency_ticks"]:
+        lines.append("latency (device ticks, per type.method):")
+        for method, ps in sorted(c["latency_ticks"].items()):
+            lines.append(
+                f"  {method}: p50={ps['p50']} p95={ps['p95']} "
+                f"p99={ps['p99']} (n={ps['total']})")
+    if c["host_turn_latency_s"]:
+        ps = c["host_turn_latency_s"]
+        lines.append(f"host turn latency: p50={ps['p50']}s "
+                     f"p95={ps['p95']}s p99={ps['p99']}s")
+    if c["dead_letters"]:
+        lines.append("dead letters: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(c["dead_letters"].items())))
+    ov = c["overload"]
+    lines.append(f"overload: shed={ov['shed_count']} "
+                 f"breaker_fast_fails={ov['breaker_fast_fails']} "
+                 f"retries_denied={ov['retries_denied']}")
+    for name, row in sorted(view.get("silos", {}).items()):
+        brk = ",".join(f"{k}:{v}" for k, v in
+                       sorted(row["breaker_states"].items())) or "none"
+        lines.append(
+            f"silo {name}: {row['status']}"
+            f"{' DEGRADED' if row['degraded'] else ''} "
+            f"queue={row['queue_depth']} shed={row['shed_level']} "
+            f"activations={row['activations']} "
+            f"rows={row['tensor_rows']} breakers[{brk}]")
+    return "\n".join(lines)
+
+
+async def _demo_cluster(n_silos: int):
+    """A live in-process cluster with a burst of traffic through both
+    planes — the --demo source (and what the test drives)."""
+    import numpy as np
+
+    import samples.presence  # noqa: F401 — registers the vector grains
+    from samples.helloworld import IHello
+    from orleans_tpu.testing.cluster import TestingCluster
+
+    cluster = await TestingCluster(n_silos=n_silos).start()
+    silo = cluster.silos[0]
+    factory = cluster.attach_client(0)
+    refs = [factory.get_grain(IHello, i) for i in range(16)]
+    import asyncio
+    await asyncio.gather(*(r.say_hello("hi") for r in refs))
+    n = 2048
+    keys = np.arange(n, dtype=np.int64)
+    silo.tensor_engine.send_batch(
+        "PresenceGrain", "heartbeat", keys,
+        {"game": (keys % 16).astype(np.int32),
+         "score": np.ones(n, np.float32),
+         "tick": np.full(n, 1, np.int32)})
+    await cluster.quiesce_engines()
+    # one publish round so every silo's view holds every peer's metrics
+    for s in cluster.silos:
+        if s.load_publisher is not None:
+            await s.load_publisher.publish_statistics()
+    return cluster
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m orleans_tpu.dashboard",
+        description="merged cluster metrics view (JSON by default)")
+    parser.add_argument("--file", nargs="*", default=None,
+                        help="per-silo registry snapshot JSONs to merge "
+                             "(offline mode)")
+    parser.add_argument("--demo", action="store_true",
+                        help="boot a live in-process demo cluster "
+                             "(default when no --file)")
+    parser.add_argument("--silos", type=int, default=2,
+                        help="demo cluster size")
+    parser.add_argument("--watch", type=float, default=None,
+                        metavar="SECONDS",
+                        help="refresh the view at this cadence "
+                             "(demo mode keeps the cluster alive)")
+    parser.add_argument("--text", action="store_true",
+                        help="human rendering instead of JSON")
+    args = parser.parse_args(argv)
+
+    def show(view: Dict[str, Any]) -> None:
+        if args.text:
+            print(render_text(view))
+        else:
+            print(json.dumps(view))
+
+    if args.file:
+        snaps = []
+        for path in args.file:
+            with open(path) as f:
+                data = json.load(f)
+            # accept either a bare registry snapshot or a saved view
+            snaps.append(data.get("merged_metrics", data))
+        show(view_from_snapshots(snaps))
+        return 0
+
+    import asyncio
+    import logging
+    logging.disable(logging.WARNING)
+
+    async def run() -> None:
+        cluster = await _demo_cluster(args.silos)
+        try:
+            show(cluster_view(cluster.silos))
+            if args.watch:
+                while True:
+                    await asyncio.sleep(args.watch)
+                    show(cluster_view(cluster.silos))
+        except KeyboardInterrupt:
+            pass
+        finally:
+            await cluster.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,470 @@
+"""MetricsRegistry: typed, catalogued, mergeable cluster metrics.
+
+The rebuild's metrics surface grew up ad hoc: every subsystem pushed
+free-string ``track_metric(name, value)`` fan-outs at the process
+telemetry manager (telemetry.py), with no types, no histograms, no
+cluster-wide view, and nothing stopping a dashboard from meeting a
+metric name no one declared.  This module is the registry half of the
+observability plane (the tracing half is orleans_tpu/spans.py):
+
+* a **catalog** — ``CATALOG`` — is the single source of truth for every
+  metric name the runtime may emit: its kind (counter/gauge/histogram),
+  unit, and doc string.  The registry REFUSES unknown names, and the
+  tests/test_metrics.py lint walks the source tree asserting every
+  emitted literal is declared, so dashboards never meet unknown strings;
+* **typed instruments** with lock-cheap updates: ``Counter`` (monotonic;
+  supports mirroring an externally-accumulated total), ``Gauge`` (last
+  value), and ``Log2Histogram`` (fixed log2 buckets — the same scheme the
+  device latency ledger uses on-mesh, tensor/ledger.py, so host and
+  device distributions merge and quantile the same way);
+* **mergeable snapshots**: ``MetricsRegistry.snapshot()`` is plain JSON;
+  ``merge_snapshots`` folds any number of per-silo snapshots into one
+  cluster view (counters sum, histogram buckets add — associative and
+  commutative, so aggregation order never changes the answer; gauges
+  keep per-source values and report min/max/sum);
+* **percentile estimation** from log2 buckets: p50/p95/p99 with a
+  bounded relative error — an estimate always lands inside its bucket,
+  and a bucket spans one octave, so the estimate is within 2x of the
+  exact value (tests/test_metrics.py proves the bound on synthetic
+  distributions).
+
+Reference analog: CounterStatistic/HistogramValueStatistic groups +
+SiloStatisticsManager aggregation (reference: src/Orleans/Statistics/
+CounterStatistic.cs, HistogramValueStatistic.cs exponential buckets,
+SiloStatisticsManager.cs:31); the catalog discipline and the cluster
+merge are the rebuild's additions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+KIND_COUNTER = "counter"
+KIND_GAUGE = "gauge"
+KIND_HISTOGRAM = "histogram"
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    """One catalogued metric: the name is the identity; kind picks the
+    instrument; unit and doc are what a dashboard renders."""
+
+    name: str
+    kind: str
+    unit: str
+    doc: str
+
+
+#: the single source of truth: every metric name the runtime may emit.
+CATALOG: Dict[str, MetricSpec] = {}
+
+
+def declare(name: str, kind: str, unit: str, doc: str) -> MetricSpec:
+    if kind not in (KIND_COUNTER, KIND_GAUGE, KIND_HISTOGRAM):
+        raise ValueError(f"unknown metric kind {kind!r} for {name!r}")
+    spec = MetricSpec(name, kind, unit, doc)
+    existing = CATALOG.get(name)
+    if existing is not None and existing != spec:
+        raise ValueError(f"metric {name!r} already declared as {existing}")
+    CATALOG[name] = spec
+    return spec
+
+
+# ---------------------------------------------------------------------------
+# the catalog (grouped by emitting subsystem)
+# ---------------------------------------------------------------------------
+
+# -- dead letters (resilience.DeadLetterRing; silo.collect_metrics) ----------
+declare("dead_letter.total", KIND_COUNTER, "messages",
+        "terminal drops of all reasons (mirrors DeadLetterRing.total)")
+for _reason in ("expired", "shed_overload", "mailbox_overflow",
+                "breaker_open", "retry_budget_exhausted", "undeliverable"):
+    declare(f"dead_letter.{_reason}", KIND_COUNTER, "messages",
+            f"terminal drops with reason {_reason}")
+
+# -- overload containment (limits.ShedController + resilience) ---------------
+declare("overload.level", KIND_GAUGE, "ratio",
+        "adaptive shed level (0 = healthy, 1 = full shed)")
+declare("overload.shed_count", KIND_COUNTER, "requests",
+        "requests shed by adaptive admission control")
+declare("overload.breaker_fast_fails", KIND_COUNTER, "requests",
+        "requests fast-failed by an open per-destination breaker")
+declare("overload.retries_denied", KIND_COUNTER, "requests",
+        "transient resends denied by the retry token budget")
+
+# -- activation collection (tensor/engine.IncrementalCollector) --------------
+declare("collect.pause_s", KIND_HISTOGRAM, "seconds",
+        "per-slice collection pause (tick-interleaved eviction stall)")
+declare("collect.pause_p99_s", KIND_GAUGE, "seconds",
+        "p99 over recent collection slice pauses")
+declare("collect.max_pause_s", KIND_GAUGE, "seconds",
+        "worst collection slice pause since engine start")
+declare("collect.rows_evicted", KIND_COUNTER, "rows",
+        "activations evicted by the incremental collector")
+declare("collect.sweeps_completed", KIND_COUNTER, "sweeps",
+        "collection sweeps drained to completion")
+declare("collect.write_back_failures", KIND_COUNTER, "chunks",
+        "eviction chunks whose storage write-back failed (parked+retried)")
+declare("arena.fragmentation", KIND_GAUGE, "ratio",
+        "per-arena freed/high-water ratio (compaction trigger input)")
+
+# -- cross-silo slab data plane (tensor/router.VectorRouter) -----------------
+for _n, _u, _d in (
+        ("slabs_shipped", "slabs", "slab frames shipped to ring owners"),
+        ("messages_shipped", "messages", "messages shipped inside slabs"),
+        ("slabs_received", "slabs", "slab frames received"),
+        ("messages_received", "messages", "messages received inside slabs"),
+        ("slabs_requeued", "slabs", "bounced slabs re-queued for retry"),
+        ("messages_dropped", "messages",
+         "slab messages dropped after retry budget exhaustion"),
+        ("slab_fragments", "fragments",
+         "pre-aggregation slab fragments offered to senders"),
+        ("slab_frames", "frames", "post-aggregation wire frames sent"),
+        ("slab_bounces", "slabs", "slab frames bounced by byte caps")):
+    declare(f"router.{_n}", KIND_COUNTER, _u, _d)
+declare("router.slab_merge_ratio", KIND_GAUGE, "ratio",
+        "fragments per wire frame (>1 = sender aggregation engaged)")
+
+# -- transport links (runtime/transport per-link stats) ----------------------
+for _n, _u, _d in (
+        ("frames_sent", "frames", "wire frames sent on this link"),
+        ("bytes_sent", "bytes", "payload bytes sent on this link"),
+        ("slab_frames_sent", "frames", "zero-copy slab frames on this link"),
+        ("drain_cycles", "cycles", "sender batching drain cycles"),
+        ("msgs_bounced", "messages", "messages bounced by queue byte caps")):
+    declare(f"transport.link.{_n}", KIND_COUNTER, _u, _d)
+
+# -- engine / device latency ledger (tensor/engine + tensor/ledger) ----------
+declare("engine.messages_processed", KIND_COUNTER, "messages",
+        "messages applied by the tensor engine")
+declare("engine.ticks", KIND_COUNTER, "ticks", "engine ticks executed")
+declare("engine.compiles", KIND_COUNTER, "programs",
+        "step-program compilations (shape churn indicator)")
+declare("engine.tick_seconds", KIND_COUNTER, "seconds",
+        "cumulative host wall time inside run_tick")
+declare("engine.latency_ticks", KIND_HISTOGRAM, "ticks",
+        "per-message turn latency in device ticks (the on-device "
+        "latency ledger: inject-tick to completion-tick delta; "
+        "label 'method' = Type.method)")
+
+# -- host control path (stats.SiloMetrics mirror) ----------------------------
+declare("host.requests_sent", KIND_COUNTER, "requests",
+        "application requests sent on the host path")
+declare("host.requests_resent", KIND_COUNTER, "requests",
+        "transient resends on the host path")
+declare("host.turns_executed", KIND_COUNTER, "turns",
+        "activation turns executed")
+declare("host.turn_latency_s", KIND_HISTOGRAM, "seconds",
+        "host-path activation turn latency")
+
+
+# ---------------------------------------------------------------------------
+# log2 histogram (shared bucket math with the device ledger)
+# ---------------------------------------------------------------------------
+
+def bucket_index(value: float, base: float, n_buckets: int) -> int:
+    """The canonical log2 bucket of ``value``: bucket 0 holds values
+    < ``base``; bucket k (k >= 1) holds [base * 2**(k-1), base * 2**k);
+    the last bucket absorbs overflow.  The device ledger's tick deltas
+    use the same scheme with base=1 (bucket 0 = completed in the inject
+    tick, bucket 1 = 1 tick, bucket 2 = 2-3 ticks, ...)."""
+    if value < base:
+        return 0
+    return min(int(np.floor(np.log2(value / base))) + 1, n_buckets - 1)
+
+
+def bucket_bounds(base: float, n_buckets: int) -> List[Tuple[float, float]]:
+    """[(lo, hi)) value range of every bucket (hi of the overflow bucket
+    is inf)."""
+    out = [(0.0, base)]
+    for k in range(1, n_buckets):
+        hi = base * (2.0 ** k) if k < n_buckets - 1 else float("inf")
+        out.append((base * (2.0 ** (k - 1)), hi))
+    return out
+
+
+def percentile_from_counts(counts: Sequence[int], p: float,
+                           base: float = 1.0) -> float:
+    """Estimate the p-th percentile (p in [0, 100]) from log2 bucket
+    counts: find the bucket holding the target rank and interpolate
+    linearly inside it.  The estimate always lies inside its bucket, so
+    the relative error vs the exact value is bounded by the bucket's
+    octave width (<= 2x; tests/test_metrics.py asserts it)."""
+    counts = np.asarray(counts, dtype=np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return 0.0
+    target = max(1.0, (p / 100.0) * total)
+    bounds = bucket_bounds(base, len(counts))
+    seen = 0
+    for k, n in enumerate(counts):
+        if n == 0:
+            continue
+        if seen + n >= target:
+            lo, hi = bounds[k]
+            if not np.isfinite(hi):
+                hi = lo * 2.0  # overflow bucket: report its lower octave
+            frac = (target - seen) / n
+            return float(lo + frac * (hi - lo))
+        seen += int(n)
+    lo, hi = bounds[-1]
+    return float(lo)
+
+
+class Log2Histogram:
+    """Fixed log2-bucket histogram (host instrument; the device ledger
+    accumulates the identical bucket layout on the mesh)."""
+
+    __slots__ = ("base", "counts", "total", "sum")
+
+    def __init__(self, n_buckets: int = 32, base: float = 1.0) -> None:
+        self.base = base
+        self.counts = np.zeros(n_buckets, dtype=np.int64)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value: float, count: int = 1) -> None:
+        self.counts[bucket_index(value, self.base, len(self.counts))] += count
+        self.total += count
+        self.sum += value * count
+
+    def add_counts(self, counts: Sequence[int],
+                   value_sum: float = 0.0) -> None:
+        """Merge an externally-accumulated bucket array (the device
+        ledger's d2h transfer lands here).  Bucket layouts must match."""
+        counts = np.asarray(counts, dtype=np.int64)
+        if len(counts) != len(self.counts):
+            raise ValueError(
+                f"bucket count mismatch: {len(counts)} vs {len(self.counts)}")
+        self.counts += counts
+        self.total += int(counts.sum())
+        self.sum += value_sum
+
+    def set_counts(self, counts: Sequence[int],
+                   value_sum: float = 0.0) -> None:
+        """MIRROR an externally-accumulated cumulative bucket array (the
+        device latency ledger, the host turn-latency histogram): replaces
+        the counts rather than adding, so periodic re-publication of a
+        cumulative source never double-counts."""
+        counts = np.asarray(counts, dtype=np.int64)
+        if len(counts) != len(self.counts):
+            raise ValueError(
+                f"bucket count mismatch: {len(counts)} vs {len(self.counts)}")
+        self.counts = counts.copy()
+        self.total = int(counts.sum())
+        self.sum = value_sum
+
+    def merge(self, other: "Log2Histogram") -> None:
+        if other.base != self.base:
+            raise ValueError("cannot merge histograms with different bases")
+        self.add_counts(other.counts, other.sum)
+
+    def percentile(self, p: float) -> float:
+        return percentile_from_counts(self.counts, p, self.base)
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.total if self.total else 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"base": self.base, "counts": self.counts.tolist(),
+                "total": self.total, "sum": round(self.sum, 9)}
+
+
+# ---------------------------------------------------------------------------
+# instruments
+# ---------------------------------------------------------------------------
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+    def set_total(self, total: float) -> None:
+        """Mirror an externally-accumulated cumulative total (the silo's
+        periodic collection mirrors component counters that already count
+        for themselves — monotonicity is kept so a stale publish can
+        never rewind the registry)."""
+        if total > self.value:
+            self.value = total
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+
+def _label_key(labels: Optional[Dict[str, Any]]) -> str:
+    if not labels:
+        return ""
+    return ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+
+
+class MetricsRegistry:
+    """Per-silo (or per-process) typed metric store.
+
+    Every instrument is keyed by (catalogued name, label set).  Unknown
+    names raise — the catalog is the contract that keeps dashboards from
+    meeting undeclared strings.  Updates are plain attribute arithmetic
+    on the owning event loop (lock-cheap: no locks, no allocation on the
+    increment path once the instrument exists)."""
+
+    def __init__(self, source: str = "",
+                 histogram_buckets: int = 32) -> None:
+        self.source = source
+        self.histogram_buckets = histogram_buckets
+        self._counters: Dict[Tuple[str, str], Counter] = {}
+        self._gauges: Dict[Tuple[str, str], Gauge] = {}
+        self._histograms: Dict[Tuple[str, str], Log2Histogram] = {}
+
+    # -- instrument access ---------------------------------------------------
+
+    def _check(self, name: str, kind: str) -> MetricSpec:
+        spec = CATALOG.get(name)
+        if spec is None:
+            raise KeyError(
+                f"metric {name!r} is not declared in the metrics catalog "
+                "(orleans_tpu/metrics.py CATALOG) — declare name, kind, "
+                "unit and doc before emitting it")
+        if spec.kind != kind:
+            raise TypeError(f"metric {name!r} is a {spec.kind}, not {kind}")
+        return spec
+
+    def counter(self, name: str,
+                labels: Optional[Dict[str, Any]] = None) -> Counter:
+        self._check(name, KIND_COUNTER)
+        key = (name, _label_key(labels))
+        inst = self._counters.get(key)
+        if inst is None:
+            inst = self._counters[key] = Counter()
+        return inst
+
+    def gauge(self, name: str,
+              labels: Optional[Dict[str, Any]] = None) -> Gauge:
+        self._check(name, KIND_GAUGE)
+        key = (name, _label_key(labels))
+        inst = self._gauges.get(key)
+        if inst is None:
+            inst = self._gauges[key] = Gauge()
+        return inst
+
+    def histogram(self, name: str, labels: Optional[Dict[str, Any]] = None,
+                  base: float = 1.0,
+                  n_buckets: Optional[int] = None) -> Log2Histogram:
+        self._check(name, KIND_HISTOGRAM)
+        key = (name, _label_key(labels))
+        inst = self._histograms.get(key)
+        if inst is None:
+            inst = self._histograms[key] = Log2Histogram(
+                n_buckets or self.histogram_buckets, base)
+        elif n_buckets is not None and len(inst.counts) != n_buckets:
+            # the source's bucket layout changed (a live ledger_buckets
+            # reload resets the device ledger too): recreate rather than
+            # raise — a layout change must never kill a publish loop
+            inst = self._histograms[key] = Log2Histogram(n_buckets, base)
+        return inst
+
+    def apply(self, name: str, value: float,
+              labels: Optional[Dict[str, Any]] = None,
+              cumulative: bool = True) -> None:
+        """Route one (name, value) observation by the catalog's kind —
+        the migration shim for the ad-hoc ``track_metric`` call sites:
+        counters mirror cumulative totals (``cumulative=False``
+        increments instead), gauges set, histograms observe."""
+        spec = CATALOG.get(name)
+        if spec is None:
+            raise KeyError(f"metric {name!r} is not declared in the "
+                           "metrics catalog")
+        if spec.kind == KIND_COUNTER:
+            c = self.counter(name, labels)
+            c.set_total(value) if cumulative else c.inc(value)
+        elif spec.kind == KIND_GAUGE:
+            self.gauge(name, labels).set(value)
+        else:
+            # seconds-valued histograms get a microsecond base so the
+            # octave resolution covers real latency ranges
+            base = 1e-6 if spec.unit == "seconds" else 1.0
+            self.histogram(name, labels, base=base).observe(value)
+
+    # -- snapshots -----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-JSON state; ``merge_snapshots`` folds many of these into
+        a cluster view."""
+        counters: Dict[str, Dict[str, float]] = {}
+        for (name, lk), c in self._counters.items():
+            counters.setdefault(name, {})[lk] = c.value
+        gauges: Dict[str, Dict[str, Dict[str, float]]] = {}
+        src = self.source or "local"
+        for (name, lk), g in self._gauges.items():
+            gauges.setdefault(name, {})[lk] = {src: g.value}
+        histograms: Dict[str, Dict[str, Any]] = {}
+        for (name, lk), h in self._histograms.items():
+            histograms.setdefault(name, {})[lk] = h.to_dict()
+        return {"source": self.source, "counters": counters,
+                "gauges": gauges, "histograms": histograms}
+
+
+def merge_snapshots(snapshots: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-silo registry snapshots into one cluster view.  Counters
+    and histogram buckets ADD (associative + commutative — aggregation
+    order cannot change the result; tests assert it); gauges keep their
+    per-source values (a shed level is not additive across silos)."""
+    counters: Dict[str, Dict[str, float]] = {}
+    gauges: Dict[str, Dict[str, Dict[str, float]]] = {}
+    histograms: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    sources: List[str] = []
+    for snap in snapshots:
+        if not snap:
+            continue
+        sources.append(snap.get("source", ""))
+        for name, by_label in snap.get("counters", {}).items():
+            dst = counters.setdefault(name, {})
+            for lk, v in by_label.items():
+                dst[lk] = dst.get(lk, 0.0) + v
+        for name, by_label in snap.get("gauges", {}).items():
+            dst = gauges.setdefault(name, {})
+            for lk, by_src in by_label.items():
+                dst.setdefault(lk, {}).update(by_src)
+        for name, by_label in snap.get("histograms", {}).items():
+            dst = histograms.setdefault(name, {})
+            for lk, h in by_label.items():
+                cur = dst.get(lk)
+                if cur is None:
+                    dst[lk] = {"base": h["base"],
+                               "counts": list(h["counts"]),
+                               "total": h["total"], "sum": h["sum"]}
+                else:
+                    if cur["base"] != h["base"] \
+                            or len(cur["counts"]) != len(h["counts"]):
+                        raise ValueError(
+                            f"histogram {name!r} bucket layouts differ "
+                            "across snapshots")
+                    cur["counts"] = [a + b for a, b
+                                     in zip(cur["counts"], h["counts"])]
+                    cur["total"] += h["total"]
+                    cur["sum"] += h["sum"]
+    return {"source": "+".join(s for s in sources if s),
+            "counters": counters, "gauges": gauges,
+            "histograms": histograms}
+
+
+def histogram_percentiles(hist: Dict[str, Any],
+                          ps: Sequence[float] = (50, 95, 99)
+                          ) -> Dict[str, float]:
+    """p50/p95/p99 (configurable) of one snapshot histogram entry."""
+    return {f"p{int(p) if float(p).is_integer() else p}":
+            percentile_from_counts(hist["counts"], p, hist["base"])
+            for p in ps}

@@ -32,6 +32,11 @@ class SiloRuntimeStatistics:
     tensor_rows: int = 0             # live vector-grain rows (TPU plane)
     is_overloaded: bool = False
     timestamp: float = 0.0
+    # piggybacked MetricsRegistry snapshot (orleans_tpu/metrics.py):
+    # the cluster metrics plane rides the SAME broadcast the placement
+    # load view already pays for — no second gossip channel.  None when
+    # the metrics plane is disabled.
+    metrics: Optional[dict] = None
 
 
 def collect_silo_statistics(silo) -> SiloRuntimeStatistics:
@@ -45,12 +50,15 @@ def collect_silo_statistics(silo) -> SiloRuntimeStatistics:
     if silo.tensor_engine is not None:
         tensor_rows = sum(a.live_count
                           for a in silo.tensor_engine.arenas.values())
+    metrics = silo.collect_metrics() if silo.config.metrics.enabled \
+        else None
     return SiloRuntimeStatistics(
         activation_count=len(silo.catalog.directory),
         enqueued_messages=enqueued,
         tensor_rows=tensor_rows,
         is_overloaded=enqueued > silo.config.messaging.max_enqueued_requests,
         timestamp=time.time(),
+        metrics=metrics,
     )
 
 
@@ -89,7 +97,16 @@ class DeploymentLoadPublisher:
             # itself and seeds its own view (reference: Start's
             # RefreshStatistics + PublishStatistics before the timer)
             while self._running:
-                await self.publish_statistics()
+                try:
+                    await self.publish_statistics()
+                except Exception:  # noqa: BLE001 — one bad stats
+                    # collection (e.g. a mid-reload metrics hiccup) must
+                    # not silently kill the broadcast for the silo's
+                    # remaining life: placement load views AND the
+                    # cluster metrics plane both ride this loop
+                    self.silo.logger.warn(
+                        "load publish failed; retrying next period",
+                        code=2920)
                 await asyncio.sleep(self.publish_period)
         except asyncio.CancelledError:
             pass

@@ -406,6 +406,7 @@ class InsideRuntimeClient:
             # sends and storage dependency spans parent under it
             ctx.RequestContext.set(_spans.TRACE_KEY,
                                    rec.child_context(trace, turn_span))
+        turn_t0 = time.monotonic()
         try:
             method = getattr(act.grain_instance, msg.method_name, None)
             if method is None:
@@ -413,6 +414,10 @@ class InsideRuntimeClient:
                     f"{act.class_info.cls.__name__} has no method "
                     f"{msg.method_name!r}")
             result = await method(*msg.args)
+            # host-path turn latency histogram (stats.SiloMetrics): the
+            # metrics registry mirrors it as host.turn_latency_s — this
+            # was the seed's declared-but-never-fed instrument
+            self.silo.metrics.turn_latency.add(time.monotonic() - turn_t0)
             rec.finish(turn_span)
             if msg.direction != Direction.ONE_WAY:
                 response = msg.create_response(codec.deep_copy(result))

@@ -109,6 +109,13 @@ class Silo:
         self.status = SiloStatus.CREATED
         self.logger = TraceLogger(f"silo.{self.name}")
         self.metrics = SiloMetrics()
+        # unified metrics plane (orleans_tpu/metrics.py): the typed,
+        # catalogued registry every component's counters/gauges/latency
+        # histograms collect into; its snapshot piggybacks on the load
+        # publisher broadcast and merges cluster-wide in snapshot()
+        from orleans_tpu.metrics import MetricsRegistry
+        self.metrics_registry = MetricsRegistry(source=self.name)
+        self._ledger_publish_tick = -(1 << 30)  # last d2h-fetch tick
 
         # distributed tracing plane (orleans_tpu/spans.py): hop spans +
         # batched engine-tick spans + the crash flight recorder.  Built
@@ -281,7 +288,8 @@ class Silo:
         # the TPU data plane (SURVEY.md §7 design stance)
         if self.config.tensor.enabled:
             from orleans_tpu.tensor.engine import TensorEngine
-            self.tensor_engine = TensorEngine(self, self.config.tensor)
+            self.tensor_engine = TensorEngine(self, self.config.tensor,
+                                              metrics=self.config.metrics)
         else:
             self.tensor_engine = None
         # cross-silo vector data plane: clustered silos partition vector
@@ -525,6 +533,12 @@ class Silo:
             enabled=tr.enabled, sample_rate=tr.sample_rate,
             flight_capacity=tr.flight_recorder_capacity,
             breaker_capacity=tr.breaker_transition_capacity)
+        mc = self.config.metrics
+        if self.tensor_engine is not None:
+            self.tensor_engine.metrics_config = mc
+            self.tensor_engine.ledger.configure(
+                enabled=mc.enabled and mc.ledger_enabled,
+                n_buckets=mc.ledger_buckets)
         # collection knobs: the engine reads pause budget/chunk/cadence
         # off the live dataclass every tick, but each arena copied the
         # compaction threshold at creation — re-push it
@@ -560,7 +574,14 @@ class Silo:
             while True:
                 await asyncio.sleep(self.config.statistics_report_period)
                 snapshot = self.metrics.snapshot()
-                self.publish_data_plane_telemetry()
+                try:
+                    self.publish_data_plane_telemetry()
+                except Exception:  # noqa: BLE001 — one bad metrics
+                    # collection must not silently kill the statistics
+                    # loop for the silo's remaining life (same hardening
+                    # as the load-publisher loop)
+                    self.logger.warn("data-plane telemetry publish "
+                                     "failed", code=2804)
                 for pub in self.statistics_publishers.values():
                     try:
                         await pub.report(self.name, snapshot)
@@ -614,6 +635,11 @@ class Silo:
             "dead_letters": self.dead_letters.snapshot(),
             "tracing": self.spans.snapshot(),
         }
+        # unified metrics plane: ONE registry collection, reused by the
+        # cluster merge over every peer's piggybacked snapshot
+        own_metrics = self.collect_metrics()
+        out["metrics"] = own_metrics
+        out["cluster_metrics"] = self.cluster_metrics(own_metrics)
         if out["degraded"]:
             # a degraded silo self-reports its crash evidence: the
             # correlated spans + dead letters + breaker transitions the
@@ -634,51 +660,119 @@ class Silo:
             breaker_transitions=list(self.spans.breaker_transitions),
             collection_slices=slices)
 
-    def publish_data_plane_telemetry(self) -> None:
-        """Mirror the cross-silo data-plane counters (vector-router slab
-        aggregation + per-link transport frames/bytes) into the process
-        telemetry manager.  No-op without metric consumers."""
+    def collect_metrics(self, mirror: bool = False,
+                        force_ledger: bool = False) -> Dict[str, Any]:
+        """Populate this silo's ``MetricsRegistry`` from every live
+        component — dead letters, overload containment, collection,
+        router slab counters, transport links, engine throughput, and
+        the on-device latency ledger — and return its mergeable snapshot
+        (orleans_tpu/metrics.py).  The load publisher piggybacks this on
+        its broadcast; the dashboard merges them cluster-wide.  Every
+        emitted name is declared in the metrics CATALOG — an undeclared
+        name raises here, which is the contract the lint test pins.
+
+        ``mirror=True`` additionally fans the same (name, value) pairs
+        out to the process TelemetryManager's metric consumers — the
+        legacy ad-hoc surface, preserved for existing sinks/tests."""
+        if not self.config.metrics.enabled:
+            return {}
         from orleans_tpu import telemetry
+        reg = self.metrics_registry
         mgr = telemetry.default_manager
-        if not mgr.consumers:
-            return
+        fan = mirror and bool(mgr.consumers)
+
+        def emit(values: Dict[str, Any],
+                 labels: Optional[Dict[str, Any]], prefix: str) -> None:
+            for k, v in values.items():
+                reg.apply(prefix + k, float(v), labels)
+            if fan:
+                props = {"silo": self.name, **(labels or {})}
+                mgr.track_metrics(values, props, prefix=prefix)
+
+        dl = self.dead_letters.snapshot()
+        emit({"total": dl["total"], **dl["by_reason"]}, None,
+             "dead_letter.")
+        emit({"level": self.shed_controller.level,
+              "shed_count": self.shed_controller.shed_count,
+              "breaker_fast_fails": self.breakers.fast_fails,
+              "retries_denied": self.retry_budget.denied},
+             None, "overload.")
+        emit({"requests_sent": self.metrics.requests_sent,
+              "requests_resent": self.metrics.requests_resent,
+              "turns_executed": self.metrics.turns_executed},
+             None, "host.")
+        # host turn latency: mirror the SiloMetrics ns-bucket histogram
+        # into the registry's log2 layout (same octave scheme, base 1ns)
+        tl = self.metrics.turn_latency
+        if tl.count:
+            hist = reg.histogram("host.turn_latency_s", base=1e-9,
+                                 n_buckets=len(tl.buckets) + 1)
+            hist.set_counts([0] + list(tl.buckets), tl.total)
         if self.vector_router is not None \
                 and hasattr(self.vector_router, "snapshot"):
-            mgr.track_metrics(self.vector_router.snapshot(),
-                              {"silo": self.name}, prefix="router.")
+            emit(self.vector_router.snapshot(), None, "router.")
         snap = getattr(self._bound_transport, "snapshot", None)
         if snap is not None:
             for link, stats in snap().get("links", {}).items():
-                mgr.track_metrics(stats,
-                                  {"silo": self.name, "link": link},
-                                  prefix="transport.link.")
-        # containment-plane counters: dead letters by reason, shed level,
-        # breaker fast-fails — the operator-visible overload ledger
-        dl = self.dead_letters.snapshot()
-        mgr.track_metrics({"total": dl["total"], **dl["by_reason"]},
-                          {"silo": self.name}, prefix="dead_letter.")
-        mgr.track_metrics(
-            {"level": self.shed_controller.level,
-             "shed_count": self.shed_controller.shed_count,
-             "breaker_fast_fails": self.breakers.fast_fails,
-             "retries_denied": self.retry_budget.denied},
-            {"silo": self.name}, prefix="overload.")
-        # activation-collection gauges: per-slice pause + per-arena
-        # fragmentation (the incremental collector also emits
-        # collect.pause_s live per slice; this is the periodic rollup)
-        if self.tensor_engine is not None:
-            col = self.tensor_engine.collector
-            mgr.track_metrics(
-                {"pause_p99_s": col.pause_p99_s(),
-                 "max_pause_s": col.max_pause_s,
-                 "rows_evicted": col.rows_evicted,
-                 "sweeps_completed": col.sweeps_completed,
-                 "write_back_failures": col.write_back_failures},
-                {"silo": self.name}, prefix="collect.")
-            for name, arena in self.tensor_engine.arenas.items():
-                mgr.track_metric("arena.fragmentation",
-                                 arena.fragmentation(),
-                                 {"silo": self.name, "arena": name})
+                emit(stats, {"link": link}, "transport.link.")
+        eng = self.tensor_engine
+        if eng is not None:
+            col = eng.collector
+            emit({"pause_p99_s": col.pause_p99_s(),
+                  "max_pause_s": col.max_pause_s,
+                  "rows_evicted": col.rows_evicted,
+                  "sweeps_completed": col.sweeps_completed,
+                  "write_back_failures": col.write_back_failures},
+                 None, "collect.")
+            for name, arena in eng.arenas.items():
+                reg.gauge("arena.fragmentation",
+                          {"arena": name}).set(arena.fragmentation())
+                if fan:
+                    mgr.track_metric("arena.fragmentation",
+                                     arena.fragmentation(),
+                                     {"silo": self.name, "arena": name})
+            emit({"messages_processed": eng.messages_processed,
+                  "ticks": eng.ticks_run,
+                  "compiles": eng.compile_count(),
+                  "tick_seconds": eng.tick_seconds}, None, "engine.")
+            # the on-device latency ledger: the bucket-count fetch is
+            # ONE small d2h transfer, gated by the publish cadence so a
+            # hot snapshot() loop cannot turn it into per-tick traffic
+            led = eng.ledger
+            if led.enabled:
+                due = force_ledger or (
+                    eng.tick_number - self._ledger_publish_tick
+                    >= self.config.metrics.publish_interval_ticks)
+                if due:
+                    self._ledger_publish_tick = eng.tick_number
+                for method, h in (led.snapshot() if due else {}).items():
+                    reg.histogram("engine.latency_ticks",
+                                  {"method": method}, base=1.0,
+                                  n_buckets=led.n_buckets
+                                  ).set_counts(h["counts"])
+        return reg.snapshot()
+
+    def cluster_metrics(self, own: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
+        """The merged cluster view: this silo's registry + the freshest
+        snapshot every peer piggybacked on its load broadcast (counters
+        and histogram buckets sum; gauges stay per-source).  ``own``
+        reuses an already-collected snapshot (snapshot() collects once
+        and merges from it)."""
+        from orleans_tpu.metrics import merge_snapshots
+        snaps = [own if own is not None else self.collect_metrics()]
+        if self.load_publisher is not None:
+            for addr, st in self.load_publisher.periodic_stats.items():
+                if addr != self.address \
+                        and getattr(st, "metrics", None):
+                    snaps.append(st.metrics)
+        return merge_snapshots(snaps)
+
+    def publish_data_plane_telemetry(self) -> None:
+        """Refresh the metrics registry AND mirror the data-plane
+        counters to the process telemetry manager (the legacy fan-out
+        surface; sinks keep seeing the same names/properties)."""
+        self.collect_metrics(mirror=True)
 
     # ================= membership view =====================================
 

@@ -88,6 +88,7 @@ class AutoFuser:
         self._chain_counters: Optional[Tuple[int, int, int]] = None
         self._chain_generations: Dict[str, int] = {}
         self._chain_epochs: Dict[str, int] = {}
+        self._chain_ledger: Optional[Tuple] = None
         # caches / stats
         self._programs: Dict[Tuple, Any] = {}
         self._disabled: Dict[Tuple, int] = {}   # sig → ring version at ban
@@ -347,7 +348,8 @@ class AutoFuser:
                       for n in prog._touched}
             prog._compiled = wrapped.lower(
                 states, statics0, stacked0,
-                jnp.zeros(2, jnp.int32)).compile()
+                jnp.zeros(2, jnp.int32),
+                self.engine.ledger.device_hist_in()).compile()
         self._program = prog
         return True
 
@@ -411,6 +413,10 @@ class AutoFuser:
             self._chain_epochs = {
                 n: engine.arena_for(n).eviction_epoch
                 for n in prog._touched}
+            # the latency ledger accumulates INSIDE the windows: a
+            # rollback must also undo those counts (the unfused replay
+            # re-records every message)
+            self._chain_ledger = engine.ledger.snapshot_state()
 
         prog.run(stackeds if prog._is_multi() else stackeds[0],
                  static_args=statics if prog._is_multi() else statics[0])
@@ -452,11 +458,13 @@ class AutoFuser:
         counters = self._chain_counters
         generations = self._chain_generations
         epochs = self._chain_epochs
+        ledger_state = self._chain_ledger
         self._chain_prog = None
         self._chain_snapshot = None
         self._chain_counters = None
         self._chain_generations = {}
         self._chain_epochs = {}
+        self._chain_ledger = None
         misses = prog.verify()
         n_ticks = sum(len(w) for w in windows)
         if misses == 0:
@@ -495,6 +503,10 @@ class AutoFuser:
             engine.arena_for(n).state = cols
         (engine.tick_number, engine.ticks_run,
          engine.messages_processed) = counters
+        if ledger_state is not None:
+            # drop the rolled-back windows' in-program accumulation —
+            # the unfused replay below re-records every message
+            engine.ledger.restore_state(ledger_state)
         sig = self._sig
         strikes = self._rollback_counts.get(sig, 0) + 1
         self._rollback_counts[sig] = strikes
@@ -535,7 +547,11 @@ class AutoFuser:
                 rows=pat.rows,
                 keys_host=pat.keys_host,
                 generation=pat.generation,
-                epoch=pat.epoch))
+                epoch=pat.epoch,
+                # replayed buffered ticks re-enter the unfused ledger
+                # path; stamp them at replay time so they are counted
+                # (once — the fused window they fell out of never ran)
+                inject_tick=self.engine.tick_number))
         return True
 
     def snapshot(self) -> Dict[str, int]:

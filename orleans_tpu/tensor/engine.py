@@ -42,10 +42,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from orleans_tpu.config import TensorEngineConfig
+from orleans_tpu.config import MetricsConfig, TensorEngineConfig
 from orleans_tpu.core.grain import MethodInfo
 from orleans_tpu.ids import GrainId
 from orleans_tpu.tensor.arena import GrainArena
+from orleans_tpu.tensor.ledger import DeviceLatencyLedger
 from orleans_tpu.tensor.vector_grain import (
     KEY_SENTINEL,
     Batch,
@@ -99,6 +100,12 @@ class PendingBatch:
     # RequestContext at enqueue).  The executing tick links its BATCHED
     # span back to this trace; never one span per message
     trace: Optional[Dict[str, Any]] = None
+    # device latency ledger (tensor/ledger.py): the engine tick at which
+    # this batch was injected/emitted.  The executing tick's delta to it
+    # is the batch's turn latency in device ticks; -1 = unstamped (not
+    # counted).  Miss-path redeliveries carry the ORIGINAL stamp so the
+    # recorded latency includes the redelivery wait.
+    inject_tick: int = -1
 
     def __len__(self) -> int:
         for c in (self.rows, self.keys_host, self.keys_dev):
@@ -121,6 +128,7 @@ class _MissCheck:
     rows: jnp.ndarray
     miss_count: jnp.ndarray
     args: Any
+    inject_tick: int = -1  # original ledger stamp, carried to redelivery
 
 
 @jax.jit
@@ -381,6 +389,14 @@ class IncrementalCollector:
                              evicted=freed,
                              remaining=record["remaining"],
                              sweep_done=done, failed=failed)
+        silo = engine.silo
+        reg = getattr(silo, "metrics_registry", None) \
+            if silo is not None else None
+        if reg is not None:
+            # typed registry (orleans_tpu/metrics.py): the live per-slice
+            # pause histogram — the periodic collect_metrics rollup
+            # mirrors the p99/max gauges from the same data
+            reg.histogram("collect.pause_s", base=1e-6).observe(dt)
         from orleans_tpu import telemetry
         mgr = telemetry.default_manager
         if mgr.consumers:
@@ -418,9 +434,18 @@ class TensorEngine:
     def __init__(self, silo=None, config: Optional[TensorEngineConfig] = None,
                  mesh: Optional[jax.sharding.Mesh] = None,
                  initial_capacity: int = 1024,
-                 store: Optional[Any] = None) -> None:
+                 store: Optional[Any] = None,
+                 metrics: Optional[MetricsConfig] = None) -> None:
         self.silo = silo
         self.config = config or TensorEngineConfig()
+        # on-device latency ledger (tensor/ledger.py): per-(type, method)
+        # log2 histograms of inject→completion tick deltas, accumulated
+        # inside the tick; MetricsConfig.ledger_enabled gates it live
+        self.metrics_config = metrics or MetricsConfig()
+        self.ledger = DeviceLatencyLedger(
+            n_buckets=self.metrics_config.ledger_buckets,
+            enabled=(self.metrics_config.enabled
+                     and self.metrics_config.ledger_enabled))
         self.mesh = mesh
         self.initial_capacity = initial_capacity
         # VectorStore backing every arena (tensor/persistence.py):
@@ -566,6 +591,10 @@ class TensorEngine:
         # sharded array shapes changed: compiled steps specialize on shard
         # layout, so drop them and let jit re-trace on next use
         self._step_cache.clear()
+        # the ledger hist may be committed to the OLD device set (fused
+        # windows return it as a program output) — fold counts to host
+        # and let the next record recreate it on the new set
+        self.ledger.relocate()
 
     async def checkpoint(self) -> int:
         """Tick-consistent snapshot: quiesce, then write every live row of
@@ -665,11 +694,12 @@ class TensorEngine:
             # later) — that cannot retroactively fix an already-resolved
             # result future, so want_results forces the host path
             batch = PendingBatch(args=args, keys_dev=keys, future=future,
-                                 trace=trace)
+                                 trace=trace, inject_tick=self.tick_number)
         else:
             batch = PendingBatch(args=args,
                                  keys_host=np.asarray(keys, dtype=np.int64),
-                                 future=future, trace=trace)
+                                 future=future, trace=trace,
+                                 inject_tick=self.tick_number)
         self.queues[(type_name, method)].append(batch)
         self._wake_up()
         return future
@@ -725,7 +755,8 @@ class TensorEngine:
                 continue  # row-only batch with no kept keys: nothing to map
             dst, gargs, valid = fanout.expand(skeys, b.args, mask)
             self.queues[(dst_type, dst_method)].append(
-                PendingBatch(args=gargs, keys_dev=dst, mask=valid))
+                PendingBatch(args=gargs, keys_dev=dst, mask=valid,
+                             inject_tick=self.tick_number))
 
     def _expand_resolved_fanout(self, fan, batches: List[PendingBatch],
                                 resolved: List[Tuple]) -> None:
@@ -744,7 +775,8 @@ class TensorEngine:
             dst, gargs, valid = fanout.expand(
                 b.keys_dev, b.args, base & (rows >= 0))
             self.queues[(dst_type, dst_method)].append(
-                PendingBatch(args=gargs, keys_dev=dst, mask=valid))
+                PendingBatch(args=gargs, keys_dev=dst, mask=valid,
+                             inject_tick=self.tick_number))
 
     def make_injector(self, interface, method: str, keys: np.ndarray):
         """Pre-resolve a stable destination set once; subsequent injections
@@ -1076,7 +1108,8 @@ class TensorEngine:
         self._pending_checks.append(
             _MissCheck(arena=arena, type_name=arena.info.name,
                        method=method, keys=keys, valid=valid,
-                       rows=rows, miss_count=miss_count, args=args))
+                       rows=rows, miss_count=miss_count, args=args,
+                       inject_tick=b.inject_tick))
         return rows, args
 
     def _drain_checks(self) -> bool:
@@ -1123,7 +1156,8 @@ class TensorEngine:
                     args=jax.tree_util.tree_map(
                         lambda a: a if np.ndim(a) == 0 else a[idx],
                         args_h),
-                    keys_host=keys64, no_fanout=True))
+                    keys_host=keys64, no_fanout=True,
+                    inject_tick=c.inject_tick))
                 requeued = True
                 continue
             miss_keys, missing = _miss_keys_kernel(c.keys, c.rows, c.valid,
@@ -1170,7 +1204,7 @@ class TensorEngine:
                 # post-settle requeue below re-enables fan-out.
                 self.queues[(c.type_name, c.method)].append(PendingBatch(
                     args=c.args, keys_dev=c.keys, mask=missing,
-                    no_fanout=True))
+                    no_fanout=True, inject_tick=c.inject_tick))
                 requeued = True
                 continue
             if len(mk):
@@ -1179,7 +1213,8 @@ class TensorEngine:
             # the fenced requeue above); convergence across cycles even
             # when unique misses exceed MISS_BUF
             self.queues[(c.type_name, c.method)].append(PendingBatch(
-                args=c.args, keys_dev=c.keys, mask=missing))
+                args=c.args, keys_dev=c.keys, mask=missing,
+                inject_tick=c.inject_tick))
             requeued = True
         # within a tick the drain is part of that tick's breakdown (folded
         # into stage_seconds at tick end); between ticks it accrues to the
@@ -1305,7 +1340,8 @@ class TensorEngine:
                         lambda a: a if np.ndim(a) == 0 else a[lidx],
                         args_h),
                     keys_host=b.keys_host[lidx],
-                    no_fanout=b.no_fanout))
+                    no_fanout=b.no_fanout,
+                    inject_tick=b.inject_tick))
         return out
 
     def _route_group(self, type_name: str, method: str,
@@ -1367,6 +1403,20 @@ class TensorEngine:
                     self._tick_traces.append(b.trace)
                 total += len(b)
             self._tick_counts[f"{type_name}.{method}"] += total
+        ledger = self.ledger
+        if ledger.enabled:
+            # latency ledger, host-resolved side: injector/host-key
+            # batches always fully deliver (host resolution activates),
+            # so their accounting is one numpy scalar add per batch —
+            # recorded BEFORE coalescing (the merge drops per-batch
+            # inject stamps).  Device-key batches are recorded after
+            # resolution below, masked to the lanes actually applied.
+            for b in batches:
+                if b.inject_tick >= 0 and (b.keys_host is not None
+                                           or b.rows is not None):
+                    ledger.record_host(type_name, method,
+                                       self.tick_number - b.inject_tick,
+                                       len(b))
         batches = self._coalesce_host_batches(batches)
 
         # re-resolve if any batch's resolution itself grew/repacked the
@@ -1380,6 +1430,21 @@ class TensorEngine:
         fan = self._fanouts.get((type_name, method))
         if fan is not None:
             self._expand_resolved_fanout(fan, batches, resolved)
+        if ledger.enabled:
+            # latency ledger, device side: count exactly the lanes the
+            # step will apply (mask ∧ resolved, combined INSIDE the jit)
+            # — unresolved misses are counted when their redelivery
+            # applies (original stamp), never twice.  One async jit
+            # dispatch per device batch; nothing crosses to the host.
+            for b, (rows, _a) in zip(batches, resolved):
+                if b.inject_tick < 0 or b.keys_host is not None \
+                        or b.rows is not None:
+                    continue
+                base = b.mask if b.mask is not None \
+                    else _mask_for(len(b))
+                ledger.record_rows(type_name, method,
+                                   self.tick_number - b.inject_tick,
+                                   rows, base)
         masks = [b.mask for b in batches]
         if len(resolved) == 1:
             rows, args = resolved[0]
@@ -1470,12 +1535,14 @@ class TensorEngine:
                           else jnp.asarray(k, jnp.int32) for k in keys)
                 self.queues[(emit.interface, emit.method)].append(
                     PendingBatch(args=emit.args, keys_wide=(hi, lo),
-                                 mask=emit.mask))
+                                 mask=emit.mask,
+                                 inject_tick=self.tick_number))
                 continue
             if not (isinstance(keys, jnp.ndarray) and keys.dtype == jnp.int32):
                 keys = jnp.asarray(keys, dtype=jnp.int32)
             self.queues[(emit.interface, emit.method)].append(PendingBatch(
-                args=emit.args, keys_dev=keys, mask=emit.mask))
+                args=emit.args, keys_dev=keys, mask=emit.mask,
+                inject_tick=self.tick_number))
 
     # ================= compilation ========================================
 
@@ -1552,6 +1619,10 @@ class TensorEngine:
             "collection": self.collector.snapshot(),
             "fragmentation": {name: round(a.fragmentation(), 4)
                               for name, a in self.arenas.items()},
+            # ledger health only (no device transfer here — the bucket
+            # counts come from engine.ledger.snapshot(), which pays the
+            # ONE d2h fetch explicitly)
+            "latency_ledger": self.ledger.stats(),
         }
 
 
@@ -1626,7 +1697,8 @@ class BatchInjector:
         self.engine.queues[(self.type_name, self.method)].append(
             PendingBatch(args=args, rows=self.rows, future=future,
                          keys_host=self.keys, keys_dev=self._keys_dev,
-                         generation=self.generation, epoch=self.epoch))
+                         generation=self.generation, epoch=self.epoch,
+                         inject_tick=self.engine.tick_number))
         self.engine._wake_up()
         return future
 

@@ -120,6 +120,17 @@ class FusedTickProgram:
         self._touched: List[str] = []
         self._compiled: Callable | None = None
         self._totals = None  # device [miss, delivered] since last verify
+        # latency-ledger integration (tensor/ledger.py): when the owning
+        # engine's ledger is enabled at BUILD time, the window program
+        # threads the [slots, buckets] histogram through its scan and
+        # every applied batch accumulates inside the compiled program —
+        # zero per-window host work.  Inside a window each tick's
+        # messages complete in their own (virtual) tick, so the recorded
+        # delta is 0: the fused steady state IS the zero-queue-delay
+        # operating point, and wall latency comes from seconds-per-tick
+        # (bench.py's device-ledger points measure exactly that).
+        self._ledger_on = False
+        self._hist_shape: "Tuple[int, int] | None" = None
         # donate=False keeps the pre-run state buffers valid after the
         # window executes, so a caller that may need to ROLL BACK (the
         # auto-fuser) gets its snapshot for free — eager device copies
@@ -166,10 +177,11 @@ class FusedTickProgram:
     # -- trace-time recursion over the emit graph ---------------------------
 
     def _apply_group(self, states: Dict[str, Any], type_name: str,
-                     method: str, rows, args, mask, depth: int):
+                     method: str, rows, args, mask, depth: int, hist):
         """Apply one (type, method) batch and recurse into its emits and
         registered fan-outs — the trace-time unrolling of the engine's
-        multi-round tick."""
+        multi-round tick.  ``hist`` is the latency-ledger accumulator
+        threaded through the window (unchanged when the ledger is off)."""
         info = vector_type(type_name)
         handler = info.handlers[method]
         if type_name not in states:
@@ -182,6 +194,17 @@ class FusedTickProgram:
             handler(states[type_name],
                     Batch(rows=rows, args=args, mask=mask), n_rows))
         states = {**states, type_name: state2}
+        if self._ledger_on:
+            # in-window latency ledger: the applied lanes accumulate at
+            # delta 0 (each tick's messages complete in their own tick)
+            # with the same one-hot + segment_sum math the unfused
+            # engine dispatches per batch — here it fuses into the scan
+            from orleans_tpu.tensor import ledger as _ledger
+            slot = self.engine.ledger.slot_for(type_name, method)
+            m = rows.shape[0]
+            hist = _ledger.accumulate(
+                hist, jnp.int32(slot), jnp.zeros(m, jnp.int32),
+                jnp.asarray(mask, bool))
         miss_total = jnp.int32(0)
         delivered = jnp.int32(0)
         at_cap = depth >= self.engine.config.max_rounds_per_tick
@@ -231,7 +254,7 @@ class FusedTickProgram:
             for _, _, _ekeys, _eargs, emask in out_batches:
                 miss_total = miss_total + jnp.sum(
                     jnp.asarray(emask, jnp.int32))
-            return states, miss_total, delivered
+            return states, miss_total, delivered, hist
 
         for dst_type, dst_method, ekeys, eargs, emask in out_batches:
             dst_arena = self.engine.arena_for(dst_type)
@@ -239,12 +262,12 @@ class FusedTickProgram:
             from orleans_tpu.tensor.engine import resolve_rows_on_device
             drows, miss = resolve_rows_on_device(dst_arena, ekeys, emask)
             delivered = delivered + jnp.sum(jnp.asarray(emask, jnp.int32))
-            states, sub_miss, sub_del = self._apply_group(
+            states, sub_miss, sub_del, hist = self._apply_group(
                 states, dst_type, dst_method, drows, eargs,
-                drows >= 0, depth + 1)
+                drows >= 0, depth + 1, hist)
             miss_total = miss_total + miss + sub_miss
             delivered = delivered + sub_del
-        return states, miss_total, delivered
+        return states, miss_total, delivered, hist
 
     def _src_keys_for(self, type_name: str, rows):
         arena = self.engine.arena_for(type_name)
@@ -262,21 +285,28 @@ class FusedTickProgram:
     # -- compile + run -------------------------------------------------------
 
     def _build(self, example_args_t: Any) -> Callable:
+        from orleans_tpu.tensor.ledger import MAX_SLOTS
+
         examples = example_args_t if self._is_multi() \
             else [example_args_t]
         src_rows = [s.rows for s in self.sources]
         masks = [ones_mask(len(s.keys)) for s in self.sources]
+        # latency ledger: bake the decision at build time (a live toggle
+        # takes effect on the next re-trace); the hist shape is part of
+        # the compiled signature, so prepare() re-traces when it changes
+        self._ledger_on = self.engine.ledger.enabled
+        self._hist_shape = (MAX_SLOTS, self.engine.ledger.n_buckets)
 
-        def apply_all(states, per_source_args):
+        def apply_all(states, per_source_args, hist):
             miss_tot = jnp.int32(0)
             del_tot = jnp.int32(0)
             for i, src in enumerate(self.sources):
-                states, miss, dd = self._apply_group(
+                states, miss, dd, hist = self._apply_group(
                     states, src.type_name, src.method, src_rows[i],
-                    per_source_args[i], masks[i], depth=1)
+                    per_source_args[i], masks[i], depth=1, hist=hist)
                 miss_tot = miss_tot + miss
                 del_tot = del_tot + dd
-            return states, miss_tot, del_tot
+            return states, miss_tot, del_tot, hist
 
         def reset_discovery() -> None:
             self._generations = {s.type_name: s.arena.generation
@@ -305,7 +335,9 @@ class FusedTickProgram:
             def discover(args_per_source):
                 states: Dict[str, Any] = {
                     s.type_name: s.arena.state for s in self.sources}
-                _states, miss, _d = apply_all(states, args_per_source)
+                hist0 = jnp.zeros(self._hist_shape, jnp.int32)
+                _states, miss, _d, _h = apply_all(states, args_per_source,
+                                                  hist0)
                 return miss
 
             jax.eval_shape(discover, examples)
@@ -317,23 +349,26 @@ class FusedTickProgram:
                 self.engine.arena_for(name)  # eager, concrete columns
         touched = list(self._touched)
 
-        def window(states, statics, stackeds, totals_in):
-            def one_tick(states, args_ts):
+        def window(states, statics, stackeds, totals_in, hist_in):
+            def one_tick(carry, args_ts):
+                states, hist = carry
                 # static leaves (identical every tick) ride OUTSIDE the
                 # scan xs: slicing a [T, m] stack per iteration costs
                 # real bandwidth; a closed-over [m] array costs nothing
                 merged = [{**statics[i], **args_ts[i]}
                           for i in range(len(self.sources))]
-                states, miss, delivered = apply_all(states, merged)
-                return states, (miss, delivered)
-            states, (misses, delivered) = jax.lax.scan(one_tick, states,
-                                                       tuple(stackeds))
+                states, miss, delivered, hist = apply_all(states, merged,
+                                                          hist)
+                return (states, hist), (miss, delivered)
+            (states, hist), (misses, delivered) = jax.lax.scan(
+                one_tick, (states, hist_in), tuple(stackeds))
             # totals accumulate ON DEVICE across runs: verify() then
             # reads one 2-element buffer no matter how many windows ran
             # (each completion observation costs ~100ms on tunneled
-            # runtimes, so per-window reads would dominate)
+            # runtimes, so per-window reads would dominate).  The ledger
+            # hist likewise stays on device until an explicit snapshot.
             return states, totals_in + jnp.stack(
-                [jnp.sum(misses), jnp.sum(delivered)])
+                [jnp.sum(misses), jnp.sum(delivered)]), hist
 
         self._touched = touched
         return jax.jit(window,
@@ -349,11 +384,14 @@ class FusedTickProgram:
         post-snapshot grow would make the snapshot unrestorable."""
         engine = self.engine
         stackeds, statics = self._as_lists(stacked_args, static_args)
+        from orleans_tpu.tensor.ledger import MAX_SLOTS
         if self._compiled is None or any(
                 engine.arena_for(n).generation != g
                 for n, g in self._generations.items()) or any(
                 engine.arena_for(n).eviction_epoch != e
-                for n, e in self._epochs.items()):
+                for n, e in self._epochs.items()) or \
+                self._hist_shape != (MAX_SLOTS, engine.ledger.n_buckets) \
+                or self._ledger_on != engine.ledger.enabled:
             for s in self.sources:
                 s.rows = jnp.asarray(s.arena.resolve_rows(s.keys))
             examples = [
@@ -385,8 +423,11 @@ class FusedTickProgram:
         states = {n: engine.arena_for(n).state for n in self._touched}
         totals_in = self._totals if self._totals is not None \
             else jnp.zeros(2, dtype=jnp.int32)
-        new_states, self._totals = self._compiled(
-            states, statics, stackeds, totals_in)
+        new_states, self._totals, hist_out = self._compiled(
+            states, statics, stackeds, totals_in,
+            engine.ledger.device_hist_in())
+        if self._ledger_on:
+            engine.ledger.device_hist_out(hist_out)
         for n in self._touched:
             engine.arena_for(n).state = new_states[n]
         engine.tick_number += n_ticks

@@ -1,0 +1,605 @@
+"""Unified metrics plane: registry + catalog lint, log2 histogram math,
+the on-device latency ledger (exactness vs a host replay, the
+one-d2h-per-snapshot transfer budget, compile-count bound), cluster
+merge via the load publisher, and the dashboard view.
+
+Marked ``metrics`` (pytest.ini); everything runs on the CPU backend.
+"""
+
+import asyncio
+import json
+import re
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import samples.presence  # noqa: F401 — registers the vector grains
+from orleans_tpu import metrics as m
+from orleans_tpu.config import MetricsConfig, TensorEngineConfig
+from orleans_tpu.tensor import TensorEngine
+from orleans_tpu.tensor import ledger as ledger_mod
+
+pytestmark = pytest.mark.metrics
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _engine(**cfg):
+    cfg.setdefault("auto_fusion_ticks", 0)
+    cfg.setdefault("tick_interval", 0.0)
+    return TensorEngine(config=TensorEngineConfig(**cfg))
+
+
+# ---------------------------------------------------------------------------
+# catalog lint: every metric name emitted anywhere in orleans_tpu/ is
+# declared (one source of truth for name/kind/unit/doc) — the satellite
+# extension of PR 4's three-ledger lint
+# ---------------------------------------------------------------------------
+
+def _source_files():
+    return (REPO / "orleans_tpu").rglob("*.py")
+
+
+def test_lint_every_emitted_metric_name_is_catalogued():
+    # literal names: track_metric("x", ...) and reg.apply("x"/prefix+k)
+    lit = re.compile(r"track_metric\(\s*[\"']([^\"']+)[\"']")
+    for path in _source_files():
+        for name in lit.findall(path.read_text()):
+            assert name in m.CATALOG, \
+                f"{path.name} emits undeclared metric {name!r}"
+
+
+def test_lint_every_emitted_prefix_group_is_catalogued():
+    # track_metrics(..., prefix="p.") families: at least one declared
+    # name per prefix, so a renamed family cannot silently vanish
+    pref = re.compile(r"prefix=\s*[\"']([^\"']+)[\"']")
+    for path in _source_files():
+        for prefix in pref.findall(path.read_text()):
+            assert any(n.startswith(prefix) for n in m.CATALOG), \
+                f"{path.name} emits undeclared metric family {prefix!r}*"
+
+
+def test_lint_registry_refuses_undeclared_names():
+    reg = m.MetricsRegistry(source="s")
+    with pytest.raises(KeyError):
+        reg.counter("no.such.metric")
+    with pytest.raises(KeyError):
+        reg.apply("no.such.metric", 1.0)
+    with pytest.raises(TypeError):  # kind mismatch is equally fatal
+        reg.gauge("dead_letter.total")
+
+
+def test_lint_live_silo_collection_is_fully_catalogued():
+    """collect_metrics routes every emission through the strict
+    registry — a live silo with engine + host traffic must not raise."""
+    from orleans_tpu.runtime.silo import Silo
+    from samples.helloworld import IHello
+
+    async def go():
+        silo = Silo(name="lint-silo")
+        await silo.start()
+        try:
+            ref = silo.attach_client().get_grain(IHello, 1)
+            await ref.say_hello("hi")
+            keys = np.arange(256, dtype=np.int64)
+            silo.tensor_engine.send_batch(
+                "PresenceGrain", "heartbeat", keys,
+                {"game": (keys % 8).astype(np.int32),
+                 "score": np.ones(256, np.float32),
+                 "tick": np.full(256, 1, np.int32)})
+            await silo.tensor_engine.flush()
+            snap = silo.collect_metrics(force_ledger=True)
+            assert snap["counters"]["engine.messages_processed"][""] >= 512
+            for name in snap["counters"]:
+                assert name in m.CATALOG
+        finally:
+            await silo.stop(graceful=False)
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# log2 histogram math
+# ---------------------------------------------------------------------------
+
+def test_bucket_boundaries_log2():
+    n = 8
+    # base=1 integer scheme (the device ledger's): 0 → b0, 1 → b1,
+    # 2..3 → b2, 4..7 → b3, ... , overflow pins at the last bucket
+    assert m.bucket_index(0, 1.0, n) == 0
+    assert m.bucket_index(1, 1.0, n) == 1
+    assert m.bucket_index(2, 1.0, n) == 2
+    assert m.bucket_index(3, 1.0, n) == 2
+    assert m.bucket_index(4, 1.0, n) == 3
+    assert m.bucket_index(7, 1.0, n) == 3
+    assert m.bucket_index(8, 1.0, n) == 4
+    assert m.bucket_index(10**9, 1.0, n) == n - 1
+    # fractional base (seconds histograms)
+    assert m.bucket_index(0.5e-6, 1e-6, 16) == 0
+    assert m.bucket_index(1.5e-6, 1e-6, 16) == 1
+    assert m.bucket_index(3e-6, 1e-6, 16) == 2
+    # bounds tile the value axis exactly
+    bounds = m.bucket_bounds(1.0, n)
+    assert bounds[0] == (0.0, 1.0)
+    for (lo, hi), (lo2, _hi2) in zip(bounds[:-1], bounds[1:]):
+        assert hi == lo2
+    assert bounds[-1][1] == float("inf")
+
+
+def test_histogram_device_host_bucket_parity():
+    """The traced device bucketing (ceil(log2(d+1))) must agree with the
+    host bucket_index for every delta — host replay depends on it."""
+    import jax.numpy as jnp
+    hist = jnp.zeros((1, 16), jnp.int32)
+    deltas = np.array([0, 1, 2, 3, 4, 5, 7, 8, 15, 16, 100, 1000, 2**14,
+                       2**20])
+    out = np.asarray(ledger_mod.accumulate(
+        hist, jnp.int32(0), jnp.asarray(deltas, jnp.int32),
+        jnp.ones(len(deltas), bool)))[0]
+    expect = np.zeros(16, np.int64)
+    for d in deltas:
+        expect[m.bucket_index(int(d), 1.0, 16)] += 1
+    assert np.array_equal(out, expect), (out, expect)
+
+
+def test_histogram_merge_associative_and_commutative():
+    rng = np.random.default_rng(7)
+
+    def make():
+        h = m.Log2Histogram(n_buckets=12, base=1.0)
+        for v in rng.integers(0, 500, 200):
+            h.observe(int(v))
+        return h.to_dict()
+
+    a, b, c = make(), make(), make()
+
+    def merge(*snaps):
+        return m.merge_snapshots([
+            {"source": f"s{i}", "counters": {}, "gauges": {},
+             "histograms": {"engine.latency_ticks": {"": s}}}
+            for i, s in enumerate(snaps)])["histograms"][
+                "engine.latency_ticks"][""]
+
+    ab_c = merge(merge(a, b), c)
+    a_bc = merge(a, merge(b, c))
+    c_ba = merge(c, b, a)
+    for other in (a_bc, c_ba):
+        assert ab_c["counts"] == other["counts"]
+        assert ab_c["total"] == other["total"]
+
+
+def test_percentile_error_bound_vs_exact():
+    """The log2-bucket percentile estimate stays inside its bucket: for
+    any sample set and percentile, estimate/exact ∈ [1/2, 2] (one
+    octave) — plus exact containment in the bucket's [lo, hi)."""
+    rng = np.random.default_rng(3)
+    for dist in (rng.integers(1, 1000, 5000),
+                 rng.exponential(50.0, 5000) + 1.0,
+                 np.full(100, 7.0)):
+        h = m.Log2Histogram(n_buckets=32, base=1.0)
+        for v in dist:
+            h.observe(float(v))
+        for p in (50, 90, 95, 99):
+            exact = float(np.percentile(dist, p))
+            est = h.percentile(p)
+            assert est <= 2.0 * exact + 1e-9, (p, est, exact)
+            assert est >= exact / 2.0 - 1e-9, (p, est, exact)
+
+
+def test_registry_counters_gauges_labels_and_merge():
+    r1 = m.MetricsRegistry(source="silo1")
+    r2 = m.MetricsRegistry(source="silo2")
+    r1.counter("dead_letter.total").inc(3)
+    r2.counter("dead_letter.total").inc(4)
+    r1.gauge("overload.level").set(0.25)
+    r2.gauge("overload.level").set(0.75)
+    r1.counter("transport.link.bytes_sent", {"link": "a->b"}).inc(100)
+    r2.counter("transport.link.bytes_sent", {"link": "b->a"}).inc(50)
+    merged = m.merge_snapshots([r1.snapshot(), r2.snapshot()])
+    assert merged["counters"]["dead_letter.total"][""] == 7
+    # gauges keep per-source values — a shed level is not additive
+    assert merged["gauges"]["overload.level"][""] == {
+        "silo1": 0.25, "silo2": 0.75}
+    assert merged["counters"]["transport.link.bytes_sent"] == {
+        "link=a->b": 100, "link=b->a": 50}
+    # counters mirror cumulative totals monotonically
+    c = r1.counter("dead_letter.total")
+    c.set_total(10)
+    c.set_total(5)  # stale publish cannot rewind
+    assert c.value == 10
+
+
+# ---------------------------------------------------------------------------
+# device latency ledger
+# ---------------------------------------------------------------------------
+
+def test_ledger_counts_match_host_replay():
+    """Drive a known pattern and compare the device ledger's buckets to
+    an exact host-side replay: injector batches enqueued between ticks
+    wait exactly one tick (bucket 1); the in-tick fan-in emits apply in
+    their own tick (bucket 0)."""
+    async def go():
+        n, n_games, n_ticks = 1500, 15, 9
+        engine = _engine()
+        keys = np.arange(n, dtype=np.int64)
+        engine.arena_for("PresenceGrain").resolve_rows(keys)
+        engine.arena_for("GameGrain").resolve_rows(
+            np.arange(n_games, dtype=np.int64))
+        inj = engine.make_injector("PresenceGrain", "heartbeat", keys)
+        for t in range(n_ticks):
+            inj.inject({"game": (keys % n_games).astype(np.int32),
+                        "score": np.ones(n, np.float32),
+                        "tick": np.full(n, t + 1, np.int32)})
+            engine.run_tick()
+        await engine.flush()
+        snap = engine.ledger.snapshot()
+        hb = snap["PresenceGrain.heartbeat"]
+        gu = snap["GameGrain.update_game_status"]
+        # host replay: every injector message waits 1 tick, every emit 0
+        expect = n * n_ticks
+        assert hb["total"] == expect and hb["counts"][1] == expect, hb
+        assert gu["total"] == expect and gu["counts"][0] == expect, gu
+
+    asyncio.run(go())
+
+
+def test_ledger_miss_redelivery_counted_once_with_original_stamp():
+    """Messages to unseen grains drop at first resolution and redeliver
+    after activation: the ledger must count them ONCE, at redelivery,
+    with the ORIGINAL inject stamp (the recorded latency includes the
+    redelivery wait)."""
+    async def go():
+        import jax.numpy as jnp
+        engine = _engine()
+        engine.arena_for("GameGrain")  # arena exists; keys are unseen
+        engine.send_batch("GameGrain", "update_game_status",
+                          jnp.arange(32, dtype=jnp.int32),
+                          {"score": jnp.ones(32, jnp.float32),
+                           "count": jnp.ones(32, jnp.int32)})
+        # several empty ticks before the quiescence point resolves the
+        # misses: the recorded delta must span them
+        for _ in range(3):
+            engine.run_tick()
+        await engine.flush()
+        snap = engine.ledger.snapshot()
+        gu = snap["GameGrain.update_game_status"]
+        assert gu["total"] == 32, gu
+        assert gu["counts"][0] == 0, gu  # nothing counted at delta 0
+        assert gu["p50_ticks"] >= 1.0, gu
+
+    asyncio.run(go())
+
+
+def test_ledger_transfer_and_compile_budget():
+    """The cost contract: processing messages performs ZERO d2h for the
+    ledger; ONE snapshot = ONE d2h fetch; a steady batch ladder keeps
+    the accumulate-kernel compile count bounded (not per tick)."""
+    async def go():
+        n, n_ticks = 1024, 12
+        engine = _engine()
+        keys = np.arange(n, dtype=np.int64)
+        engine.arena_for("PresenceGrain").resolve_rows(keys)
+        engine.arena_for("GameGrain").resolve_rows(
+            np.arange(8, dtype=np.int64))
+        inj = engine.make_injector("PresenceGrain", "heartbeat", keys)
+        compiles0 = ledger_mod.accumulate_compiles()
+        for t in range(n_ticks):
+            inj.inject({"game": (keys % 8).astype(np.int32),
+                        "score": np.ones(n, np.float32),
+                        "tick": np.full(n, t + 1, np.int32)})
+            engine.run_tick()
+        await engine.flush()
+        assert engine.ledger.d2h_fetches == 0  # zero per-message/tick d2h
+        assert engine.ledger.records > 0
+        engine.ledger.snapshot()
+        assert engine.ledger.d2h_fetches == 1  # the ONE bucket-count read
+        # a second snapshot with no new device records is free
+        engine.ledger.snapshot()
+        assert engine.ledger.d2h_fetches == 1
+        # compile-count bound: steady shapes, not one program per tick
+        assert ledger_mod.accumulate_compiles() - compiles0 <= 2
+
+    asyncio.run(go())
+
+
+def test_ledger_disabled_is_inert_and_live_toggleable():
+    async def go():
+        engine = _engine()
+        engine.ledger.configure(enabled=False)
+        keys = np.arange(64, dtype=np.int64)
+        engine.arena_for("PresenceGrain").resolve_rows(keys)
+        inj = engine.make_injector("PresenceGrain", "heartbeat", keys)
+        inj.inject({"game": np.zeros(64, np.int32),
+                    "score": np.ones(64, np.float32),
+                    "tick": np.ones(64, np.int32)})
+        engine.run_tick()
+        await engine.flush()
+        assert engine.ledger.records == 0
+        assert engine.ledger.snapshot() == {}
+        engine.ledger.configure(enabled=True)  # live re-enable
+        inj.inject({"game": np.zeros(64, np.int32),
+                    "score": np.ones(64, np.float32),
+                    "tick": np.ones(64, np.int32)})
+        engine.run_tick()
+        await engine.flush()
+        assert engine.ledger.records > 0
+        assert "PresenceGrain.heartbeat" in engine.ledger.snapshot()
+
+    asyncio.run(go())
+
+
+def test_ledger_fused_window_counts_match():
+    """The fused path accumulates INSIDE the compiled window program:
+    counts must equal every applied source + emit message."""
+    async def go():
+        from samples.presence import run_presence_load_fused
+        engine = TensorEngine()
+        await run_presence_load_fused(engine, n_players=512, n_games=8,
+                                      n_ticks=6, window=3)
+        snap = engine.ledger.snapshot()
+        # 6 measured ticks + the warm window of 3
+        assert snap["PresenceGrain.heartbeat"]["total"] == 512 * 9
+        assert snap["GameGrain.update_game_status"]["total"] == 512 * 9
+        # fused deltas are 0 by the virtual tick clock
+        assert snap["PresenceGrain.heartbeat"]["counts"][0] == 512 * 9
+
+    asyncio.run(go())
+
+
+@pytest.fixture(scope="module")
+def hop_grains():
+    """A two-hop pair whose emits a test can steer at cold keys to force
+    fused-window rollbacks (the ledger must roll back with the state)."""
+    import jax.numpy as jnp
+    from orleans_tpu.core.grain import batched_method
+    from orleans_tpu.tensor import (
+        Batch,
+        Emit,
+        VectorGrain,
+        field,
+        vector_grain,
+    )
+    from orleans_tpu.tensor.vector_grain import (
+        scatter_add_rows,
+        vector_type,
+    )
+
+    if vector_type("MetricsHopGrain") is not None:
+        return  # already registered (module re-import)
+
+    @vector_grain
+    class MetricsLwwGrain(VectorGrain):
+        count = field(jnp.int32, 0)
+
+        @batched_method
+        @staticmethod
+        def put(state, batch: Batch, n_rows: int):
+            ones = jnp.ones_like(batch.rows, jnp.int32) * batch.mask
+            return {**state, "count": scatter_add_rows(
+                state["count"], batch.rows, ones)}
+
+    @vector_grain
+    class MetricsHopGrain(VectorGrain):
+        sent = field(jnp.int32, 0)
+
+        @batched_method
+        @staticmethod
+        def send(state, batch: Batch, n_rows: int):
+            ones = jnp.ones_like(batch.rows, jnp.int32) * batch.mask
+            state = {**state, "sent": scatter_add_rows(
+                state["sent"], batch.rows, ones)}
+            emit = Emit(interface="MetricsLwwGrain", method="put",
+                        keys=batch.args["dst"],
+                        args={"v": batch.args["v"]}, mask=batch.mask)
+            return state, None, (emit,)
+
+
+def test_ledger_rollback_restores_counts(hop_grains):
+    """Review regression: a fused window that rolls back (cold emit
+    destination) must roll its in-window ledger accumulation back too —
+    the unfused replay re-records every message, so totals stay exact."""
+    async def go():
+        n, T = 16, 24
+        src = np.arange(n, dtype=np.int64)
+        engine = TensorEngine(config=TensorEngineConfig(
+            auto_fusion_ticks=3, auto_fusion_window=4, tick_interval=0.0,
+            auto_fusion_max_rollbacks=100))
+        engine.arena_for("MetricsHopGrain").reserve(n)
+        engine.arena_for("MetricsLwwGrain").reserve(n + 64)
+        inj = engine.make_injector("MetricsHopGrain", "send", src)
+        cold_tick = 18  # past engagement, inside a fused window
+        for t in range(T):
+            dst = np.full(n, 5000 if t == cold_tick else 0, np.int32)
+            inj.inject({"dst": dst, "v": np.full(n, t + 1, np.int32)})
+            await engine.drain_queues()
+        await engine.flush()
+        assert engine.autofuser.windows_rolled_back >= 1, \
+            "cold destination did not trigger a rollback"
+        snap = engine.ledger.snapshot()
+        assert snap["MetricsHopGrain.send"]["total"] == n * T, snap
+        assert snap["MetricsLwwGrain.put"]["total"] == n * T, snap
+
+    asyncio.run(go())
+
+
+def test_ledger_toggle_retraces_fused_program():
+    """Review regression: a live ledger toggle must take effect on a
+    steady fused program (prepare() re-traces on the flag change)."""
+    async def go():
+        import jax.numpy as jnp
+        engine = TensorEngine()
+        players = np.arange(128, dtype=np.int64)
+        engine.arena_for("PresenceGrain").resolve_rows(players)
+        engine.arena_for("GameGrain").resolve_rows(
+            np.arange(4, dtype=np.int64))
+        prog = engine.fuse_ticks("PresenceGrain", "heartbeat", players)
+        static = {"game": jnp.zeros(128, jnp.int32),
+                  "score": jnp.ones(128, jnp.float32)}
+
+        def window(t0):
+            prog.run({"tick": jnp.arange(t0, t0 + 2, dtype=jnp.int32)},
+                     static_args=static)
+
+        def total():
+            return engine.ledger.snapshot().get(
+                "PresenceGrain.heartbeat", {}).get("total", 0)
+
+        window(1)
+        assert prog.verify() == 0
+        assert total() == 256
+        # live disable: the steady program must re-trace and stop
+        # accumulating (counts hold at the pre-toggle value)
+        engine.ledger.configure(enabled=False)
+        window(3)
+        assert prog.verify() == 0
+        assert total() == 256
+        # live re-enable: accumulation resumes
+        engine.ledger.configure(enabled=True)
+        window(5)
+        assert prog.verify() == 0
+        assert total() == 512
+    asyncio.run(go())
+
+
+def test_ledger_buckets_reload_keeps_collection_alive():
+    """Review regression: a live ledger_buckets change must not wedge
+    collect_metrics (the registry recreates the histogram at the new
+    layout instead of raising into the load-publisher loop)."""
+    from orleans_tpu.runtime.silo import Silo
+
+    async def go():
+        silo = Silo(name="reload-buckets")
+        await silo.start()
+        try:
+            keys = np.arange(128, dtype=np.int64)
+
+            def drive():
+                silo.tensor_engine.send_batch(
+                    "PresenceGrain", "heartbeat", keys,
+                    {"game": (keys % 4).astype(np.int32),
+                     "score": np.ones(128, np.float32),
+                     "tick": np.full(128, 1, np.int32)})
+                return silo.tensor_engine.flush()
+
+            await drive()
+            silo.collect_metrics(force_ledger=True)
+            silo.update_config({"metrics": {"ledger_buckets": 8}})
+            await drive()
+            snap = silo.collect_metrics(force_ledger=True)
+            hists = snap["histograms"]["engine.latency_ticks"]
+            for h in hists.values():
+                assert len(h["counts"]) == 8, h
+        finally:
+            await silo.stop(graceful=False)
+
+    asyncio.run(go())
+
+
+def test_silo_config_live_reload_metrics():
+    from orleans_tpu.runtime.silo import Silo
+
+    async def go():
+        silo = Silo(name="reload-silo")
+        await silo.start()
+        try:
+            assert silo.tensor_engine.ledger.enabled
+            silo.update_config({"metrics": {"ledger_enabled": False}})
+            assert not silo.tensor_engine.ledger.enabled
+            silo.update_config({"metrics": {"ledger_enabled": True,
+                                            "ledger_buckets": 24}})
+            assert silo.tensor_engine.ledger.enabled
+            assert silo.tensor_engine.ledger.n_buckets == 24
+        finally:
+            await silo.stop(graceful=False)
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# cluster aggregation + dashboard
+# ---------------------------------------------------------------------------
+
+def test_cluster_merge_and_dashboard_live():
+    """The acceptance path: a live in-process multi-silo cluster, silo
+    snapshots piggybacked on the load publisher, merged in
+    silo.snapshot() and rendered by the dashboard."""
+    from orleans_tpu import dashboard
+
+    async def go():
+        cluster = await dashboard._demo_cluster(2)
+        try:
+            view = dashboard.cluster_view(cluster.silos)
+            c = view["cluster"]
+            assert c["throughput"]["engine_messages"] > 0
+            assert c["throughput"]["host_requests"] > 0
+            assert "PresenceGrain.heartbeat" in c["latency_ticks"]
+            ps = c["latency_ticks"]["PresenceGrain.heartbeat"]
+            assert ps["total"] > 0 and ps["p99"] >= ps["p50"] >= 0
+            assert len(view["silos"]) == 2
+            for row in view["silos"].values():
+                assert "breaker_states" in row and "queue_depth" in row
+            text = dashboard.render_text(view)
+            for silo in cluster.silos:
+                assert silo.name in text
+            assert "latency (device ticks" in text
+
+            # the piggyback: every silo's merged view includes peers
+            a = cluster.silos[0]
+            snap = a.snapshot()
+            assert "metrics" in snap and "cluster_metrics" in snap
+            own = sum(snap["metrics"]["counters"]
+                      .get("engine.messages_processed", {}).values())
+            merged = sum(snap["cluster_metrics"]["counters"]
+                         .get("engine.messages_processed", {}).values())
+            cluster_total = sum(
+                s.tensor_engine.messages_processed for s in cluster.silos)
+            assert merged == cluster_total
+            assert merged >= own
+            # the view is JSON-serializable (the CLI's one-shot output)
+            json.dumps(view)
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
+
+
+def test_dashboard_file_mode(tmp_path):
+    from orleans_tpu import dashboard
+
+    r1 = m.MetricsRegistry(source="silo1")
+    r2 = m.MetricsRegistry(source="silo2")
+    for reg, n in ((r1, 10), (r2, 20)):
+        reg.counter("engine.messages_processed").inc(n)
+        reg.counter("engine.ticks").inc(2)
+        h = reg.histogram("engine.latency_ticks", {"method": "T.m"},
+                          base=1.0, n_buckets=16)
+        h.observe(1, count=n)
+    p1, p2 = tmp_path / "s1.json", tmp_path / "s2.json"
+    p1.write_text(json.dumps(r1.snapshot()))
+    p2.write_text(json.dumps(r2.snapshot()))
+    assert dashboard.main(["--file", str(p1), str(p2)]) == 0
+    view = dashboard.view_from_snapshots(
+        [json.loads(p1.read_text()), json.loads(p2.read_text())])
+    assert view["cluster"]["throughput"]["engine_messages"] == 30
+    assert view["cluster"]["latency_ticks"]["T.m"]["total"] == 30
+
+
+def test_bench_ledger_operating_point():
+    """The bench's device-ledger latency measurement: percentiles in
+    ticks→seconds with no sync-floor anywhere in the path."""
+    from samples.presence import run_presence_ledger_point
+
+    async def go():
+        engine = _engine()
+        stats = await run_presence_ledger_point(
+            engine, n_players=2048, n_games=32, budget=0.05,
+            n_ticks=10, warm_ticks=3)
+        assert stats["p99_ticks"] > 0
+        assert stats["p99_s"] == pytest.approx(
+            stats["p99_ticks"] * stats["seconds_per_tick"], abs=1e-6)
+        assert "sync_floor" not in json.dumps(stats)
+        assert stats["by_method"]["PresenceGrain.heartbeat"]["messages"] \
+            == 2048 * 10
+
+    asyncio.run(go())
