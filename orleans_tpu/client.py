@@ -406,6 +406,10 @@ class TcpGatewayHandle:
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pump: Optional[asyncio.Task] = None
+        # set exactly when this handle stops being usable (pump exit on
+        # connection loss, or local disconnect) — the event-driven
+        # death signal: waiters need no alive-polling loop
+        self.closed: Optional[asyncio.Event] = None
         # control replies ("welcome"/"ok") resolve in arrival order
         self._control_waiters: "asyncio.Queue[asyncio.Future]" = None
         # vector batch_id → result future (out-of-order safe)
@@ -418,6 +422,7 @@ class TcpGatewayHandle:
                    control_timeout: float = 10.0) -> "TcpGatewayHandle":
         self = cls(host, port, client_id, on_message,
                    control_timeout=control_timeout)
+        self.closed = asyncio.Event()
         self._reader, self._writer = await asyncio.open_connection(host, port)
         self._control_waiters = asyncio.Queue()
         write_gateway_frame(self._writer, {"op": "hello",
@@ -460,6 +465,8 @@ class TcpGatewayHandle:
             if self._writer is not None:
                 self._writer.close()
                 self._writer = None  # alive -> False; pool skips us
+            if self.closed is not None:
+                self.closed.set()
             # fail in-flight control calls NOW instead of letting them
             # sit out their timeout against a dead socket
             while self._control_waiters is not None \
@@ -527,6 +534,8 @@ class TcpGatewayHandle:
                 self._pump.cancel()
             self._writer.close()
             self._writer = None
+            if self.closed is not None:
+                self.closed.set()
         else:
             write_gateway_frame(self._writer,
                                 {"op": "unregister", "grain_id": grain_id})

@@ -138,6 +138,44 @@ class MetricsConfig:
 
 
 @dataclass
+class ProfilerConfig:
+    """Device cost plane knobs (orleans_tpu/tensor/profiler.py tick-phase
+    profiler + compile-churn attribution, orleans_tpu/tensor/memledger.py
+    HBM ledger).  No single reference analog — the reference's
+    StageAnalysis (src/Orleans/Statistics/StageAnalysis.cs:81) generalized
+    to an always-on, cheap cost-attribution plane in the spirit of
+    Google-Wide Profiling.  Live-reloadable like TracingConfig
+    (silo.update_config re-pushes into the running engine)."""
+
+    enabled: bool = True
+    # log2 buckets of the per-phase host histograms (base 1us; bucket 0
+    # < 1us, bucket k = [2**(k-1), 2**k) us) — 24 covers ~4s phases
+    phase_buckets: int = 24
+    # triggered deep capture: when a tick's wall time breaches this
+    # threshold the NEXT capture_ticks ticks are captured with
+    # jax.profiler into capture_dir (trace referenced from the flight
+    # recorder).  0 disables the trigger; silo.capture_profile(ticks=N)
+    # captures explicitly regardless.
+    capture_threshold_s: float = 0.0
+    capture_ticks: int = 4
+    # wall-clock backstop on a capture: the tick countdown only runs
+    # while the engine ticks, so an idle engine (explicit capture on a
+    # quiet silo, or a burst ending mid-capture) must not leave the
+    # process-global jax trace open indefinitely
+    capture_max_seconds: float = 60.0
+    # jax.profiler trace root; "" = <system tmpdir>/orleans_tpu_profiles
+    capture_dir: str = ""
+    # captures per engine lifetime (triggered + explicit combined): a
+    # pathological threshold must not fill the disk
+    capture_limit: int = 8
+    # memory ledger → overload containment: below this device-HBM
+    # headroom ratio the ShedController floors its shed level (the
+    # memory analog of the watchdog stall floor)
+    memory_low_watermark: float = 0.1
+    memory_shed_level: float = 0.5
+
+
+@dataclass
 class RemindersConfig:
     """(reference: GlobalConfiguration reminder service section :84)"""
 
@@ -275,6 +313,7 @@ class SiloConfig:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
     reminders: RemindersConfig = field(default_factory=RemindersConfig)
     tensor: TensorEngineConfig = field(default_factory=TensorEngineConfig)
     extra: Dict[str, Any] = field(default_factory=dict)

@@ -75,6 +75,29 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
             ((n, _counter_total(merged, n))
              for n in merged.get("counters", {}) if n.startswith(
                  "dead_letter.")) if total}
+    # compile-churn attribution (tensor/profiler.py): cause-coded totals
+    # — "13 compiles" becomes "9 new_method + 4 bucket_growth"
+    compiles = {(lk.split("=", 1)[1] if "=" in lk else lk): int(v)
+                for lk, v in merged.get("counters", {})
+                .get("compile.events", {}).items()}
+    # tick-phase profiler: merged per-phase latency percentiles
+    phases: Dict[str, Any] = {}
+    for lk, hist in merged.get("histograms", {}) \
+                          .get("engine.phase_s", {}).items():
+        phase = lk.split("=", 1)[1] if "=" in lk else (lk or "all")
+        phases[phase] = {"seconds": round(hist.get("sum", 0.0), 4),
+                         **{k: round(v, 6) for k, v in
+                            histogram_percentiles(hist, (50, 99)).items()}}
+    # memory ledger: per-silo self-accounted bytes + headroom gauges
+    memory: Dict[str, Any] = {}
+    for lk, by_src in merged.get("gauges", {}) \
+                            .get("memory.self_bytes", {}).items():
+        for src, v in by_src.items():
+            memory.setdefault(src, {})["self_bytes"] = int(v)
+    for lk, by_src in merged.get("gauges", {}) \
+                            .get("memory.headroom", {}).items():
+        for src, v in by_src.items():
+            memory.setdefault(src, {})["headroom"] = round(v, 4)
     view = {
         "cluster": {
             "throughput": {
@@ -90,6 +113,9 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
             },
             "latency_ticks": latency,
             "host_turn_latency_s": host_latency,
+            "tick_phases": phases,
+            "compile_causes": compiles,
+            "memory": memory,
             "dead_letters": dead,
             "overload": {
                 "shed_count": int(
@@ -159,6 +185,26 @@ def render_text(view: Dict[str, Any]) -> str:
         ps = c["host_turn_latency_s"]
         lines.append(f"host turn latency: p50={ps['p50']}s "
                      f"p95={ps['p95']}s p99={ps['p99']}s")
+    if c.get("tick_phases"):
+        parts = []
+        total = sum(p["seconds"] for p in c["tick_phases"].values())
+        for phase in ("host", "h2d", "dispatch", "route", "d2h"):
+            p = c["tick_phases"].get(phase)
+            if p is not None and total > 0:
+                parts.append(f"{phase}={100 * p['seconds'] / total:.0f}%")
+        if parts:
+            lines.append("tick phases: " + " ".join(parts)
+                         + f" (of {total:.2f}s tick time)")
+    if c.get("compile_causes"):
+        lines.append("compiles: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(c["compile_causes"].items(),
+                                          key=lambda kv: -kv[1])))
+    if c.get("memory"):
+        lines.append("memory: " + "; ".join(
+            f"{src}: {row.get('self_bytes', 0) / 1e6:.1f}MB"
+            + (f" headroom={row['headroom']:.0%}"
+               if "headroom" in row else "")
+            for src, row in sorted(c["memory"].items())))
     if c["dead_letters"]:
         lines.append("dead letters: " + ", ".join(
             f"{k}={v}" for k, v in sorted(c["dead_letters"].items())))

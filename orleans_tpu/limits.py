@@ -158,6 +158,10 @@ class ShedController:
         self._stall_until = 0.0
         self._sampled_at = -1e9
         self._sampled_depth = 0
+        # device-memory pressure floor (fed by the memory ledger,
+        # tensor/memledger.py, via silo.collect_metrics)
+        self.memory_headroom: Optional[float] = None
+        self._memory_floor = 0.0
 
     # -- signals ------------------------------------------------------------
 
@@ -166,6 +170,18 @@ class ShedController:
         window — depth sampling was blind while the loop was wedged."""
         self.stall_count += 1
         self._stall_until = self.clock() + self.stall_window
+
+    def note_memory_headroom(self, headroom: Optional[float],
+                             low_watermark: float = 0.1,
+                             floor_level: float = 0.5) -> None:
+        """Device-HBM headroom from the memory ledger: below the low
+        watermark the shed level floors at ``floor_level`` — queue depth
+        alone cannot see a heap about to OOM the data plane.  ``None``
+        (backend exposes no memory_stats, e.g. CPU) is no-signal: the
+        floor clears rather than guessing."""
+        self.memory_headroom = headroom
+        self._memory_floor = floor_level \
+            if (headroom is not None and headroom < low_watermark) else 0.0
 
     def current_depth(self) -> int:
         now = self.clock()
@@ -189,7 +205,7 @@ class ShedController:
             lvl = min(1.0, max(0.0, lvl))
         if self.clock() < self._stall_until:
             lvl = max(lvl, self.stall_level)
-        return lvl
+        return max(lvl, self._memory_floor)
 
     @property
     def degraded(self) -> bool:
@@ -225,4 +241,6 @@ class ShedController:
                 "queue_soft": self.queue_soft, "queue_hard": self.queue_hard,
                 "shed_count": self.shed_count,
                 "admitted_count": self.admitted_count,
-                "stall_count": self.stall_count}
+                "stall_count": self.stall_count,
+                "memory_headroom": self.memory_headroom,
+                "memory_floor": self._memory_floor}

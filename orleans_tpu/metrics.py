@@ -150,6 +150,36 @@ declare("engine.latency_ticks", KIND_HISTOGRAM, "ticks",
         "latency ledger: inject-tick to completion-tick delta; "
         "label 'method' = Type.method)")
 
+# -- device cost plane (tensor/profiler.py + tensor/memledger.py) ------------
+declare("engine.phase_s", KIND_HISTOGRAM, "seconds",
+        "per-tick wall time of one pipeline phase (label 'phase' = "
+        "host | h2d | dispatch | route | d2h; the tick-phase profiler's "
+        "log2 histograms mirrored per phase)")
+declare("compile.events", KIND_COUNTER, "compiles",
+        "cause-coded compile/retrace events (label 'cause' = the "
+        "tensor/profiler.py churn taxonomy: new_method, bucket_growth, "
+        "shape_change, epoch_mismatch, generation_repack, config_toggle, "
+        "mesh_reshard, new_window)")
+declare("compile.lowering_s", KIND_COUNTER, "seconds",
+        "cumulative lowering/compile wall time across tracked retraces")
+declare("memory.self_bytes", KIND_GAUGE, "bytes",
+        "HBM accounted by the device memory ledger (arena columns, "
+        "mirrors, clocks, pending slabs, latency-ledger hist)")
+declare("memory.peak_bytes", KIND_GAUGE, "bytes",
+        "peak self-accounted HBM observed since engine start")
+declare("memory.owner_bytes", KIND_GAUGE, "bytes",
+        "self-accounted HBM of one owner group (label 'owner' = "
+        "arena.<type> | pending_batches | latency_ledger | "
+        "autofuse_chain)")
+declare("memory.device_bytes_in_use", KIND_GAUGE, "bytes",
+        "backend-reported bytes in use (device.memory_stats; absent on "
+        "backends without the query)")
+declare("memory.device_bytes_limit", KIND_GAUGE, "bytes",
+        "backend-reported HBM capacity (device.memory_stats)")
+declare("memory.headroom", KIND_GAUGE, "ratio",
+        "free HBM fraction (1 - in_use/limit); the ShedController "
+        "floors its shed level below the configured low watermark")
+
 # -- host control path (stats.SiloMetrics mirror) ----------------------------
 declare("host.requests_sent", KIND_COUNTER, "requests",
         "application requests sent on the host path")

@@ -1,0 +1,267 @@
+"""Perf regression gate: compare a bench artifact against a checked-in
+baseline with per-metric tolerance bands.
+
+``python -m orleans_tpu.perfgate`` loads ``PERF_BASELINE.json`` (the
+committed contract — one entry per guarded metric: the dotted path into
+the bench artifact, the baseline value, a fractional tolerance band and
+a direction) and the freshest ``BENCH_r*.json`` in the working
+directory, then renders a pass/fail verdict as one JSON line plus an
+optional markdown table.  Exit code 0 = pass, 1 = regression, 2 = no
+usable inputs.
+
+Why a gate and not a dashboard: BENCH rounds r01→r05 carried at least
+two silent regressions (a 20.5s collection stall, a 100x stream-plane
+shortfall) that were visible in the artifacts for multiple rounds before
+anyone compared numbers.  VERDICT r5 weak #8 names the pattern — "there
+is no trend guard, so a regression would be invisible behind the note".
+The gate makes round-over-round comparison a mechanical step
+(``bench.py --workload profile --smoke`` runs it and embeds the
+verdict in PROFILE_SMOKE.json).
+
+Tolerance discipline: bands are wide (30-60%) because the tunneled rig's
+run-to-run variance is real and measured — the gate exists to catch
+order-of-magnitude cliffs and steady drifts, not 5% noise.  Direction
+matters: an IMPROVEMENT never fails, in either direction's metric.
+
+Artifact shapes accepted: the bare ``bench.py`` JSON, or the driver
+wrapper ``{"parsed": {...}}`` (unwrapped automatically; a wrapper whose
+``parsed`` is null — the BENCH_r05 truncation — is reported as
+unusable rather than silently passing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+STATUS_PASS = "pass"
+STATUS_FAIL = "fail"
+STATUS_MISSING = "missing"
+
+DIRECTION_HIGHER = "higher"   # regression when current < base * (1 - tol)
+DIRECTION_LOWER = "lower"     # regression when current > base * (1 + tol)
+
+
+def resolve_path(obj: Any, path: str) -> Optional[float]:
+    """Walk a dotted path (``a.b.c``) through dicts; returns None when
+    any hop is absent or the leaf is not a number."""
+    cur = obj
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def unwrap_artifact(data: Any) -> Optional[Dict[str, Any]]:
+    """Accept a bare bench artifact or the driver wrapper; None when the
+    wrapper's parsed payload is null/absent (a truncated capture must
+    read as 'unusable', never as 'no regressions')."""
+    if not isinstance(data, dict):
+        return None
+    if "parsed" in data:
+        parsed = data["parsed"]
+        return parsed if isinstance(parsed, dict) else None
+    # a bare artifact has the bench's headline keys
+    return data if ("value" in data or "metric" in data) else None
+
+
+def evaluate_metric(name: str, spec: Dict[str, Any],
+                    artifact: Dict[str, Any]) -> Dict[str, Any]:
+    base = float(spec["value"])
+    tol = float(spec.get("tolerance", 0.3))
+    direction = spec.get("direction", DIRECTION_HIGHER)
+    current = resolve_path(artifact, spec["path"])
+    row: Dict[str, Any] = {
+        "name": name, "path": spec["path"], "baseline": base,
+        "current": current, "tolerance": tol, "direction": direction,
+    }
+    if current is None:
+        row["status"] = STATUS_MISSING
+        return row
+    row["ratio"] = round(current / base, 4) if base else None
+    if direction == DIRECTION_LOWER:
+        bound = base * (1.0 + tol)
+        row["bound"] = bound
+        row["status"] = STATUS_FAIL if current > bound else STATUS_PASS
+    else:
+        bound = base * (1.0 - tol)
+        row["bound"] = bound
+        row["status"] = STATUS_FAIL if current < bound else STATUS_PASS
+    return row
+
+
+def evaluate(baseline: Dict[str, Any], artifact: Dict[str, Any],
+             strict_missing: bool = False) -> Dict[str, Any]:
+    """The verdict: per-metric rows + an overall status.  Missing
+    metrics warn by default (auxiliary bench sections degrade to error
+    entries by design — see bench._guard); ``strict_missing`` promotes
+    them to failures for CI setups that want full coverage."""
+    rows = [evaluate_metric(name, spec, artifact)
+            for name, spec in baseline.get("metrics", {}).items()]
+    if not rows:
+        # a baseline that checks NOTHING must read as broken, never as
+        # "pass" — a silently-unguarding gate is the exact failure mode
+        # this module exists to prevent
+        return {"status": "error",
+                "error": "baseline declares no metrics (missing or "
+                         "empty 'metrics' mapping)",
+                "checked": 0, "passed": 0, "failed": 0, "missing": 0,
+                "baseline_source": baseline.get("source", ""),
+                "metrics": []}
+    failed = [r for r in rows if r["status"] == STATUS_FAIL]
+    missing = [r for r in rows if r["status"] == STATUS_MISSING]
+    ok = not failed and not (strict_missing and missing)
+    return {
+        "status": STATUS_PASS if ok else STATUS_FAIL,
+        "checked": len(rows),
+        "passed": len([r for r in rows if r["status"] == STATUS_PASS]),
+        "failed": len(failed),
+        "missing": len(missing),
+        "baseline_source": baseline.get("source", ""),
+        "metrics": rows,
+    }
+
+
+def render_markdown(verdict: Dict[str, Any],
+                    artifact_name: str = "") -> str:
+    """Human-facing verdict table (written next to the JSON)."""
+    icon = "✅ PASS" if verdict["status"] == STATUS_PASS else "❌ FAIL"
+    lines = [
+        f"# Perf gate: {icon}",
+        "",
+        f"Artifact: `{artifact_name or 'unknown'}` vs baseline "
+        f"`{verdict.get('baseline_source', '')}` — "
+        f"{verdict['passed']}/{verdict['checked']} within band, "
+        f"{verdict['failed']} failed, {verdict['missing']} missing.",
+        "",
+        "| metric | baseline | current | ratio | band | status |",
+        "|---|---|---|---|---|---|",
+    ]
+
+    def fmt(v: Optional[float]) -> str:
+        if v is None:
+            return "—"
+        if abs(v) >= 1000:
+            return f"{v:,.0f}"
+        return f"{v:.4g}"
+
+    for r in verdict["metrics"]:
+        band = (f"{'≤' if r['direction'] == DIRECTION_LOWER else '≥'} "
+                f"{fmt(r.get('bound'))}")
+        mark = {STATUS_PASS: "pass", STATUS_FAIL: "**FAIL**",
+                STATUS_MISSING: "missing"}[r["status"]]
+        lines.append(
+            f"| {r['name']} | {fmt(r['baseline'])} | {fmt(r['current'])} "
+            f"| {fmt(r.get('ratio'))} | {band} | {mark} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def newest_bench_artifact(directory: str = ".") -> Optional[Tuple[str, Dict]]:
+    """The freshest usable BENCH_r*.json by round number (unparseable
+    rounds — e.g. the truncated r05 — are skipped with a note to
+    stderr, not silently treated as regression-free)."""
+    rounds: List[Tuple[int, str]] = []
+    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            rounds.append((int(m.group(1)), path))
+    for _, path in sorted(rounds, reverse=True):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        artifact = unwrap_artifact(data)
+        if artifact is not None:
+            return path, artifact
+        print(f"perfgate: skipping {path}: no parseable payload",
+              file=sys.stderr)
+    return None
+
+
+def run_gate(baseline_path: str, artifact: Optional[Dict[str, Any]] = None,
+             artifact_name: str = "",
+             strict_missing: bool = False) -> Dict[str, Any]:
+    """Library entry point (bench.py embeds this in the profile tier)."""
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    if artifact is None:
+        found = newest_bench_artifact(os.path.dirname(baseline_path) or ".")
+        if found is None:
+            return {"status": "error",
+                    "error": "no usable BENCH_r*.json artifact found"}
+        artifact_name, artifact = found[0], found[1]
+    verdict = evaluate(baseline, artifact, strict_missing=strict_missing)
+    verdict["artifact"] = artifact_name
+    return verdict
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m orleans_tpu.perfgate",
+        description="compare a bench artifact against PERF_BASELINE.json "
+                    "with per-metric tolerance bands")
+    parser.add_argument("--baseline", default="PERF_BASELINE.json")
+    parser.add_argument("--artifact", default=None,
+                        help="bench artifact JSON (default: the freshest "
+                             "usable BENCH_r*.json beside the baseline)")
+    parser.add_argument("--markdown", default=None, metavar="PATH",
+                        help="also write the verdict as a markdown table")
+    parser.add_argument("--strict-missing", action="store_true",
+                        help="treat metrics absent from the artifact as "
+                             "failures instead of warnings")
+    args = parser.parse_args(argv)
+
+    if not os.path.exists(args.baseline):
+        print(json.dumps({"status": "error",
+                          "error": f"baseline {args.baseline} not found"}))
+        return 2
+    artifact = None
+    artifact_name = ""
+    if args.artifact:
+        try:
+            with open(args.artifact) as f:
+                artifact = unwrap_artifact(json.load(f))
+        except (OSError, json.JSONDecodeError) as exc:
+            print(json.dumps({"status": "error",
+                              "error": f"artifact: {exc}"}))
+            return 2
+        if artifact is None:
+            print(json.dumps({"status": "error",
+                              "error": f"artifact {args.artifact} has no "
+                                       "parseable bench payload"}))
+            return 2
+        artifact_name = args.artifact
+
+    try:
+        verdict = run_gate(args.baseline, artifact, artifact_name,
+                           strict_missing=args.strict_missing)
+    except (json.JSONDecodeError, KeyError, TypeError, ValueError) as exc:
+        # a malformed baseline is a usage error (exit 2 + JSON), never a
+        # raw traceback — the documented CLI contract
+        print(json.dumps({"status": "error",
+                          "error": f"baseline: {type(exc).__name__}: "
+                                   f"{exc}"}))
+        return 2
+    if verdict.get("status") == "error":
+        print(json.dumps(verdict))
+        return 2
+    md = render_markdown(verdict, verdict.get("artifact", artifact_name))
+    if args.markdown:
+        with open(args.markdown, "w") as f:
+            f.write(md + "\n")
+    print(json.dumps(verdict))
+    return 0 if verdict["status"] == STATUS_PASS else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,6 +107,14 @@ class SiloControl:
             return {}
         return {"silo": str(self.silo.address), **engine.snapshot()}
 
+    async def capture_profile(self, ticks: int = 8) -> dict:
+        """Start a jax.profiler deep capture over the next ``ticks``
+        engine ticks (tensor/profiler.py); returns the capture event
+        record with the trace directory path.  The same record rides
+        the flight-recorder dump, so an operator-triggered capture and
+        a threshold-triggered one leave identical evidence."""
+        return self.silo.capture_profile(ticks, reason="silo_control")
+
     async def get_detailed_grain_report(self, grain_id: GrainId
                                         ) -> DetailedGrainReport:
         """(reference: GetDetailedGrainReport :120)"""
@@ -158,6 +166,7 @@ class IManagementGrain:
     async def force_tensor_collection(self, idle_ticks: int = 0) -> int: ...
     async def get_runtime_statistics(self) -> list: ...
     async def get_tensor_statistics(self) -> list: ...
+    async def capture_profile(self, ticks: int = 8) -> list: ...
     async def lookup(self, grain_id: GrainId) -> Optional[str]: ...
     async def unregister(self, grain_id: GrainId) -> bool: ...
 
@@ -220,6 +229,12 @@ class ManagementGrain(Grain, IManagementGrain):
     async def get_tensor_statistics(self) -> list:
         """Per-silo tick-engine counters, empty dicts filtered."""
         return [s for s in await self._fanout("get_tensor_statistics") if s]
+
+    async def capture_profile(self, ticks: int = 8) -> list:
+        """Cluster-wide deep capture: every silo starts a jax.profiler
+        trace over its next ``ticks`` ticks; returns the per-silo
+        capture records (error entries filtered by _fanout)."""
+        return await self._fanout("capture_profile", ticks)
 
     async def lookup(self, grain_id: GrainId) -> Optional[str]:
         return await self._silo.system_rpc(

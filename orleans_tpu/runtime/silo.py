@@ -289,7 +289,8 @@ class Silo:
         if self.config.tensor.enabled:
             from orleans_tpu.tensor.engine import TensorEngine
             self.tensor_engine = TensorEngine(self, self.config.tensor,
-                                              metrics=self.config.metrics)
+                                              metrics=self.config.metrics,
+                                              profiler=self.config.profiler)
         else:
             self.tensor_engine = None
         # cross-silo vector data plane: clustered silos partition vector
@@ -539,6 +540,10 @@ class Silo:
             self.tensor_engine.ledger.configure(
                 enabled=mc.enabled and mc.ledger_enabled,
                 n_buckets=mc.ledger_buckets)
+            # device cost plane: the profiler reads the SAME ProfilerConfig
+            # dataclass object update_config just mutated — configure()
+            # only refreshes derived state (bucket-array layout)
+            self.tensor_engine.profiler.configure()
         # collection knobs: the engine reads pause budget/chunk/cadence
         # off the live dataclass every tick, but each arena copied the
         # compaction threshold at creation — re-push it
@@ -654,11 +659,25 @@ class Silo:
         degraded snapshots trigger it; callable any time."""
         slices = list(self.tensor_engine.collector.last_slices) \
             if self.tensor_engine is not None else None
+        captures = list(self.tensor_engine.profiler.capture_events) \
+            if self.tensor_engine is not None else None
         return self.spans.flight.dump(
             reason=reason,
             dead_letters=list(self.dead_letters.entries),
             breaker_transitions=list(self.spans.breaker_transitions),
-            collection_slices=slices)
+            collection_slices=slices,
+            profile_captures=captures)
+
+    def capture_profile(self, ticks: int = 8,
+                        reason: str = "management") -> Dict[str, Any]:
+        """Explicit deep-capture entry point (the management surface —
+        SiloControl.capture_profile fans in here): start a jax.profiler
+        trace over the next ``ticks`` engine ticks.  Returns the capture
+        event record (trace directory path, or ``error``); the same
+        record rides every subsequent flight-recorder dump."""
+        if self.tensor_engine is None:
+            return {"error": "no tensor engine on this silo"}
+        return self.tensor_engine.profiler.capture(ticks, reason=reason)
 
     def collect_metrics(self, mirror: bool = False,
                         force_ledger: bool = False) -> Dict[str, Any]:
@@ -735,6 +754,51 @@ class Silo:
                   "ticks": eng.ticks_run,
                   "compiles": eng.compile_count(),
                   "tick_seconds": eng.tick_seconds}, None, "engine.")
+            # compile-churn attribution: cause-coded counters replace
+            # the bare compiles int as the actionable churn signal
+            ct = eng.compile_tracker
+            for cause, n in ct.by_cause.items():
+                if n:
+                    reg.counter("compile.events",
+                                {"cause": cause}).set_total(n)
+            reg.counter("compile.lowering_s").set_total(
+                ct.lowering_seconds)
+            # tick-phase profiler: mirror the cumulative per-phase log2
+            # histograms (same set_counts discipline as the ledger)
+            prof = eng.profiler
+            if prof.enabled and prof.ticks_observed:
+                for phase, counts in prof.phase_counts.items():
+                    reg.histogram("engine.phase_s", {"phase": phase},
+                                  base=prof.hist_base,
+                                  n_buckets=len(counts)
+                                  ).set_counts(counts,
+                                               prof.phase_seconds[phase])
+            # memory ledger: HBM by owner + headroom; the headroom gauge
+            # also feeds the shed controller's memory floor
+            mem = eng.memledger.snapshot()
+            reg.gauge("memory.self_bytes").set(mem["total_self_bytes"])
+            reg.gauge("memory.peak_bytes").set(mem["peak_self_bytes"])
+            groups: Dict[str, float] = {}
+            for owner, nbytes in mem["owners"].items():
+                group = ".".join(owner.split(".")[:2]) \
+                    if owner.startswith("arena.") else owner
+                groups[group] = groups.get(group, 0.0) + nbytes
+            for group, nbytes in groups.items():
+                reg.gauge("memory.owner_bytes", {"owner": group}).set(nbytes)
+            dev_mem = mem["device"]
+            if dev_mem is not None:
+                if "bytes_in_use" in dev_mem:
+                    reg.gauge("memory.device_bytes_in_use").set(
+                        dev_mem["bytes_in_use"])
+                if "bytes_limit" in dev_mem:
+                    reg.gauge("memory.device_bytes_limit").set(
+                        dev_mem["bytes_limit"])
+            pc = self.config.profiler
+            self.shed_controller.note_memory_headroom(
+                mem["headroom"], low_watermark=pc.memory_low_watermark,
+                floor_level=pc.memory_shed_level)
+            if mem["headroom"] is not None:
+                reg.gauge("memory.headroom").set(mem["headroom"])
             # the on-device latency ledger: the bucket-count fetch is
             # ONE small d2h transfer, gated by the publish cadence so a
             # hot snapshot() loop cannot turn it into per-tick traffic

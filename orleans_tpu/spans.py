@@ -192,7 +192,8 @@ class FlightRecorder:
     def dump(self, reason: str = "",
              dead_letters: Optional[Iterable[Dict[str, Any]]] = None,
              breaker_transitions: Optional[Iterable[Dict[str, Any]]] = None,
-             collection_slices: Optional[Iterable[Dict[str, Any]]] = None
+             collection_slices: Optional[Iterable[Dict[str, Any]]] = None,
+             profile_captures: Optional[Iterable[Dict[str, Any]]] = None
              ) -> Dict[str, Any]:
         """The correlated evidence bundle: spans grouped by trace, each
         trace joined with its dead letters; tick spans and unattributable
@@ -226,6 +227,10 @@ class FlightRecorder:
             # recent incremental-collection slices (engine.collect):
             # a crash mid-sweep names what the collector was doing
             "collection_slices": list(collection_slices or [])[-32:],
+            # jax.profiler deep captures (tensor/profiler.py): a latency
+            # incident that breached the capture threshold ships with
+            # the trace-directory reference to its own profile
+            "profile_captures": list(profile_captures or [])[-8:],
         }
 
 
@@ -410,21 +415,35 @@ class SpanRecorder:
     def tick_span(self, tick: int, start: float, duration: float,
                   messages: int, rounds: int,
                   per_method: Dict[str, int], compiles: int,
-                  traces: List[Dict[str, Any]]) -> Span:
+                  traces: List[Dict[str, Any]],
+                  phases: Optional[Dict[str, float]] = None,
+                  compile_events: Optional[List[Dict[str, Any]]] = None
+                  ) -> Span:
         """ONE span for one engine tick (never per-message — the TPU-first
         batching discipline), plus a link event into every distinct
         SAMPLED trace the tick executed (``traces`` carries sampled
         contexts only — the engine filters at enqueue) so a request's
         critical path names its tick (and that tick's compile events /
-        batch size)."""
+        batch size).  ``phases`` carries the tick-phase profiler's
+        host/h2d/dispatch/route/d2h breakdown; ``compile_events`` the
+        cause-coded compiles this tick paid (tensor/profiler.py) — a
+        slow tick in the flight recorder names its slow phase and its
+        compile cause without a reproduction run."""
         self.started += 1
+        attrs = {"tick": tick, "messages": messages, "rounds": rounds,
+                 "per_method": dict(per_method), "compiles": compiles,
+                 "linked_traces": 0}
+        if phases:
+            attrs["phases"] = {p: round(v, 6) for p, v in phases.items()}
+        if compile_events:
+            attrs["compile_events"] = [
+                {"cause": e["cause"], "key": e["key"],
+                 "seconds": e["seconds"]} for e in compile_events]
         span = Span(
             trace_id="", span_id=new_id(), parent_id=None,
             name=f"tick {tick}", kind="engine.tick", silo=self.name,
             sampled=True, start=start, duration=duration,
-            attrs={"tick": tick, "messages": messages, "rounds": rounds,
-                   "per_method": dict(per_method), "compiles": compiles,
-                   "linked_traces": 0})
+            attrs=attrs)
         seen: set = set()
         for t in traces:
             tid = t.get("trace_id")

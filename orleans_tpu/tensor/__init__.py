@@ -29,6 +29,12 @@ from orleans_tpu.tensor.vector_grain import (
 from orleans_tpu.tensor.engine import TensorEngine
 from orleans_tpu.tensor.fanout import DeviceFanout, FanoutOverflowError
 from orleans_tpu.tensor.fused import FusedTickProgram
+from orleans_tpu.tensor.memledger import DeviceMemoryLedger
+from orleans_tpu.tensor.profiler import (
+    COMPILE_CAUSES,
+    CompileTracker,
+    TickPhaseProfiler,
+)
 from orleans_tpu.tensor.persistence import (
     FileVectorStore,
     MemoryVectorStore,
@@ -54,4 +60,8 @@ __all__ = [
     "DeviceFanout",
     "FanoutOverflowError",
     "FusedTickProgram",
+    "DeviceMemoryLedger",
+    "TickPhaseProfiler",
+    "CompileTracker",
+    "COMPILE_CAUSES",
 ]

@@ -330,6 +330,7 @@ class AutoFuser:
             # execution, and run() then calls the compiled executable
             # directly (window shape and arg structure are fixed for the
             # engagement's lifetime).
+            t_compile = time.perf_counter()
             wrapped = prog._build(
                 [dict(e[2]) for e in entries] if prog._is_multi()
                 else dict(entries[0][2]))
@@ -350,6 +351,17 @@ class AutoFuser:
                 states, statics0, stacked0,
                 jnp.zeros(2, jnp.int32),
                 self.engine.ledger.device_hist_in()).compile()
+            prog._reshard_count = self.engine.reshard_count
+            # churn attribution: the engagement's AOT lower+compile is
+            # the one fused site where the FULL lowering wall time is
+            # visible (jit-path builds defer compile to first call)
+            from orleans_tpu.tensor.profiler import CAUSE_NEW_WINDOW
+            self.engine.compile_tracker.record(
+                CAUSE_NEW_WINDOW,
+                key="autofuse:" + "+".join(
+                    f"{k[0]}.{k[1]}" for k, _b, _a, _p in entries),
+                seconds=time.perf_counter() - t_compile,
+                tick=self.engine.tick_number)
         self._program = prog
         return True
 

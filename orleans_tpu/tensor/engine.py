@@ -42,11 +42,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from orleans_tpu.config import MetricsConfig, TensorEngineConfig
+from orleans_tpu.config import (
+    MetricsConfig,
+    ProfilerConfig,
+    TensorEngineConfig,
+)
 from orleans_tpu.core.grain import MethodInfo
 from orleans_tpu.ids import GrainId
 from orleans_tpu.tensor.arena import GrainArena
 from orleans_tpu.tensor.ledger import DeviceLatencyLedger
+from orleans_tpu.tensor.memledger import DeviceMemoryLedger
+from orleans_tpu.tensor.profiler import (
+    CAUSE_BUCKET_GROWTH,
+    CAUSE_GENERATION_REPACK,
+    CAUSE_MESH_RESHARD,
+    CAUSE_NEW_METHOD,
+    CAUSE_SHAPE_CHANGE,
+    CompileTracker,
+    TickPhaseProfiler,
+)
 from orleans_tpu.tensor.vector_grain import (
     KEY_SENTINEL,
     Batch,
@@ -435,7 +449,8 @@ class TensorEngine:
                  mesh: Optional[jax.sharding.Mesh] = None,
                  initial_capacity: int = 1024,
                  store: Optional[Any] = None,
-                 metrics: Optional[MetricsConfig] = None) -> None:
+                 metrics: Optional[MetricsConfig] = None,
+                 profiler: Optional[ProfilerConfig] = None) -> None:
         self.silo = silo
         self.config = config or TensorEngineConfig()
         # on-device latency ledger (tensor/ledger.py): per-(type, method)
@@ -446,6 +461,12 @@ class TensorEngine:
             n_buckets=self.metrics_config.ledger_buckets,
             enabled=(self.metrics_config.enabled
                      and self.metrics_config.ledger_enabled))
+        # the device cost plane (tensor/profiler.py + memledger.py):
+        # tick-phase attribution + triggered deep capture, cause-coded
+        # compile accounting, and HBM-by-owner accounting
+        self.profiler = TickPhaseProfiler(self, profiler)
+        self.compile_tracker = CompileTracker()
+        self.memledger = DeviceMemoryLedger(self)
         self.mesh = mesh
         self.initial_capacity = initial_capacity
         # VectorStore backing every arena (tensor/persistence.py):
@@ -481,6 +502,14 @@ class TensorEngine:
         self._in_tick = False
 
         self._step_cache: Dict[Tuple[str, str, int], Callable] = {}
+        # compile-churn attribution (tensor/profiler.py): step-call input
+        # signatures already paid for ((type, method, is_host, m)); the
+        # first call of a new signature is timed and cause-coded.  A
+        # reshard drops the compiled steps — signatures it forgot are
+        # re-attributed to the reshard, not to "new" traffic.
+        self._seen_steps: set = set()
+        self._reshard_forgotten: set = set()
+        self.reshard_count = 0
         self._pending_checks: List[_MissCheck] = []
         # batches parked by the handoff fence during a tick's rounds;
         # re-queued at tick end so they retry next tick, not next round
@@ -591,6 +620,13 @@ class TensorEngine:
         # sharded array shapes changed: compiled steps specialize on shard
         # layout, so drop them and let jit re-trace on next use
         self._step_cache.clear()
+        # churn attribution: recompiles of signatures the reshard forgot
+        # are caused by the reshard, not by new traffic shapes (keyed
+        # WITHOUT capacity — the reshard itself changes it)
+        self.reshard_count += 1
+        self._reshard_forgotten = {(s[0], s[1], s[2])
+                                   for s in self._seen_steps}
+        self._seen_steps = set()
         # the ledger hist may be committed to the OLD device set (fused
         # windows return it as a program output) — fold counts to host
         # and let the next record recreate it on the new set
@@ -861,6 +897,8 @@ class TensorEngine:
         if self._task is not None:
             await self._task
             self._task = None
+        # never leave a triggered jax.profiler capture session dangling
+        self.profiler.shutdown()
 
     def _wake_up(self) -> None:
         if self._wake is not None:
@@ -1016,6 +1054,13 @@ class TensorEngine:
         self.last_tick_stages = dict(stages)
         self.tick_seconds += dt
         self.tick_durations.append(dt)
+        # tick-phase profiler (tensor/profiler.py): fold the stage
+        # timers into the five canonical phases + trigger deep capture
+        # on a wall-time breach; compile events recorded this tick ride
+        # the batched span so a slow tick names its compile
+        phases = self.profiler.observe_tick(dt, stages) \
+            if self.profiler.enabled else None
+        compile_events = self.compile_tracker.drain_tick_events()
         if rec is not None:
             # ONE batched span per tick (batch size, per-type counts,
             # compile events) + link events into the sampled traces it
@@ -1025,8 +1070,12 @@ class TensorEngine:
                 messages=self.messages_processed - span_msgs0,
                 rounds=rounds, per_method=dict(self._tick_counts),
                 compiles=self.compile_count() - span_compiles0,
-                traces=self._tick_traces)
+                traces=self._tick_traces, phases=phases,
+                compile_events=compile_events)
             self._tick_traces = []
+        # unconditionally: an active capture must count down (and stop)
+        # even if the profiler was live-disabled mid-capture
+        self.profiler.tick_done()
         self._adapt(dt)
 
     def tick_interval(self) -> float:
@@ -1491,7 +1540,31 @@ class TensorEngine:
         if mask is None:
             mask = _mask_for(rows.shape[0] if hasattr(rows, "shape")
                              else len(rows))
-        new_state, results, emits = step(arena.state, rows, args, mask)
+        # host rows are already bucket-padded here, so len(rows) is the
+        # COMPILED shape (the padding rung), not the logical batch size.
+        # The arena capacity is part of the signature because the state
+        # columns' shapes are the capacity — a grow retraces EVERY batch
+        # shape and must be attributed, not silently skipped.  Host vs
+        # device is deliberately NOT in the key: jit caches on avals, so
+        # an np batch and a device batch of the same shape share one
+        # compile (a host/device split would record phantom events).
+        sig = (info.name, method, int(len(rows)), arena.capacity)
+        if sig in self._seen_steps:
+            new_state, results, emits = step(arena.state, rows, args, mask)
+        else:
+            # first call of this input signature: jax traces + lowers +
+            # compiles synchronously inside the call, so its wall time
+            # IS the lowering cost — record it cause-coded
+            # (tensor/profiler.py churn taxonomy)
+            cause = self._infer_step_cause(
+                info.name, method, sig, isinstance(rows, np.ndarray))
+            t_compile = time.perf_counter()
+            new_state, results, emits = step(arena.state, rows, args, mask)
+            self.compile_tracker.record(
+                cause, key=f"{info.name}.{method}[{sig[2]}]",
+                seconds=time.perf_counter() - t_compile,
+                tick=self.tick_number)
+            self._seen_steps.add(sig)
         arena.state = new_state
         if not isinstance(rows, np.ndarray):
             # device-routed batches (injector fast path, emit hits) never
@@ -1546,6 +1619,31 @@ class TensorEngine:
 
     # ================= compilation ========================================
 
+    def _infer_step_cause(self, type_name: str, method: str,
+                          sig: Tuple, is_host: bool) -> str:
+        """Name the cause of a first-seen step-call signature (the churn
+        taxonomy in tensor/profiler.py): a (type, method, m) the last
+        reshard forgot recompiles BECAUSE of the reshard; a batch shape
+        already seen under a DIFFERENT arena capacity recompiles because
+        the arena grew/repacked (state column shapes ARE the capacity);
+        a never-seen (type, method) is genuinely new; a host batch above
+        every rung seen for its method grew the padding bucket; anything
+        else is a new batch shape."""
+        _t, _m, m, _cap = sig
+        if (type_name, method, m) in self._reshard_forgotten:
+            self._reshard_forgotten.discard((type_name, method, m))
+            return CAUSE_MESH_RESHARD
+        seen_method = [s for s in self._seen_steps
+                       if s[0] == type_name and s[1] == method]
+        if not seen_method:
+            return CAUSE_NEW_METHOD
+        if any(s[2] == m for s in seen_method):
+            # same batch shape, different capacity: the arena repacked
+            return CAUSE_GENERATION_REPACK
+        if is_host and m > max(s[2] for s in seen_method):
+            return CAUSE_BUCKET_GROWTH
+        return CAUSE_SHAPE_CHANGE
+
     def _bucket_for(self, m: int) -> int:
         for b in self.config.bucket_sizes:
             if m <= b:
@@ -1565,8 +1663,11 @@ class TensorEngine:
 
         def step_fn(state, rows, args, mask):
             n_rows = next(iter(state.values())).shape[0]
-            out = handler(state, Batch(rows=rows, args=args, mask=mask),
-                          n_rows)
+            # named_scope labels the HLO for jax.profiler deep captures
+            # (tensor/profiler.py) — trace-time only, zero runtime cost
+            with jax.named_scope(f"orleans.dispatch.{info.name}.{method}"):
+                out = handler(state, Batch(rows=rows, args=args, mask=mask),
+                              n_rows)
             # normalize handler returns: state | (state,) | (state, results)
             # | (state, results, emits)
             if isinstance(out, dict):
@@ -1623,6 +1724,12 @@ class TensorEngine:
             # counts come from engine.ledger.snapshot(), which pays the
             # ONE d2h fetch explicitly)
             "latency_ledger": self.ledger.stats(),
+            # the device cost plane: tick-phase breakdown, cause-coded
+            # compile churn (the attributed replacement for the bare
+            # "compiles" int above), HBM by owner + headroom
+            "phases": self.profiler.snapshot(),
+            "compile_attribution": self.compile_tracker.snapshot(),
+            "memory": self.memledger.snapshot(),
         }
 
 

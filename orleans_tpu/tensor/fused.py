@@ -30,12 +30,20 @@ Steady-state contract (checked, not assumed):
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from orleans_tpu.tensor.profiler import (
+    CAUSE_CONFIG_TOGGLE,
+    CAUSE_EPOCH_MISMATCH,
+    CAUSE_GENERATION_REPACK,
+    CAUSE_MESH_RESHARD,
+    CAUSE_NEW_WINDOW,
+)
 from orleans_tpu.tensor.vector_grain import (
     KEY_SENTINEL,
     Batch,
@@ -137,6 +145,10 @@ class FusedTickProgram:
         # are ruinously slow on tunneled runtimes.  Manual fused drivers
         # keep donation (no rollback path; verify() asserts instead).
         self.donate = True
+        # compile-churn attribution: engine.reshard bumps this counter,
+        # so a post-reshard re-trace names the reshard as its cause
+        # instead of the generation bump it also produced
+        self._reshard_count = self.engine.reshard_count
 
     # -- legacy single-source aliases (manual drivers, tests) ---------------
 
@@ -190,9 +202,12 @@ class FusedTickProgram:
             states[type_name] = self.engine.arena_for(type_name).state
             self._note_arena(type_name, self.engine.arena_for(type_name))
         n_rows = next(iter(states[type_name].values())).shape[0]
-        state2, _results, emits = _normalize(
-            handler(states[type_name],
-                    Batch(rows=rows, args=args, mask=mask), n_rows))
+        # named_scope labels the window HLO for jax.profiler deep
+        # captures (tensor/profiler.py) — trace-time only
+        with jax.named_scope(f"orleans.fused.{type_name}.{method}"):
+            state2, _results, emits = _normalize(
+                handler(states[type_name],
+                        Batch(rows=rows, args=args, mask=mask), n_rows))
         states = {**states, type_name: state2}
         if self._ledger_on:
             # in-window latency ledger: the applied lanes accumulate at
@@ -385,21 +400,40 @@ class FusedTickProgram:
         engine = self.engine
         stackeds, statics = self._as_lists(stacked_args, static_args)
         from orleans_tpu.tensor.ledger import MAX_SLOTS
-        if self._compiled is None or any(
-                engine.arena_for(n).generation != g
-                for n, g in self._generations.items()) or any(
-                engine.arena_for(n).eviction_epoch != e
-                for n, e in self._epochs.items()) or \
-                self._hist_shape != (MAX_SLOTS, engine.ledger.n_buckets) \
+        # cause-coded re-trace decision (tensor/profiler.py churn
+        # taxonomy): the FIRST matching condition names the cause —
+        # reshard outranks the generation bump it also produced
+        cause = None
+        if self._compiled is None:
+            cause = CAUSE_NEW_WINDOW
+        elif self._reshard_count != engine.reshard_count:
+            cause = CAUSE_MESH_RESHARD
+        elif any(engine.arena_for(n).generation != g
+                 for n, g in self._generations.items()):
+            cause = CAUSE_GENERATION_REPACK
+        elif any(engine.arena_for(n).eviction_epoch != e
+                 for n, e in self._epochs.items()):
+            cause = CAUSE_EPOCH_MISMATCH
+        elif self._hist_shape != (MAX_SLOTS, engine.ledger.n_buckets) \
                 or self._ledger_on != engine.ledger.enabled:
+            cause = CAUSE_CONFIG_TOGGLE
+        if cause is not None:
             for s in self.sources:
                 s.rows = jnp.asarray(s.arena.resolve_rows(s.keys))
             examples = [
                 {**statics[i], **jax.tree_util.tree_map(lambda a: a[0],
                                                         stackeds[i])}
                 for i in range(len(self.sources))]
+            t_build = time.perf_counter()
             self._compiled = self._build(
                 examples if self._is_multi() else examples[0])
+            self._reshard_count = engine.reshard_count
+            engine.compile_tracker.record(
+                cause,
+                key="fused:" + "+".join(f"{s.type_name}.{s.method}"
+                                        for s in self.sources),
+                seconds=time.perf_counter() - t_build,
+                tick=engine.tick_number)
 
     def run(self, stacked_args: Any, static_args: Any = None) -> None:
         """Execute T fused ticks.

@@ -1,0 +1,237 @@
+"""DeviceMemoryLedger: byte-accurate HBM accounting by owner.
+
+The arena IS the heap of this runtime — state columns, directory
+mirrors, use clocks, pending-batch slabs and the latency-ledger
+histogram are the device allocations a silo makes — yet until now the
+only memory number anywhere was whatever ``device.memory_stats()``
+happened to say, with no attribution.  This ledger walks the engine's
+own references and accounts every byte to an owner:
+
+* ``arena.<type>.state`` — state columns (per-field detail in the
+  ``arenas`` section, since "which FIELD is fat" is the actionable
+  number when a grain type outgrows its budget);
+* ``arena.<type>.clocks`` — the device use clock;
+* ``arena.<type>.mirror`` — device directory mirrors (sorted / dense /
+  wide), the replicated routing state;
+* ``pending_batches`` — device-resident leaves of queued
+  ``PendingBatch``es (emit slabs awaiting their tick);
+* ``latency_ledger`` — the PR 6 on-device histogram;
+* ``autofuse_chain`` — pre-run state buffers pinned by the auto-fuser's
+  rollback snapshot (counted only while they differ from the live
+  columns — before the first window runs they alias the live state).
+
+Free-list slack (bytes of column storage attributable to freed rows) and
+fragmentation ride the per-arena detail: slack is *reusable* capacity,
+not an extra allocation, so it overlays the state bytes rather than
+adding to the total.
+
+Where the backend exposes ``device.memory_stats()`` (TPU), the snapshot
+reconciles self-accounting against ``bytes_in_use`` and derives a
+**headroom** ratio the ShedController consumes (memory pressure floors
+the shed level, the same discipline as the watchdog stall floor).  On
+backends that return ``None`` (CPU) the ledger degrades to pure
+self-accounting — no warnings, headroom unknown (tests pin this under
+``JAX_PLATFORMS=cpu``).
+
+Everything is host-side attribute walking over buffers the engine
+already holds: no device work, no transfers, no allocation beyond the
+snapshot dict.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _dev_bytes(x: Any) -> int:
+    """Bytes of a device-resident array (0 for host/np/scalars)."""
+    import jax
+    if isinstance(x, jax.Array):
+        return int(x.nbytes)
+    return 0
+
+
+def _host_bytes(x: Any) -> int:
+    return int(x.nbytes) if isinstance(x, np.ndarray) else 0
+
+
+class DeviceMemoryLedger:
+    """Per-engine HBM accounting (see module docstring)."""
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        self.peak_bytes = 0          # peak self-accounted total observed
+        self.snapshots_taken = 0
+
+    # -- device stats (guarded: CPU backends return None) --------------------
+
+    def _devices(self) -> List[Any]:
+        eng = self.engine
+        if eng.mesh is not None:
+            return list(eng.mesh.devices.flat)
+        try:
+            import jax
+            return [jax.devices()[0]]
+        except Exception:  # noqa: BLE001 — no backend, no stats
+            return []
+
+    def device_stats(self) -> Optional[Dict[str, int]]:
+        """Aggregated ``memory_stats()`` over the engine's devices, or
+        None when the backend exposes nothing (CPU) — the degrade path
+        is silent by contract (no warnings; self-accounting stands)."""
+        per_dev = []
+        for d in self._devices():
+            fn = getattr(d, "memory_stats", None)
+            if fn is None:
+                continue
+            try:
+                s = fn()
+            except Exception:  # noqa: BLE001 — a backend without the
+                s = None       # query must not break the snapshot
+            if s:
+                per_dev.append(s)
+        if not per_dev:
+            return None
+        out: Dict[str, int] = {"devices": len(per_dev)}
+        for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use",
+                    "bytes_reserved"):
+            vals = [s[key] for s in per_dev if key in s]
+            if vals:
+                out[key] = int(sum(vals))
+        return out
+
+    # -- self accounting -----------------------------------------------------
+
+    @staticmethod
+    def _row_bytes(arena) -> int:
+        """Bytes one arena row occupies across its state columns."""
+        total = 0
+        for f in arena.info.state_fields.values():
+            n = 1
+            for d in f.shape:
+                n *= d
+            total += n * np.dtype(f.dtype).itemsize
+        return total
+
+    def _arena_detail(self, name: str, arena) -> Dict[str, Any]:
+        fields = {fname: _dev_bytes(col)
+                  for fname, col in arena.state.items()}
+        mirror = sum(_dev_bytes(m) for m in (
+            arena._dev_sorted_keys, arena._dev_sorted_rows,
+            arena._dev_dense))
+        if arena._dev_wide is not None:
+            mirror += sum(_dev_bytes(p) for p in arena._dev_wide)
+        free_rows = sum(len(f) for f in arena._free)
+        return {
+            "capacity": arena.capacity,
+            "live_rows": arena.live_count,
+            "state_bytes": sum(fields.values()),
+            "fields": fields,
+            "clock_bytes": _dev_bytes(arena.last_use_dev),
+            "mirror_bytes": mirror,
+            "free_rows": free_rows,
+            # slack: column bytes currently attributable to freed rows —
+            # reusable in place, an overlay of state_bytes (not added to
+            # the owner totals)
+            "slack_bytes": free_rows * self._row_bytes(arena),
+            "fragmentation": round(arena.fragmentation(), 4),
+        }
+
+    def _pending(self) -> Dict[str, int]:
+        import jax
+        dev = host = batches = 0
+        for queue in self.engine.queues.values():
+            for b in queue:
+                batches += 1
+                leaves = list(jax.tree_util.tree_leaves(b.args))
+                leaves += [b.rows, b.keys_dev, b.mask]
+                if b.keys_wide is not None:
+                    leaves += list(b.keys_wide)
+                for leaf in leaves:
+                    if leaf is None:
+                        continue
+                    dev += _dev_bytes(leaf)
+                    host += _host_bytes(leaf)
+                host += _host_bytes(b.keys_host)
+        return {"batches": batches, "device_bytes": dev,
+                "host_bytes": host}
+
+    def _autofuse_chain_bytes(self) -> int:
+        """Rollback-snapshot buffers the auto-fuser pins: counted only
+        when they are NOT the live columns (post-window the live state is
+        a fresh buffer; pre-window the snapshot aliases it)."""
+        fuser = getattr(self.engine, "autofuser", None)
+        snap = getattr(fuser, "_chain_snapshot", None) if fuser else None
+        if not snap:
+            return 0
+        total = 0
+        for name, cols in snap.items():
+            arena = self.engine.arenas.get(name)
+            live = arena.state if arena is not None else {}
+            for fname, col in cols.items():
+                if live.get(fname) is not col:
+                    total += _dev_bytes(col)
+        return total
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The full accounting: owners, per-arena detail, device
+        reconciliation, headroom.  Cheap enough for every
+        ``engine.snapshot()`` — pure host attribute walks."""
+        eng = self.engine
+        owners: Dict[str, int] = {}
+        arenas: Dict[str, Any] = {}
+        for name, arena in eng.arenas.items():
+            detail = self._arena_detail(name, arena)
+            arenas[name] = detail
+            owners[f"arena.{name}.state"] = detail["state_bytes"]
+            owners[f"arena.{name}.clocks"] = detail["clock_bytes"]
+            if detail["mirror_bytes"]:
+                owners[f"arena.{name}.mirror"] = detail["mirror_bytes"]
+        pending = self._pending()
+        if pending["device_bytes"]:
+            owners["pending_batches"] = pending["device_bytes"]
+        ledger_hist = getattr(eng.ledger, "_hist", None)
+        if ledger_hist is not None:
+            owners["latency_ledger"] = _dev_bytes(ledger_hist)
+        chain = self._autofuse_chain_bytes()
+        if chain:
+            owners["autofuse_chain"] = chain
+        total = sum(owners.values())
+        self.peak_bytes = max(self.peak_bytes, total)
+        self.snapshots_taken += 1
+        device = self.device_stats()
+        headroom = None
+        if device is not None and device.get("bytes_limit"):
+            headroom = round(
+                1.0 - device.get("bytes_in_use", 0)
+                / device["bytes_limit"], 4)
+        out: Dict[str, Any] = {
+            "total_self_bytes": total,
+            "peak_self_bytes": self.peak_bytes,
+            "owners": owners,
+            "arenas": arenas,
+            "pending": pending,
+            # device reconciliation: None on backends without
+            # memory_stats (CPU) — self-accounting stands alone
+            "device": device,
+            "headroom": headroom,
+            "source": "device+self" if device is not None else "self",
+        }
+        if device is not None and device.get("bytes_in_use"):
+            # accounted / in-use: <1 means allocations the ledger does
+            # not own (XLA scratch, compiled programs); ~1 means the
+            # ledger explains the heap
+            out["accounted_ratio"] = round(
+                total / device["bytes_in_use"], 4)
+        return out
+
+    def headroom(self) -> Optional[float]:
+        """The shed-controller gauge: device HBM headroom in [0, 1], or
+        None when the backend cannot say (CPU self-accounting has no
+        denominator — the controller treats None as no-signal)."""
+        device = self.device_stats()
+        if device is None or not device.get("bytes_limit"):
+            return None
+        return 1.0 - device.get("bytes_in_use", 0) / device["bytes_limit"]

@@ -78,16 +78,37 @@ def test_tcp_client_gateway_pool_failover(run):
                 victim = cluster.silos[0]
                 cluster.kill_silo(victim)
                 await cluster.wait_for_liveness_convergence(timeout=15.0)
-                # the dead gateway's handle reports not-alive soon after
-                deadline = asyncio.get_running_loop().time() + 5
-                while all(g.alive for g in client._gateways):
-                    assert asyncio.get_running_loop().time() < deadline
-                    await asyncio.sleep(0.05)
+                # event-driven death detection: the dead gateway's pump
+                # exits on connection loss and sets its `closed` event —
+                # no alive-polling loop racing the socket teardown (the
+                # sleep/race recipe the PR 3 batch-edge fix replaced)
+                await asyncio.wait_for(
+                    asyncio.wait([asyncio.ensure_future(g.closed.wait())
+                                  for g in client._gateways],
+                                 return_when=asyncio.FIRST_COMPLETED),
+                    timeout=10.0)
+                assert not all(g.alive for g in client._gateways)
 
-                results = await asyncio.gather(
-                    *(r.add(1) for r in refs), return_exceptions=True)
-                ok = [r for r in results if isinstance(r, int)]
-                assert len(ok) == 6, results
+                # event-driven convergence instead of a one-shot gather
+                # racing the survivors' directory heal: grains placed on
+                # (or directory-owned by) the dead silo re-place/re-route
+                # asynchronously after the kill, so each reference is
+                # retried until its call lands — the assertion (all 6
+                # callable through the surviving gateway) is unchanged,
+                # only the wait is no longer a race
+                deadline = asyncio.get_running_loop().time() + 30
+                pending = dict(enumerate(refs))
+                while pending:
+                    results = await asyncio.gather(
+                        *(r.add(1) for r in pending.values()),
+                        return_exceptions=True)
+                    for i, res in zip(list(pending), results):
+                        if isinstance(res, int):
+                            del pending[i]
+                    if pending:
+                        assert asyncio.get_running_loop().time() \
+                            < deadline, f"still failing: {results}"
+                        await asyncio.sleep(0.1)
             finally:
                 await client.close()
         finally:
