@@ -411,6 +411,197 @@ async def _cluster_presence(n_players: int, n_games: int, n_ticks: int,
         await cluster.stop()
 
 
+async def _multichip_tier(smoke: bool, sizes: "tuple | None" = None
+                          ) -> dict:
+    """The multichip data-plane tier: the 8-device mesh run as ONE
+    logical cluster (tensor/exchange.py cross-shard routing), published
+    as a STRUCTURED artifact — aggregate msgs/s, a cross-shard-ratio
+    sweep (0/10/50/90%) with exactness asserted against the unfused
+    exchange-off replay at every ratio, per-shard balance, device-ledger
+    latency, compile counts, the exchange on/off A/B, and the host-slab
+    reference the on-device path replaces.  Replaces the opaque
+    {n_devices, rc, ok, tail} MULTICHIP artifact with something the
+    perfgate can band (--family multichip)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from orleans_tpu.tensor.engine import TensorEngine
+    from samples.routing import run_routing_load
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        devices = jax.devices("cpu")
+    n_dev = min(8, len(devices))
+    if n_dev < 2:
+        raise RuntimeError(
+            "multichip tier needs a multi-device mesh (got "
+            f"{len(devices)} {devices[0].platform} device(s)); unset "
+            "ORLEANS_TPU_MULTICHIP_TPU to re-exec on the 8-device "
+            "virtual CPU mesh")
+    mesh = Mesh(np.array(devices[:n_dev]), ("grains",))
+
+    if sizes is not None:
+        n_src, n_sink, ticks, window = sizes  # plumbing tests
+    elif smoke:
+        n_src, n_sink, ticks, window = 4096, 1024, 8, 4
+    else:
+        n_src, n_sink, ticks, window = 4_000_000, 524_288, 12, 4
+    ratios = (0.0, 0.1, 0.5, 0.9)
+
+    def mk(exchange: bool) -> TensorEngine:
+        e = TensorEngine(mesh=mesh, initial_capacity=max(64, n_dev * 8))
+        e.config.auto_fusion_ticks = 0
+        e.config.cross_shard_exchange = exchange
+        return e
+
+    def sink_per_tick(engine, total_ticks: int):
+        from samples.routing import sink_keys
+
+        arena = engine.arena_for("RouteSink")
+        rows, found = arena.lookup_rows(sink_keys(n_sink))
+        assert found.all()
+        # integer cross-multiplication later: exact per-tick comparison
+        return (np.asarray(arena.state["received"])[rows], total_ticks)
+
+    async def one_ratio(r: float) -> dict:
+        e_f = mk(True)
+        fstats = await run_routing_load(e_f, n_src, n_sink, r,
+                                        n_ticks=ticks,
+                                        fused_window=window)
+        e_u = mk(True)
+        ustats = await run_routing_load(e_u, n_src, n_sink, r,
+                                        n_ticks=max(2, ticks // 2))
+        e_off = mk(False)
+        offstats = await run_routing_load(e_off, n_src, n_sink, r,
+                                          n_ticks=max(2, ticks // 2))
+        # exactness vs the unfused exchange-off replay: identical
+        # per-tick traffic, so counts cross-multiply exactly
+        rf, tf = sink_per_tick(e_f, fstats["ticks"] + window)
+        ro, to = sink_per_tick(e_off, offstats["ticks"] + 2)
+        exact = bool((rf.astype(np.int64) * to
+                      == ro.astype(np.int64) * tf).all())
+        xs = e_u.snapshot()["exchange"]
+        led = e_u.ledger.snapshot()
+        spt = ustats["seconds"] / ustats["ticks"]
+        sink_lat = led.get("RouteSink.recv", {})
+        occ = e_u.arena_for("RouteSink").shard_occupancy()
+        return {
+            "cross_ratio": r,
+            "fused_msgs_per_sec": round(fstats["messages_per_sec"], 1),
+            "unfused_msgs_per_sec": round(ustats["messages_per_sec"], 1),
+            "exchange_off_msgs_per_sec": round(
+                offstats["messages_per_sec"], 1),
+            "exact_vs_unfused_replay": exact,
+            "cross_shard_msgs": xs["cross_shard_msgs"],
+            "exchange_dropped": xs["dropped_msgs"],
+            "device_ledger": {
+                "p50_ticks": sink_lat.get("p50_ticks", 0.0),
+                "p99_ticks": sink_lat.get("p99_ticks", 0.0),
+                "p50_s": round(sink_lat.get("p50_ticks", 0.0) * spt, 6),
+                "p99_s": round(sink_lat.get("p99_ticks", 0.0) * spt, 6),
+            },
+            "per_shard_sink_occupancy": occ.tolist(),
+            "shard_imbalance": round(float(occ.max() / max(occ.mean(),
+                                                           1e-9)), 3),
+            "compiles": e_u.compile_count() + e_f.compile_count(),
+        }
+
+    sweep = {}
+    for r in ratios:
+        # pct keys ("r50"): perfgate paths walk dots, so "0.5" would be
+        # unreachable as a baseline path segment.  A ratio's failure
+        # degrades to an error entry (the _guard discipline) instead of
+        # costing the round the rest of the sweep.
+        try:
+            sweep[f"r{int(round(r * 100))}"] = await one_ratio(r)
+        except Exception as exc:  # noqa: BLE001 — published, not hidden
+            sweep[f"r{int(round(r * 100))}"] = {
+                "cross_ratio": r,
+                "error": f"{type(exc).__name__}: {exc}"}
+    usable = [s for s in sweep.values() if "error" not in s]
+    best = max((max(s["fused_msgs_per_sec"], s["unfused_msgs_per_sec"])
+                for s in usable), default=0.0)
+    exact_all = all(s["exact_vs_unfused_replay"] for s in usable) \
+        and len(usable) == len(ratios)
+
+    # exchange on/off A/B at the acceptance point (50% cross-shard),
+    # both fused — the same program shape with the all_to_all replaced
+    # by XLA's implicit scatter collectives
+    at50 = sweep["r50"]
+    if "error" not in at50:
+        e_foff = mk(False)
+        foff = await run_routing_load(e_foff, n_src, n_sink, 0.5,
+                                      n_ticks=ticks, fused_window=window)
+        foff_rate = round(foff["messages_per_sec"], 1)
+        speedup_50 = round(at50["fused_msgs_per_sec"]
+                           / max(foff["messages_per_sec"], 1e-9), 3)
+    else:
+        foff_rate = None
+        speedup_50 = None
+
+    # the host-slab reference: the 2-silo TCP cluster tier — the path
+    # cross-shard traffic used to take (cross-process transport; here
+    # reserved for true cross-process hops only)
+    if smoke:
+        slab = await _cluster_presence(2_000, 20, 10, aggregate=True)
+    else:
+        slab = await _cluster_presence(20_000, 100, 30, aggregate=True)
+    slab_rate = slab.get("total_msgs_per_sec", 0.0)
+
+    out = {
+        "metric": "multichip_aggregate_msgs_per_sec",
+        "value": best,
+        "unit": "msg/s",
+        "workload": "multichip",
+        "n_devices": n_dev,
+        "platform": devices[0].platform,
+        "grains": n_src + n_sink,
+        "sources": n_src,
+        "sinks": n_sink,
+        "ticks": ticks,
+        "engine": "8-device mesh as one logical cluster: fused windows "
+                  "with the cross-shard exchange (bucket-by-shard + "
+                  "lax.all_to_all) inside the scan; host slab transport "
+                  "reserved for cross-process hops",
+        "aggregate_msgs_per_sec": best,
+        "aggregate_def": "best operating point across the ratio sweep "
+                         "(max of fused/unfused msgs/s, exchange on)",
+        "sweep": sweep,
+        "exact_all_ratios": exact_all,
+        "exchange_off_fused_at_50": foff_rate,
+        "exchange_speedup_at_50": speedup_50,
+        "host_slab_reference": {
+            "total_msgs_per_sec": slab_rate,
+            "cross_silo_msgs_per_sec": slab.get("msgs_per_sec", 0.0),
+            "definition": "2-silo TCP cluster Presence tier (slab fast "
+                          "path) — the cross-process transport the "
+                          "on-device exchange keeps cross-shard "
+                          "traffic off of",
+        },
+        "vs_host_slab_at_50": round(
+            at50["fused_msgs_per_sec"] / max(slab_rate, 1e-9), 2)
+        if "error" not in at50 else None,
+    }
+    # perfgate: band the multichip family in-run (same embed discipline
+    # as the profile tier — any gate failure degrades to an error entry)
+    try:
+        from orleans_tpu.perfgate import run_gate
+        out["perfgate"] = run_gate("PERF_BASELINE.json", artifact=out,
+                                   artifact_name="<in-run multichip>",
+                                   family="multichip")
+    except Exception as exc:  # noqa: BLE001 — published, not hidden
+        out["perfgate"] = {"status": "error",
+                           "error": f"{type(exc).__name__}: {exc}"}
+    if smoke:
+        assert exact_all, {k: s.get("exact_vs_unfused_replay")
+                           for k, s in sweep.items()}
+        assert all(s["exchange_dropped"] == 0 for s in usable)
+        assert at50["cross_shard_msgs"] > 0
+    return out
+
+
 _DEGRADED_TYPES: dict = {}
 
 
@@ -1699,7 +1890,7 @@ def main() -> None:
                         choices=("presence", "chirper", "gpstracker",
                                  "twitter", "helloworld", "cluster",
                                  "degraded", "collection", "metrics",
-                                 "profile"),
+                                 "profile", "multichip"),
                         default="presence")
     parser.add_argument("--no-slab-aggregation", action="store_true",
                         help="cluster workload: disable the sender-side "
@@ -1735,6 +1926,24 @@ def main() -> None:
         # one output path: the chaos CLI owns printing + CHAOS_SMOKE.json
         from orleans_tpu.chaos.report import main as chaos_main
         sys.exit(chaos_main(["--seed", "1234", "--repeat", "2"]))
+
+    if args.workload == "multichip" \
+            and os.environ.get("ORLEANS_TPU_MULTICHIP_TPU") != "1":
+        # the tier needs an 8-device mesh; on a 1-device (tunneled) rig
+        # re-exec on the virtual CPU platform exactly like the driver's
+        # dryrun.  ORLEANS_TPU_MULTICHIP_TPU=1 skips the dance on a real
+        # multi-device accelerator.
+        import subprocess
+
+        import __graft_entry__ as graft
+        if not graft._can_force_in_process(8):
+            env = graft._cpu_mesh_env(dict(os.environ), 8)
+            env["ORLEANS_TPU_DRYRUN_CHILD"] = "1"
+            here = os.path.dirname(os.path.abspath(__file__))
+            argv = [sys.executable, os.path.abspath(__file__),
+                    "--workload", "multichip"] \
+                + (["--smoke"] if args.smoke else [])
+            sys.exit(subprocess.run(argv, env=env, cwd=here).returncode)
 
     if args.smoke:
         args.players, args.games, args.ticks = 10_000, 100, 5
@@ -2174,11 +2383,15 @@ def main() -> None:
     async def run_profile() -> dict:
         return await _profile_tier(args.smoke)
 
+    async def run_multichip() -> dict:
+        return await _multichip_tier(args.smoke)
+
     runners = {"presence": run, "chirper": run_chirper,
                "gpstracker": run_gps, "twitter": run_twitter,
                "helloworld": run_hello, "cluster": run_cluster,
                "degraded": run_degraded, "collection": run_collection,
-               "metrics": run_metrics, "profile": run_profile}
+               "metrics": run_metrics, "profile": run_profile,
+               "multichip": run_multichip}
     result = asyncio.run(runners[args.workload]())
     print(json.dumps(result))
     if args.workload == "degraded" and args.smoke:
@@ -2197,6 +2410,13 @@ def main() -> None:
         # coverage, memory-ledger exactness, capture proof, perfgate
         # verdict — the device cost plane's contract in one file
         with open("PROFILE_SMOKE.json", "w") as f:
+            f.write(json.dumps(result, indent=1) + "\n")
+    if args.workload == "multichip":
+        # the STRUCTURED multichip artifact (perfgate --family multichip
+        # falls back to it until driver rounds carry structured
+        # payloads) — written for full runs and smoke alike: the perf
+        # trajectory is the point
+        with open("MULTICHIP_BENCH.json", "w") as f:
             f.write(json.dumps(result, indent=1) + "\n")
 
 

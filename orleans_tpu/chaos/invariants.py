@@ -207,3 +207,63 @@ async def wait_for_at_least_once(produced: Iterable,
             if time.monotonic() > deadline:
                 raise
             await asyncio.sleep(0.05)
+
+
+def check_mesh_single_activation(engine) -> Dict[str, Any]:
+    """Mesh-path twin of ``check_single_activation``: within one
+    engine's sharded arenas, every live key occupies exactly ONE row,
+    and every row sits in the shard block the directory hash assigns —
+    ``shard_of_keys``, the SAME function the cross-shard exchange
+    buckets by (tensor/exchange.py).  Checked after mid-traffic mesh
+    reshards and eviction-epoch churn: a key doubly resident, or
+    resident in a foreign block, means the device cluster broke the
+    single-activation guarantee the silo ring enforces at its own
+    granularity."""
+    import numpy as np
+
+    from orleans_tpu.tensor.arena import shard_of_keys
+    report: Dict[str, Any] = {"ok": True, "arenas": {}}
+    for name, arena in engine.arenas.items():
+        keys = arena.keys()
+        uniq, counts = np.unique(keys, return_counts=True)
+        doubled = uniq[counts > 1]
+        if len(doubled):
+            raise InvariantViolation(
+                f"mesh single-activation violated for {name!r}: keys "
+                f"{doubled[:20].tolist()} live in multiple rows")
+        rows, found = arena.lookup_rows(uniq)
+        if not found.all():
+            raise InvariantViolation(
+                f"arena {name!r} index inconsistent: "
+                f"{int((~found).sum())} live keys fail lookup")
+        shards = rows // arena.shard_capacity
+        expected = shard_of_keys(uniq, arena.n_shards)
+        strays = uniq[shards != expected]
+        if len(strays):
+            raise InvariantViolation(
+                f"mesh placement violated for {name!r}: keys "
+                f"{strays[:20].tolist()} resident outside their home "
+                f"shard block (directory/arena disagreement)")
+        report["arenas"][name] = {"live": int(arena.live_count),
+                                  "n_shards": int(arena.n_shards)}
+    return report
+
+
+def check_exchange_accounting(engine) -> Dict[str, Any]:
+    """The exchange's no-silent-loss ledger: after quiescence, every
+    bucket-overflow lane must have been re-delivered (parked checks all
+    drained) and the delivered/cross counters internally consistent —
+    the device-plane analog of ``check_dead_letter_accounting``."""
+    xch = engine.exchange
+    if xch is None:
+        return {"ok": True, "exchange": None}
+    if engine._exchange_checks:
+        raise InvariantViolation(
+            f"{len(engine._exchange_checks)} exchange overflow checks "
+            "still parked after quiescence (drain/flush contract broken)")
+    snap = xch.snapshot()
+    if snap["cross_shard_msgs"] > snap["delivered_msgs"] + \
+            snap["dropped_msgs"]:
+        raise InvariantViolation(
+            f"exchange counters inconsistent: {snap}")
+    return {"ok": True, **snap}

@@ -240,6 +240,23 @@ class TensorEngineConfig:
     bucket_sizes: tuple = (256, 4096, 32768, 131072, 262144, 524288,
                            1 << 20)
     mesh_axis: str = "grains"
+    # device-resident cross-shard routing (tensor/exchange.py): under a
+    # mesh, device batches are bucketed by destination shard and moved
+    # with ONE lax.all_to_all inside the compiled program, so the step
+    # kernel's scatters are shard-local — the 8-device mesh runs as one
+    # logical cluster with host slab transport reserved for true
+    # cross-process hops.  Off = the implicit-collective baseline the
+    # multichip bench A/Bs against.  Live-toggleable (fused windows
+    # re-trace, cause config_toggle).
+    cross_shard_exchange: bool = True
+    # per-(src shard, dst shard) bucket floor (lanes): small batches pad
+    # to at least this so bucket sizes don't churn compiles
+    exchange_pad_quantum: int = 256
+    # bucket size relative to the uniform share L/n_shards: 2.0 absorbs
+    # 2x destination skew before lanes overflow into redelivery (the
+    # engine re-delivers dropped lanes with their original inject stamp;
+    # a fused window counts them as misses and rolls back)
+    exchange_capacity_factor: float = 2.0
     # cross-silo sender aggregation (tensor/router.py): slab fragments
     # bound for one (destination, type, method) within a drain cycle
     # merge into ONE wire frame, so receivers see stable batch sizes

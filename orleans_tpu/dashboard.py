@@ -111,6 +111,19 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                 "cross_silo_messages": int(
                     _counter_total(merged, "router.messages_received")),
             },
+            # device-resident cross-shard routing (tensor/exchange.py):
+            # traffic that crossed mesh shards WITHOUT leaving the device
+            "cross_shard": {
+                "exchanged_messages": int(
+                    _counter_total(merged, "route.cross_shard_msgs")),
+                "delivered_messages": int(
+                    _counter_total(merged, "route.delivered_msgs")),
+                "dropped_redelivered": int(
+                    _counter_total(merged, "route.exchange_dropped")),
+                "exchanges": int(_counter_total(merged, "route.exchanges")),
+                "exchange_seconds": round(
+                    _counter_total(merged, "route.exchange_s"), 4),
+            },
             "latency_ticks": latency,
             "host_turn_latency_s": host_latency,
             "tick_phases": phases,
@@ -175,6 +188,13 @@ def render_text(view: Dict[str, Any]) -> str:
         f"ticks ({t['engine_msgs_per_sec']} msg/s of tick time); "
         f"host rpc: {t['host_requests']}; "
         f"cross-silo: {t['cross_silo_messages']}")
+    xs = c.get("cross_shard", {})
+    if xs.get("exchanges"):
+        lines.append(
+            f"cross-shard (on device): {xs['exchanged_messages']} msgs "
+            f"across shards / {xs['delivered_messages']} exchanged, "
+            f"{xs['dropped_redelivered']} overflow-redelivered, "
+            f"{xs['exchanges']} dispatches")
     if c["latency_ticks"]:
         lines.append("latency (device ticks, per type.method):")
         for method, ps in sorted(c["latency_ticks"].items()):
@@ -188,7 +208,8 @@ def render_text(view: Dict[str, Any]) -> str:
     if c.get("tick_phases"):
         parts = []
         total = sum(p["seconds"] for p in c["tick_phases"].values())
-        for phase in ("host", "h2d", "dispatch", "route", "d2h"):
+        for phase in ("host", "h2d", "exchange", "dispatch", "route",
+                      "d2h"):
             p = c["tick_phases"].get(phase)
             if p is not None and total > 0:
                 parts.append(f"{phase}={100 * p['seconds'] / total:.0f}%")

@@ -128,6 +128,26 @@ for _n, _u, _d in (
 declare("router.slab_merge_ratio", KIND_GAUGE, "ratio",
         "fragments per wire frame (>1 = sender aggregation engaged)")
 
+# -- device-resident cross-shard routing (tensor/exchange.py) ----------------
+declare("route.cross_shard_msgs", KIND_COUNTER, "messages",
+        "messages exchanged to a DIFFERENT mesh shard on device "
+        "(all_to_all lanes; the traffic the host slab path no longer "
+        "carries)")
+declare("route.delivered_msgs", KIND_COUNTER, "messages",
+        "messages delivered through the cross-shard exchange "
+        "(local + cross-shard lanes, bucket overflows excluded)")
+declare("route.exchange_dropped", KIND_COUNTER, "messages",
+        "lanes that overflowed their destination bucket and were "
+        "re-delivered next tick with their original inject stamp")
+declare("route.exchanges", KIND_COUNTER, "dispatches",
+        "cross-shard exchange dispatches (one per exchanged batch)")
+declare("route.exchange_s", KIND_COUNTER, "seconds",
+        "cumulative host wall time in the exchange stage (dispatch "
+        "side; the device cost shows as the 'exchange' tick phase)")
+declare("arena.shard_occupancy", KIND_GAUGE, "rows",
+        "live rows in one mesh shard block (labels 'arena', 'shard') — "
+        "the per-shard balance behind the multichip bench")
+
 # -- transport links (runtime/transport per-link stats) ----------------------
 for _n, _u, _d in (
         ("frames_sent", "frames", "wire frames sent on this link"),
@@ -153,13 +173,13 @@ declare("engine.latency_ticks", KIND_HISTOGRAM, "ticks",
 # -- device cost plane (tensor/profiler.py + tensor/memledger.py) ------------
 declare("engine.phase_s", KIND_HISTOGRAM, "seconds",
         "per-tick wall time of one pipeline phase (label 'phase' = "
-        "host | h2d | dispatch | route | d2h; the tick-phase profiler's "
-        "log2 histograms mirrored per phase)")
+        "host | h2d | exchange | dispatch | route | d2h; the tick-phase "
+        "profiler's log2 histograms mirrored per phase)")
 declare("compile.events", KIND_COUNTER, "compiles",
         "cause-coded compile/retrace events (label 'cause' = the "
         "tensor/profiler.py churn taxonomy: new_method, bucket_growth, "
         "shape_change, epoch_mismatch, generation_repack, config_toggle, "
-        "mesh_reshard, new_window)")
+        "mesh_reshard, new_window, cross_shard)")
 declare("compile.lowering_s", KIND_COUNTER, "seconds",
         "cumulative lowering/compile wall time across tracked retraces")
 declare("memory.self_bytes", KIND_GAUGE, "bytes",

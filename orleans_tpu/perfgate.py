@@ -63,14 +63,28 @@ def resolve_path(obj: Any, path: str) -> Optional[float]:
 def unwrap_artifact(data: Any) -> Optional[Dict[str, Any]]:
     """Accept a bare bench artifact or the driver wrapper; None when the
     wrapper's parsed payload is null/absent (a truncated capture must
-    read as 'unusable', never as 'no regressions')."""
+    read as 'unusable', never as 'no regressions').  The legacy opaque
+    multichip wrapper ({n_devices, rc, ok, tail} with no metrics) reads
+    as unusable too — only structured artifacts (a ``workload`` key or
+    the bench headline keys) are comparable."""
     if not isinstance(data, dict):
         return None
     if "parsed" in data:
         parsed = data["parsed"]
         return parsed if isinstance(parsed, dict) else None
-    # a bare artifact has the bench's headline keys
-    return data if ("value" in data or "metric" in data) else None
+    # a bare artifact has the bench's headline keys (or, for the
+    # multichip family, the structured tier's workload tag)
+    return data if ("value" in data or "metric" in data
+                    or "workload" in data) else None
+
+
+#: artifact family → (round-file prefix, baseline metrics section,
+#: fallback artifact written directly by bench.py)
+FAMILIES: Dict[str, Tuple[str, str, Optional[str]]] = {
+    "bench": ("BENCH", "metrics", None),
+    "multichip": ("MULTICHIP", "multichip_metrics",
+                  "MULTICHIP_BENCH.json"),
+}
 
 
 def evaluate_metric(name: str, spec: Dict[str, Any],
@@ -165,16 +179,26 @@ def render_markdown(verdict: Dict[str, Any],
     return "\n".join(lines)
 
 
-def newest_bench_artifact(directory: str = ".") -> Optional[Tuple[str, Dict]]:
-    """The freshest usable BENCH_r*.json by round number (unparseable
-    rounds — e.g. the truncated r05 — are skipped with a note to
-    stderr, not silently treated as regression-free)."""
+def newest_bench_artifact(directory: str = ".", family: str = "bench"
+                          ) -> Optional[Tuple[str, Dict]]:
+    """The freshest usable artifact of ``family`` by round number
+    (unparseable/opaque rounds — e.g. the truncated BENCH_r05, or the
+    legacy {n_devices, rc, ok} multichip wrappers — are skipped with a
+    note to stderr, not silently treated as regression-free).  Families
+    with a bench-written fallback artifact (MULTICHIP_BENCH.json) use it
+    when no structured driver round exists."""
+    prefix, _section, fallback = FAMILIES[family]
     rounds: List[Tuple[int, str]] = []
-    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
-        m = re.search(r"BENCH_r(\d+)\.json$", path)
+    for path in glob.glob(os.path.join(directory, f"{prefix}_r*.json")):
+        m = re.search(rf"{prefix}_r(\d+)\.json$", path)
         if m:
             rounds.append((int(m.group(1)), path))
-    for _, path in sorted(rounds, reverse=True):
+    candidates = [path for _, path in sorted(rounds, reverse=True)]
+    if fallback is not None:
+        fb = os.path.join(directory, fallback)
+        if os.path.exists(fb):
+            candidates.append(fb)
+    for path in candidates:
         try:
             with open(path) as f:
                 data = json.load(f)
@@ -190,18 +214,26 @@ def newest_bench_artifact(directory: str = ".") -> Optional[Tuple[str, Dict]]:
 
 def run_gate(baseline_path: str, artifact: Optional[Dict[str, Any]] = None,
              artifact_name: str = "",
-             strict_missing: bool = False) -> Dict[str, Any]:
-    """Library entry point (bench.py embeds this in the profile tier)."""
+             strict_missing: bool = False,
+             family: str = "bench") -> Dict[str, Any]:
+    """Library entry point (bench.py embeds this in the profile and
+    multichip tiers).  ``family`` selects the artifact glob and the
+    baseline metrics section (FAMILIES)."""
     with open(baseline_path) as f:
         baseline = json.load(f)
+    prefix, section, _fb = FAMILIES[family]
+    if section != "metrics":
+        baseline = {**baseline, "metrics": baseline.get(section, {})}
     if artifact is None:
-        found = newest_bench_artifact(os.path.dirname(baseline_path) or ".")
+        found = newest_bench_artifact(
+            os.path.dirname(baseline_path) or ".", family=family)
         if found is None:
             return {"status": "error",
-                    "error": "no usable BENCH_r*.json artifact found"}
+                    "error": f"no usable {prefix} artifact found"}
         artifact_name, artifact = found[0], found[1]
     verdict = evaluate(baseline, artifact, strict_missing=strict_missing)
     verdict["artifact"] = artifact_name
+    verdict["family"] = family
     return verdict
 
 
@@ -219,6 +251,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--strict-missing", action="store_true",
                         help="treat metrics absent from the artifact as "
                              "failures instead of warnings")
+    parser.add_argument("--family", choices=sorted(FAMILIES),
+                        default="bench",
+                        help="artifact family: 'bench' compares "
+                             "BENCH_r*.json against the baseline's "
+                             "'metrics'; 'multichip' compares the "
+                             "structured multichip artifacts "
+                             "(MULTICHIP_r*.json / MULTICHIP_BENCH"
+                             ".json) against 'multichip_metrics'")
     args = parser.parse_args(argv)
 
     if not os.path.exists(args.baseline):
@@ -244,7 +284,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     try:
         verdict = run_gate(args.baseline, artifact, artifact_name,
-                           strict_missing=args.strict_missing)
+                           strict_missing=args.strict_missing,
+                           family=args.family)
     except (json.JSONDecodeError, KeyError, TypeError, ValueError) as exc:
         # a malformed baseline is a usage error (exit 2 + JSON), never a
         # raw traceback — the documented CLI contract

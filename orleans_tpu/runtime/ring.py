@@ -25,6 +25,17 @@ from orleans_tpu.ids import GrainId, SiloAddress
 RANGE_SIZE = 1 << 32
 
 
+def device_shard_of_keys(keys, n_shards: int):
+    """The ring's DEVICE-granularity owner lookup: which mesh shard
+    block holds a grain key's state row.  Delegates to the one canonical
+    hash (tensor/arena.shard_of_keys) so "which silo owns this grain"
+    (the bucket ring above) and "which device shard holds its row" stay
+    the same function at two granularities — the 'directory IS the
+    sharding map' contract, enforced by the agreement property test."""
+    from orleans_tpu.tensor.arena import shard_of_keys
+    return shard_of_keys(keys, n_shards)
+
+
 @dataclass(frozen=True)
 class RingRange:
     """Half-open hash range (begin, end] on the 32-bit ring

@@ -750,6 +750,22 @@ class Silo:
                     mgr.track_metric("arena.fragmentation",
                                      arena.fragmentation(),
                                      {"silo": self.name, "arena": name})
+                if arena.n_shards > 1:
+                    # per-shard balance of the mesh-sharded arena (the
+                    # exchange's load-balance health signal)
+                    for shard, rows in \
+                            enumerate(arena.shard_occupancy().tolist()):
+                        reg.gauge("arena.shard_occupancy",
+                                  {"arena": name,
+                                   "shard": str(shard)}).set(rows)
+            if eng.exchange is not None:
+                xs = eng.exchange.snapshot()
+                emit({"cross_shard_msgs": xs["cross_shard_msgs"],
+                      "delivered_msgs": xs["delivered_msgs"],
+                      "exchange_dropped": xs["dropped_msgs"],
+                      "exchanges": xs["exchanges_run"],
+                      "exchange_s": xs["exchange_seconds"]},
+                     None, "route.")
             emit({"messages_processed": eng.messages_processed,
                   "ticks": eng.ticks_run,
                   "compiles": eng.compile_count(),

@@ -69,6 +69,20 @@ def _hash_keys_u64(keys: np.ndarray) -> np.ndarray:
     return z ^ (z >> np.uint64(31))
 
 
+def shard_of_keys(keys: np.ndarray, n_shards: int) -> np.ndarray:
+    """THE device-shard-of-key function — the mesh-granularity twin of
+    the silo ring's owner lookup (runtime/ring.py re-exports this as
+    ``device_shard_of_keys``): every consumer of "which shard block
+    holds this grain" — arena row allocation, the exchange's
+    destination bucketing (``rows // shard_capacity``, which agrees by
+    construction since rows are allocated in the key's home block), and
+    the multichip bench's ratio construction — derives from this one
+    hash.  The directory IS the sharding map, enforced by the agreement
+    property test (tests/test_cross_shard.py)."""
+    return (_hash_keys_u64(np.asarray(keys, dtype=np.int64))
+            % np.uint64(max(1, n_shards))).astype(np.int64)
+
+
 # -- wide (64-bit) key support ------------------------------------------------
 # Device int64 needs jax x64 mode, so a wide key rides the mesh as TWO
 # int32 words (reference key breadth: UniqueKey.cs:34 — two 64-bit words).
@@ -404,7 +418,7 @@ class GrainArena:
                 f"[0, 2**63); got {int(keys.min())}")
         if len(keys) and int(keys.max()) >= 2**31 - 1:
             self.has_wide_keys = True
-        shards = (_hash_keys_u64(keys) % np.uint64(self.n_shards)).astype(np.int64)
+        shards = shard_of_keys(keys, self.n_shards)
         # capacity per shard counts free-list slots as available — freed
         # rows are reused in place before the bump pointer advances, so
         # steady churn (activate/evict cycles) never grows the arena
@@ -517,6 +531,14 @@ class GrainArena:
         host = jax.device_get({name: col[idx]
                                for name, col in self.state.items()})
         return {name: col[:n] for name, col in host.items()}
+
+    def shard_occupancy(self) -> np.ndarray:
+        """Live rows per shard block (int64[n_shards]) — the balance
+        gauge behind ``arena.shard_occupancy`` and the multichip bench's
+        per-shard balance section.  Host-only arithmetic."""
+        live = np.nonzero(self._key_of_row >= 0)[0]
+        return np.bincount(live // self.shard_capacity,
+                           minlength=self.n_shards).astype(np.int64)
 
     def fragmentation(self) -> float:
         """Worst per-shard freed/high-water ratio (0.0 = no holes).  The

@@ -50,10 +50,12 @@ from orleans_tpu.config import (
 from orleans_tpu.core.grain import MethodInfo
 from orleans_tpu.ids import GrainId
 from orleans_tpu.tensor.arena import GrainArena
+from orleans_tpu.tensor.exchange import exchangeable_args
 from orleans_tpu.tensor.ledger import DeviceLatencyLedger
 from orleans_tpu.tensor.memledger import DeviceMemoryLedger
 from orleans_tpu.tensor.profiler import (
     CAUSE_BUCKET_GROWTH,
+    CAUSE_CROSS_SHARD,
     CAUSE_GENERATION_REPACK,
     CAUSE_MESH_RESHARD,
     CAUSE_NEW_METHOD,
@@ -143,6 +145,23 @@ class _MissCheck:
     miss_count: jnp.ndarray
     args: Any
     inject_tick: int = -1  # original ledger stamp, carried to redelivery
+
+
+@dataclass
+class _ExchangeCheck:
+    """A parked cross-shard exchange overflow check (tensor/exchange.py):
+    lanes that did not fit their destination bucket carry a device-side
+    dropped mask; at the next quiescence point they re-deliver through
+    the same path with the ORIGINAL inject stamp (never silent loss,
+    same discipline as _MissCheck)."""
+
+    type_name: str
+    method: str
+    keys: jnp.ndarray          # int32[m] device — redelivery addresses
+    args: Any                  # the PRE-exchange args pytree
+    dropped: jnp.ndarray       # bool[m] device
+    stats: jnp.ndarray         # int32[3] device (cross, dropped, delivered)
+    inject_tick: int = -1
 
 
 @jax.jit
@@ -511,6 +530,9 @@ class TensorEngine:
         self._reshard_forgotten: set = set()
         self.reshard_count = 0
         self._pending_checks: List[_MissCheck] = []
+        # parked cross-shard exchange overflow checks (drained with the
+        # miss checks — one batched device read covers both families)
+        self._exchange_checks: List[_ExchangeCheck] = []
         # batches parked by the handoff fence during a tick's rounds;
         # re-queued at tick end so they retry next tick, not next round
         self._fence_deferred: List[Tuple[Tuple[str, str], PendingBatch]] = []
@@ -557,6 +579,26 @@ class TensorEngine:
             self.n_shards = 1
             self.sharding = None
             self.replicated = None
+        # device-resident cross-shard router (tensor/exchange.py): built
+        # whenever a multi-shard mesh is present so the
+        # config.cross_shard_exchange toggle can flip live; counters
+        # carry across a reshard (the compiled programs do not — they
+        # specialize on the shard layout)
+        prev = getattr(self, "exchange", None)
+        if mesh is not None and self.n_shards > 1:
+            from orleans_tpu.tensor.exchange import ShardExchange
+            self.exchange = ShardExchange(self)
+            self.exchange.adopt_stats(prev)
+        else:
+            self.exchange = None
+
+    def _exchange_live(self) -> bool:
+        """True when device batches route through the cross-shard
+        exchange (mesh present + config toggle on) — the one predicate
+        the unfused dispatch, the fused trace, and prepare()'s re-trace
+        detection all share."""
+        return self.exchange is not None and \
+            self.config.cross_shard_exchange
 
     # ================= arenas =============================================
 
@@ -648,6 +690,16 @@ class TensorEngine:
         restore point for survivors after a hard kill.  Returns seconds
         spent (0.0 when it did not fire)."""
         if not self.checkpoint_due():
+            return 0.0
+        if self._exchange_checks and self._drain_exchange_checks():
+            # exchange-overflow redeliveries just requeued: their SOURCE
+            # updates have not applied yet, but their fan-out subscriber
+            # deliveries (expanded in the original pass) may have — a
+            # checkpoint now could persist subscriber effects without
+            # the source update.  Defer the write one tick (the drain's
+            # batched stat read decides: the common no-drop steady state
+            # proceeds, so continuous traffic cannot starve the
+            # cadence); checkpoint_due() keeps firing until it lands.
             return 0.0
         t_cp = time.perf_counter()
         for a in self.arenas.values():
@@ -1016,8 +1068,11 @@ class TensorEngine:
                 self.collector.run_slice(cfg.collection_pause_budget_s,
                                          cfg.collection_chunk_rows)
                 stages["collect"] += time.perf_counter() - t0
-        if len(self._pending_checks) >= self.config.miss_check_cap:
+        if len(self._pending_checks) + len(self._exchange_checks) \
+                >= self.config.miss_check_cap:
             # bound device memory pinned by parked optimistic checks
+            # (exchange overflow checks pin their batch's args the same
+            # way, so they count against the same cap)
             self._drain_checks()
         rounds = 0
         while rounds < self.config.max_rounds_per_tick:
@@ -1165,12 +1220,12 @@ class TensorEngine:
         """Quiescence point: activate unseen keys discovered by optimistic
         resolution and re-deliver their (and only their) messages.
         Returns True if new work was queued."""
-        if not self._pending_checks:
+        if not self._pending_checks and not self._exchange_checks:
             return False
         t0 = time.perf_counter()
         checks = self._pending_checks
         self._pending_checks = []
-        requeued = False
+        requeued = self._drain_exchange_checks()
         # one batched sync for all parked counts — a single device
         # transfer regardless of how many checks are parked.  The arity
         # pads to the next power of two so the varargs jit compiles
@@ -1270,6 +1325,43 @@ class TensorEngine:
         # cumulative totals directly
         sink = self._tick_stages if self._in_tick else self.stage_seconds
         sink["miss_checks"] += time.perf_counter() - t0
+        return requeued
+
+    def _drain_exchange_checks(self) -> bool:
+        """Quiescence half of the cross-shard exchange: fold the parked
+        device stat vectors (ONE batched transfer for all parked checks,
+        same discipline as the miss counters) and re-deliver any
+        bucket-overflow lanes through the exact path with their original
+        inject stamps.  Returns True if redeliveries were queued."""
+        if not self._exchange_checks:
+            return False
+        checks = self._exchange_checks
+        self._exchange_checks = []
+        if len(checks) == 1:
+            stats = np.asarray(checks[0].stats)[None, :]
+        else:
+            n = len(checks)
+            padded = 1 << (n - 1).bit_length()
+            xs = [c.stats for c in checks] \
+                + [np.zeros(3, np.int32)] * (padded - n)
+            stats = np.asarray(_stack_counts(*xs))[:n]
+        xch = self.exchange
+        requeued = False
+        for c, row in zip(checks, stats):
+            if xch is not None:
+                xch.fold_stats(row)
+            if int(row[1]) == 0:
+                continue
+            if xch is not None:
+                xch.redeliveries += 1
+            # no_fanout: the original pass already expanded subscriber
+            # deliveries for these lanes (expansion gates on RESOLUTION,
+            # which succeeded — the drop happened downstream, in the
+            # bucket); re-expanding would double-deliver
+            self.queues[(c.type_name, c.method)].append(PendingBatch(
+                args=c.args, keys_dev=c.keys, mask=c.dropped,
+                no_fanout=True, inject_tick=c.inject_tick))
+            requeued = True
         return requeued
 
     # -- group execution ----------------------------------------------------
@@ -1452,8 +1544,20 @@ class TensorEngine:
                     self._tick_traces.append(b.trace)
                 total += len(b)
             self._tick_counts[f"{type_name}.{method}"] += total
+        # cross-shard exchange pre-check (tensor/exchange.py): a group is
+        # an exchange candidate when every batch carries device keys (the
+        # redelivery address for bucket-overflow lanes) and no futures
+        # (the exchange permutes lanes, which would destroy positional
+        # results).  Final eligibility also needs every RESOLUTION to be
+        # device-side — checked after resolve; ledger accounting for
+        # candidates moves past that decision so dropped lanes are never
+        # counted before they deliver.
+        maybe_exchange = (
+            self._exchange_live() and arena.sharding is not None
+            and all(b.future is None and b.keys_dev is not None
+                    and b.keys_wide is None for b in batches))
         ledger = self.ledger
-        if ledger.enabled:
+        if ledger.enabled and not maybe_exchange:
             # latency ledger, host-resolved side: injector/host-key
             # batches always fully deliver (host resolution activates),
             # so their accounting is one numpy scalar add per batch —
@@ -1479,15 +1583,34 @@ class TensorEngine:
         fan = self._fanouts.get((type_name, method))
         if fan is not None:
             self._expand_resolved_fanout(fan, batches, resolved)
-        if ledger.enabled:
+        # final exchange eligibility: every resolution stayed on device
+        # (a stale injector falls back to host re-resolution — np rows —
+        # and the group takes the legacy path this round) and every
+        # batch's args are lane-aligned (slab-style handlers consuming a
+        # whole buffer per tick cannot have their rows permuted away
+        # from the buffer)
+        will_exchange = maybe_exchange and not any(
+            isinstance(r, np.ndarray) for r, _ in resolved) and all(
+            exchangeable_args(b.args, len(b)) for b in batches)
+        if ledger.enabled and not will_exchange:
             # latency ledger, device side: count exactly the lanes the
             # step will apply (mask ∧ resolved, combined INSIDE the jit)
             # — unresolved misses are counted when their redelivery
             # applies (original stamp), never twice.  One async jit
             # dispatch per device batch; nothing crosses to the host.
             for b, (rows, _a) in zip(batches, resolved):
-                if b.inject_tick < 0 or b.keys_host is not None \
-                        or b.rows is not None:
+                if b.inject_tick < 0:
+                    continue
+                if maybe_exchange:
+                    # exchange candidate that fell back this round: the
+                    # pre-coalesce host-side record was skipped above —
+                    # account the batch by its actual resolution kind
+                    if isinstance(rows, np.ndarray):
+                        ledger.record_host(
+                            type_name, method,
+                            self.tick_number - b.inject_tick, len(b))
+                        continue
+                elif b.keys_host is not None or b.rows is not None:
                     continue
                 base = b.mask if b.mask is not None \
                     else _mask_for(len(b))
@@ -1533,8 +1656,42 @@ class TensorEngine:
 
         self.messages_processed += m_total
         want_results = any(b.future is not None for b in batches)
+        t_x = time.perf_counter()
+        stages["resolve"] += t_x - t_res
+
+        exchanged = False
+        if will_exchange and not isinstance(rows, np.ndarray):
+            # cross-shard exchange (tensor/exchange.py): bucket by
+            # destination shard + one all_to_all, so the step kernel's
+            # scatters land shard-local.  The dropped mask + stats stay
+            # on device, parked like a miss-check; messages_processed
+            # already counted the LOGICAL lanes above (the exchanged
+            # width is a padded transport shape, not traffic).
+            keys_cat = batches[0].keys_dev if len(batches) == 1 \
+                else jnp.concatenate([b.keys_dev for b in batches])
+            base = mask if mask is not None \
+                else _mask_for(rows.shape[0])
+            orig_args = args
+            rows, args, mask, dropped, stats = self.exchange.dispatch(
+                arena, rows, args, base)
+            # the ORIGINAL inject stamp rides the check: overflow lanes
+            # redeliver with it, so their recorded latency includes the
+            # redelivery wait (min over the group's stamped batches —
+            # conservative when a rare multi-batch group mixes ticks)
+            inj = min((b.inject_tick for b in batches
+                       if b.inject_tick >= 0), default=-1)
+            self._exchange_checks.append(_ExchangeCheck(
+                type_name=type_name, method=method, keys=keys_cat,
+                args=orig_args, dropped=dropped, stats=stats,
+                inject_tick=inj))
+            if ledger.enabled and inj >= 0:
+                # post-exchange accounting: exactly the lanes delivered
+                # this tick (dropped lanes count at redelivery)
+                ledger.record_rows(type_name, method,
+                                   self.tick_number - inj, rows, mask)
+            exchanged = True
+            stages["exchange"] += time.perf_counter() - t_x
         t_apply = time.perf_counter()
-        stages["resolve"] += t_apply - t_res
 
         step = self._get_step(info, method)
         if mask is None:
@@ -1548,7 +1705,12 @@ class TensorEngine:
         # device is deliberately NOT in the key: jit caches on avals, so
         # an np batch and a device batch of the same shape share one
         # compile (a host/device split would record phantom events).
-        sig = (info.name, method, int(len(rows)), arena.capacity)
+        # The exchange flag IS in the key: an exchanged batch's lanes
+        # are a different transport shape, and a live exchange toggle
+        # re-specializing a seen (type, method, m) must be attributed
+        # (cause cross_shard), not read as organic shape churn.
+        sig = (info.name, method, int(len(rows)), arena.capacity,
+               exchanged)
         if sig in self._seen_steps:
             new_state, results, emits = step(arena.state, rows, args, mask)
         else:
@@ -1627,9 +1789,10 @@ class TensorEngine:
         already seen under a DIFFERENT arena capacity recompiles because
         the arena grew/repacked (state column shapes ARE the capacity);
         a never-seen (type, method) is genuinely new; a host batch above
-        every rung seen for its method grew the padding bucket; anything
-        else is a new batch shape."""
-        _t, _m, m, _cap = sig
+        every rung seen for its method grew the padding bucket; a seen
+        shape re-specializing under the OTHER cross-shard-exchange flag
+        is the exchange toggle; anything else is a new batch shape."""
+        _t, _m, m, _cap, xch = sig
         if (type_name, method, m) in self._reshard_forgotten:
             self._reshard_forgotten.discard((type_name, method, m))
             return CAUSE_MESH_RESHARD
@@ -1637,9 +1800,20 @@ class TensorEngine:
                        if s[0] == type_name and s[1] == method]
         if not seen_method:
             return CAUSE_NEW_METHOD
-        if any(s[2] == m for s in seen_method):
-            # same batch shape, different capacity: the arena repacked
+        if any(s[2] == m and s[4] == xch for s in seen_method):
+            # same batch shape + exchange flag, different capacity: the
+            # arena repacked
             return CAUSE_GENERATION_REPACK
+        if (xch or not is_host) \
+                and xch not in {s[4] for s in seen_method}:
+            # first compile of this method under the OTHER exchange
+            # flag: the toggle re-specialized it (exchanged widths are
+            # padded transport shapes, so the lane count changes too —
+            # without this check the toggle would read as organic shape
+            # churn).  Host batches never exchange by design, so an
+            # unexchanged HOST compile for an exchanged-only method is
+            # organic traffic, not a toggle.
+            return CAUSE_CROSS_SHARD
         if is_host and m > max(s[2] for s in seen_method):
             return CAUSE_BUCKET_GROWTH
         return CAUSE_SHAPE_CHANGE
@@ -1720,6 +1894,9 @@ class TensorEngine:
             "collection": self.collector.snapshot(),
             "fragmentation": {name: round(a.fragmentation(), 4)
                               for name, a in self.arenas.items()},
+            # cross-shard routing plane (tensor/exchange.py); None off-mesh
+            "exchange": self.exchange.snapshot()
+            if self.exchange is not None else None,
             # ledger health only (no device transfer here — the bucket
             # counts come from engine.ledger.snapshot(), which pays the
             # ONE d2h fetch explicitly)

@@ -1,0 +1,319 @@
+"""ShardExchange: on-device cross-shard message routing over the mesh.
+
+The arena is mesh-sharded (the directory's consistent-hash assignment IS
+the shard-block map — arena.py, runtime/ring.py), but until now a batch's
+scatter into rows owned by OTHER shards was left to XLA's implicit
+collectives: every `state.at[rows].set` over a sharded column turns into
+unstructured gather/scatter communication, re-planned per kernel.  This
+module makes the cross-shard hop an EXPLICIT, structured exchange — the
+device analog of the cross-silo slab path (tensor/router.py), so the
+8-device mesh runs as one logical cluster with host transport reserved
+for true cross-process hops:
+
+1. **bucket** — each shard classifies its slice of the batch by
+   destination shard (``rows // shard_capacity``; identical to the
+   directory's `shard_of_keys` hash by construction — the agreement is
+   property-tested) and packs messages into a ``[n_shards, cap]`` send
+   buffer, ``cap`` pow2-padded so compile count stays O(log n) under
+   varying load;
+2. **exchange** — ONE ``lax.all_to_all`` over the mesh axis moves every
+   bucket to its owner (inside the compiled program: the fused window
+   threads this through its ``lax.scan``);
+3. **fold** — the received lanes carry rows that are all shard-local, so
+   the existing step kernel's scatter/segment-sum applies them without
+   further communication.
+
+Exactness across the bounded buckets: a lane that does not fit its
+bucket (``cap`` overflow under skew) is never silently lost — the
+send side computes a per-lane ``dropped`` mask, the engine parks it like
+an optimistic miss-check, and the dropped lanes re-deliver next tick
+through the exact same path with their ORIGINAL ``inject_tick`` stamp
+(the latency ledger therefore includes the redelivery wait, same
+contract as the miss path).  Inside a fused window the dropped count
+folds into the window's miss counter instead: a nonzero count fails
+``verify()`` and the auto-fuser rolls back and replays unfused —
+transparency never costs exactness.
+
+Ordering caveat (same as host-batch padding): the exchange permutes lane
+order within a (type, method) batch.  Delivery SETS are preserved
+exactly; handlers that resolve duplicate-row writes by lane order
+(``scatter_rows`` with duplicate destinations) are order-sensitive and
+should combine with ``seg_*`` instead — the contract vector_grain.py
+already states for fan-in.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import PartitionSpec
+
+
+def pow2ceil(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+class ShardExchange:
+    """Per-engine exchange plane: builds and caches the jitted exchange
+    programs (one per (batch size, capacity, shard layout) — batch sizes
+    are stable in steady state, and ``cap`` is pow2-padded) and holds the
+    device-side stat accumulators the engine drains at quiescence.
+
+    ``capacity_factor`` sizes the per-(src, dst) bucket relative to the
+    uniform share ``L / n_shards``: 2.0 tolerates 2x destination skew
+    before any lane overflows into redelivery.  ``pad_quantum`` floors
+    the bucket so tiny batches don't churn compiles."""
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        self.mesh = engine.mesh
+        self.axis = engine.config.mesh_axis
+        self.n_shards = engine.n_shards
+        # cumulative stats (folded from device at drain points)
+        self.exchanges_run = 0
+        self.cross_shard_msgs = 0
+        self.delivered_msgs = 0
+        self.dropped_msgs = 0
+        self.redeliveries = 0
+        self.exchange_seconds = 0.0
+        self._jit_cache: Dict[Tuple[int, int, int], Any] = {}
+
+    def adopt_stats(self, prev: "Optional[ShardExchange]") -> None:
+        """Carry cumulative counters across a mesh reshard (the engine
+        rebuilds the exchange; the perf trajectory must not reset)."""
+        if prev is None:
+            return
+        self.exchanges_run = prev.exchanges_run
+        self.cross_shard_msgs = prev.cross_shard_msgs
+        self.delivered_msgs = prev.delivered_msgs
+        self.dropped_msgs = prev.dropped_msgs
+        self.redeliveries = prev.redeliveries
+        self.exchange_seconds = prev.exchange_seconds
+
+    # -- planning ------------------------------------------------------------
+
+    def plan(self, m: int) -> Tuple[int, int]:
+        """(per-shard lanes L, per-(src,dst) bucket cap) for an m-lane
+        batch.  Both pow2 so the compile set under varying batch sizes is
+        O(log n); cap is clamped to L (a bucket can never need more than
+        one shard's whole slice)."""
+        n = self.n_shards
+        cfg = self.engine.config
+        L = pow2ceil(-(-m // n))
+        cap = min(L, pow2ceil(max(
+            int(cfg.exchange_pad_quantum),
+            int(L / n * cfg.exchange_capacity_factor))))
+        return L, cap
+
+    # -- the per-shard program (pure jax; traced into jit or a fused scan) ---
+
+    def _traced(self, rows, leaves: List[Any], mask, shard_capacity: int,
+                L: int, cap: int):
+        """The exchange body at padded size ``n * L``: returns
+        ``(recv_rows, recv_leaves, recv_mask, dropped, stats)`` where
+        ``dropped`` is a bool[n*L] mask in INPUT lane order (slice back
+        to m) and ``stats`` is an int32[3] (cross_shard, dropped,
+        delivered) summed over shards."""
+        from jax.experimental.shard_map import shard_map
+
+        n = self.n_shards
+        axis = self.axis
+        m_pad = n * L
+        W = pow2ceil(L + n * cap)  # output lanes per shard
+
+        def pad_to(x, fill):
+            if x.shape[0] == m_pad:
+                return x
+            widths = [(0, m_pad - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, widths, constant_values=fill)
+
+        rows = pad_to(jnp.asarray(rows, jnp.int32), -1)
+        mask = pad_to(jnp.asarray(mask, bool), False)
+        leaves = [pad_to(jnp.asarray(x), 0) for x in leaves]
+
+        def per_shard(rows_l, mask_l, *leaves_l):
+            my = jax.lax.axis_index(axis)
+            valid = mask_l & (rows_l >= 0)
+            # destination shard straight from the row-block layout — the
+            # same function as the directory's shard_of_keys (arena rows
+            # are allocated in the key's home block; property-tested)
+            dest = jnp.where(valid, rows_l // shard_capacity, n)
+            # lanes already home stay IN PLACE (first L output lanes):
+            # the all_to_all carries only cross-shard traffic, so its
+            # volume — and the bucket pressure `cap` must absorb —
+            # scales with the cross-shard ratio, not the batch size
+            local = valid & (dest == my)
+            sdest_in = jnp.where(valid & ~local, dest, n)
+            order = jnp.argsort(sdest_in)  # ties keep relative order
+            sdest = sdest_in[order]
+            start = jnp.searchsorted(sdest,
+                                     jnp.arange(n, dtype=sdest.dtype))
+            pos = jnp.arange(L) - start[jnp.clip(sdest, 0, n - 1)]
+            fits = (sdest < n) & (pos < cap)
+            # out-of-range slot + mode="drop": invalid/overflow lanes
+            # scatter nowhere
+            slot = jnp.where(fits, sdest * cap + pos, n * cap)
+            send_rows = jnp.full(n * cap, -1, jnp.int32) \
+                .at[slot].set(rows_l[order], mode="drop")
+
+            def bucket(leaf):
+                s = leaf[order]
+                out = jnp.zeros((n * cap,) + s.shape[1:], s.dtype)
+                return out.at[slot].set(s, mode="drop")
+
+            send_leaves = [bucket(x) for x in leaves_l]
+
+            def a2a(x):
+                r = jax.lax.all_to_all(
+                    x.reshape((n, cap) + x.shape[1:]), axis,
+                    split_axis=0, concat_axis=0)
+                return r.reshape((n * cap,) + x.shape[2:])
+
+            # output per-shard width pads to pow2: a DOWNSTREAM exchange
+            # (the emit leg of this batch) re-slices the global output
+            # into pow2 per-shard runs, and only a pow2 width keeps
+            # those slices aligned with THIS exchange's shard boundaries
+            # — misaligned slices would re-cross lanes that are already
+            # home (correct but wasteful; the accounting test pins it)
+            tail = W - (L + n * cap)
+            recv_rows = jnp.concatenate(
+                [jnp.where(local, rows_l, -1), a2a(send_rows),
+                 jnp.full(tail, -1, jnp.int32)])
+            recv_leaves = [
+                jnp.concatenate(
+                    [x, a2a(s),
+                     jnp.zeros((tail,) + x.shape[1:], x.dtype)])
+                for x, s in zip(leaves_l, send_leaves)]
+            recv_mask = recv_rows >= 0
+            # dropped mask back in input lane order
+            dropped_sorted = (sdest < n) & (pos >= cap)
+            dropped_l = jnp.zeros(L, bool).at[order].set(dropped_sorted)
+            n_dropped = jnp.sum(dropped_sorted.astype(jnp.int32))
+            stats = jnp.stack([
+                jnp.sum((valid & ~local).astype(jnp.int32)),
+                n_dropped,
+                jnp.sum(valid.astype(jnp.int32)) - n_dropped,
+            ])[None, :]  # [1, 3]: per-shard partial, summed outside
+            return (recv_rows, recv_mask, dropped_l, stats, *recv_leaves)
+
+        P = PartitionSpec
+        sharded = P(axis)
+        out_specs = (sharded, sharded, sharded, sharded) \
+            + (sharded,) * len(leaves)
+        fn = shard_map(per_shard, mesh=self.mesh,
+                       in_specs=(sharded, sharded) + (sharded,) * len(leaves),
+                       out_specs=out_specs, check_rep=False)
+        recv_rows, recv_mask, dropped, stats, *recv_leaves = fn(
+            rows, mask, *leaves)
+        return (recv_rows, recv_leaves, recv_mask, dropped,
+                jnp.sum(stats, axis=0))
+
+    # -- fused-path entry (called under an active trace) ---------------------
+
+    def apply_traced(self, shard_capacity: int, rows, args: Any, mask):
+        """Exchange inside a fused window trace: returns
+        ``(rows2, args2, mask2, dropped_count)`` — the dropped count
+        folds into the window's device-side miss counter so a capacity
+        overflow fails ``verify()`` (rollback + unfused replay) instead
+        of losing lanes.  A group whose args are not lane-aligned (slab
+        -style handlers consuming a whole buffer per tick, e.g. the
+        twitter dispatcher) passes through untouched — permuting rows
+        away from such args would break the handler's row↔buffer
+        correspondence."""
+        m = rows.shape[0]
+        if not exchangeable_args(args, m):
+            return rows, args, mask, jnp.int32(0)
+        L, cap = self.plan(m)
+        leaves, treedef, scalar_ix = _split_leaves(args, m)
+        rows2, leaves2, mask2, _dropped, stats = self._traced(
+            rows, leaves, mask, shard_capacity, L, cap)
+        args2 = _join_leaves(treedef, scalar_ix, leaves2)
+        return rows2, args2, mask2, stats[1]
+
+    # -- unfused-path entry (jitted dispatch; stats parked on device) --------
+
+    def dispatch(self, arena, rows, args: Any, mask):
+        """One async exchange dispatch for an unfused batch.  Returns
+        ``(rows2, args2, mask2, dropped_mask, stats)`` with the dropped
+        mask and the int32[3] stats still ON DEVICE — the engine parks
+        them (like a miss-check) and reads everything in one batched
+        transfer at the next quiescence point."""
+        t0 = time.perf_counter()
+        m = int(rows.shape[0])
+        shard_capacity = int(arena.shard_capacity)
+        L, cap = self.plan(m)
+        leaves, treedef, scalar_ix = _split_leaves(args, m)
+        key = (L, cap, shard_capacity, len(leaves))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            def call(rows, mask, *leaves):
+                return self._traced(rows, list(leaves), mask,
+                                    shard_capacity, L, cap)
+            fn = jax.jit(call)
+            self._jit_cache[key] = fn
+        rows2, leaves2, mask2, dropped, stats = fn(
+            jnp.asarray(rows), mask, *leaves)
+        args2 = _join_leaves(treedef, scalar_ix, leaves2)
+        self.exchanges_run += 1
+        self.exchange_seconds += time.perf_counter() - t0
+        return rows2, args2, mask2, dropped[:m], stats
+
+    def fold_stats(self, stats_host: np.ndarray) -> None:
+        """Accumulate one drained [3] stats vector."""
+        self.cross_shard_msgs += int(stats_host[0])
+        self.dropped_msgs += int(stats_host[1])
+        self.delivered_msgs += int(stats_host[2])
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "n_shards": self.n_shards,
+            "exchanges_run": self.exchanges_run,
+            "cross_shard_msgs": self.cross_shard_msgs,
+            "delivered_msgs": self.delivered_msgs,
+            "dropped_msgs": self.dropped_msgs,
+            "redeliveries": self.redeliveries,
+            "exchange_seconds": round(self.exchange_seconds, 6),
+            "compiled_programs": len(self._jit_cache),
+        }
+
+
+def exchangeable_args(args: Any, m: int) -> bool:
+    """True when every non-scalar arg leaf is lane-aligned ([m, ...]) —
+    the precondition for permuting lanes.  Slab-style handlers (args
+    consumed as a whole buffer, not per lane) fail this and keep the
+    legacy path."""
+    return all(np.ndim(leaf) == 0 or np.shape(leaf)[0] == m
+               for leaf in jax.tree_util.tree_leaves(args))
+
+
+def _split_leaves(args: Any, m: int):
+    """Flatten an args pytree into (exchangeable [m, ...] leaves,
+    treedef, scalar positions).  Scalar leaves broadcast in the kernels
+    and are uniform across lanes, so they bypass the exchange."""
+    flat, treedef = jax.tree_util.tree_flatten(args)
+    leaves: List[Any] = []
+    scalar_ix: Dict[int, Any] = {}
+    for i, leaf in enumerate(flat):
+        if np.ndim(leaf) == 0:
+            scalar_ix[i] = leaf
+        else:
+            if np.shape(leaf)[0] != m:
+                raise ValueError(
+                    f"exchange: arg leaf {i} has leading dim "
+                    f"{np.shape(leaf)[0]}, batch has {m} lanes")
+            leaves.append(leaf)
+    return leaves, treedef, scalar_ix
+
+
+def _join_leaves(treedef, scalar_ix: Dict[int, Any],
+                 leaves: List[Any]) -> Any:
+    flat: List[Any] = []
+    it = iter(leaves)
+    for i in range(treedef.num_leaves):
+        flat.append(scalar_ix[i] if i in scalar_ix else next(it))
+    return jax.tree_util.tree_unflatten(treedef, flat)

@@ -139,6 +139,13 @@ class FusedTickProgram:
         # (bench.py's device-ledger points measure exactly that).
         self._ledger_on = False
         self._hist_shape: "Tuple[int, int] | None" = None
+        # cross-shard exchange (tensor/exchange.py): baked at build time
+        # like the ledger — the window threads the all_to_all through
+        # its scan; a live toggle re-traces (cause config_toggle).
+        # In-window bucket overflows fold into the miss counter, so a
+        # skewed window fails verify() and replays unfused (exactness
+        # over throughput, the standing fused contract).
+        self._exchange_on = False
         # donate=False keeps the pre-run state buffers valid after the
         # window executes, so a caller that may need to ROLL BACK (the
         # auto-fuser) gets its snapshot for free — eager device copies
@@ -202,6 +209,19 @@ class FusedTickProgram:
             states[type_name] = self.engine.arena_for(type_name).state
             self._note_arena(type_name, self.engine.arena_for(type_name))
         n_rows = next(iter(states[type_name].values())).shape[0]
+        miss_total = jnp.int32(0)
+        xch = self.engine.exchange
+        if self._exchange_on and xch is not None:
+            arena = self.engine.arena_for(type_name)
+            if arena.sharding is not None:
+                # cross-shard exchange INSIDE the window: sources and
+                # recursed emit deliveries alike arrive shard-local at
+                # their kernel; bucket-overflow lanes count as misses
+                # (the window is then non-exact and replays unfused —
+                # no in-window redelivery path exists by design)
+                rows, args, mask, dropped = xch.apply_traced(
+                    int(arena.shard_capacity), rows, args, mask)
+                miss_total = miss_total + dropped
         # named_scope labels the window HLO for jax.profiler deep
         # captures (tensor/profiler.py) — trace-time only
         with jax.named_scope(f"orleans.fused.{type_name}.{method}"):
@@ -220,7 +240,6 @@ class FusedTickProgram:
             hist = _ledger.accumulate(
                 hist, jnp.int32(slot), jnp.zeros(m, jnp.int32),
                 jnp.asarray(mask, bool))
-        miss_total = jnp.int32(0)
         delivered = jnp.int32(0)
         at_cap = depth >= self.engine.config.max_rounds_per_tick
 
@@ -311,6 +330,8 @@ class FusedTickProgram:
         # the compiled signature, so prepare() re-traces when it changes
         self._ledger_on = self.engine.ledger.enabled
         self._hist_shape = (MAX_SLOTS, self.engine.ledger.n_buckets)
+        # cross-shard exchange: same bake-at-build discipline
+        self._exchange_on = self.engine._exchange_live()
 
         def apply_all(states, per_source_args, hist):
             miss_tot = jnp.int32(0)
@@ -415,7 +436,8 @@ class FusedTickProgram:
                  for n, e in self._epochs.items()):
             cause = CAUSE_EPOCH_MISMATCH
         elif self._hist_shape != (MAX_SLOTS, engine.ledger.n_buckets) \
-                or self._ledger_on != engine.ledger.enabled:
+                or self._ledger_on != engine.ledger.enabled \
+                or self._exchange_on != engine._exchange_live():
             cause = CAUSE_CONFIG_TOGGLE
         if cause is not None:
             for s in self.sources:

@@ -57,8 +57,10 @@ from orleans_tpu.config import ProfilerConfig
 # phase model
 # ---------------------------------------------------------------------------
 
-#: canonical tick phases, in pipeline order
-PHASES = ("host", "h2d", "dispatch", "route", "d2h")
+#: canonical tick phases, in pipeline order.  ``exchange`` is the
+#: cross-shard stage (tensor/exchange.py): bucket-by-destination-shard +
+#: all_to_all dispatch between resolution and the step kernel.
+PHASES = ("host", "h2d", "exchange", "dispatch", "route", "d2h")
 
 #: engine stage-timer key → canonical phase.  Stages are disjoint
 #: perf_counter segments inside run_tick, so their sum never exceeds the
@@ -68,6 +70,7 @@ STAGE_TO_PHASE: Dict[str, str] = {
     "fanout": "host",        # subscription expansion bookkeeping
     "miss_checks": "host",   # optimistic-resolution drain
     "resolve": "h2d",        # coalesce + pad + destination resolution
+    "exchange": "exchange",  # cross-shard all_to_all dispatch
     "apply": "dispatch",     # step-program dispatch (kernel)
     "route": "route",        # emit routing / fan-out enqueue
     "results": "d2h",        # explicit result delivery
@@ -328,11 +331,13 @@ CAUSE_GENERATION_REPACK = "generation_repack"  # rows moved (grow/compact)
 CAUSE_CONFIG_TOGGLE = "config_toggle"      # ledger/config live-reload re-trace
 CAUSE_MESH_RESHARD = "mesh_reshard"        # mesh change dropped compiled steps
 CAUSE_NEW_WINDOW = "new_window"            # first build of a fused window
+CAUSE_CROSS_SHARD = "cross_shard"          # exchange toggle re-specialized a
+#                                            seen (type, method, m) step
 
 COMPILE_CAUSES = (
     CAUSE_NEW_METHOD, CAUSE_BUCKET_GROWTH, CAUSE_SHAPE_CHANGE,
     CAUSE_EPOCH_MISMATCH, CAUSE_GENERATION_REPACK, CAUSE_CONFIG_TOGGLE,
-    CAUSE_MESH_RESHARD, CAUSE_NEW_WINDOW,
+    CAUSE_MESH_RESHARD, CAUSE_NEW_WINDOW, CAUSE_CROSS_SHARD,
 )
 
 

@@ -1,0 +1,213 @@
+"""Cross-shard routing workload — the multichip bench's ratio sweep.
+
+A deliberately minimal two-type pipeline whose ONE tunable is the
+fraction of traffic that crosses mesh shards: ``RouteSource.send``
+updates the source row and emits one message per lane to a
+``RouteSink`` key chosen so that exactly ``cross_ratio`` of the
+destinations live in a DIFFERENT shard block than their source (the
+shared shard-of-key hash — tensor/arena.shard_of_keys — makes the
+construction exact, not statistical).  Both kernels combine with
+``seg_sum``, so delivery is order-free and the exchange's lane
+permutation cannot perturb results: state equality against an
+exchange-off replay is exact (integer-valued float payloads — no
+float-reassociation noise either).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.core.grain import batched_method
+from orleans_tpu.tensor import (
+    Batch,
+    Emit,
+    VectorGrain,
+    field,
+    seg_sum,
+    vector_grain,
+)
+from orleans_tpu.tensor.vector_grain import scatter_add_rows
+from orleans_tpu.tensor.arena import shard_of_keys
+
+#: sink keys start here (disjoint from the source key space so the two
+#: arenas never alias); bench/test readers derive the sink set from it
+SINK_BASE = 1 << 20
+
+
+def sink_keys(n_sinks: int) -> np.ndarray:
+    return np.arange(SINK_BASE, SINK_BASE + n_sinks, dtype=np.int64)
+
+
+@vector_grain
+class RouteSource(VectorGrain):
+    """Per-producer state: counts its own sends."""
+
+    sent = field(jnp.int32, 0)
+
+    @batched_method
+    @staticmethod
+    def send(state, batch: Batch, n_rows: int):
+        rows, args = batch.rows, batch.args
+        ones = jnp.ones(rows.shape[0], jnp.int32)
+        state = {**state,
+                 "sent": scatter_add_rows(state["sent"], rows, ones)}
+        emit = Emit(interface="RouteSink", method="recv",
+                    keys=args["dst"],
+                    args={"v": args["v"], "count": ones},
+                    mask=batch.mask)
+        return state, None, (emit,)
+
+
+@vector_grain
+class RouteSink(VectorGrain):
+    """Per-consumer aggregate (order-free fan-in)."""
+
+    total = field(jnp.float32, 0.0)
+    received = field(jnp.int32, 0)
+
+    @batched_method
+    @staticmethod
+    def recv(state, batch: Batch, n_rows: int):
+        rows, args = batch.rows, batch.args
+        return {**state,
+                "total": state["total"]
+                + seg_sum(args["v"], rows, n_rows),
+                "received": state["received"]
+                + seg_sum(args["count"], rows, n_rows)}
+
+
+def build_ratio_destinations(sources: np.ndarray, sinks: np.ndarray,
+                             n_shards: int, cross_ratio: float,
+                             seed: int = 0) -> np.ndarray:
+    """One destination sink key per source, with EXACTLY
+    ``round(cross_ratio * n)`` of them in a different shard than their
+    source (by the canonical shard-of-key hash).  Requires every shard
+    to hold at least one sink — size ``sinks`` generously."""
+    rng = np.random.default_rng(seed)
+    src_shard = shard_of_keys(sources, n_shards)
+    sink_shard = shard_of_keys(sinks, n_shards)
+    by_shard = [sinks[sink_shard == s] for s in range(n_shards)]
+    if any(len(b) == 0 for b in by_shard):
+        raise ValueError("every shard needs at least one sink key")
+    n = len(sources)
+    cross = np.zeros(n, dtype=bool)
+    n_cross = int(round(cross_ratio * n))
+    cross[rng.choice(n, size=n_cross, replace=False)] = True
+    dst = np.empty(n, dtype=np.int64)
+    for s in range(n_shards):
+        mine = src_shard == s
+        # same-shard picks come from the source's own block; cross picks
+        # from a uniformly random OTHER block
+        local_pool = by_shard[s]
+        idx = np.nonzero(mine & ~cross)[0]
+        dst[idx] = local_pool[rng.integers(0, len(local_pool), len(idx))]
+        idx = np.nonzero(mine & cross)[0]
+        if len(idx):
+            others = rng.integers(0, n_shards - 1, len(idx))
+            others = others + (others >= s)
+            for o in range(n_shards):
+                sel = idx[others == o]
+                if len(sel):
+                    pool = by_shard[o]
+                    dst[sel] = pool[rng.integers(0, len(pool), len(sel))]
+    return dst
+
+
+async def run_routing_load(engine, n_sources: int, n_sinks: int,
+                           cross_ratio: float, n_ticks: int = 10,
+                           seed: int = 0, warm_ticks: int = 2,
+                           fused_window: int = 0
+                           ) -> Dict[str, float]:
+    """Drive ``n_ticks`` of the routing pipeline at a fixed cross-shard
+    ratio; returns stats (2 logical messages per source per tick: the
+    source send + the sink delivery).  ``fused_window > 0`` runs the
+    steady state through ``engine.fuse_ticks`` windows of that length
+    (exactness asserted via the window miss counter); 0 drives the
+    unfused tick loop through a cached injector."""
+    import jax as _jax
+
+    sources = np.arange(n_sources, dtype=np.int64)
+    sinks = sink_keys(n_sinks)
+    dst = build_ratio_destinations(sources, sinks, engine.n_shards,
+                                   cross_ratio, seed=seed)
+    rng = np.random.default_rng(seed + 1)
+    # integer-valued floats: seg_sum order cannot perturb the total
+    values = rng.integers(1, 8, n_sources).astype(np.float32)
+
+    engine.arena_for("RouteSource").reserve(n_sources)
+    engine.arena_for("RouteSink").reserve(n_sinks)
+    engine.arena_for("RouteSource").resolve_rows(sources)
+    engine.arena_for("RouteSink").resolve_rows(sinks)
+
+    sink_arena = engine.arena_for("RouteSink")
+    dst_d = jnp.asarray(dst.astype(np.int32))
+    values_d = jnp.asarray(values)
+
+    if fused_window > 0:
+        from orleans_tpu.tensor.fused import plan_windows
+        window, n_windows, n_ticks = plan_windows(fused_window, n_ticks)
+        prog = engine.fuse_ticks("RouteSource", "send", sources)
+        static = {"dst": dst_d, "v": values_d}
+        # warm window: compile outside the timed segment
+        prog.run({"tick": jnp.arange(window, dtype=jnp.int32)},
+                 static_args=static)
+        _jax.block_until_ready(sink_arena.state["total"])
+        t0 = time.perf_counter()
+        for w in range(n_windows):
+            prog.run({"tick": jnp.arange(window, dtype=jnp.int32)
+                      + (w + 1) * window}, static_args=static)
+        _jax.block_until_ready(sink_arena.state["total"])
+        elapsed = time.perf_counter() - t0
+        misses = prog.verify()
+        if misses:
+            raise RuntimeError(
+                f"fused routing window missed {misses} deliveries")
+        engine_kind = "fused"
+    else:
+        injector = engine.make_injector("RouteSource", "send", sources)
+
+        def args_for(t: int):
+            return {"dst": dst_d, "v": values_d, "tick": np.int32(t)}
+
+        for t in range(warm_ticks):
+            injector.inject(args_for(t))
+            await engine.drain_queues()
+        await engine.flush()
+        _jax.block_until_ready(sink_arena.state["total"])
+        t0 = time.perf_counter()
+        for t in range(n_ticks):
+            injector.inject(args_for(warm_ticks + t))
+            await engine.drain_queues()
+        await engine.flush()
+        _jax.block_until_ready(sink_arena.state["total"])
+        elapsed = time.perf_counter() - t0
+        engine_kind = "unfused"
+
+    messages = 2 * n_sources * n_ticks
+    return {
+        "sources": n_sources,
+        "sinks": n_sinks,
+        "cross_ratio": cross_ratio,
+        "ticks": n_ticks,
+        "seconds": elapsed,
+        "messages": messages,
+        "messages_per_sec": messages / elapsed,
+        "engine": engine_kind,
+    }
+
+
+def expected_sink_state(sources: np.ndarray, dst: np.ndarray,
+                        values: np.ndarray, sinks: np.ndarray,
+                        n_ticks: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side ground truth: (total float64, received int64) per sink
+    key, for exactness assertions against any engine configuration."""
+    order = np.searchsorted(sinks, dst)
+    total = np.zeros(len(sinks), np.float64)
+    np.add.at(total, order, values.astype(np.float64))
+    received = np.zeros(len(sinks), np.int64)
+    np.add.at(received, order, 1)
+    return total * n_ticks, received * n_ticks
