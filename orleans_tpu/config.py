@@ -199,14 +199,31 @@ class TensorEngineConfig:
     target_tick_latency: float = 0.0
     tick_interval_min: float = 0.0002
     tick_interval_max: float = 0.05
-    # the rig's completion-observation floor (measure_sync_floor):
-    # subtracted from measured tick durations before the adaptive
-    # controller compares them to the budget.  On tunneled runtimes raw
-    # durations are floored at ~100ms — above any tight budget — which
-    # would pin the interval at min and starve batch growth; the
-    # controller must steer ENGINE latency, not measurement artifact.
-    # 0 (direct-attached rigs) = strict raw comparison.
-    observation_floor: float = 0.0
+    # continuous pipelined ticking (engine.TickPipeline): how many
+    # dispatched ticks may be awaiting their device COMPLETION EVENT
+    # before the loop backpressures on the oldest one.  1 = the legacy
+    # serialized loop; 2 double-buffers — tick N+1's dispatch (and its
+    # staged h2d) overlaps tick N's device execution, which donated
+    # state buffers make safe.  Completion is observed event-driven (an
+    # executor thread resolves a future on the tick's FENCE output the
+    # moment the device signals), never by polling.  Live-reloadable.
+    pipeline_depth: int = 2
+    # the honest 10ms mode: pace the loop by completion events at the
+    # minimum accumulation interval instead of the throughput-biased
+    # adaptive/fixed sleep.  Live-reloadable.
+    low_latency: bool = False
+    # step/fused programs take the arena state columns as DONATED
+    # inputs (jax donate_argnums), so XLA double-buffers in place and
+    # back-to-back ticks never serialize on a host round-trip.  Off =
+    # the undonated serial baseline the exactness A/B replays against
+    # (bench.py --workload latency); rollback pins copy-before-donate.
+    # A live toggle re-traces step programs (cause config_toggle).
+    donate_state: bool = True
+    # overlapped h2d: BatchInjector.stage() (and the auto-fuser's
+    # window buffering) device_put the NEXT tick's injection slabs
+    # while the current tick computes, so the transfer rides under
+    # device execution instead of serializing before dispatch.
+    overlap_h2d: bool = True
     # ring buffer of recent per-tick durations backing latency percentiles
     latency_window: int = 1024
     # tensor-path activation collection (reference: ActivationCollector

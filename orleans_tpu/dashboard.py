@@ -48,13 +48,39 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
     merged half; ``silos_info`` adds the live per-silo rows when the
     caller has them)."""
     merged = merge_snapshots(snapshots)
+    # the latency row: device-ledger percentiles in ticks AND seconds
+    # (ticks x the cluster's amortized seconds-per-tick), judged against
+    # the live latency budget — honored state beside the numbers
+    ticks_total = _counter_total(merged, "engine.ticks")
+    spt = (_counter_total(merged, "engine.tick_seconds") / ticks_total
+           if ticks_total > 0 else 0.0)
+    budget = max((v for by_src in merged.get("gauges", {})
+                  .get("engine.latency_budget_s", {}).values()
+                  for v in by_src.values()), default=0.0)
     latency: Dict[str, Any] = {}
     for lk, hist in merged.get("histograms", {}) \
                           .get("engine.latency_ticks", {}).items():
         method = lk.split("=", 1)[1] if "=" in lk else (lk or "all")
-        latency[method] = {"total": hist["total"],
-                           **{k: round(v, 3) for k, v in
-                              histogram_percentiles(hist).items()}}
+        ps = histogram_percentiles(hist)
+        row = {"total": hist["total"],
+               **{k: round(v, 3) for k, v in ps.items()},
+               "p50_s": round(ps.get("p50", 0.0) * spt, 6),
+               "p99_s": round(ps.get("p99", 0.0) * spt, 6)}
+        if budget > 0:
+            row["budget_s"] = budget
+            row["honored"] = bool(row["p99_s"] <= budget)
+        latency[method] = row
+    # continuous pipelined ticking: in-flight window + overlap credit +
+    # donation health (engine.TickPipeline)
+    pipeline = {
+        "inflight": int(max(
+            (v for by_src in merged.get("gauges", {})
+             .get("engine.inflight_ticks", {}).values()
+             for v in by_src.values()), default=0)),
+        "overlap_s": round(_counter_total(merged, "engine.overlap_s"), 4),
+        "donation_fallbacks": int(
+            _counter_total(merged, "engine.donation_fallbacks")),
+    }
     # host.turn_latency_s is emitted unlabeled today; merge across any
     # label sets a future emission adds rather than keeping just one
     turn = merged.get("histograms", {}).get("host.turn_latency_s", {})
@@ -125,6 +151,9 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                     _counter_total(merged, "route.exchange_s"), 4),
             },
             "latency_ticks": latency,
+            "latency_budget_s": budget,
+            "seconds_per_tick": round(spt, 6),
+            "pipeline": pipeline,
             "host_turn_latency_s": host_latency,
             "tick_phases": phases,
             "compile_causes": compiles,
@@ -196,11 +225,25 @@ def render_text(view: Dict[str, Any]) -> str:
             f"{xs['dropped_redelivered']} overflow-redelivered, "
             f"{xs['exchanges']} dispatches")
     if c["latency_ticks"]:
-        lines.append("latency (device ticks, per type.method):")
+        budget = c.get("latency_budget_s", 0.0)
+        header = "latency (device ledger, per type.method"
+        header += f"; budget={budget}s):" if budget > 0 else "):"
+        lines.append(header)
         for method, ps in sorted(c["latency_ticks"].items()):
-            lines.append(
-                f"  {method}: p50={ps['p50']} p95={ps['p95']} "
-                f"p99={ps['p99']} (n={ps['total']})")
+            row = (f"  {method}: p50={ps['p50']} p99={ps['p99']} ticks"
+                   f" (~p50={ps.get('p50_s', 0)}s"
+                   f" p99={ps.get('p99_s', 0)}s, n={ps['total']})")
+            if "honored" in ps:
+                row += " budget " + ("HONORED" if ps["honored"]
+                                     else "MISSED")
+            lines.append(row)
+    pl = c.get("pipeline", {})
+    if pl.get("overlap_s") or pl.get("inflight") \
+            or pl.get("donation_fallbacks"):
+        lines.append(
+            f"pipeline: inflight={pl.get('inflight', 0)} "
+            f"overlap={pl.get('overlap_s', 0)}s "
+            f"donation_fallbacks={pl.get('donation_fallbacks', 0)}")
     if c["host_turn_latency_s"]:
         ps = c["host_turn_latency_s"]
         lines.append(f"host turn latency: p50={ps['p50']}s "

@@ -45,17 +45,26 @@ STATUS_MISSING = "missing"
 
 DIRECTION_HIGHER = "higher"   # regression when current < base * (1 - tol)
 DIRECTION_LOWER = "lower"     # regression when current > base * (1 + tol)
+# a truth FLAG (e.g. honored_strict): regression whenever current <
+# baseline, tolerance IGNORED — an honored latency budget going
+# unhonored is always a failure; unhonored→honored is an improvement
+DIRECTION_FLAG = "flag"
 
 
-def resolve_path(obj: Any, path: str) -> Optional[float]:
+def resolve_path(obj: Any, path: str,
+                 allow_bool: bool = False) -> Optional[float]:
     """Walk a dotted path (``a.b.c``) through dicts; returns None when
-    any hop is absent or the leaf is not a number."""
+    any hop is absent or the leaf is not a number.  ``allow_bool``
+    (flag-direction metrics) maps True/False to 1.0/0.0 instead of
+    rejecting them."""
     cur = obj
     for part in path.split("."):
         if not isinstance(cur, dict) or part not in cur:
             return None
         cur = cur[part]
-    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+    if isinstance(cur, bool):
+        return (1.0 if cur else 0.0) if allow_bool else None
+    if not isinstance(cur, (int, float)):
         return None
     return float(cur)
 
@@ -84,6 +93,7 @@ FAMILIES: Dict[str, Tuple[str, str, Optional[str]]] = {
     "bench": ("BENCH", "metrics", None),
     "multichip": ("MULTICHIP", "multichip_metrics",
                   "MULTICHIP_BENCH.json"),
+    "latency": ("LATENCY", "latency_metrics", "LATENCY_BENCH.json"),
 }
 
 
@@ -92,7 +102,8 @@ def evaluate_metric(name: str, spec: Dict[str, Any],
     base = float(spec["value"])
     tol = float(spec.get("tolerance", 0.3))
     direction = spec.get("direction", DIRECTION_HIGHER)
-    current = resolve_path(artifact, spec["path"])
+    current = resolve_path(artifact, spec["path"],
+                           allow_bool=(direction == DIRECTION_FLAG))
     row: Dict[str, Any] = {
         "name": name, "path": spec["path"], "baseline": base,
         "current": current, "tolerance": tol, "direction": direction,
@@ -101,6 +112,13 @@ def evaluate_metric(name: str, spec: Dict[str, Any],
         row["status"] = STATUS_MISSING
         return row
     row["ratio"] = round(current / base, 4) if base else None
+    if direction == DIRECTION_FLAG:
+        # truth flag: tolerance NEVER widens this — a flag the baseline
+        # holds must stay held (honored→unhonored always fails);
+        # gaining a flag the baseline lacked passes
+        row["bound"] = base
+        row["status"] = STATUS_FAIL if current < base else STATUS_PASS
+        return row
     if direction == DIRECTION_LOWER:
         bound = base * (1.0 + tol)
         row["bound"] = bound
@@ -168,8 +186,9 @@ def render_markdown(verdict: Dict[str, Any],
         return f"{v:.4g}"
 
     for r in verdict["metrics"]:
-        band = (f"{'≤' if r['direction'] == DIRECTION_LOWER else '≥'} "
-                f"{fmt(r.get('bound'))}")
+        mark_dir = {DIRECTION_LOWER: "≤", DIRECTION_FLAG: "="} \
+            .get(r["direction"], "≥")
+        band = f"{mark_dir} {fmt(r.get('bound'))}"
         mark = {STATUS_PASS: "pass", STATUS_FAIL: "**FAIL**",
                 STATUS_MISSING: "missing"}[r["status"]]
         lines.append(
@@ -258,7 +277,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "'metrics'; 'multichip' compares the "
                              "structured multichip artifacts "
                              "(MULTICHIP_r*.json / MULTICHIP_BENCH"
-                             ".json) against 'multichip_metrics'")
+                             ".json) against 'multichip_metrics'; "
+                             "'latency' compares LATENCY_r*.json / "
+                             "LATENCY_BENCH.json against "
+                             "'latency_metrics' (honored flags use "
+                             "direction 'flag': honored→unhonored "
+                             "always fails)")
     args = parser.parse_args(argv)
 
     if not os.path.exists(args.baseline):

@@ -769,7 +769,14 @@ class Silo:
             emit({"messages_processed": eng.messages_processed,
                   "ticks": eng.ticks_run,
                   "compiles": eng.compile_count(),
-                  "tick_seconds": eng.tick_seconds}, None, "engine.")
+                  "tick_seconds": eng.tick_seconds,
+                  # continuous pipelined ticking (engine.TickPipeline):
+                  # in-flight window, overlap credit, donation health
+                  "inflight_ticks": eng.pipeline.inflight(),
+                  "overlap_s": eng.pipeline.overlap_seconds,
+                  "donation_fallbacks": eng.donation_fallbacks,
+                  "latency_budget_s": eng.config.target_tick_latency},
+                 None, "engine.")
             # compile-churn attribution: cause-coded counters replace
             # the bare compiles int as the actionable churn signal
             ct = eng.compile_tracker

@@ -137,6 +137,10 @@ class GrainArena:
 
         self.state: Dict[str, jnp.ndarray] = {}
         self._init_state_columns(self.capacity)
+        # double-buffer flips: times the engine swapped the live columns
+        # for a program's outputs (adopt_state) — with donated inputs
+        # the old buffers are gone the moment the swap happens
+        self.state_flips = 0
         # bumped whenever rows move (growth/repack); consumers holding
         # resolved row vectors must re-resolve on mismatch
         self.generation = 0
@@ -244,6 +248,32 @@ class GrainArena:
     def _init_state_columns(self, capacity: int) -> None:
         self.state = {name: self._make_column(f, capacity)
                       for name, f in self.info.state_fields.items()}
+
+    def adopt_state(self, new_state: Dict[str, Any]) -> None:
+        """Flip the live columns to a program's output buffers — the
+        double-buffer handoff of donated execution (the engine's step
+        and fused-window programs take the current columns as DONATED
+        inputs; their outputs become the live state).  Validates the
+        pytree layout cheaply (host-side shape/dtype attributes only):
+        a donated program must never smuggle in a wrong-shaped column,
+        because every cached row vector and directory mirror assumes
+        the capacity."""
+        if new_state is self.state:
+            return
+        for name, col in self.state.items():
+            new = new_state.get(name)
+            if new is None:
+                raise ValueError(
+                    f"adopt_state({self.info.name}): program output "
+                    f"dropped column {name!r}")
+            if tuple(new.shape) != tuple(col.shape) \
+                    or new.dtype != col.dtype:
+                raise ValueError(
+                    f"adopt_state({self.info.name}.{name}): output "
+                    f"{new.shape}/{new.dtype} != live "
+                    f"{col.shape}/{col.dtype}")
+        self.state = new_state
+        self.state_flips += 1
 
     # -- key → row resolution ----------------------------------------------
 

@@ -37,6 +37,17 @@ import jax.numpy as jnp
 import numpy as np
 
 
+@jax.jit
+def _pin_copy(cols):
+    """Copy-before-donate: one compiled device-side copy of an arena's
+    state columns, taken as the rollback pin BEFORE the first DONATED
+    window of a chain runs (the window consumes the live buffers, so a
+    by-reference snapshot would be reading donated-away memory at
+    rollback time).  One async dispatch — never an eager per-column
+    copy, which is ruinously slow on tunneled runtimes."""
+    return jax.tree_util.tree_map(jnp.copy, cols)
+
+
 class _PatternState:
     """Per-(type, method) detection/engagement state of one steady
     injection stream.  A tick's steady state may carry SEVERAL streams
@@ -281,11 +292,23 @@ class AutoFuser:
             return False  # nothing varies per tick: no window axis
         if self._program is None and not self._engage(sig, entries):
             return False
-        # consume this tick into the window buffer
+        # consume this tick into the window buffer.  Overlapped h2d
+        # (config.overlap_h2d): per-tick numpy slabs start their device
+        # copy NOW — the transfer rides under the currently-executing
+        # window instead of serializing into the next window's dispatch
+        # (stack_source then jnp.stacks device leaves, itself async).
+        overlap = cfg.overlap_h2d
+
+        def stage(v):
+            if overlap and isinstance(v, np.ndarray) and v.ndim:
+                return jax.device_put(v)
+            return v
+
         for key, _b, _args, _p in entries:
             self.engine.queues[key].clear()
         self._buffer.append([
-            {k: v for k, v in args.items() if k not in pat.static_keys}
+            {k: stage(v) for k, v in args.items()
+             if k not in pat.static_keys}
             for pat, (_key, _b, args, _p) in zip(self._patterns, entries)])
         if len(self._buffer) >= cfg.auto_fusion_window:
             self._run_window()
@@ -315,11 +338,16 @@ class AutoFuser:
                 self.engine,
                 [(key[0], key[1], b.keys_host)
                  for key, b, _args, _p in entries])
-            # no donation: the pre-run buffers stay valid, making the
-            # rollback snapshot a dict of references instead of device
-            # copies (see FusedTickProgram.donate)
-            prog.donate = False
+            # donation per config (the pipelined default): windows
+            # double-buffer state in place; the rollback snapshot is
+            # then a copy-before-donate device copy (_run_window).
+            # Undonated (the A/B baseline) the pre-run buffers stay
+            # valid and the snapshot is free references, as before.
             self._programs[sig] = prog
+        # (re-)pin the donation mode at engagement: a cached program
+        # compiled under the other mode re-traces in prepare() (cause
+        # config_toggle) before its first window runs
+        prog.donate = self.engine.config.donate_state
         for pat, (_key, _b, args, _p) in zip(self._patterns, entries):
             pat.static_args = {k: args[k] for k in pat.static_keys}
         if prog._compiled is None:
@@ -412,12 +440,36 @@ class AutoFuser:
         prog.prepare(stackeds if prog._is_multi() else stackeds[0],
                      statics if prog._is_multi() else statics[0])
         if self._chain_snapshot is None:
-            # chain start: the pre-run buffers ARE the snapshot — the
-            # programs never donate (see _engage), so these references
-            # stay valid until the chain settles
+            # chain start: the rollback pin.  Undonated programs leave
+            # the pre-run buffers valid, so plain references suffice.
+            # DONATED programs consume them — copy-before-donate: one
+            # compiled device-side copy per touched arena, taken
+            # before the first donated window of the chain runs, so a
+            # rollback never reads a donated-away buffer.
+            if prog.donate:
+                t_pin = time.perf_counter()
+                sizer = getattr(_pin_copy, "_cache_size", None)
+                pins0 = sizer() if callable(sizer) else None
+                snapshot = {n: dict(_pin_copy(engine.arena_for(n).state))
+                            for n in prog._touched}
+                if pins0 is not None and sizer() > pins0:
+                    # the pin's jit traced+compiled synchronously inside
+                    # the call (first donated chain over this column
+                    # structure, or a capacity grow) — attributed like
+                    # every other compile site; the cache-size delta
+                    # keeps cache hits from recording phantom events
+                    from orleans_tpu.tensor.profiler import \
+                        CAUSE_NEW_WINDOW
+                    engine.compile_tracker.record(
+                        CAUSE_NEW_WINDOW,
+                        key="pin_copy:" + "+".join(sorted(prog._touched)),
+                        seconds=time.perf_counter() - t_pin,
+                        tick=engine.tick_number)
+            else:
+                snapshot = {n: dict(engine.arena_for(n).state)
+                            for n in prog._touched}
             self._chain_prog = prog
-            self._chain_snapshot = {n: dict(engine.arena_for(n).state)
-                                    for n in prog._touched}
+            self._chain_snapshot = snapshot
             self._chain_counters = (engine.tick_number, engine.ticks_run,
                                     engine.messages_processed)
             self._chain_generations = {
@@ -512,7 +564,10 @@ class AutoFuser:
                 "snapshot is unrestorable")
         self.windows_rolled_back += 1
         for n, cols in snapshot.items():
-            engine.arena_for(n).state = cols
+            # restore the pin (a copy under donation — the donated
+            # buffers themselves are long gone, which is exactly why
+            # the pin was copied before the first donated run)
+            engine.arena_for(n).adopt_state(cols)
         (engine.tick_number, engine.ticks_run,
          engine.messages_processed) = counters
         if ledger_state is not None:

@@ -55,6 +55,7 @@ from orleans_tpu.tensor.ledger import DeviceLatencyLedger
 from orleans_tpu.tensor.memledger import DeviceMemoryLedger
 from orleans_tpu.tensor.profiler import (
     CAUSE_BUCKET_GROWTH,
+    CAUSE_CONFIG_TOGGLE,
     CAUSE_CROSS_SHARD,
     CAUSE_GENERATION_REPACK,
     CAUSE_MESH_RESHARD,
@@ -267,6 +268,140 @@ def _miss_keys_kernel(keys, rows, valid, miss_buf: int):
     missing = (rows < 0) & valid & (keys < KEY_SENTINEL)
     return jnp.unique(jnp.where(missing, keys, KEY_SENTINEL),
                       size=miss_buf, fill_value=KEY_SENTINEL), missing
+
+
+def _fence_block(fence) -> None:
+    """Executor-thread completion wait on a tick's FENCE output (a
+    1-lane array no program ever donates).  Blocking here converts the
+    device's completion signal into an asyncio future resolution — the
+    event-driven observation path; the dispatch path never blocks."""
+    try:
+        jax.block_until_ready(fence)
+    except RuntimeError as e:
+        # a DELETED fence can only mean a LATER program consumed the
+        # buffer — engine fences are never donated, so this covers
+        # exotic caller-supplied tokens; the work it fenced is done.
+        # Anything else (XlaRuntimeError subclasses RuntimeError: OOM,
+        # execution failure) is a real device failure and must surface
+        # through the completion future, never read as a completed tick
+        if "deleted" not in str(e).lower():
+            raise
+
+
+class TickPipeline:
+    """Continuous pipelined ticking: event-driven completion tracking
+    plus depth-bounded backpressure.
+
+    Every dispatched tick registers a completion future on its device
+    fence; an executor thread resolves it the moment the device
+    signals.  The engine loop (and the bench latency rig) lets up to
+    ``config.pipeline_depth`` ticks ride in flight before awaiting the
+    OLDEST completion, so tick N+1's dispatch — and its staged h2d
+    injection — overlaps tick N's device execution.  Donated state
+    buffers (``config.donate_state``) make the overlap safe: XLA
+    double-buffers the arena columns in place, and no host round-trip
+    ever serializes back-to-back ticks.
+
+    ``overlap_seconds`` accrues the device time that ran concurrently
+    with later host work (completion timestamp minus dispatch-return
+    timestamp) — the profiler's phase-reconciliation credit: pipelined
+    phases overlap, so host-side phase sums no longer tile wall time."""
+
+    def __init__(self, engine: "TensorEngine") -> None:
+        self.engine = engine
+        self._inflight: deque = deque()  # (tick, dispatched_at, future)
+        self.ticks_tracked = 0
+        self.completions = 0
+        self.waits = 0
+        self.wait_seconds = 0.0
+        self.overlap_seconds = 0.0
+        self.max_inflight = 0
+        self._tick_overlap = 0.0
+
+    @property
+    def depth(self) -> int:
+        return max(1, int(self.engine.config.pipeline_depth))
+
+    def inflight(self) -> int:
+        self._prune()
+        return len(self._inflight)
+
+    def _prune(self) -> int:
+        q = self._inflight
+        while q and q[0][2].done():
+            q.popleft()
+        return len(q)
+
+    def note_tick(self, fence, on_complete=None):
+        """Register completion tracking for the tick that just
+        dispatched ``fence``; returns the completion future (None when
+        nothing was registered).  No-op outside a running event loop
+        (sync drivers have nothing to resolve the future into).
+        ``on_complete(timestamp)``, when given, runs IN the executor
+        thread the moment the fence resolves — rigs timestamp the
+        device event there instead of blocking a SECOND thread on the
+        same fence."""
+        if fence is None:
+            return None
+        if on_complete is None:
+            work = partial(_fence_block, fence)
+        else:
+            def work(f=fence, cb=on_complete):
+                _fence_block(f)
+                cb(time.perf_counter())
+        try:
+            loop = asyncio.get_running_loop()
+            fut = loop.run_in_executor(None, work)
+        except RuntimeError:
+            return None  # no loop, or executor already shut down
+        dispatched = time.perf_counter()
+        self.ticks_tracked += 1
+
+        def _completed(_f, t0=dispatched) -> None:
+            self.completions += 1
+            overlap = max(0.0, time.perf_counter() - t0)
+            self.overlap_seconds += overlap
+            self._tick_overlap += overlap
+
+        fut.add_done_callback(_completed)
+        self._inflight.append((self.engine.tick_number, dispatched, fut))
+        self.max_inflight = max(self.max_inflight, len(self._inflight))
+        return fut
+
+    def take_tick_overlap(self) -> float:
+        """Overlap credit accrued since the last tick observed it
+        (consumed by the profiler's reconciliation)."""
+        o, self._tick_overlap = self._tick_overlap, 0.0
+        return o
+
+    async def throttle(self) -> None:
+        """Backpressure: await oldest completions until fewer than
+        ``depth`` ticks are in flight.  This is the pipeline's only
+        wait, and it is an EVENT (the fence future), not a poll."""
+        while self._prune() >= self.depth:
+            fut = self._inflight[0][2]
+            t0 = time.perf_counter()
+            await fut
+            self.waits += 1
+            self.wait_seconds += time.perf_counter() - t0
+
+    async def drain(self) -> None:
+        """Quiesce: await every in-flight completion."""
+        while self._prune():
+            await self._inflight[0][2]
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "depth": self.depth,
+            "inflight": self.inflight(),
+            "ticks_tracked": self.ticks_tracked,
+            "completions": self.completions,
+            "waits": self.waits,
+            "wait_seconds": round(self.wait_seconds, 6),
+            "overlap_seconds": round(self.overlap_seconds, 6),
+            "max_inflight": self.max_inflight,
+            "donation_fallbacks": self.engine.donation_fallbacks,
+        }
 
 
 @jax.jit
@@ -528,7 +663,20 @@ class TensorEngine:
         # re-attributed to the reshard, not to "new" traffic.
         self._seen_steps: set = set()
         self._reshard_forgotten: set = set()
+        # a live donate_state toggle equally drops the compiled steps;
+        # its forgotten signatures re-attribute to the toggle
+        self._toggle_forgotten: set = set()
+        self._steps_donated = self.config.donate_state
         self.reshard_count = 0
+        # continuous pipelined ticking: event-driven completion tracking
+        # + depth backpressure; the fence is the latest tick's 1-lane
+        # completion output (never donated — see _get_step)
+        self.pipeline = TickPipeline(self)
+        self._tick_fence = None
+        # executions that fell back to the undonated path (donate_state
+        # off): the pipeline still works, but XLA can no longer
+        # double-buffer state in place
+        self.donation_fallbacks = 0
         self._pending_checks: List[_MissCheck] = []
         # parked cross-shard exchange overflow checks (drained with the
         # miss checks — one batched device read covers both families)
@@ -949,6 +1097,9 @@ class TensorEngine:
         if self._task is not None:
             await self._task
             self._task = None
+        # settle in-flight completion futures so no executor thread
+        # outlives the engine holding fence references
+        await self.pipeline.drain()
         # never leave a triggered jax.profiler capture session dangling
         self.profiler.shutdown()
 
@@ -970,6 +1121,12 @@ class TensorEngine:
             while self._running:
                 while self._running and any(self.queues.values()):
                     self.run_tick()
+                    # pipelined pacing: register the tick's completion
+                    # event and, with pipeline_depth ticks in flight,
+                    # await the OLDEST completion (event-driven
+                    # backpressure — the device sets the pace, no poll)
+                    self.pipeline.note_tick(self._tick_fence)
+                    await self.pipeline.throttle()
                     # yield so producers can batch up the next tick; the
                     # accumulation interval is the latency/throughput knob
                     await asyncio.sleep(self.tick_interval())
@@ -1034,6 +1191,32 @@ class TensorEngine:
         # path parks totals on device instead of synchronizing per round)
         for fanout, _, _ in self._fanouts.values():
             fanout.overflow_check()
+
+    # ================= event-driven completion ============================
+
+    def completion_future(self):
+        """An awaitable resolving when every device program dispatched so
+        far has completed — the event-driven replacement for host-side
+        ``block_until_ready`` on arena columns.  Blocks on the latest
+        tick's FENCE output (which nothing ever donates, so the wait is
+        safe even while later ticks donate the state buffers away);
+        programs execute in dispatch order per device, so the latest
+        fence's readiness implies everything before it.  None when no
+        tick has dispatched yet."""
+        if self._tick_fence is None:
+            return None
+        loop = asyncio.get_running_loop()
+        return loop.run_in_executor(None, _fence_block, self._tick_fence)
+
+    async def wait_completion(self) -> None:
+        """Await full device completion of all dispatched work: drain the
+        pipeline's in-flight ticks, then the latest fence.  The one sync
+        point benches/tests need — a message's observed completion is the
+        device event, not the next poll."""
+        await self.pipeline.drain()
+        fut = self.completion_future()
+        if fut is not None:
+            await fut
 
     # ================= tick execution =====================================
 
@@ -1112,9 +1295,17 @@ class TensorEngine:
         # tick-phase profiler (tensor/profiler.py): fold the stage
         # timers into the five canonical phases + trigger deep capture
         # on a wall-time breach; compile events recorded this tick ride
-        # the batched span so a slow tick names its compile
-        phases = self.profiler.observe_tick(dt, stages) \
-            if self.profiler.enabled else None
+        # the batched span so a slow tick names its compile.  Pipelined
+        # ticks overlap device work with later host work — observe_tick
+        # pulls the accrued credit from the pipeline for reconciliation.
+        if self.profiler.enabled:
+            phases = self.profiler.observe_tick(dt, stages)
+        else:
+            phases = None
+            # discard the credit while profiling is off: left to accrue,
+            # the whole backlog would land on the first observed tick
+            # after a live re-enable and blind its overrun detector
+            self.pipeline.take_tick_overlap()
         compile_events = self.compile_tracker.drain_tick_events()
         if rec is not None:
             # ONE batched span per tick (batch size, per-type counts,
@@ -1135,6 +1326,10 @@ class TensorEngine:
 
     def tick_interval(self) -> float:
         """Seconds to accumulate messages before the next tick."""
+        if self.config.low_latency:
+            # the honest 10ms mode: the pipeline's completion events set
+            # the pace; the sleep only yields to producers
+            return self.config.tick_interval_min
         if self.config.target_tick_latency <= 0:
             return self.config.tick_interval
         return self._adaptive_interval
@@ -1145,14 +1340,13 @@ class TensorEngine:
         interval to keep that sum inside ``target_tick_latency``.  Longer
         intervals build bigger batches (throughput); the controller grows
         the interval only while the budget has headroom and cuts it
-        multiplicatively when a tick overruns."""
+        multiplicatively when a tick overruns.  The controller judges the
+        raw measured duration: completion is observed event-driven now,
+        so there is no rig observation floor left to net out."""
         budget = self.config.target_tick_latency
         if budget <= 0:
             return
         cfg = self.config
-        # judge the ENGINE's latency, not the rig's observation channel
-        # (config.observation_floor; 0 on direct-attached hardware)
-        tick_duration = max(tick_duration - cfg.observation_floor, 0.0)
         if tick_duration + self._adaptive_interval > budget:
             self._adaptive_interval = max(cfg.tick_interval_min,
                                           self._adaptive_interval * 0.5)
@@ -1694,6 +1888,12 @@ class TensorEngine:
         t_apply = time.perf_counter()
 
         step = self._get_step(info, method)
+        if not self._steps_donated:
+            # undonated EXECUTION (donate_state off) — counted per run
+            # like the fused path, matching the metric's unit; a
+            # per-compile count would flatline while every tick ran
+            # without double-buffering
+            self.donation_fallbacks += 1
         if mask is None:
             mask = _mask_for(rows.shape[0] if hasattr(rows, "shape")
                              else len(rows))
@@ -1712,7 +1912,8 @@ class TensorEngine:
         sig = (info.name, method, int(len(rows)), arena.capacity,
                exchanged)
         if sig in self._seen_steps:
-            new_state, results, emits = step(arena.state, rows, args, mask)
+            new_state, results, emits, fence = step(arena.state, rows,
+                                                    args, mask)
         else:
             # first call of this input signature: jax traces + lowers +
             # compiles synchronously inside the call, so its wall time
@@ -1721,13 +1922,18 @@ class TensorEngine:
             cause = self._infer_step_cause(
                 info.name, method, sig, isinstance(rows, np.ndarray))
             t_compile = time.perf_counter()
-            new_state, results, emits = step(arena.state, rows, args, mask)
+            new_state, results, emits, fence = step(arena.state, rows,
+                                                    args, mask)
             self.compile_tracker.record(
                 cause, key=f"{info.name}.{method}[{sig[2]}]",
                 seconds=time.perf_counter() - t_compile,
                 tick=self.tick_number)
             self._seen_steps.add(sig)
-        arena.state = new_state
+        # buffer flip: the donated input columns are gone; the program's
+        # outputs are the live state now (layout validated — donation
+        # must never smuggle in a wrong-shaped column)
+        arena.adopt_state(new_state)
+        self._tick_fence = fence
         if not isinstance(rows, np.ndarray):
             # device-routed batches (injector fast path, emit hits) never
             # cross to the host, so record their traffic on the device-side
@@ -1796,6 +2002,12 @@ class TensorEngine:
         if (type_name, method, m) in self._reshard_forgotten:
             self._reshard_forgotten.discard((type_name, method, m))
             return CAUSE_MESH_RESHARD
+        if (type_name, method, m) in self._toggle_forgotten:
+            # a live donate_state toggle dropped the compiled steps:
+            # recompiles of signatures it forgot are caused by the
+            # toggle, not by organic traffic shapes
+            self._toggle_forgotten.discard((type_name, method, m))
+            return CAUSE_CONFIG_TOGGLE
         seen_method = [s for s in self._seen_steps
                        if s[0] == type_name and s[1] == method]
         if not seen_method:
@@ -1829,6 +2041,16 @@ class TensorEngine:
         return -(-m // last) * last
 
     def _get_step(self, info: VectorGrainInfo, method: str) -> Callable:
+        donate = self.config.donate_state
+        if donate != self._steps_donated:
+            # live donation toggle: the compiled steps baked the other
+            # donation mode — drop them and attribute the recompiles to
+            # the toggle (the _reshard_forgotten discipline)
+            self._steps_donated = donate
+            self._step_cache.clear()
+            self._toggle_forgotten |= {(s[0], s[1], s[2])
+                                       for s in self._seen_steps}
+            self._seen_steps = set()
         key = (info.name, method)
         step = self._step_cache.get(key)
         if step is not None:
@@ -1845,14 +2067,22 @@ class TensorEngine:
             # normalize handler returns: state | (state,) | (state, results)
             # | (state, results, emits)
             if isinstance(out, dict):
-                return out, None, ()
-            out = tuple(out)
-            state2 = out[0]
-            results = out[1] if len(out) > 1 else None
-            emits = out[2] if len(out) > 2 else ()
-            return state2, results, emits
+                state2, results, emits = out, None, ()
+            else:
+                out = tuple(out)
+                state2 = out[0]
+                results = out[1] if len(out) > 1 else None
+                emits = out[2] if len(out) > 2 else ()
+            # the completion FENCE: a 1-lane output derived from the new
+            # state.  The pipeline's event-driven completion blocks on
+            # THIS, never on the state columns — the next tick donates
+            # those away while the fence (its own tiny output buffer)
+            # stays valid for the waiting executor thread.
+            first = jax.tree_util.tree_leaves(state2)[0]
+            fence = jnp.reshape(first, (-1,))[:1]
+            return state2, results, emits, fence
 
-        step = jax.jit(step_fn, donate_argnums=(0,))
+        step = jax.jit(step_fn, donate_argnums=(0,) if donate else ())
         self._step_cache[key] = step
         return step
 
@@ -1887,6 +2117,9 @@ class TensorEngine:
             "stages": dict(self.stage_seconds),
             "last_tick_stages": dict(self.last_tick_stages),
             "tick_latency": self.latency_stats(),
+            # continuous pipelined ticking: in-flight window, completion
+            # events, overlap credit, donation fallbacks
+            "pipeline": self.pipeline.snapshot(),
             "autofuse": self.autofuser.snapshot(),
             "arenas": {name: a.live_count for name, a in self.arenas.items()},
             "evicted": sum(a.evicted_count for a in self.arenas.values()),
@@ -1933,6 +2166,12 @@ class BatchInjector:
         self._rows_host = None  # host mirror for cheap epoch revalidation
         self.generation = -2
         self.epoch = -2
+        # overlapped h2d (stage()): the next injection's device-staged
+        # slab + an identity-memoized np→device cache so a loader
+        # reusing the same payload array keeps LEAF IDENTITY stable
+        # (auto-fusion's static/per-tick split keys on it)
+        self._staged: Optional[Any] = None
+        self._stage_cache: Dict[int, Tuple[Any, Any]] = {}
         self._refresh()
         self.n = len(keys)
 
@@ -1970,8 +2209,58 @@ class BatchInjector:
         self.generation = arena.generation
         self.epoch = arena.eviction_epoch
 
-    def inject(self, args: Any, want_results: bool = False
+    def stage(self, args: Any) -> Any:
+        """Overlapped h2d: start copying the NEXT injection's payload to
+        device NOW (async ``jax.device_put``), so the transfer rides
+        under the current tick's device execution instead of
+        serializing before the next dispatch.  ``inject()`` (with no
+        args) then enqueues the staged slab with zero h2d on the
+        dispatch path; the ledger's ``inject_tick`` stamp is applied at
+        inject time — staging moves bytes, not the message's logical
+        arrival.  Repeated stagings of the SAME numpy array reuse one
+        device copy (identity-memoized), so auto-fusion's static-leaf
+        detection still sees a stable identity."""
+        if not self.engine.config.overlap_h2d:
+            self._staged = args
+            return args
+
+        def put(a):
+            if not isinstance(a, np.ndarray) or a.ndim == 0:
+                return a
+            ent = self._stage_cache.get(id(a))
+            if ent is not None and ent[0]() is a \
+                    and np.array_equal(a, ent[2]):
+                # identity alone is not enough: a loader mutating the
+                # SAME buffer in place between stagings must get a
+                # fresh upload, not the first staging's contents — the
+                # host memcmp is cheaper than the h2d it avoids on the
+                # unchanged steady state
+                return ent[1]
+            dev = jax.device_put(a)
+            try:
+                ref = weakref.ref(a)
+            except TypeError:
+                return dev  # non-weakrefable subclass: no memo
+            while len(self._stage_cache) >= 32:
+                self._stage_cache.pop(next(iter(self._stage_cache)))
+            self._stage_cache[id(a)] = (ref, dev, a.copy())
+            return dev
+
+        self._staged = jax.tree_util.tree_map(put, args)
+        return self._staged
+
+    def inject(self, args: Any = None, want_results: bool = False
                ) -> Optional[asyncio.Future]:
+        if args is None:
+            args, self._staged = self._staged, None
+            if args is None:
+                raise ValueError("inject() with no args needs a staged "
+                                 "slab — call stage(args) first")
+        else:
+            # an explicit injection supersedes any staged slab: kept
+            # around, a later no-arg inject() would resurrect the stale
+            # payload under a fresh inject_tick stamp
+            self._staged = None
         if self.generation != self._arena.generation \
                 or self.epoch != self._arena.eviction_epoch:
             # rows repacked (generation) or freed (epoch) — revalidate

@@ -146,16 +146,34 @@ class FusedTickProgram:
         # skewed window fails verify() and replays unfused (exactness
         # over throughput, the standing fused contract).
         self._exchange_on = False
-        # donate=False keeps the pre-run state buffers valid after the
-        # window executes, so a caller that may need to ROLL BACK (the
-        # auto-fuser) gets its snapshot for free — eager device copies
-        # are ruinously slow on tunneled runtimes.  Manual fused drivers
-        # keep donation (no rollback path; verify() asserts instead).
-        self.donate = True
+        # donation (config.donate_state, default on): the window takes
+        # the state columns as donated inputs, so XLA double-buffers in
+        # place and back-to-back windows pipeline without a host round
+        # trip.  Callers that may need to ROLL BACK (the auto-fuser)
+        # must take their snapshot as a device COPY BEFORE the first
+        # donated run — copy-before-donate (autofuse._run_window); a
+        # rolled-back chain then restores the copy and never touches a
+        # donated-away buffer.  donate=False is the undonated serial
+        # baseline the exactness A/B replays against.  An explicit
+        # caller assignment PINS the mode (prepare() then never syncs
+        # it back to the live config — manual drivers that snapshot
+        # pre-run buffers by reference rely on staying undonated).
+        self._donate = self.engine.config.donate_state
+        self._donate_pinned = False
+        self._built_donate: "bool | None" = None  # mode _build baked
         # compile-churn attribution: engine.reshard bumps this counter,
         # so a post-reshard re-trace names the reshard as its cause
         # instead of the generation bump it also produced
         self._reshard_count = self.engine.reshard_count
+
+    @property
+    def donate(self) -> bool:
+        return self._donate
+
+    @donate.setter
+    def donate(self, value: bool) -> None:
+        self._donate = bool(value)
+        self._donate_pinned = True
 
     # -- legacy single-source aliases (manual drivers, tests) ---------------
 
@@ -407,6 +425,7 @@ class FusedTickProgram:
                 [jnp.sum(misses), jnp.sum(delivered)]), hist
 
         self._touched = touched
+        self._built_donate = self.donate
         return jax.jit(window,
                        donate_argnums=(0,) if self.donate else ())
 
@@ -424,6 +443,11 @@ class FusedTickProgram:
         # cause-coded re-trace decision (tensor/profiler.py churn
         # taxonomy): the FIRST matching condition names the cause —
         # reshard outranks the generation bump it also produced
+        # donation target: an explicit caller pin wins (manual drivers
+        # that snapshot pre-run buffers by reference stay undonated);
+        # otherwise the live config decides and a toggle re-traces
+        donate_target = self._donate if self._donate_pinned \
+            else engine.config.donate_state
         cause = None
         if self._compiled is None:
             cause = CAUSE_NEW_WINDOW
@@ -439,7 +463,14 @@ class FusedTickProgram:
                 or self._ledger_on != engine.ledger.enabled \
                 or self._exchange_on != engine._exchange_live():
             cause = CAUSE_CONFIG_TOGGLE
+        elif self._built_donate != donate_target:
+            # the compiled window baked the other donation mode (live
+            # donate_state toggle, or a re-pinned cached program) —
+            # re-trace; the step-program twin clears _step_cache for
+            # the same reason
+            cause = CAUSE_CONFIG_TOGGLE
         if cause is not None:
+            self._donate = donate_target
             for s in self.sources:
                 s.rows = jnp.asarray(s.arena.resolve_rows(s.keys))
             examples = [
@@ -485,7 +516,17 @@ class FusedTickProgram:
         if self._ledger_on:
             engine.ledger.device_hist_out(hist_out)
         for n in self._touched:
-            engine.arena_for(n).state = new_states[n]
+            # double-buffer flip: donated windows consumed the inputs;
+            # the outputs are the live columns now (layout validated)
+            engine.arena_for(n).adopt_state(new_states[n])
+        # the window's on-device totals accumulator doubles as the
+        # pipeline's completion FENCE: it is a program output nothing
+        # ever donates (it feeds the NEXT window as a plain input), so
+        # event-driven completion can block on it while later windows
+        # donate the state buffers away
+        engine._tick_fence = self._totals
+        if not self._donate:
+            engine.donation_fallbacks += 1
         engine.tick_number += n_ticks
         engine.ticks_run += n_ticks
         engine.messages_processed += n_ticks * self.n_msgs

@@ -2,10 +2,12 @@
 the device, in device-tick units.
 
 Why this exists (ROADMAP item 2's precondition): every host-side latency
-number this rig can observe is floored by its completion-observation
-channel (~100ms on tunneled runtimes, samples/presence.py
-measure_sync_floor) — a per-message, or even per-tick, blocking
-measurement reports the rig, not the engine.  The ledger moves the
+number a BLOCKING rig can observe is floored by its completion-
+observation channel (~100ms on tunneled runtimes; the event-driven
+completion path — engine.TickPipeline + samples/presence.py
+measure_event_floor — is what removed that floor from the latency rig)
+— a per-message, or even per-tick, blocking measurement on the dispatch
+path reports the rig, not the engine.  The ledger moves the
 measurement to where the traffic lives: each message is stamped with its
 INJECTION tick (PendingBatch.inject_tick, set at enqueue), completion is
 stamped by the tick that applies it, and the tick-delta latencies

@@ -113,6 +113,12 @@ class TickPhaseProfiler:
         # measured wall time by >10% (double-counted stage — a bug the
         # reconciliation test pins)
         self.overrun_ticks = 0
+        # pipelined-tick reconciliation credit: device time that ran
+        # CONCURRENTLY with later host work (engine.TickPipeline
+        # completion events).  Pipelined phases overlap, so per-tick
+        # host-side phase sums no longer tile total engine time — the
+        # credit is the honest difference, not an accounting error.
+        self.overlap_credit_s = 0.0
         # -- deep capture state ------------------------------------------
         self.captures_started = 0
         self.capture_events: deque = deque(maxlen=16)
@@ -148,23 +154,34 @@ class TickPhaseProfiler:
         self.last_tick_phases = {}
         self.ticks_observed = 0
         self.overrun_ticks = 0
+        self.overlap_credit_s = 0.0
 
     # -- per-tick accounting -------------------------------------------------
 
     def observe_tick(self, duration: float,
-                     stages: Dict[str, float]) -> Dict[str, float]:
+                     stages: Dict[str, float],
+                     overlap_s: Optional[float] = None) -> Dict[str, float]:
         """Fold one tick's stage timers into the five phases; returns the
         tick's phase breakdown (attached to the batched tick span).  The
         unmeasured remainder accrues to ``host``; a negative remainder
-        beyond 10% of the tick means a stage was double-counted and is
-        surfaced via ``overrun_ticks`` instead of silently clamped."""
+        beyond 10% of the tick (plus the pipeline's ``overlap_s`` credit
+        — device work completing under this tick's wall is overlap, not
+        double-counting) means a stage was double-counted and is
+        surfaced via ``overrun_ticks`` instead of silently clamped.
+        ``overlap_s=None`` pulls the credit accrued since the last
+        observation from the engine's TickPipeline."""
+        if overlap_s is None:
+            pipeline = getattr(self.engine, "pipeline", None)
+            overlap_s = pipeline.take_tick_overlap() \
+                if pipeline is not None else 0.0
+        self.overlap_credit_s += overlap_s
         phases = {p: 0.0 for p in PHASES}
         for key, seconds in stages.items():
             phases[STAGE_TO_PHASE.get(key, "host")] += seconds
         remainder = duration - sum(phases.values())
         if remainder >= 0.0:
             phases["host"] += remainder
-        elif -remainder > 0.10 * max(duration, 1e-9):
+        elif -remainder > 0.10 * max(duration, 1e-9) + overlap_s:
             self.overrun_ticks += 1
         self.ticks_observed += 1
         base = self.hist_base
@@ -304,6 +321,7 @@ class TickPhaseProfiler:
             "enabled": self.enabled,
             "ticks_observed": self.ticks_observed,
             "overrun_ticks": self.overrun_ticks,
+            "overlap_credit_s": round(self.overlap_credit_s, 6),
             "phase_seconds": {p: round(v, 6)
                               for p, v in self.phase_seconds.items()},
             "phase_fraction": {p: round(v / total, 4) if total > 0 else 0.0

@@ -279,43 +279,34 @@ async def run_presence_load_fused(engine, n_players: int = 100_000,
     return stats
 
 
-def measure_sync_floor(repeats: int = 11) -> "Tuple[float, float]":
-    """The rig's host-observability floor: the wall time to OBSERVE the
-    completion of an in-flight device program whose device time is ~0.
-
-    On a direct-attached TPU this is ~0; on a tunneled runtime (IFRT
-    proxy) completion notifications arrive on a ~100ms cadence, flooring
-    every blocking latency MEASUREMENT regardless of actual device
-    latency.  Returns ``(median, p95)`` of the observation samples —
-    the channel has its OWN tail (~±30ms observed), which a per-tick p99
-    necessarily rides.  Published alongside latency numbers so
-    budget-honoring can be judged net of the rig artifact (measured:
-    block/spin/async-copy all floor identically, so no client-side
-    workaround exists)."""
+async def measure_event_floor(repeats: int = 9) -> "Tuple[float, float]":
+    """The rig's EVENT-DRIVEN observation floor: the wall time for a
+    completion FUTURE to resolve for a trivial already-dispatched device
+    program — the successor of the old ``measure_sync_floor`` blocking
+    probe.  The engine no longer blocks on the dispatch path at all
+    (completion is observed by an executor thread resolving an asyncio
+    future the moment the device signals — engine.TickPipeline), so
+    this is the only observation cost the latency rig pays, and it sits
+    OFF the dispatch path: it delays the *timestamp*, never the next
+    tick.  Returns ``(median, p95)`` — published as ``sync_floor_s``
+    for artifact continuity; the acceptance bar is ≤ 5ms."""
+    import asyncio as _asyncio
     import jax as _jax
-    from functools import partial
 
-    a = jnp.ones((512, 512), jnp.bfloat16)
-
-    @partial(_jax.jit, static_argnames=("n",))
-    def probe(x, n):
-        return jnp.sum(_jax.lax.scan(
-            lambda c, _: (c @ a, None), x, None, length=n)[0])
-
-    probe(a, 1).block_until_ready()  # compile
+    loop = _asyncio.get_running_loop()
+    x = jnp.ones((256,), jnp.float32)
+    probe = _jax.jit(lambda a: a * 2.0)
+    probe(x).block_until_ready()  # compile
+    # warm the executor pool: the FIRST run_in_executor spawns a thread,
+    # which is pool setup cost, not observation cost
+    await loop.run_in_executor(None, _jax.block_until_ready, probe(x))
     samples = []
     for _ in range(repeats):
+        y = probe(x)
         t0 = time.perf_counter()
-        probe(a, 1).block_until_ready()
+        await loop.run_in_executor(None, _jax.block_until_ready, y)
         samples.append(time.perf_counter() - t0)
-    floor = float(np.median(samples))
-    p95 = float(np.percentile(samples, 95))
-    # device time of one 512^3 matmul is microseconds; anything beyond
-    # a couple ms is pure observation latency.  Below that, report 0 so
-    # direct-attached rigs use the strict definition.
-    if floor <= 2e-3:
-        return 0.0, 0.0
-    return floor, p95
+    return float(np.median(samples)), float(np.percentile(samples, 95))
 
 
 async def run_presence_ledger_point(engine, n_players: int, n_games: int,
@@ -324,7 +315,8 @@ async def run_presence_ledger_point(engine, n_players: int, n_games: int,
                                     n_ticks: int = 48, warm_ticks: int = 8,
                                     seed: int = 0) -> Dict[str, float]:
     """One latency operating point measured by the ON-DEVICE ledger
-    (tensor/ledger.py) — the honest companion to run_presence_bounded:
+    (tensor/ledger.py) — the device-side companion to
+    run_presence_pipelined:
     the host never observes per-tick completion at all.
 
     Closed loop per tick: sleep the accumulation interval, inject the
@@ -433,60 +425,49 @@ async def run_presence_ledger_point(engine, n_players: int, n_games: int,
     }
 
 
-async def run_presence_bounded(engine, n_players: int, n_games: int,
-                               budget: float,
-                               offered_rate: Optional[float] = None,
-                               n_ticks: int = 40, warm_ticks: int = 12,
-                               sync_floor: float = 0.0,
-                               sync_floor_p95: float = 0.0,
-                               seed: int = 0) -> Dict[str, float]:
-    """One latency-bounded operating point: (msgs/sec, true p99 turn
-    latency) with the adaptive tick controller holding accumulation-wait
-    + tick-service inside ``budget`` (SURVEY §7 hard-part 5 — p99 is half
-    the north-star metric).
+async def run_presence_pipelined(engine, n_players: int, n_games: int,
+                                 budget: float,
+                                 offered_rate: Optional[float] = None,
+                                 n_ticks: int = 40, warm_ticks: int = 10,
+                                 pipeline_depth: int = 2,
+                                 seed: int = 0) -> Dict[str, float]:
+    """One latency-bounded operating point, measured with EVENT-DRIVEN
+    completion and pipelined dispatch — the honest 10ms mode that
+    replaced ``run_presence_bounded``'s blocking rig.
 
-    Closed loop per tick: sleep the controller's accumulation interval,
-    inject the heartbeats a rate-``offered_rate`` producer generated in
-    that window (rounded down to a precompiled batch-size ladder rung),
-    run the tick to completion, record window-start→completion wall time
-    — the turn latency of the window's OLDEST message, so the published
-    p99 is conservative.  The controller (engine._adapt) shrinks the
-    interval when ticks run long and grows it for throughput when the
-    budget has headroom.
+    Closed loop per tick: sleep the accumulation interval, dispatch the
+    heartbeats a rate-``offered_rate`` producer generated in that
+    window (rounded down to a precompiled batch-size ladder rung) as
+    ONE fused single-tick program with DONATED state buffers, then move
+    straight on — the dispatch path never blocks.  Each tick's
+    completion is observed by an executor thread that timestamps the
+    device's completion signal for the tick's FENCE (an output nothing
+    donates), so the recorded duration window-start→completion-event is
+    the turn latency of the tick's OLDEST message with NO polling floor
+    and NO sync-floor subtraction: the floor is gone, not netted out.
+    Up to ``pipeline_depth`` ticks ride in flight (the engine pipeline's
+    event-driven backpressure), so tick N+1's dispatch overlaps tick
+    N's device execution — donation makes that safe (XLA
+    double-buffers the columns in place).
 
-    ``offered_rate=None`` estimates the highest sustainable rate from the
-    warm pass's measured service times; the caller verifies p99 ≤ budget
+    ``offered_rate=None`` estimates the highest sustainable rate from
+    measured per-rung service times; the caller verifies p99 ≤ budget
     and retries lower if the estimate overshot (bench.py does this).
-
-    ``sync_floor`` (see measure_sync_floor): the rig's completion-
-    observation floor.  It is SUBTRACTED for budget-honoring decisions
-    and rate estimation (it is measurement artifact, not engine
-    latency); both raw and net percentiles are returned.
-
-    Latency mode rides the FUSED single-tick program: each bounded tick
-    — heartbeat kernel, device-mirror resolve of the game emits, game
-    fan-in — is ONE compiled XLA call (window=1: no buffering, so none
-    of window fusion's batching-vs-latency tradeoff), where the unfused
-    path dispatches each stage separately (inject→resolve→apply→route→
-    fan-in) and pays per-dispatch overhead on tunneled rigs.  Delivery
-    exactness is asserted via the programs' device-side miss counters
-    at the end of the run.
-    """
-    import jax as _jax
+    Delivery exactness is asserted via the programs' device-side miss
+    counters at the end of the run."""
+    import asyncio as _asyncio
 
     cfg = engine.config
     cfg.target_tick_latency = budget
-    cfg.tick_interval_max = budget * 0.5
-    cfg.tick_interval_min = max(1e-4, budget / 50.0)
-    cfg.observation_floor = sync_floor  # controller judges net latency
-    engine._adaptive_interval = budget / 4.0
+    cfg.pipeline_depth = max(1, int(pipeline_depth))
+    cfg.low_latency = True
+    pipeline = engine.pipeline
 
-    game_arena = engine.arena_for("GameGrain")
     # the rung ladder (programs + compiles + measured service times) is
     # cached on the engine: bench.py retries this function up to 4 times
     # per budget on one engine, and rebuilding ~6 fused programs per
     # attempt would be almost all compile wall time on tunneled rigs
-    cache = getattr(engine, "_bounded_rung_cache", None)
+    cache = getattr(engine, "_pipelined_rung_cache", None)
     if cache is not None and cache["key"] == (n_players, n_games, seed):
         rungs, service = cache["rungs"], cache["service"]
     else:
@@ -505,8 +486,10 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
 
         # batch-size ladder: one compiled window=1 program per prefix
         # size, so variable offered load maps to a bounded set of
-        # compiled shapes
-        ladder = [m for m in (2048, 8192, 32768, 131072, 524288)
+        # compiled shapes (finer rungs at the bottom — the 10ms budget
+        # lands there on slow rigs, and the rate search needs steps)
+        ladder = [m for m in (2048, 4096, 8192, 16384, 32768, 65536,
+                              131072, 262144, 524288)
                   if m < n_players] + [n_players]
         rungs = []
         for m in ladder:
@@ -518,33 +501,49 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
                            "score": jnp.asarray(scores[:m])},
             })
 
-        # warm pass: compile each rung (rep 1) and measure its synced
-        # service time (rep 2) for the rate estimate
+        # warm pass: compile each rung (rep 1), then measure its service
+        # time event-driven (median of 3 — one noisy sample must not
+        # steer the operating point on a shared rig)
         service = {}
         for rung in rungs:
-            for rep in range(2):
+            rung["prog"].run({"tick": np.full(1, 1, np.int32)},
+                             static_args=rung["static"])
+            await engine.wait_completion()
+            reps = []
+            for rep in range(3):
                 s0 = time.perf_counter()
                 rung["prog"].run({"tick": np.full(1, 1, np.int32)},
                                  static_args=rung["static"])
-                _jax.block_until_ready(game_arena.state["updates"])
-                service[rung["m"]] = time.perf_counter() - s0
-        engine._bounded_rung_cache = {"key": (n_players, n_games, seed),
-                                      "rungs": rungs, "service": service}
+                await engine.wait_completion()
+                reps.append(time.perf_counter() - s0)
+            service[rung["m"]] = float(np.median(reps))
+        engine._pipelined_rung_cache = {"key": (n_players, n_games, seed),
+                                        "rungs": rungs, "service": service}
 
+    # accumulation interval: 40% of the budget goes to queue-wait; the
+    # rest is service + completion-event headroom
+    interval = budget * 0.4
     if offered_rate is None:
-        candidates = [m / (budget - max(s - sync_floor, 1e-4))
-                      for m, s in service.items()
-                      if max(s - sync_floor, 1e-4) < 0.7 * budget]
+        # largest rung whose measured service leaves p99 headroom:
+        # oldest-message latency ≈ interval + service, so require
+        # service ≤ 50% of budget (10% margin for event jitter)
+        candidates = [m / interval for m, s in service.items()
+                      if s <= 0.5 * budget]
         offered_rate = max(candidates) if candidates \
             else rungs[0]["m"] / budget
 
-    durations = []
-    messages = 0
+    records = []
+    futs = []
     tick_counter = 0
-    batch_sizes = []
+    # per-run pipeline accounting: the bench reuses ONE engine across
+    # budgets and retry attempts, so the published point must carry
+    # THIS run's overlap/fallbacks/high-water — not the engine lifetime
+    overlap0 = pipeline.overlap_seconds
+    fallbacks0 = engine.donation_fallbacks
+    pipeline.max_inflight = 0
     window_start = time.perf_counter()
     for t in range(warm_ticks + n_ticks):
-        await asyncio.sleep(engine.tick_interval())
+        await _asyncio.sleep(interval)
         accumulated = time.perf_counter() - window_start
         m_target = offered_rate * accumulated
         rung = rungs[0]
@@ -552,35 +551,43 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
             if r["m"] <= m_target:
                 rung = r
         tick_counter += 1
-        svc0 = time.perf_counter()
-        # the whole tick is one dispatch + one blocking observation
+        # ONE dispatch; no blocking — the completion event does the
+        # timestamping off the dispatch path
         rung["prog"].run({"tick": np.full(1, tick_counter, np.int32)},
                          static_args=rung["static"])
-        _jax.block_until_ready(game_arena.state["updates"])
-        done = time.perf_counter()
-        # feed the controller the tick SERVICE time (the engine loop
-        # does this from run_tick; the fused path bypasses it) — the
-        # controller itself nets out config.observation_floor, set above
-        engine._adapt(done - svc0)
-        if t >= warm_ticks:
-            durations.append(done - window_start)
-            messages += 2 * rung["m"]
-            batch_sizes.append(rung["m"])
-        window_start = done
+        rec = {"start": window_start, "done": None, "m": rung["m"],
+               "measured": t >= warm_ticks}
+        records.append(rec)
+        # engine-pipeline bookkeeping + depth backpressure: with
+        # pipeline_depth ticks in flight, await the OLDEST completion
+        # event before dispatching another.  The on_complete callback
+        # timestamps IN the pipeline's executor thread the moment the
+        # device signals — the event IS the observation, and the one
+        # blocked thread serves both the rig and the pipeline
+        fut = pipeline.note_tick(
+            engine._tick_fence,
+            on_complete=lambda ts, rec=rec: rec.__setitem__("done", ts))
+        if fut is not None:
+            futs.append(fut)
+        await pipeline.throttle()
+        window_start = time.perf_counter()
+    await _asyncio.gather(*futs)
+    await engine.wait_completion()
     # exactness: every window resolved every emit in the frozen mirror
     for rung in rungs:
         misses = rung["prog"].verify()
         if misses:  # not assert: -O must not skip exactness verification
             raise RuntimeError(
-                f"bounded fused tick touched {misses} unactivated grains")
+                f"pipelined fused tick touched {misses} unactivated "
+                "grains")
 
-    # durations tile the measured wall clock exactly (window_start resets
-    # at each observation), so wall throughput = messages / sum(d); the
-    # net figure removes the per-tick observation floor — the cost a
-    # deployment without a measuring host would not pay
-    d = np.asarray(durations)
-    elapsed = float(d.sum())
-    elapsed_net = float(np.maximum(d - sync_floor, 1e-4).sum())
+    measured = [r for r in records if r["measured"] and r["done"]]
+    d = np.asarray([r["done"] - r["start"] for r in measured])
+    messages = int(sum(2 * r["m"] for r in measured))
+    # wall span of the measured segment: first window start → last
+    # completion EVENT (completions may land out of band — pipelined)
+    elapsed = max(r["done"] for r in measured) \
+        - min(r["start"] for r in measured)
     p99 = float(np.percentile(d, 99))
     return {
         "budget_s": budget,
@@ -588,18 +595,21 @@ async def run_presence_bounded(engine, n_players: int, n_games: int,
         "messages": messages,
         "seconds": elapsed,
         "messages_per_sec": messages / elapsed,
-        "messages_per_sec_net": messages / elapsed_net,
         "tick_p50_seconds": float(np.percentile(d, 50)),
         "tick_p99_seconds": p99,
         "tick_max_seconds": float(d.max()),
-        "mean_batch": float(np.mean(batch_sizes)),
-        "ticks": n_ticks,
-        "sync_floor_s": sync_floor,
-        "sync_floor_p95_s": sync_floor_p95,
-        "tick_p99_net_seconds": max(0.0, p99 - sync_floor),
-        # honored net of the rig's observation channel: a per-tick p99
-        # necessarily rides the channel's own tail, so the bound is
-        # budget + the channel's p95 (strict when the floor is 0)
-        "honored": bool(p99 - max(sync_floor_p95, sync_floor) <= budget),
+        "mean_batch": float(np.mean([r["m"] for r in measured])),
+        "ticks": len(measured),
+        "pipeline_depth": cfg.pipeline_depth,
+        "inflight_max": pipeline.max_inflight,
+        "overlap_s": round(pipeline.overlap_seconds - overlap0, 6),
+        "donation_fallbacks": engine.donation_fallbacks - fallbacks0,
+        # no floor, no netting: completion is the device's event, and
+        # honored is a direct observation — strict IS the headline
+        "honored": bool(p99 <= budget),
         "honored_strict": bool(p99 <= budget),
+        "measurement": "event-driven completion (executor-thread "
+                       "timestamp on the tick fence); pipelined "
+                       "dispatch with donated state; no sync-floor "
+                       "subtraction — the dispatch path never blocks",
     }

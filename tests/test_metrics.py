@@ -542,7 +542,7 @@ def test_cluster_merge_and_dashboard_live():
             text = dashboard.render_text(view)
             for silo in cluster.silos:
                 assert silo.name in text
-            assert "latency (device ticks" in text
+            assert "latency (device ledger" in text
 
             # the piggyback: every silo's merged view includes peers
             a = cluster.silos[0]

@@ -384,22 +384,30 @@ def test_gps_host_path(run):
     run(main())
 
 
-def test_presence_bounded_latency_mode_fused_exact(run):
-    """The latency-bounded operating point rides the window=1 fused
-    program (one XLA call per tick).  Exactness: every injected
-    heartbeat lands exactly one game update, asserted through both the
-    state columns and the device miss counters folded at end of run."""
+def test_presence_pipelined_latency_mode_fused_exact(run):
+    """The pipelined latency operating point rides window=1 fused
+    programs with DONATED state and event-driven completion (the
+    honest 10ms mode).  Exactness: every injected heartbeat lands
+    exactly one game update, asserted through both the state columns
+    and the device miss counters folded at end of run; honored flags
+    are direct observations (no floor fields exist any more)."""
 
     async def main():
-        from samples.presence import run_presence_bounded
+        from samples.presence import run_presence_pipelined
 
         engine = TensorEngine()
-        stats = await run_presence_bounded(
+        stats = await run_presence_pipelined(
             engine, n_players=4096, n_games=64, budget=0.05,
             n_ticks=12, warm_ticks=4)
         assert stats["messages"] > 0
         assert stats["tick_p99_seconds"] > 0
         assert stats["mean_batch"] >= 2048
+        assert stats["pipeline_depth"] >= 2
+        # the floor is gone, not netted out: no sync-floor keys, and
+        # honored IS honored_strict
+        assert "sync_floor_s" not in stats
+        assert stats["honored"] == stats["honored_strict"]
+        assert stats["donation_fallbacks"] == 0  # donated path active
         upd = np.asarray(engine.arena_for("GameGrain").state["updates"])
         hb = np.asarray(
             engine.arena_for("PresenceGrain").state["heartbeats"])
