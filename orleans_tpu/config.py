@@ -135,6 +135,27 @@ class MetricsConfig:
     # collection (load publisher / stats loop) asks for a snapshot; an
     # explicit ledger.snapshot() always fetches
     publish_interval_ticks: int = 32
+    # workload attribution plane (tensor/attribution.py): per-row
+    # traffic counts + count-min sketch + per-method slots accumulated
+    # on device, HotSet/skew published by collect_metrics and the load
+    # broadcast.  Live-reloadable; a toggle re-traces fused windows
+    # (cause config_toggle), the ledger discipline.
+    attribution_enabled: bool = True
+    # hot grains published per snapshot (the candidate top-K read off
+    # the device counts column; also the HotSet length)
+    attribution_top_k: int = 16
+    # count-min sketch layout: error bound est-true <= (e/width)*N with
+    # probability >= 1 - exp(-depth); 4x8192 int32 = 128KB per arena
+    attribution_cms_depth: int = 4
+    attribution_cms_width: int = 8192
+    # SLO rollup (slo.* catalog rows): the latency SLO is "all but this
+    # fraction of messages complete within the engine's latency budget"
+    # (engine.config.target_tick_latency; no budget = no latency SLO),
+    # the drop SLO is "all but this fraction of offered messages are
+    # delivered" (dead letters + shed vs attempted).  Burn rate =
+    # observed error fraction / error budget; > 1 is unhealthy.
+    slo_latency_error_budget: float = 0.01
+    slo_drop_error_budget: float = 0.001
 
 
 @dataclass

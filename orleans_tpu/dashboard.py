@@ -114,6 +114,80 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
         phases[phase] = {"seconds": round(hist.get("sum", 0.0), 4),
                          **{k: round(v, 6) for k, v in
                             histogram_percentiles(hist, (50, 99)).items()}}
+    # workload attribution (tensor/attribution.py): hot grains from the
+    # merged hot.* gauges — labels carry (arena, key), sources carry the
+    # owning silo, so the row answers "who is hot and where it lives"
+    gauges = merged.get("gauges", {})
+
+    def _labels(lk: str) -> Dict[str, str]:
+        return dict(p.split("=", 1) for p in lk.split(",") if "=" in p)
+
+    hot_grains: List[Dict[str, Any]] = []
+    shares = gauges.get("hot.grain_share", {})
+    for lk, by_src in gauges.get("hot.grain_msgs", {}).items():
+        lab = _labels(lk)
+        for src, msgs in by_src.items():
+            hot_grains.append({
+                "arena": lab.get("arena", ""),
+                "key": lab.get("key", ""),
+                "silo": src,
+                "msgs": int(msgs),
+                "share": round(shares.get(lk, {}).get(src, 0.0), 6),
+            })
+    hot_grains.sort(key=lambda h: -h["msgs"])
+    hot_grains = hot_grains[:16]
+    skew: Dict[str, Any] = {}
+    for name, field in (("skew.max_shard_share", "max_shard_share"),
+                        ("skew.gini", "gini"),
+                        ("skew.p99_to_mean", "p99_to_mean"),
+                        ("hot.topk_share", "topk_share"),
+                        ("hot.confidence", "confidence")):
+        for lk, by_src in gauges.get(name, {}).items():
+            arena = _labels(lk).get("arena", lk or "all")
+            row = skew.setdefault(arena, {})
+            # worst-case across silos: skew is a per-silo property and
+            # the dashboard flags the worst offender
+            row[field] = round(max(by_src.values(), default=0.0), 6)
+    # cluster SLO rollup: burn rates recomputed from the SUMMED
+    # counters (exact cluster fractions), responsibility named from the
+    # per-source burn gauges
+    lat_window = _counter_total(merged, "slo.latency_window_msgs")
+    lat_over = _counter_total(merged, "slo.latency_over_budget")
+    attempted = _counter_total(merged, "slo.attempted_msgs")
+    dropped = _counter_total(merged, "slo.dropped_msgs")
+
+    def _gauge_max_by_src(name: str) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for by_src in gauges.get(name, {}).values():
+            for src, v in by_src.items():
+                out[src] = max(out.get(src, 0.0), v)
+        return out
+
+    lat_eb = max((v for v in _gauge_max_by_src(
+        "slo.latency_error_budget").values()), default=0.0)
+    drop_eb = max((v for v in _gauge_max_by_src(
+        "slo.drop_error_budget").values()), default=0.0)
+    lat_burn = (lat_over / lat_window / lat_eb) \
+        if lat_window and lat_eb else 0.0
+    drop_burn = (dropped / attempted / drop_eb) \
+        if attempted and drop_eb else 0.0
+    by_silo_burn = {
+        src: round(max(v, _gauge_max_by_src(
+            "slo.drop_burn_rate").get(src, 0.0)), 4)
+        for src, v in _gauge_max_by_src("slo.latency_burn_rate").items()}
+    worst = max(by_silo_burn.items(), key=lambda kv: kv[1],
+                default=(None, 0.0))
+    slo = {
+        "latency_burn_rate": round(lat_burn, 4),
+        "latency_over_budget": int(lat_over),
+        "latency_window_msgs": int(lat_window),
+        "drop_burn_rate": round(drop_burn, 4),
+        "dropped_msgs": int(dropped),
+        "attempted_msgs": int(attempted),
+        "healthy": bool(lat_burn <= 1.0 and drop_burn <= 1.0),
+        "by_silo_burn": by_silo_burn,
+        "worst_silo": worst[0] if worst[1] > 0 else None,
+    }
     # memory ledger: per-silo self-accounted bytes + headroom gauges
     memory: Dict[str, Any] = {}
     for lk, by_src in merged.get("gauges", {}) \
@@ -158,6 +232,11 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
             "tick_phases": phases,
             "compile_causes": compiles,
             "memory": memory,
+            # workload attribution + SLO rollup: who is hot, how skewed,
+            # and whether the cluster is inside its error budgets
+            "hot_grains": hot_grains,
+            "skew": skew,
+            "slo": slo,
             "dead_letters": dead,
             "overload": {
                 "shed_count": int(
@@ -269,6 +348,26 @@ def render_text(view: Dict[str, Any]) -> str:
             + (f" headroom={row['headroom']:.0%}"
                if "headroom" in row else "")
             for src, row in sorted(c["memory"].items())))
+    if c.get("hot_grains"):
+        lines.append("hot grains: " + "; ".join(
+            f"{h['arena']}/{h['key']}@{h['silo']}: {h['msgs']} msgs "
+            f"({h['share']:.1%})" for h in c["hot_grains"][:5]))
+    if c.get("skew"):
+        lines.append("skew: " + "; ".join(
+            f"{arena}: shard_max={row.get('max_shard_share', 0):.2f} "
+            f"gini={row.get('gini', 0):.2f} "
+            f"p99/mean={row.get('p99_to_mean', 0):.1f} "
+            f"top{''}k={row.get('topk_share', 0):.1%}"
+            for arena, row in sorted(c["skew"].items())))
+    s = c.get("slo")
+    if s and (s["latency_window_msgs"] or s["attempted_msgs"]):
+        who = f" worst={s['worst_silo']}" if s.get("worst_silo") else ""
+        lines.append(
+            f"slo: {'HEALTHY' if s['healthy'] else 'BURNING'} "
+            f"latency_burn={s['latency_burn_rate']} "
+            f"({s['latency_over_budget']}/{s['latency_window_msgs']} "
+            f"over budget) drop_burn={s['drop_burn_rate']} "
+            f"({s['dropped_msgs']}/{s['attempted_msgs']} dropped){who}")
     if c["dead_letters"]:
         lines.append("dead letters: " + ", ".join(
             f"{k}={v}" for k, v in sorted(c["dead_letters"].items())))

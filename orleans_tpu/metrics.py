@@ -215,6 +215,64 @@ declare("memory.headroom", KIND_GAUGE, "ratio",
         "free HBM fraction (1 - in_use/limit); the ShedController "
         "floors its shed level below the configured low watermark")
 
+# -- workload attribution plane (tensor/attribution.py) ----------------------
+declare("hot.tracked_msgs", KIND_COUNTER, "messages",
+        "message lanes folded into the attribution plane (per-row "
+        "traffic counts + count-min sketch; live + retired)")
+declare("hot.method_msgs", KIND_COUNTER, "messages",
+        "messages applied per (type, method) slot (label 'method' = "
+        "Type.method; the attribution plane's traffic-share numerator)")
+declare("hot.grain_msgs", KIND_GAUGE, "messages",
+        "messages received by one HotSet grain since engine start "
+        "(labels 'arena', 'key'; the candidate top-K read off the "
+        "device counts column, merged with eviction-retired history)")
+declare("hot.grain_share", KIND_GAUGE, "ratio",
+        "one HotSet grain's share of its arena's tracked traffic "
+        "(labels 'arena', 'key') — the hot-shard detection signal")
+declare("hot.topk_share", KIND_GAUGE, "ratio",
+        "combined traffic share of the arena's top-K grains (label "
+        "'arena'; 1.0 = all traffic lands on K grains)")
+declare("hot.confidence", KIND_GAUGE, "ratio",
+        "count-min sketch confidence of the HotSet estimates "
+        "(1 - exp(-depth); the error bound is (e/width) * total)")
+declare("skew.max_shard_share", KIND_GAUGE, "ratio",
+        "largest mesh-shard's share of one arena's traffic (label "
+        "'arena'; 1/n_shards = perfectly balanced)")
+declare("skew.gini", KIND_GAUGE, "ratio",
+        "Gini coefficient of per-grain traffic over one arena's live "
+        "rows (label 'arena'; 0 = uniform, →1 = one grain takes all)")
+declare("skew.p99_to_mean", KIND_GAUGE, "ratio",
+        "p99 per-grain message count over the mean across live rows "
+        "(label 'arena'; the heavy-tail gauge)")
+
+# -- cluster SLO rollup (silo.collect_metrics; dashboard slo row) ------------
+declare("slo.latency_window_msgs", KIND_COUNTER, "messages",
+        "messages judged against the latency budget (device-ledger "
+        "totals while a target_tick_latency budget is set)")
+declare("slo.latency_over_budget", KIND_COUNTER, "messages",
+        "messages whose device-ledger latency bucket lies entirely "
+        "above the budget (conservative: only surely-over buckets)")
+declare("slo.latency_burn_rate", KIND_GAUGE, "ratio",
+        "latency SLO burn: over-budget fraction / error budget "
+        "(> 1 = the silo is burning its latency budget)")
+declare("slo.latency_error_budget", KIND_GAUGE, "ratio",
+        "configured latency error budget (MetricsConfig."
+        "slo_latency_error_budget)")
+declare("slo.dropped_msgs", KIND_COUNTER, "messages",
+        "terminally dropped or shed messages counted against the drop "
+        "SLO (dead letters + adaptive shed)")
+declare("slo.attempted_msgs", KIND_COUNTER, "messages",
+        "messages offered to the silo (engine + host path + drops; the "
+        "drop SLO's denominator)")
+declare("slo.drop_burn_rate", KIND_GAUGE, "ratio",
+        "drop SLO burn: dropped fraction / error budget")
+declare("slo.drop_error_budget", KIND_GAUGE, "ratio",
+        "configured drop error budget (MetricsConfig."
+        "slo_drop_error_budget)")
+declare("slo.healthy", KIND_GAUGE, "bool",
+        "1 when every burn rate is within budget on this silo, else 0 "
+        "— the dashboard's one-look cluster-health answer")
+
 # -- host control path (stats.SiloMetrics mirror) ----------------------------
 declare("host.requests_sent", KIND_COUNTER, "requests",
         "application requests sent on the host path")
@@ -425,6 +483,17 @@ class MetricsRegistry:
             inst = self._gauges[key] = Gauge()
         return inst
 
+    def drop_gauges(self, name: str) -> None:
+        """Remove every labeled instance of one gauge family — for
+        re-published bounded sets (the HotSet's (arena, key) rows)
+        whose label VALUES churn: without the drop, a grain that left
+        the hot set would keep its last cumulative gauge in every later
+        snapshot forever, and the label cardinality would grow without
+        bound over a long-running silo's life."""
+        self._check(name, KIND_GAUGE)
+        for key in [k for k in self._gauges if k[0] == name]:
+            del self._gauges[key]
+
     def histogram(self, name: str, labels: Optional[Dict[str, Any]] = None,
                   base: float = 1.0,
                   n_buckets: Optional[int] = None) -> Log2Histogram:
@@ -533,3 +602,63 @@ def histogram_percentiles(hist: Dict[str, Any],
     return {f"p{int(p) if float(p).is_integer() else p}":
             percentile_from_counts(hist["counts"], p, hist["base"])
             for p in ps}
+
+
+# ---------------------------------------------------------------------------
+# catalog documentation (METRICS.md is generated from here — the test in
+# tests/test_metrics.py fails when the checked-in file drifts)
+# ---------------------------------------------------------------------------
+
+def generate_doc() -> str:
+    """Render the CATALOG as the METRICS.md markdown: one table per
+    dotted-prefix group, deterministic order, nothing hand-written —
+    ``python -m orleans_tpu.metrics --doc > METRICS.md`` regenerates."""
+    lines = [
+        "# Metrics catalog",
+        "",
+        "Every metric name the runtime may emit, generated from the one",
+        "source of truth (`orleans_tpu/metrics.py` `CATALOG`).  Do not",
+        "edit by hand — regenerate with:",
+        "",
+        "```bash",
+        "python -m orleans_tpu.metrics --doc > METRICS.md",
+        "```",
+        "",
+        "The registry refuses undeclared names and the catalog lint",
+        "(`tests/test_metrics.py`) walks the source tree asserting every",
+        "emitted literal is declared, so this file is complete by",
+        "construction.",
+    ]
+    groups: Dict[str, List[MetricSpec]] = {}
+    for name in sorted(CATALOG):
+        groups.setdefault(name.split(".", 1)[0], []).append(CATALOG[name])
+    for prefix in sorted(groups):
+        lines += ["", f"## `{prefix}.*`", "",
+                  "| name | kind | unit | description |",
+                  "|---|---|---|---|"]
+        for spec in groups[prefix]:
+            doc = " ".join(spec.doc.split())
+            lines.append(f"| `{spec.name}` | {spec.kind} | {spec.unit} "
+                         f"| {doc} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m orleans_tpu.metrics",
+        description="metrics catalog tooling")
+    parser.add_argument("--doc", action="store_true",
+                        help="print the generated METRICS.md content")
+    args = parser.parse_args(argv)
+    if args.doc:
+        print(generate_doc(), end="")
+        return 0
+    parser.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

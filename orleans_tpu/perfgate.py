@@ -94,7 +94,43 @@ FAMILIES: Dict[str, Tuple[str, str, Optional[str]]] = {
     "multichip": ("MULTICHIP", "multichip_metrics",
                   "MULTICHIP_BENCH.json"),
     "latency": ("LATENCY", "latency_metrics", "LATENCY_BENCH.json"),
+    "attribution": ("ATTRIBUTION", "attribution_metrics",
+                    "ATTRIBUTION_BENCH.json"),
 }
+
+
+def check_rig(baseline: Dict[str, Any],
+              artifact: Dict[str, Any]) -> Dict[str, Any]:
+    """Compare the artifact's ``rig`` header (bench.py _rig_header:
+    toolchain versions + device identity) against the baseline's
+    recorded rig.  A mismatch is a WARNING, never a failure — the bands
+    still evaluate, but the verdict says the numbers were measured on
+    different hardware/toolchains so the reader stops trusting small
+    ratios (this repo's CPU-mesh multichip rounds are the cautionary
+    tale).  Artifacts predating the rig header report 'unknown'."""
+    base_rig = baseline.get("rig")
+    art_rig = artifact.get("rig")
+    if not isinstance(base_rig, dict) or not isinstance(art_rig, dict):
+        return {"status": "unknown",
+                "note": "rig header absent from "
+                        + ("baseline and artifact"
+                           if not isinstance(base_rig, dict)
+                           and not isinstance(art_rig, dict)
+                           else "baseline" if not isinstance(base_rig,
+                                                             dict)
+                           else "artifact")}
+    mismatches = [
+        {"field": k, "baseline": base_rig[k], "artifact": art_rig[k]}
+        for k in sorted(set(base_rig) & set(art_rig))
+        if k != "schema_version" and base_rig[k] != art_rig[k]]
+    if mismatches:
+        return {"status": "mismatch", "mismatches": mismatches,
+                "warning": "artifact and baseline were measured on "
+                           "differing rigs ("
+                           + ", ".join(m["field"] for m in mismatches)
+                           + ") — tolerance bands compare "
+                             "apples to oranges"}
+    return {"status": "match"}
 
 
 def evaluate_metric(name: str, spec: Dict[str, Any],
@@ -194,6 +230,9 @@ def render_markdown(verdict: Dict[str, Any],
         lines.append(
             f"| {r['name']} | {fmt(r['baseline'])} | {fmt(r['current'])} "
             f"| {fmt(r.get('ratio'))} | {band} | {mark} |")
+    rig = verdict.get("rig_check", {})
+    if rig.get("status") == "mismatch":
+        lines += ["", f"⚠️ RIG MISMATCH: {rig['warning']}"]
     lines.append("")
     return "\n".join(lines)
 
@@ -253,7 +292,43 @@ def run_gate(baseline_path: str, artifact: Optional[Dict[str, Any]] = None,
     verdict = evaluate(baseline, artifact, strict_missing=strict_missing)
     verdict["artifact"] = artifact_name
     verdict["family"] = family
+    verdict["rig_check"] = check_rig(baseline, artifact)
     return verdict
+
+
+def run_all_families(baseline_path: str,
+                     strict_missing: bool = False) -> Dict[str, Any]:
+    """The one-CI-gate entrypoint (``--all-families``): evaluate every
+    artifact family against its baseline section in one invocation.
+    Combined status is the worst family's — any fail beats any error
+    beats pass — so one exit code guards the whole perf surface; a
+    family whose artifact or baseline section is missing reads as an
+    error entry, never as silently skipped."""
+    families: Dict[str, Any] = {}
+    for family in sorted(FAMILIES):
+        try:
+            families[family] = run_gate(baseline_path,
+                                        strict_missing=strict_missing,
+                                        family=family)
+        except (OSError, json.JSONDecodeError, KeyError, TypeError,
+                ValueError) as exc:
+            families[family] = {"status": "error",
+                                "error": f"{type(exc).__name__}: {exc}"}
+    statuses = [v.get("status") for v in families.values()]
+    combined = (STATUS_FAIL if STATUS_FAIL in statuses
+                else "error" if "error" in statuses else STATUS_PASS)
+    rig_warnings = {
+        f: v["rig_check"]["warning"] for f, v in families.items()
+        if v.get("rig_check", {}).get("status") == "mismatch"}
+    out: Dict[str, Any] = {
+        "status": combined,
+        "families": families,
+        "checked": sum(v.get("checked", 0) for v in families.values()),
+        "failed": sum(v.get("failed", 0) for v in families.values()),
+    }
+    if rig_warnings:
+        out["rig_warnings"] = rig_warnings
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -282,8 +357,42 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "LATENCY_BENCH.json against "
                              "'latency_metrics' (honored flags use "
                              "direction 'flag': honored→unhonored "
-                             "always fails)")
+                             "always fails); 'attribution' compares "
+                             "ATTRIBUTION_r*.json / ATTRIBUTION_BENCH"
+                             ".json against 'attribution_metrics'")
+    parser.add_argument("--all-families", action="store_true",
+                        help="evaluate EVERY family in one invocation "
+                             "(the one CI gate entrypoint): combined "
+                             "JSON verdict, single exit code — any "
+                             "family failing fails the gate, any "
+                             "unusable family is exit 2")
     args = parser.parse_args(argv)
+
+    if args.all_families:
+        if args.artifact:
+            print(json.dumps({"status": "error",
+                              "error": "--all-families locates each "
+                                       "family's artifact itself; "
+                                       "--artifact conflicts with it"}))
+            return 2
+        if not os.path.exists(args.baseline):
+            print(json.dumps({"status": "error",
+                              "error": f"baseline {args.baseline} "
+                                       "not found"}))
+            return 2
+        combined = run_all_families(args.baseline,
+                                    strict_missing=args.strict_missing)
+        for fam, warning in combined.get("rig_warnings", {}).items():
+            print(f"perfgate: [{fam}] {warning}", file=sys.stderr)
+        if args.markdown:
+            md = "\n".join(
+                render_markdown(v, v.get("artifact", ""))
+                for v in combined["families"].values()
+                if v.get("metrics") is not None)
+            with open(args.markdown, "w") as f:
+                f.write(md + "\n")
+        print(json.dumps(combined))
+        return {STATUS_PASS: 0, STATUS_FAIL: 1}.get(combined["status"], 2)
 
     if not os.path.exists(args.baseline):
         print(json.dumps({"status": "error",
@@ -320,6 +429,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if verdict.get("status") == "error":
         print(json.dumps(verdict))
         return 2
+    rig = verdict.get("rig_check", {})
+    if rig.get("status") == "mismatch":
+        print(f"perfgate: {rig['warning']}", file=sys.stderr)
     md = render_markdown(verdict, verdict.get("artifact", artifact_name))
     if args.markdown:
         with open(args.markdown, "w") as f:

@@ -37,6 +37,11 @@ class SiloRuntimeStatistics:
     # load view already pays for — no second gossip channel.  None when
     # the metrics plane is disabled.
     metrics: Optional[dict] = None
+    # piggybacked HotSet (tensor/attribution.py): the silo's hot grains
+    # with estimated message share + sketch confidence — the hot-shard
+    # detection signal ROADMAP item 4's rebalancer consumes.  Same
+    # broadcast, same reasoning; empty when attribution is off.
+    hot_set: Optional[list] = None
 
 
 def collect_silo_statistics(silo) -> SiloRuntimeStatistics:
@@ -59,6 +64,11 @@ def collect_silo_statistics(silo) -> SiloRuntimeStatistics:
         is_overloaded=enqueued > silo.config.messaging.max_enqueued_requests,
         timestamp=time.time(),
         metrics=metrics,
+        # serves the copy the cadence-gated attribution publish cached
+        # (silo.hot_set default) — under traffic the snapshot cache key
+        # moves every tick, so a live read here would be an ungated
+        # blocking device fetch per broadcast
+        hot_set=silo.hot_set(),
     )
 
 

@@ -116,6 +116,10 @@ class Silo:
         from orleans_tpu.metrics import MetricsRegistry
         self.metrics_registry = MetricsRegistry(source=self.name)
         self._ledger_publish_tick = -(1 << 30)  # last d2h-fetch tick
+        # HotSet refreshed on the cadence-gated attribution publish —
+        # the broadcast path serves this copy instead of paying an
+        # ungated device fetch per publisher interval
+        self._hot_set_cache: Optional[List[Dict[str, Any]]] = None
 
         # distributed tracing plane (orleans_tpu/spans.py): hop spans +
         # batched engine-tick spans + the crash flight recorder.  Built
@@ -540,6 +544,11 @@ class Silo:
             self.tensor_engine.ledger.configure(
                 enabled=mc.enabled and mc.ledger_enabled,
                 n_buckets=mc.ledger_buckets)
+            self.tensor_engine.attribution.configure(
+                enabled=mc.enabled and mc.attribution_enabled,
+                top_k=mc.attribution_top_k,
+                cms_depth=mc.attribution_cms_depth,
+                cms_width=mc.attribution_cms_width)
             # device cost plane: the profiler reads the SAME ProfilerConfig
             # dataclass object update_config just mutated — configure()
             # only refreshes derived state (bucket-array layout)
@@ -824,20 +833,146 @@ class Silo:
                 reg.gauge("memory.headroom").set(mem["headroom"])
             # the on-device latency ledger: the bucket-count fetch is
             # ONE small d2h transfer, gated by the publish cadence so a
-            # hot snapshot() loop cannot turn it into per-tick traffic
+            # hot snapshot() loop cannot turn it into per-tick traffic.
+            # The attribution plane and the latency-SLO judgement share
+            # the same cadence gate (their d2h reads ride it too).
+            due = force_ledger or (
+                eng.tick_number - self._ledger_publish_tick
+                >= self.config.metrics.publish_interval_ticks)
+            if due:
+                self._ledger_publish_tick = eng.tick_number
             led = eng.ledger
             if led.enabled:
-                due = force_ledger or (
-                    eng.tick_number - self._ledger_publish_tick
-                    >= self.config.metrics.publish_interval_ticks)
-                if due:
-                    self._ledger_publish_tick = eng.tick_number
                 for method, h in (led.snapshot() if due else {}).items():
                     reg.histogram("engine.latency_ticks",
                                   {"method": method}, base=1.0,
                                   n_buckets=led.n_buckets
                                   ).set_counts(h["counts"])
+            att = eng.attribution
+            if due:
+                if att.enabled:
+                    self._publish_attribution(reg, att.snapshot())
+                    # the snapshot above is cached, so flattening the
+                    # HotSet here is free — and the broadcast path can
+                    # serve this copy on the same cadence
+                    self._hot_set_cache = att.hot_set()
+                elif self._hot_set_cache:
+                    # attribution live-disabled since the last publish:
+                    # retract the published rows and the broadcast
+                    # cache — a stale HotSet/gauge row would keep
+                    # feeding the rebalancer and dashboard dead data
+                    for name in self._ATTRIBUTION_GAUGE_FAMILIES:
+                        reg.drop_gauges(name)
+                    self._hot_set_cache = []
+                self._publish_slo(reg, eng)
         return reg.snapshot()
+
+    #: every attribution gauge family whose label VALUES churn between
+    #: publishes — dropped before each re-publish, and retracted
+    #: wholesale when the plane is live-disabled
+    _ATTRIBUTION_GAUGE_FAMILIES = (
+        "hot.grain_msgs", "hot.grain_share", "hot.topk_share",
+        "hot.confidence", "skew.max_shard_share", "skew.gini",
+        "skew.p99_to_mean")
+
+    def _publish_attribution(self, reg, snap: Dict[str, Any]) -> None:
+        """Mirror the workload-attribution snapshot into the registry's
+        hot.*/skew.* rows (tensor/attribution.py): HotSet grains keyed
+        by (arena, key) gauge labels so the offline dashboard merge and
+        the load-publisher broadcast carry them without a side channel.
+        Every re-published family is dropped first: the label values
+        churn (grains enter and leave the hot set, arenas come and go),
+        and a gauge left behind would sit stale in every later snapshot
+        while the registry's cardinality grew without bound."""
+        for name in self._ATTRIBUTION_GAUGE_FAMILIES:
+            reg.drop_gauges(name)
+        tracked = 0
+        for arena_name, a in snap["arenas"].items():
+            tracked += a["total_msgs"]
+            labels = {"arena": arena_name}
+            sk = a["skew"]
+            reg.gauge("skew.max_shard_share",
+                      labels).set(sk["max_shard_share"])
+            reg.gauge("skew.gini", labels).set(sk["gini"])
+            reg.gauge("skew.p99_to_mean", labels).set(sk["p99_to_mean"])
+            reg.gauge("hot.topk_share", labels).set(a["topk_share"])
+            reg.gauge("hot.confidence",
+                      labels).set(snap["sketch"]["confidence"])
+            for h in a["hot"]:
+                hl = {"arena": arena_name, "key": str(h["key"])}
+                reg.gauge("hot.grain_msgs", hl).set(h["msgs"])
+                reg.gauge("hot.grain_share", hl).set(h["share"])
+        reg.counter("hot.tracked_msgs").set_total(tracked)
+        for method, msgs in snap["methods"].items():
+            reg.counter("hot.method_msgs",
+                        {"method": method}).set_total(msgs)
+
+    def _publish_slo(self, reg, eng) -> None:
+        """The cluster SLO rollup's per-silo half: judge the device
+        ledger's latency distribution against the live budget and the
+        drop counters against the offered load, as burn-rate gauges
+        (slo.* catalog rows).  Counters are cluster-mergeable, so the
+        dashboard recomputes the CLUSTER burn from summed counters and
+        names the silo responsible from the per-source gauges."""
+        from orleans_tpu.metrics import bucket_bounds
+        mc = self.config.metrics
+        budget = eng.config.target_tick_latency
+        window = over = 0
+        if budget > 0 and eng.ledger.enabled and eng.ticks_run:
+            spt = eng.tick_seconds / eng.ticks_run
+            counts = eng.ledger.fetch_counts()
+            window = int(counts.sum())
+            if spt > 0:
+                bounds = bucket_bounds(1.0, eng.ledger.n_buckets)
+                # conservative: only buckets whose LOWER bound already
+                # exceeds the budget count as surely-over
+                over_buckets = [k for k, (lo, _hi) in enumerate(bounds)
+                                if lo * spt > budget]
+                over = int(counts[:, over_buckets].sum()) \
+                    if over_buckets else 0
+        reg.counter("slo.latency_window_msgs").set_total(window)
+        reg.counter("slo.latency_over_budget").set_total(over)
+        lat_burn = (over / window / mc.slo_latency_error_budget) \
+            if window and mc.slo_latency_error_budget > 0 else 0.0
+        reg.gauge("slo.latency_burn_rate").set(lat_burn)
+        reg.gauge("slo.latency_error_budget").set(
+            mc.slo_latency_error_budget)
+        dropped = self.dead_letters.total + self.shed_controller.shed_count
+        attempted = dropped + eng.messages_processed \
+            + self.metrics.requests_sent
+        reg.counter("slo.dropped_msgs").set_total(dropped)
+        reg.counter("slo.attempted_msgs").set_total(attempted)
+        drop_burn = (dropped / attempted / mc.slo_drop_error_budget) \
+            if attempted and mc.slo_drop_error_budget > 0 else 0.0
+        reg.gauge("slo.drop_burn_rate").set(drop_burn)
+        reg.gauge("slo.drop_error_budget").set(mc.slo_drop_error_budget)
+        reg.gauge("slo.healthy").set(
+            1.0 if lat_burn <= 1.0 and drop_burn <= 1.0 else 0.0)
+
+    def hot_set(self, refresh: bool = False) -> List[Dict[str, Any]]:
+        """The silo's HotSet — hot grains with estimated message share
+        and sketch confidence (tensor/attribution.py contract).  The
+        load publisher broadcasts it with the runtime statistics; the
+        rebalance plane (ROADMAP item 4) consumes it unchanged.
+
+        Serves the copy cached by the cadence-gated attribution publish
+        (``collect_metrics``): the attribution snapshot cache keys on
+        the fold count, which moves every tick under traffic, so an
+        on-demand read per publisher broadcast would be an ungated
+        blocking device fetch — exactly the per-interval sync point the
+        ledger's cadence gate exists to prevent.  ``refresh=True`` (or
+        a never-published silo) computes live — the interactive /
+        diagnostic read, an explicit device fetch like
+        ``ledger.snapshot()``.  A live-disabled plane reports empty
+        immediately — the cadence-gated retraction must not gate the
+        broadcast on serving one more stale copy."""
+        eng = self.tensor_engine
+        if eng is None or not self.config.metrics.enabled \
+                or not eng.attribution.enabled:
+            return []
+        if refresh or self._hot_set_cache is None:
+            self._hot_set_cache = eng.attribution.hot_set()
+        return self._hot_set_cache
 
     def cluster_metrics(self, own: Optional[Dict[str, Any]] = None
                         ) -> Dict[str, Any]:

@@ -220,6 +220,18 @@ class GrainArena:
             if fuser is not None and fuser._unverified:
                 fuser._settle_chain()
 
+    def _attribution(self):
+        """The owning engine's workload-attribution plane when it holds
+        counts for this arena — row-lifecycle events (eviction, growth,
+        compaction, reshard) must keep its per-row traffic column in
+        step with the key→row map (tensor/attribution.py)."""
+        ref = self._owner_engine
+        engine = ref() if ref is not None else None
+        att = getattr(engine, "attribution", None) \
+            if engine is not None else None
+        return att if att is not None and att.has_state(self.info.name) \
+            else None
+
     # -- state columns ------------------------------------------------------
 
     def _make_column(self, f: StateField, capacity: int) -> jnp.ndarray:
@@ -527,6 +539,11 @@ class GrainArena:
             new_state[name] = col
         self.last_use_dev = self._dev_zeros_i32(new_capacity).at[dst].set(
             self.last_use_dev[idx])
+        att = self._attribution()
+        if att is not None:
+            # traffic counts move with their rows (device scatter, the
+            # last_use_dev discipline — keys keep their totals)
+            att.remap_rows(self, old_rows, new_rows, new_capacity)
 
         self.state = new_state
         self.shard_capacity = new_per
@@ -667,6 +684,12 @@ class GrainArena:
         if len(victims) == 0:
             return 0
         keys = self._key_of_row[victims]
+        att = self._attribution()
+        if att is not None:
+            # retire the victims' traffic counts per key BEFORE the rows
+            # return to the free list — a reused slot must never inherit
+            # the evicted grain's attribution (epoch bit-exactness)
+            att.on_evict(self, victims, keys)
         if write_back and self.store is not None:
             # columnar fast path: the gathered columns go to the store
             # as-is — no O(victims) list-of-dicts construction here
@@ -739,6 +762,9 @@ class GrainArena:
             self.state[name] = col.at[dst].set(self.state[name][idx])
         self.last_use_dev = self._dev_zeros_i32(self.capacity).at[dst].set(
             self.last_use_dev[idx])
+        att = self._attribution()
+        if att is not None:
+            att.remap_rows(self, old_rows, new_rows, self.capacity)
         self._dirty = True
         self.generation += 1
 
@@ -753,6 +779,13 @@ class GrainArena:
         the same stable key hash and the state gathers to its new block in
         one scatter per column."""
         self._settle_owner_chain()
+        att = self._attribution()
+        if att is not None:
+            # fold traffic counts to the host retired mirror while the
+            # key→row map still describes the old layout (the mesh may
+            # change under us — ledger.relocate's reasoning); counts
+            # re-accumulate on the new device set, totals survive per key
+            att.fold_type(self.info.name, self)
         live_rows = np.nonzero(self._key_of_row >= 0)[0]
         keys = self._key_of_row[live_rows]
         last_use = self.effective_last_use()[live_rows]

@@ -100,6 +100,7 @@ class AutoFuser:
         self._chain_generations: Dict[str, int] = {}
         self._chain_epochs: Dict[str, int] = {}
         self._chain_ledger: Optional[Tuple] = None
+        self._chain_attr: Optional[Tuple] = None
         # caches / stats
         self._programs: Dict[Tuple, Any] = {}
         self._disabled: Dict[Tuple, int] = {}   # sig → ring version at ban
@@ -378,7 +379,8 @@ class AutoFuser:
             prog._compiled = wrapped.lower(
                 states, statics0, stacked0,
                 jnp.zeros(2, jnp.int32),
-                self.engine.ledger.device_hist_in()).compile()
+                self.engine.ledger.device_hist_in(),
+                prog.attr_state_in()).compile()
             prog._reshard_count = self.engine.reshard_count
             # churn attribution: the engagement's AOT lower+compile is
             # the one fused site where the FULL lowering wall time is
@@ -477,10 +479,11 @@ class AutoFuser:
             self._chain_epochs = {
                 n: engine.arena_for(n).eviction_epoch
                 for n in prog._touched}
-            # the latency ledger accumulates INSIDE the windows: a
-            # rollback must also undo those counts (the unfused replay
-            # re-records every message)
+            # the latency ledger and the attribution plane accumulate
+            # INSIDE the windows: a rollback must also undo those
+            # counts (the unfused replay re-records every message)
             self._chain_ledger = engine.ledger.snapshot_state()
+            self._chain_attr = engine.attribution.snapshot_state()
 
         prog.run(stackeds if prog._is_multi() else stackeds[0],
                  static_args=statics if prog._is_multi() else statics[0])
@@ -523,12 +526,14 @@ class AutoFuser:
         generations = self._chain_generations
         epochs = self._chain_epochs
         ledger_state = self._chain_ledger
+        attr_state = self._chain_attr
         self._chain_prog = None
         self._chain_snapshot = None
         self._chain_counters = None
         self._chain_generations = {}
         self._chain_epochs = {}
         self._chain_ledger = None
+        self._chain_attr = None
         misses = prog.verify()
         n_ticks = sum(len(w) for w in windows)
         if misses == 0:
@@ -574,6 +579,10 @@ class AutoFuser:
             # drop the rolled-back windows' in-program accumulation —
             # the unfused replay below re-records every message
             engine.ledger.restore_state(ledger_state)
+        if attr_state is not None:
+            # attribution counts rolled back the same way (bit-exact
+            # sketch/count survival is the plane's acceptance contract)
+            engine.attribution.restore_state(attr_state)
         sig = self._sig
         strikes = self._rollback_counts.get(sig, 0) + 1
         self._rollback_counts[sig] = strikes

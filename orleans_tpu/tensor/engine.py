@@ -50,8 +50,9 @@ from orleans_tpu.config import (
 from orleans_tpu.core.grain import MethodInfo
 from orleans_tpu.ids import GrainId
 from orleans_tpu.tensor.arena import GrainArena
+from orleans_tpu.tensor.attribution import WorkloadAttribution
 from orleans_tpu.tensor.exchange import exchangeable_args
-from orleans_tpu.tensor.ledger import DeviceLatencyLedger
+from orleans_tpu.tensor.ledger import DeviceLatencyLedger, SlotRegistry
 from orleans_tpu.tensor.memledger import DeviceMemoryLedger
 from orleans_tpu.tensor.profiler import (
     CAUSE_BUCKET_GROWTH,
@@ -611,10 +612,26 @@ class TensorEngine:
         # log2 histograms of inject→completion tick deltas, accumulated
         # inside the tick; MetricsConfig.ledger_enabled gates it live
         self.metrics_config = metrics or MetricsConfig()
+        # shared (type, method) → slot map: the ledger's histogram rows
+        # and the attribution plane's traffic counters index identically
+        self.slot_registry = SlotRegistry()
         self.ledger = DeviceLatencyLedger(
             n_buckets=self.metrics_config.ledger_buckets,
             enabled=(self.metrics_config.enabled
-                     and self.metrics_config.ledger_enabled))
+                     and self.metrics_config.ledger_enabled),
+            slots=self.slot_registry)
+        # workload attribution plane (tensor/attribution.py): per-row
+        # traffic counts + count-min sketch + skew gauges, accumulated
+        # in the dispatch phase and threaded through fused windows like
+        # the ledger hist
+        self.attribution = WorkloadAttribution(
+            self,
+            enabled=(self.metrics_config.enabled
+                     and self.metrics_config.attribution_enabled),
+            top_k=self.metrics_config.attribution_top_k,
+            cms_depth=self.metrics_config.attribution_cms_depth,
+            cms_width=self.metrics_config.attribution_cms_width,
+            slots=self.slot_registry)
         # the device cost plane (tensor/profiler.py + memledger.py):
         # tick-phase attribution + triggered deep capture, cause-coded
         # compile accounting, and HBM-by-owner accounting
@@ -804,6 +821,11 @@ class TensorEngine:
         handoff on membership change,
         GrainDirectoryHandoffManager.cs:141)."""
         await self.flush()
+        # attribution counts fold to the host retired mirror FIRST,
+        # while every arena's key→row map still describes the rows the
+        # counts were accumulated against (arena.reshard hooks the same
+        # fold for direct calls; fold_type is idempotent)
+        self.attribution.relocate()
         self._apply_mesh(mesh)
         for arena in self.arenas.values():
             arena.reshard(self.n_shards, self.sharding)
@@ -1897,6 +1919,22 @@ class TensorEngine:
         if mask is None:
             mask = _mask_for(rows.shape[0] if hasattr(rows, "shape")
                              else len(rows))
+        if self.attribution.enabled:
+            # workload attribution (tensor/attribution.py): fold this
+            # group's destination rows into the per-row traffic counts +
+            # sketch + method slots — ONE async jit dispatch.  Rows here
+            # are final (post-exchange when exchanged, so dropped lanes
+            # count at their redelivery; masked miss lanes likewise),
+            # which keeps the fold in lock-step with what the step
+            # kernel actually applies.  The batch's keys_dev is the
+            # delta-plan memo's stable identity for emit-leg batches,
+            # whose rows re-resolve to a FRESH array every tick (valid
+            # only unexchanged + single-batch: exchange permutes lanes
+            # per tick, concat builds fresh buffers).
+            ident = batches[0].keys_dev \
+                if len(batches) == 1 and not exchanged else None
+            self.attribution.record_group(arena, type_name, method,
+                                          rows, mask, ident=ident)
         # host rows are already bucket-padded here, so len(rows) is the
         # COMPILED shape (the padding rung), not the logical batch size.
         # The arena capacity is part of the signature because the state
@@ -2134,6 +2172,9 @@ class TensorEngine:
             # counts come from engine.ledger.snapshot(), which pays the
             # ONE d2h fetch explicitly)
             "latency_ledger": self.ledger.stats(),
+            # attribution plane health only (HotSet/skew come from
+            # engine.attribution.snapshot(), same explicit-d2h contract)
+            "attribution": self.attribution.stats(),
             # the device cost plane: tick-phase breakdown, cause-coded
             # compile churn (the attributed replacement for the bare
             # "compiles" int above), HBM by owner + headroom

@@ -139,6 +139,12 @@ class FusedTickProgram:
         # (bench.py's device-ledger points measure exactly that).
         self._ledger_on = False
         self._hist_shape: "Tuple[int, int] | None" = None
+        # workload attribution (tensor/attribution.py): baked at build
+        # time like the ledger — the window threads the per-arena
+        # traffic counts + sketch + slot counters through its scan; a
+        # live toggle/sketch-layout change re-traces (config_toggle)
+        self._attr_on = False
+        self._attr_sig: "Tuple | None" = None
         # cross-shard exchange (tensor/exchange.py): baked at build time
         # like the ledger — the window threads the all_to_all through
         # its scan; a live toggle re-traces (cause config_toggle).
@@ -214,11 +220,14 @@ class FusedTickProgram:
     # -- trace-time recursion over the emit graph ---------------------------
 
     def _apply_group(self, states: Dict[str, Any], type_name: str,
-                     method: str, rows, args, mask, depth: int, hist):
+                     method: str, rows, args, mask, depth: int, hist,
+                     attr):
         """Apply one (type, method) batch and recurse into its emits and
         registered fan-outs — the trace-time unrolling of the engine's
         multi-round tick.  ``hist`` is the latency-ledger accumulator
-        threaded through the window (unchanged when the ledger is off)."""
+        threaded through the window (unchanged when the ledger is off);
+        ``attr`` is the workload-attribution accumulator pytree
+        (tensor/attribution.py), empty when that plane is off."""
         info = vector_type(type_name)
         handler = info.handlers[method]
         if type_name not in states:
@@ -258,6 +267,28 @@ class FusedTickProgram:
             hist = _ledger.accumulate(
                 hist, jnp.int32(slot), jnp.zeros(m, jnp.int32),
                 jnp.asarray(mask, bool))
+        if self._attr_on:
+            # in-window workload attribution: the same applied lanes
+            # fold into the traffic counts/sketch/slots — the unfused
+            # engine's per-group dispatch, fused into the scan
+            from orleans_tpu.tensor import attribution as _attr
+            att = self.engine.attribution
+            counts = attr["counts"].get(type_name)
+            if counts is None:
+                # arena discovered mid-trace (discovery pass only — the
+                # real window trace receives every touched arena's
+                # accumulator as an input)
+                counts = att.counts_for(type_name)
+                cms = att.cms_for(type_name)
+            else:
+                cms = attr["cms"][type_name]
+            c2, s2, sl2 = _attr.fold_batch(
+                counts, cms, attr["slots"], att._seed_arr(),
+                jnp.int32(att.slots.slot_for(type_name, method)),
+                rows, jnp.asarray(mask, bool))
+            attr = {"counts": {**attr["counts"], type_name: c2},
+                    "cms": {**attr["cms"], type_name: s2},
+                    "slots": sl2}
         delivered = jnp.int32(0)
         at_cap = depth >= self.engine.config.max_rounds_per_tick
 
@@ -306,7 +337,7 @@ class FusedTickProgram:
             for _, _, _ekeys, _eargs, emask in out_batches:
                 miss_total = miss_total + jnp.sum(
                     jnp.asarray(emask, jnp.int32))
-            return states, miss_total, delivered, hist
+            return states, miss_total, delivered, hist, attr
 
         for dst_type, dst_method, ekeys, eargs, emask in out_batches:
             dst_arena = self.engine.arena_for(dst_type)
@@ -314,12 +345,12 @@ class FusedTickProgram:
             from orleans_tpu.tensor.engine import resolve_rows_on_device
             drows, miss = resolve_rows_on_device(dst_arena, ekeys, emask)
             delivered = delivered + jnp.sum(jnp.asarray(emask, jnp.int32))
-            states, sub_miss, sub_del, hist = self._apply_group(
+            states, sub_miss, sub_del, hist, attr = self._apply_group(
                 states, dst_type, dst_method, drows, eargs,
-                drows >= 0, depth + 1, hist)
+                drows >= 0, depth + 1, hist, attr)
             miss_total = miss_total + miss + sub_miss
             delivered = delivered + sub_del
-        return states, miss_total, delivered, hist
+        return states, miss_total, delivered, hist, attr
 
     def _src_keys_for(self, type_name: str, rows):
         arena = self.engine.arena_for(type_name)
@@ -348,19 +379,24 @@ class FusedTickProgram:
         # the compiled signature, so prepare() re-traces when it changes
         self._ledger_on = self.engine.ledger.enabled
         self._hist_shape = (MAX_SLOTS, self.engine.ledger.n_buckets)
+        # workload attribution: same bake-at-build discipline as the
+        # ledger (prepare() re-traces on toggle/sketch-layout change)
+        self._attr_on = self.engine.attribution.enabled
+        self._attr_sig = self.engine.attribution.build_signature()
         # cross-shard exchange: same bake-at-build discipline
         self._exchange_on = self.engine._exchange_live()
 
-        def apply_all(states, per_source_args, hist):
+        def apply_all(states, per_source_args, hist, attr):
             miss_tot = jnp.int32(0)
             del_tot = jnp.int32(0)
             for i, src in enumerate(self.sources):
-                states, miss, dd, hist = self._apply_group(
+                states, miss, dd, hist, attr = self._apply_group(
                     states, src.type_name, src.method, src_rows[i],
-                    per_source_args[i], masks[i], depth=1, hist=hist)
+                    per_source_args[i], masks[i], depth=1, hist=hist,
+                    attr=attr)
                 miss_tot = miss_tot + miss
                 del_tot = del_tot + dd
-            return states, miss_tot, del_tot, hist
+            return states, miss_tot, del_tot, hist, attr
 
         def reset_discovery() -> None:
             self._generations = {s.type_name: s.arena.generation
@@ -390,8 +426,10 @@ class FusedTickProgram:
                 states: Dict[str, Any] = {
                     s.type_name: s.arena.state for s in self.sources}
                 hist0 = jnp.zeros(self._hist_shape, jnp.int32)
-                _states, miss, _d, _h = apply_all(states, args_per_source,
-                                                  hist0)
+                attr0 = self.attr_state_in(
+                    [s.type_name for s in self.sources])
+                _states, miss, _d, _h, _a = apply_all(
+                    states, args_per_source, hist0, attr0)
                 return miss
 
             jax.eval_shape(discover, examples)
@@ -403,31 +441,42 @@ class FusedTickProgram:
                 self.engine.arena_for(name)  # eager, concrete columns
         touched = list(self._touched)
 
-        def window(states, statics, stackeds, totals_in, hist_in):
+        def window(states, statics, stackeds, totals_in, hist_in,
+                   attr_in):
             def one_tick(carry, args_ts):
-                states, hist = carry
+                states, hist, attr = carry
                 # static leaves (identical every tick) ride OUTSIDE the
                 # scan xs: slicing a [T, m] stack per iteration costs
                 # real bandwidth; a closed-over [m] array costs nothing
                 merged = [{**statics[i], **args_ts[i]}
                           for i in range(len(self.sources))]
-                states, miss, delivered, hist = apply_all(states, merged,
-                                                          hist)
-                return (states, hist), (miss, delivered)
-            (states, hist), (misses, delivered) = jax.lax.scan(
-                one_tick, (states, hist_in), tuple(stackeds))
+                states, miss, delivered, hist, attr = apply_all(
+                    states, merged, hist, attr)
+                return (states, hist, attr), (miss, delivered)
+            (states, hist, attr), (misses, delivered) = jax.lax.scan(
+                one_tick, (states, hist_in, attr_in), tuple(stackeds))
             # totals accumulate ON DEVICE across runs: verify() then
             # reads one 2-element buffer no matter how many windows ran
             # (each completion observation costs ~100ms on tunneled
             # runtimes, so per-window reads would dominate).  The ledger
-            # hist likewise stays on device until an explicit snapshot.
+            # hist and the attribution pytree likewise stay on device
+            # until an explicit snapshot.
             return states, totals_in + jnp.stack(
-                [jnp.sum(misses), jnp.sum(delivered)]), hist
+                [jnp.sum(misses), jnp.sum(delivered)]), hist, attr
 
         self._touched = touched
         self._built_donate = self.donate
         return jax.jit(window,
                        donate_argnums=(0,) if self.donate else ())
+
+    def attr_state_in(self, touched: "List[str] | None" = None):
+        """The attribution accumulator pytree a window run (or the
+        auto-fuser's AOT lower) passes as ``attr_in`` — empty when the
+        plane was off at build time, so the signature stays stable."""
+        if not self._attr_on:
+            return {}
+        return self.engine.attribution.device_state_in(
+            touched if touched is not None else self._touched)
 
     def prepare(self, stacked_args: Any, static_args: Any = None) -> None:
         """Re-resolve the source rows and re-trace if any touched arena
@@ -461,6 +510,7 @@ class FusedTickProgram:
             cause = CAUSE_EPOCH_MISMATCH
         elif self._hist_shape != (MAX_SLOTS, engine.ledger.n_buckets) \
                 or self._ledger_on != engine.ledger.enabled \
+                or self._attr_sig != engine.attribution.build_signature() \
                 or self._exchange_on != engine._exchange_live():
             cause = CAUSE_CONFIG_TOGGLE
         elif self._built_donate != donate_target:
@@ -510,11 +560,13 @@ class FusedTickProgram:
         states = {n: engine.arena_for(n).state for n in self._touched}
         totals_in = self._totals if self._totals is not None \
             else jnp.zeros(2, dtype=jnp.int32)
-        new_states, self._totals, hist_out = self._compiled(
+        new_states, self._totals, hist_out, attr_out = self._compiled(
             states, statics, stackeds, totals_in,
-            engine.ledger.device_hist_in())
+            engine.ledger.device_hist_in(), self.attr_state_in())
         if self._ledger_on:
             engine.ledger.device_hist_out(hist_out)
+        if self._attr_on:
+            engine.attribution.device_state_out(attr_out)
         for n in self._touched:
             # double-buffer flip: donated windows consumed the inputs;
             # the outputs are the live columns now (layout validated)

@@ -45,6 +45,40 @@ import numpy as np
 MAX_SLOTS = 64
 
 
+class SlotRegistry:
+    """The (type, method) → slot map shared by every device accumulator
+    keyed per method — the latency ledger's histograms and the workload
+    attribution plane's traffic counters index the SAME slots, so their
+    per-method rows join without a name translation layer.  Bounded at
+    MAX_SLOTS (the fixed device-array dimension both planes bake into
+    their compiled programs)."""
+
+    __slots__ = ("_slots", "_names")
+
+    def __init__(self) -> None:
+        self._slots: Dict[Tuple[str, str], int] = {}
+        self._names: List[Tuple[str, str]] = []
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    def items(self):
+        return self._slots.items()
+
+    def slot_for(self, type_name: str, method: str) -> int:
+        key = (type_name, method)
+        slot = self._slots.get(key)
+        if slot is None:
+            if len(self._names) >= MAX_SLOTS:
+                raise RuntimeError(
+                    f"slot registry capacity ({MAX_SLOTS} distinct "
+                    "(type, method) pairs) exceeded")
+            slot = len(self._names)
+            self._slots[key] = slot
+            self._names.append(key)
+        return slot
+
+
 def accumulate(hist, slot, deltas, valid):
     """One batched ledger update (traceable — the fused tick program
     inlines this inside its scan): bucket every lane's tick delta
@@ -84,11 +118,13 @@ class DeviceLatencyLedger:
     the whole count array (``d2h_fetches`` counts them — the
     transfer-count test in tests/test_metrics.py pins the budget)."""
 
-    def __init__(self, n_buckets: int = 16, enabled: bool = True) -> None:
+    def __init__(self, n_buckets: int = 16, enabled: bool = True,
+                 slots: Optional[SlotRegistry] = None) -> None:
         self.enabled = enabled
         self.n_buckets = n_buckets
-        self._slots: Dict[Tuple[str, str], int] = {}
-        self._slot_names: List[Tuple[str, str]] = []
+        # (type, method) → slot; shareable with the attribution plane so
+        # both device accumulators index the same rows
+        self.slots = slots if slots is not None else SlotRegistry()
         self._hist: Optional[jnp.ndarray] = None   # [MAX_SLOTS, n_buckets]
         self._host_hist = np.zeros((MAX_SLOTS, n_buckets), dtype=np.int64)
         self._dev_dirty = False      # device hist has unfetched updates
@@ -159,17 +195,7 @@ class DeviceLatencyLedger:
     # -- slots ---------------------------------------------------------------
 
     def slot_for(self, type_name: str, method: str) -> int:
-        key = (type_name, method)
-        slot = self._slots.get(key)
-        if slot is None:
-            if len(self._slot_names) >= MAX_SLOTS:
-                raise RuntimeError(
-                    f"latency ledger slot capacity ({MAX_SLOTS} distinct "
-                    "(type, method) pairs) exceeded")
-            slot = len(self._slot_names)
-            self._slots[key] = slot
-            self._slot_names.append(key)
-        return slot
+        return self.slots.slot_for(type_name, method)
 
     def _device_hist(self) -> jnp.ndarray:
         if self._hist is None:
@@ -242,7 +268,7 @@ class DeviceLatencyLedger:
         from orleans_tpu.metrics import percentile_from_counts
         counts = self.fetch_counts()
         out: Dict[str, Any] = {}
-        for (type_name, method), slot in self._slots.items():
+        for (type_name, method), slot in self.slots.items():
             row = counts[slot]
             total = int(row.sum())
             if total == 0:
@@ -259,7 +285,7 @@ class DeviceLatencyLedger:
     def stats(self) -> Dict[str, Any]:
         """Cheap host-side ledger health (no transfer)."""
         return {"enabled": self.enabled, "n_buckets": self.n_buckets,
-                "slots": len(self._slot_names), "records": self.records,
+                "slots": len(self.slots), "records": self.records,
                 "d2h_fetches": self.d2h_fetches,
                 "accumulate_compiles": accumulate_compiles()}
 

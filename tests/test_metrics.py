@@ -99,6 +99,30 @@ def test_lint_live_silo_collection_is_fully_catalogued():
     asyncio.run(go())
 
 
+def test_metrics_md_matches_catalog():
+    """METRICS.md is GENERATED from the catalog (``python -m
+    orleans_tpu.metrics --doc``) — this fails the moment the checked-in
+    file drifts from the one source of truth."""
+    checked_in = (REPO / "METRICS.md").read_text()
+    assert checked_in == m.generate_doc(), \
+        "METRICS.md drifted from the catalog — regenerate with " \
+        "`python -m orleans_tpu.metrics --doc > METRICS.md`"
+
+
+def test_metrics_doc_cli():
+    """The --doc CLI prints the generated catalog and exits 0; bare
+    invocation is a usage error."""
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert m.main(["--doc"]) == 0
+    assert buf.getvalue() == m.generate_doc()
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert m.main([]) == 2
+
+
 # ---------------------------------------------------------------------------
 # log2 histogram math
 # ---------------------------------------------------------------------------
