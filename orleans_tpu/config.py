@@ -295,6 +295,15 @@ class TensorEngineConfig:
     # engine re-delivers dropped lanes with their original inject stamp;
     # a fused window counts them as misses and rolls back)
     exchange_capacity_factor: float = 2.0
+    # device streams plane (tensor/streams_plane.py): registered
+    # stream-subscription routes expand ON DEVICE — pull-mode (one
+    # payload gather + one scatter-free segment reduction per tick)
+    # when the publish pattern matches the bound key set, push-mode
+    # CSR expansion otherwise.  Off = the host-expansion baseline the
+    # streams bench A/Bs against (per-publish d2h + numpy adjacency
+    # walk).  Live-toggleable: fused windows re-trace, cause
+    # config_toggle.
+    stream_plane: bool = True
     # cross-silo sender aggregation (tensor/router.py): slab fragments
     # bound for one (destination, type, method) within a drain cycle
     # merge into ONE wire frame, so receivers see stable batch sizes

@@ -148,6 +148,37 @@ declare("arena.shard_occupancy", KIND_GAUGE, "rows",
         "live rows in one mesh shard block (labels 'arena', 'shard') — "
         "the per-shard balance behind the multichip bench")
 
+# -- device streams plane (tensor/streams_plane.py) --------------------------
+declare("stream.published_events", KIND_COUNTER, "events",
+        "stream-ingress publishes routed through a device subscription "
+        "adjacency (label 'route' = SrcType.method)")
+declare("stream.delivered_events", KIND_COUNTER, "events",
+        "subscriber deliveries with host-known counts: pull-path edges "
+        "+ host-fallback expansions (label 'route').  Push-path "
+        "delivery volume is device-resident — count it per method via "
+        "engine.latency_ticks / the attribution plane; "
+        "stream.redeliveries tracks its overflow rounds")
+declare("stream.subscriptions", KIND_GAUGE, "edges",
+        "live (stream, subscriber) edges in the adjacency (label "
+        "'route')")
+declare("stream.cold_subscribers", KIND_GAUGE, "edges",
+        "bound-pattern edges whose subscriber is not currently "
+        "activated — the plane falls back to push delivery (which "
+        "reactivates them) until the next rebuild (label 'route')")
+declare("stream.rebuilds", KIND_COUNTER, "rebuilds",
+        "device CSR re-lays (batched churn merges, eviction "
+        "retirement, row moves; label 'route')")
+declare("stream.retired_edges", KIND_COUNTER, "edges",
+        "adjacency edges retired because their subscriber row was "
+        "evicted BEFORE the slot could be reused (label 'route')")
+declare("stream.dropped_lanes", KIND_COUNTER, "events",
+        "publish source lanes parked by CSR-width overflow and "
+        "re-expanded at the next quiescence point with their original "
+        "inject stamp (label 'route'; never silent loss)")
+declare("stream.redeliveries", KIND_COUNTER, "rounds",
+        "overflow redelivery rounds run for parked publish lanes "
+        "(label 'route')")
+
 # -- transport links (runtime/transport per-link stats) ----------------------
 for _n, _u, _d in (
         ("frames_sent", "frames", "wire frames sent on this link"),

@@ -96,6 +96,7 @@ FAMILIES: Dict[str, Tuple[str, str, Optional[str]]] = {
     "latency": ("LATENCY", "latency_metrics", "LATENCY_BENCH.json"),
     "attribution": ("ATTRIBUTION", "attribution_metrics",
                     "ATTRIBUTION_BENCH.json"),
+    "streams": ("STREAMS", "streams_metrics", "STREAMS_BENCH.json"),
 }
 
 
@@ -359,7 +360,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "direction 'flag': honored→unhonored "
                              "always fails); 'attribution' compares "
                              "ATTRIBUTION_r*.json / ATTRIBUTION_BENCH"
-                             ".json against 'attribution_metrics'")
+                             ".json against 'attribution_metrics'; "
+                             "'streams' compares STREAMS_r*.json / "
+                             "STREAMS_BENCH.json against "
+                             "'streams_metrics' (exactness flags use "
+                             "direction 'flag')")
     parser.add_argument("--all-families", action="store_true",
                         help="evaluate EVERY family in one invocation "
                              "(the one CI gate entrypoint): combined "

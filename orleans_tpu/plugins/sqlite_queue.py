@@ -65,6 +65,11 @@ class SqliteQueueAdapter(QueueAdapter):
                                      check_same_thread=False)
         self._conn.execute("PRAGMA busy_timeout=5000")
         self._lock = threading.Lock()  # serialize our own threads
+        #: sqlite round-trips (write transactions + pull selects) — the
+        #: batching contract's observable: one produce() of k items is
+        #: ONE transaction, one pull cycle's dequeue+ack is ONE
+        #: transaction (tests assert the before/after counts)
+        self.transactions = 0
         with self._lock:
             self._conn.executescript(self._SCHEMA)
 
@@ -74,11 +79,16 @@ class SqliteQueueAdapter(QueueAdapter):
 
     # -- synchronous cores (run via asyncio.to_thread) ----------------------
 
-    def _enqueue_sync(self, queue_id: int, msg: QueueMessage) -> int:
+    def _enqueue_many_sync(self, queue_id: int,
+                           msgs: List[QueueMessage]) -> int:
+        """Insert a whole produce() batch under ONE write transaction —
+        k items no longer pay k sequence-allocation round-trips (the
+        per-event half of the old stream-plane host cost)."""
         with self._lock:
             # IMMEDIATE takes the write lock BEFORE the read, so two
             # producer processes cannot both read the same next_seq
             self._conn.execute("BEGIN IMMEDIATE")
+            self.transactions += 1
             try:
                 self._conn.execute(
                     "INSERT OR IGNORE INTO stream_cursors (queue_id, "
@@ -86,22 +96,27 @@ class SqliteQueueAdapter(QueueAdapter):
                 (next_seq,) = self._conn.execute(
                     "SELECT next_seq FROM stream_cursors WHERE queue_id=?",
                     (queue_id,)).fetchone()
-                msg.seq = next_seq
-                self._conn.execute(
+                first = next_seq
+                rows = []
+                for msg in msgs:
+                    msg.seq = next_seq
+                    rows.append((queue_id, next_seq, codec.serialize(msg)))
+                    next_seq += 1
+                self._conn.executemany(
                     "INSERT INTO stream_events (queue_id, seq, payload) "
-                    "VALUES (?,?,?)",
-                    (queue_id, next_seq, codec.serialize(msg)))
+                    "VALUES (?,?,?)", rows)
                 self._conn.execute(
                     "UPDATE stream_cursors SET next_seq=? WHERE queue_id=?",
-                    (next_seq + 1, queue_id))
+                    (next_seq, queue_id))
                 self._conn.execute("COMMIT")
-                return next_seq
+                return first
             except BaseException:
                 self._conn.execute("ROLLBACK")
                 raise
 
     def _pull_sync(self, queue_id: int, max_count: int) -> List[QueueMessage]:
         with self._lock:
+            self.transactions += 1
             row = self._conn.execute(
                 "SELECT cursor FROM stream_cursors WHERE queue_id=?",
                 (queue_id,)).fetchone()
@@ -115,6 +130,7 @@ class SqliteQueueAdapter(QueueAdapter):
     def _ack_sync(self, queue_id: int, up_to_seq: int) -> None:
         with self._lock:
             self._conn.execute("BEGIN IMMEDIATE")
+            self.transactions += 1
             try:
                 self._conn.execute(
                     "UPDATE stream_cursors SET cursor=MAX(cursor, ?) "
@@ -128,10 +144,49 @@ class SqliteQueueAdapter(QueueAdapter):
                 self._conn.execute("ROLLBACK")
                 raise
 
+    def _pull_ack_sync(self, queue_id: int, max_count: int,
+                       ack_up_to: int) -> List[QueueMessage]:
+        """One pull cycle's dequeue AND the previous cycle's ack in ONE
+        write transaction (the pulling agent's batching contract —
+        today's equivalent was one ack round-trip per delivered run,
+        i.e. per EVENT on un-sinked streams)."""
+        with self._lock:
+            self._conn.execute("BEGIN IMMEDIATE")
+            self.transactions += 1
+            try:
+                if ack_up_to >= 0:
+                    self._conn.execute(
+                        "UPDATE stream_cursors SET cursor=MAX(cursor, ?) "
+                        "WHERE queue_id=?", (ack_up_to + 1, queue_id))
+                    self._conn.execute(
+                        "DELETE FROM stream_events WHERE queue_id=? AND "
+                        "seq<(SELECT cursor FROM stream_cursors WHERE "
+                        "queue_id=?) - ?",
+                        (queue_id, queue_id, self.retain))
+                row = self._conn.execute(
+                    "SELECT cursor FROM stream_cursors WHERE queue_id=?",
+                    (queue_id,)).fetchone()
+                cursor = row[0] if row is not None else 0
+                rows = self._conn.execute(
+                    "SELECT payload FROM stream_events WHERE queue_id=? "
+                    "AND seq>=? ORDER BY seq LIMIT ?",
+                    (queue_id, cursor, max_count)).fetchall()
+                self._conn.execute("COMMIT")
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+        return [codec.deserialize(b) for (b,) in rows]
+
     # -- adapter contract ----------------------------------------------------
 
     async def queue_message(self, queue_id: int, msg: QueueMessage) -> None:
-        msg.seq = await asyncio.to_thread(self._enqueue_sync, queue_id, msg)
+        await asyncio.to_thread(self._enqueue_many_sync, queue_id, [msg])
+
+    async def queue_messages(self, queue_id: int,
+                             msgs: List[QueueMessage]) -> None:
+        """Batch enqueue: one transaction for the whole produce() call."""
+        if msgs:
+            await asyncio.to_thread(self._enqueue_many_sync, queue_id, msgs)
 
     def create_receiver(self, queue_id: int) -> "SqliteQueueReceiver":
         return SqliteQueueReceiver(self, queue_id)
@@ -153,6 +208,15 @@ class SqliteQueueReceiver(QueueAdapterReceiver):
         delete-after-processing of the reference's queue receipts)."""
         await asyncio.to_thread(self.adapter._ack_sync, self.queue_id,
                                 up_to_seq)
+
+    async def pull_and_ack(self, max_count: int,
+                           ack_up_to: int) -> List[QueueMessage]:
+        """Combined dequeue + previous-cycle ack: ONE sqlite write
+        transaction per pull cycle (the pulling agent's batching path —
+        ``ack_up_to < 0`` = nothing to ack yet)."""
+        return await asyncio.to_thread(self.adapter._pull_ack_sync,
+                                       self.queue_id, max_count,
+                                       ack_up_to)
 
     async def read_from(self, seq: int,
                         max_count: int) -> List[QueueMessage]:

@@ -775,6 +775,17 @@ class Silo:
                       "exchanges": xs["exchanges_run"],
                       "exchange_s": xs["exchange_seconds"]},
                      None, "route.")
+            for (src_t, src_m), route in eng._stream_routes.items():
+                ss = route.snapshot()
+                emit({"published_events": ss["published_events"],
+                      "delivered_events": ss["delivered_events"],
+                      "subscriptions": ss["edges"],
+                      "cold_subscribers": ss["cold_subscribers"],
+                      "rebuilds": ss["rebuilds"],
+                      "retired_edges": ss["retired_edges"],
+                      "dropped_lanes": ss["dropped_lanes"],
+                      "redeliveries": ss["redeliveries"]},
+                     {"route": f"{src_t}.{src_m}"}, "stream.")
             emit({"messages_processed": eng.messages_processed,
                   "ticks": eng.ticks_run,
                   "compiles": eng.compile_count(),

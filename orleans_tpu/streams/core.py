@@ -234,6 +234,23 @@ def new_subscription_id() -> int:
     return uuid.uuid4().int >> 66  # small positive int, codec-friendly
 
 
+def device_stream_key(stream_id: StreamId) -> int:
+    """A stream's key in the device plane's int31 key space
+    (tensor/streams_plane.py: the subscription CSR and the stream-
+    ingress arena are int32-keyed, like every device directory mirror).
+    Small integer stream keys pass through unchanged — the identity the
+    samples and benches rely on; wider/string identities hash in, the
+    device-routing convention (samples/twitter_sentiment.hashtag_key)."""
+    key = stream_id.key
+    if isinstance(key, int) and 0 <= key < 2**31 - 1:
+        return key
+    # modulo, not `& 0x7FFFFFFE`: the mask would clear bit 0 and halve
+    # the hash space (doubling silent stream collisions); the only
+    # requirement is staying below the int31 KEY_SENTINEL
+    return jenkins_hash(
+        f"{stream_id.namespace}/{key}".encode()) % (2**31 - 1)
+
+
 # ---------------------------------------------------------------------------
 # the stream handle
 # ---------------------------------------------------------------------------

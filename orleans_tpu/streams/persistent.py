@@ -84,6 +84,17 @@ class QueueAdapterReceiver:
         the reference's cache-bounded rewind."""
         raise NotImplementedError
 
+    async def pull_and_ack(self, max_count: int,
+                           ack_up_to: int) -> List[QueueMessage]:
+        """Combined dequeue + deferred ack — the pulling agent's ONE
+        round-trip per pull cycle (``ack_up_to < 0`` = nothing to ack).
+        Durable adapters override this with a single write transaction
+        (plugins/sqlite_queue.py); the default composes the two calls
+        for adapters without transactional batching."""
+        if ack_up_to >= 0:
+            await self.ack(ack_up_to)
+        return await self.get_queue_messages(max_count)
+
 
 class QueueAdapter:
     """(reference: IQueueAdapter — QueueMessageBatchAsync + CreateReceiver)"""
@@ -92,6 +103,14 @@ class QueueAdapter:
 
     async def queue_message(self, queue_id: int, msg: QueueMessage) -> None:
         raise NotImplementedError
+
+    async def queue_messages(self, queue_id: int,
+                             msgs: List[QueueMessage]) -> None:
+        """Batch enqueue: durable adapters override with ONE write
+        transaction for the whole produce() call (plugins/sqlite_queue
+        .py); the default loops."""
+        for msg in msgs:
+            await self.queue_message(queue_id, msg)
 
     def create_receiver(self, queue_id: int) -> QueueAdapterReceiver:
         raise NotImplementedError
@@ -275,6 +294,11 @@ class PullingAgent:
         self.logger = TraceLogger(
             f"streams.{provider.name}.{provider.silo.name}.q{queue_id}")
         self.delivered = 0
+        # durable-ack state, exposed for the graceful-stop flush: the
+        # combined pull_and_ack batching lets the cursor trail delivery
+        # by one cycle while the stream is hot
+        self._delivered_up_to = -1
+        self._acked_up_to = -1
         self._task: Optional[asyncio.Task] = None
         # stream → (consumer list, fetched_at) — TTL cache; agents are not
         # grains, so pub/sub pushes can't reach them (reference agents ARE
@@ -286,30 +310,73 @@ class PullingAgent:
         # sink-bound streams already checked for starved pub/sub
         # subscribers (one advisory warning per stream)
         self._sink_checked: set = set()
+        # sink → (last slab key set, BatchInjector or None): a producer
+        # repeating the same destination slab gets cached resolved rows
+        # + overlapped h2d staging (engine.BatchInjector.stage — the
+        # upload rides under the previous slab's device compute)
+        self._sink_injectors: Dict[Any, list] = {}
 
     def start(self) -> None:
         from orleans_tpu.utils.async_utils import spawn_in_fresh_context
         self._task = spawn_in_fresh_context(self._pull_loop())
 
-    def stop(self) -> None:
+    def stop(self, flush_ack: bool = True) -> "Optional[asyncio.Task]":
+        """Stop pulling; returns the final-ack flush task (None when
+        nothing pends).  A GRACEFUL stop (shutdown, balancer queue
+        handoff) flushes the deferred durable ack first — the cursor
+        may trail delivery by one batched cycle, and a replacement
+        agent would otherwise redeliver (and possibly reorder behind
+        newer production) the delivered tail.  The hard-kill path
+        passes ``flush_ack=False``: a dead silo's agents never touch
+        the shared queues again."""
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        if flush_ack and self._delivered_up_to > self._acked_up_to:
+            seq = self._delivered_up_to
+            self._acked_up_to = seq
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                return None  # no loop (teardown): redelivery covers it
+            from orleans_tpu.utils.async_utils import \
+                spawn_in_fresh_context
+            return spawn_in_fresh_context(self._final_ack(seq))
+        return None
+
+    async def _final_ack(self, seq: int) -> None:
+        try:
+            await self.receiver.ack(seq)
+        except Exception:  # noqa: BLE001 — best effort; at-least-once
+            # covers a lost final ack with redelivery
+            self.logger.warn(f"final ack to seq={seq} failed")
 
     async def _pull_loop(self) -> None:
         p = self.provider
-        delivered_up_to = -1
         attempts = 0  # failed delivery tries for the current retry head
         retry_at = 0.0  # backoff gate for the retry head
         while True:
             try:
                 space = self.cache.free_space
-                if space > 0:  # cache full = backpressure: stop pulling
-                    msgs = await self.receiver.get_queue_messages(
-                        min(p.batch_size, space))
+                if space > 0 or self._delivered_up_to > self._acked_up_to:
+                    # ONE adapter round-trip per pull cycle: dequeue the
+                    # next batch AND ack everything delivered since the
+                    # last cycle in a single transaction (today's cost
+                    # was one ack round-trip per delivered RUN — per
+                    # EVENT on un-sinked streams).  Ack-after-delivery
+                    # is preserved (the ack trails by at most one loop
+                    # iteration — the at-least-once redelivery window
+                    # after a hard kill widens by that one cycle).
+                    ack = self._delivered_up_to \
+                        if self._delivered_up_to > self._acked_up_to \
+                        else -1
+                    msgs = await self.receiver.pull_and_ack(
+                        min(p.batch_size, max(space, 0)), ack)
+                    if ack >= 0:
+                        self._acked_up_to = ack
                     self.cache.add(msgs)  # dedup by seq
                 progressed = False
-                window_msgs = list(self.cache.window(delivered_up_to + 1))
+                window_msgs = list(self.cache.window(self._delivered_up_to + 1))
                 k = 0
                 while k < len(window_msgs):
                     if attempts and time.monotonic() < retry_at:
@@ -322,18 +389,35 @@ class PullingAgent:
                         # field set delivers as ONE slab (splitting on a
                         # field-set boundary keeps mixed-schema traffic
                         # on the fast path — a mixed run would fail
-                        # validation and burn the whole retry schedule)
+                        # validation and burn the whole retry schedule).
+                        # The run is WIDTH-capped (sink_run_max_events):
+                        # merging per-event items amortizes dispatch, but
+                        # concatenating already-slab-sized items would
+                        # build one giant novel key set per pull cycle —
+                        # defeating the sink injector's cached rows, the
+                        # h2d staging overlap, and the attribution
+                        # plane's delta-plan memo all at once
                         def fset(msg):
                             return frozenset(msg.item) \
                                 if isinstance(msg.item, dict) else None
+
+                        def width_of(msg):
+                            kv = msg.item.get(sink.key_field) \
+                                if isinstance(msg.item, dict) else None
+                            return len(kv) if hasattr(kv, "__len__") else 1
                         run = [m]
                         head_fields = fset(m)
+                        run_events = width_of(m)
                         while (k + len(run) < len(window_msgs)
                                and window_msgs[k + len(run)].kind == "item"
                                and p.tensor_sink_for(
                                    window_msgs[k + len(run)]) is sink
                                and fset(window_msgs[k + len(run)])
-                               == head_fields):
+                               == head_fields
+                               and run_events
+                               + width_of(window_msgs[k + len(run)])
+                               <= p.sink_run_max_events):
+                            run_events += width_of(window_msgs[k + len(run)])
                             run.append(window_msgs[k + len(run)])
                         ok = await self._deliver_slab(sink, run)
                         n = len(run)
@@ -384,15 +468,26 @@ class PullingAgent:
                                 f"dropping seq={m.seq} on {m.stream_id} "
                                 f"after {attempts} failed delivery attempts")
                     attempts = 0
-                    last_seq = window_msgs[k + n - 1].seq
-                    await self.receiver.ack(last_seq)
-                    delivered_up_to = last_seq
+                    # delivery recorded; the durable ack batches into
+                    # the NEXT cycle's combined pull_and_ack transaction
+                    self._delivered_up_to = window_msgs[k + n - 1].seq
                     self.delivered += n
                     progressed = True
                     k += n
                 if progressed:
-                    self.cache.trim_to(delivered_up_to)
+                    self.cache.trim_to(self._delivered_up_to)
                     continue  # drain hot queue without sleeping
+                if self._delivered_up_to > self._acked_up_to:
+                    # going idle: flush the deferred ack NOW.  Batching
+                    # the ack into the next pull's transaction is the
+                    # win under sustained flow; at quiescence the
+                    # durable cursor must not trail delivery — a hard
+                    # kill here would redeliver an already-delivered
+                    # tail to the replacement agent, which (beyond the
+                    # wasted work) can REORDER old events after newer
+                    # post-crash production
+                    await self.receiver.ack(self._delivered_up_to)
+                    self._acked_up_to = self._delivered_up_to
             except asyncio.CancelledError:
                 raise
             except Exception as exc:  # noqa: BLE001
@@ -560,7 +655,7 @@ class PullingAgent:
             slab_keys = np.concatenate(keys)
             args = {f: np.concatenate(vs) if len(vs) > 1 else vs[0]
                     for f, vs in cols.items()}
-            engine.send_batch(sink.type_name, sink.method, slab_keys, args)
+            self._inject_slab(engine, sink, slab_keys, args)
         except Exception as exc:  # noqa: BLE001 — retried by the pull loop
             self.logger.warn(
                 f"slab delivery of {len(run)} events to "
@@ -581,6 +676,35 @@ class PullingAgent:
                 f"acking as delivered-with-error (the slab is in the "
                 f"engine; redelivery would double-apply)")
         return True
+
+    def _inject_slab(self, engine, sink: TensorSinkBinding,
+                     slab_keys, args) -> None:
+        """Inject one assembled slab.  A steady producer repeating the
+        SAME destination key set gets a cached BatchInjector: the rows
+        resolve once, and ``stage()`` starts the payload's h2d copy
+        immediately — because the engine's drain does not block on
+        device completion, the upload overlaps the PREVIOUS slab's
+        device compute instead of serializing before this dispatch.
+        Novel key sets take the plain send_batch path."""
+        import numpy as np
+
+        ent = self._sink_injectors.get(sink)
+        if ent is not None and len(ent[0]) == len(slab_keys) \
+                and np.array_equal(ent[0], slab_keys):
+            if ent[1] is None:
+                # second sighting of this key set: steady producer —
+                # build the injector (cluster injectors without a
+                # stage() path fall back to send_batch)
+                inj = engine.make_injector(sink.type_name, sink.method,
+                                           ent[0])
+                ent[1] = inj if hasattr(inj, "stage") else False
+            if ent[1]:
+                ent[1].stage(args)
+                ent[1].inject()
+                return
+        else:
+            self._sink_injectors[sink] = [slab_keys.copy(), None]
+        engine.send_batch(sink.type_name, sink.method, slab_keys, args)
 
     async def _deliver(self, msg: QueueMessage) -> bool:
         """Deliver one event to every subscriber.  Returns False when any
@@ -632,11 +756,15 @@ class PersistentStreamPullingManager:
         self.provider.silo.ring.subscribe(lambda *_: self.rebalance())
         self.rebalance()
 
-    def stop(self) -> None:
+    def stop(self, flush_acks: bool = True) -> list:
+        """Stop every agent; returns the final-ack flush tasks so a
+        graceful provider stop can await them BEFORE releasing the
+        adapter (an unawaited flush would race the adapter close)."""
         self._running = False
-        for agent in self.agents.values():
-            agent.stop()
+        tasks = [agent.stop(flush_ack=flush_acks)
+                 for agent in self.agents.values()]
         self.agents.clear()
+        return [t for t in tasks if t is not None]
 
     def rebalance(self) -> None:
         if not self._running:
@@ -668,7 +796,8 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
                  consumer_cache_ttl: float = 1.0,
                  max_delivery_attempts: int = 8,
                  retry_backoff_initial: float = 0.1,
-                 retry_backoff_max: float = 2.0) -> None:
+                 retry_backoff_max: float = 2.0,
+                 sink_run_max_events: int = 1 << 19) -> None:
         self.adapter = adapter
         self.mapper = HashRingStreamQueueMapper(adapter.n_queues)
         self.pull_period = pull_period
@@ -678,6 +807,8 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
         self.max_delivery_attempts = max_delivery_attempts
         self.retry_backoff_initial = retry_backoff_initial
         self.retry_backoff_max = retry_backoff_max
+        #: width cap on one sink run's concatenated slab (events)
+        self.sink_run_max_events = sink_run_max_events
         self._balancer_cls = balancer_cls
         self.name = "persistent"
         self.silo = None
@@ -745,7 +876,10 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
         self.manager.start()
 
     async def stop(self) -> None:
-        self.manager.stop()
+        tasks = self.manager.stop()
+        if tasks:
+            # settle the final durable acks before releasing the adapter
+            await asyncio.gather(*tasks, return_exceptions=True)
         # durable adapters own real resources (sqlite connections, file
         # handles) — release them with the provider
         close = getattr(self.adapter, "close", None)
@@ -754,9 +888,10 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
 
     def kill(self) -> None:
         """Synchronous teardown for the hard-kill path — a dead silo's
-        agents must never touch the shared queues again."""
+        agents must never touch the shared queues again (no final ack
+        flush either — at-least-once redelivery covers the tail)."""
         if self.manager is not None:
-            self.manager.stop()
+            self.manager.stop(flush_acks=False)
         close = getattr(self.adapter, "close", None)
         if close is not None:
             close()
@@ -767,9 +902,12 @@ class PersistentStreamProvider(PubSubStreamProviderMixin):
 
     async def produce(self, stream_id: StreamId, items: List[Any]) -> None:
         q = self.mapper.queue_for(stream_id)
-        for item in items:
-            await self.adapter.queue_message(
-                q, QueueMessage(stream_id=stream_id, item=item, seq=-1))
+        # one adapter call (durable adapters: ONE write transaction) for
+        # the whole batch — on_next_batch producers no longer pay one
+        # sequence-allocation round-trip per item
+        await self.adapter.queue_messages(
+            q, [QueueMessage(stream_id=stream_id, item=item, seq=-1)
+                for item in items])
 
     async def complete(self, stream_id: StreamId,
                        error: Optional[Exception]) -> None:

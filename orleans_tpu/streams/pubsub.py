@@ -38,12 +38,54 @@ class PubSubStreamProviderMixin:
         from orleans_tpu.streams.core import StreamImpl
         return StreamImpl(self, StreamId(self.name, namespace, key))
 
+    # -- device streams plane (tensor/streams_plane.py) ----------------------
+
+    def bind_device_subscriptions(self, namespace: str,
+                                  subscriptions) -> None:
+        """Mirror this namespace's pub/sub registrations into a device
+        subscription adjacency: every register/unregister through this
+        provider ALSO lands as a batched CSR mutation, so the engine's
+        stream-ingress fan-out (engine.register_subscriptions) always
+        sees the current subscriber set — subscribe/unsubscribe churn
+        batches into the plane's vectorized rebuilds instead of one
+        rendezvous RPC per delivered event.  Only int31-keyed consumers
+        mirror (the device CSR's key space); wider identities keep the
+        host pub/sub path."""
+        planes = getattr(self, "device_planes", None)
+        if planes is None:
+            planes = self.device_planes = {}
+        planes[namespace] = subscriptions
+
+    def _device_plane_for(self, stream_id: StreamId):
+        planes = getattr(self, "device_planes", None)
+        return planes.get(stream_id.namespace) if planes else None
+
+    def _mirror_subscription(self, handle: StreamSubscriptionHandle,
+                             add: bool) -> None:
+        plane = self._device_plane_for(handle.stream_id)
+        if plane is None:
+            return
+        from orleans_tpu.streams.core import device_stream_key
+        try:
+            sub_key = handle.consumer.primary_key_int
+        except Exception:  # noqa: BLE001 — non-integer grain identity
+            return
+        if not 0 <= sub_key < 2**31 - 1:
+            return
+        skey = device_stream_key(handle.stream_id)
+        if add:
+            plane.subscribe(skey, sub_key)
+        else:
+            plane.unsubscribe(skey, sub_key)
+
     async def register_subscription(self,
                                     handle: StreamSubscriptionHandle) -> None:
         await self._pubsub(handle.stream_id).register_consumer(handle)
+        self._mirror_subscription(handle, add=True)
 
     async def unsubscribe(self, handle: StreamSubscriptionHandle) -> None:
         await self._pubsub(handle.stream_id).unregister_consumer(handle)
+        self._mirror_subscription(handle, add=False)
         from orleans_tpu.core import context as ctx
         act = ctx.current_activation()
         if act is not None and act.grain_instance is not None:

@@ -29,6 +29,7 @@ from orleans_tpu.tensor.vector_grain import (
 from orleans_tpu.tensor.engine import TensorEngine
 from orleans_tpu.tensor.fanout import DeviceFanout, FanoutOverflowError
 from orleans_tpu.tensor.fused import FusedTickProgram
+from orleans_tpu.tensor.streams_plane import DeviceSubscriptions
 from orleans_tpu.tensor.memledger import DeviceMemoryLedger
 from orleans_tpu.tensor.profiler import (
     COMPILE_CAUSES,
@@ -58,6 +59,7 @@ __all__ = [
     "vector_grain",
     "TensorEngine",
     "DeviceFanout",
+    "DeviceSubscriptions",
     "FanoutOverflowError",
     "FusedTickProgram",
     "DeviceMemoryLedger",

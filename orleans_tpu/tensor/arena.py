@@ -40,6 +40,16 @@ def _touch_kernel(last_use_dev, rows, tick):
 
 
 @jax.jit
+def _touch_dense_kernel(last_use_dev, segments, tick):
+    """Pull-mode delivery touch: rows holding edges (non-empty offset
+    ranges) stamp in one elementwise pass — never a lane-sized
+    scatter-max (tensor/streams_plane.py keeps that path scatter-free
+    end to end)."""
+    live = segments[1:] > segments[:-1]
+    return jnp.maximum(last_use_dev, jnp.where(live, tick, 0))
+
+
+@jax.jit
 def _idle_mask_kernel(last_use_dev, last_use_host, live, cutoff):
     """Victim selection stays on device: merge both use clocks with one
     vectorized compare; only the boolean victim mask (1 byte/row) crosses
@@ -232,6 +242,19 @@ class GrainArena:
         return att if att is not None and att.has_state(self.info.name) \
             else None
 
+    def _stream_routes(self):
+        """The owning engine's stream-subscription routes whose
+        SUBSCRIBER arena is this one (tensor/streams_plane.py) — the
+        deactivation path retires victims from the adjacency BEFORE
+        their rows return to the free list, so a reused slot can never
+        receive a dead subscription's events."""
+        ref = self._owner_engine
+        engine = ref() if ref is not None else None
+        if engine is None:
+            return ()
+        return [r for r in getattr(engine, "_stream_routes", {}).values()
+                if r.type_name == self.info.name]
+
     # -- state columns ------------------------------------------------------
 
     def _make_column(self, f: StateField, capacity: int) -> jnp.ndarray:
@@ -251,6 +274,13 @@ class GrainArena:
         on device; padding rows -1 dropped)."""
         self.last_use_dev = _touch_kernel(self.last_use_dev, rows,
                                           jnp.int32(tick))
+
+    def touch_rows_dense(self, segments: jnp.ndarray, tick: int) -> None:
+        """Pull-mode delivery touch (tensor/streams_plane.py): the
+        row-aligned offsets already know which rows received — one
+        elementwise max instead of an edge-sized scatter."""
+        self.last_use_dev = _touch_dense_kernel(self.last_use_dev,
+                                                segments, jnp.int32(tick))
 
     def effective_last_use(self) -> np.ndarray:
         """Merge the host and device use clocks (collection-time only)."""
@@ -690,6 +720,12 @@ class GrainArena:
             # return to the free list — a reused slot must never inherit
             # the evicted grain's attribution (epoch bit-exactness)
             att.on_evict(self, victims, keys)
+        for route in self._stream_routes():
+            # retire evicted subscribers from the device adjacency
+            # BEFORE slot reuse is possible (tensor/streams_plane.py:
+            # a subscribed victim dirties the row layout; otherwise the
+            # stamp just advances and no rebuild is paid)
+            route.on_evict(self, victims, keys)
         if write_back and self.store is not None:
             # columnar fast path: the gathered columns go to the store
             # as-is — no O(victims) list-of-dicts construction here

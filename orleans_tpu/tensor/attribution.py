@@ -130,6 +130,47 @@ def fold_batch(counts, cms, slots, seeds, slot, rows, valid):
     return counts, cms, slots
 
 
+def fold_counts(counts, slots, slot, rows, valid, segments=None):
+    """The scan-carry half of an in-window fold: per-row counts + the
+    (type, method) slot counter, WITHOUT the sketch — the fused window
+    folds the CMS once per window from the counts delta
+    (``fold_cms_dense``), which removes a lane-sized sketch scatter
+    from every scanned tick.  Integer adds commute, so the split is
+    bit-identical to per-lane ``fold_batch`` calls.
+
+    ``segments`` (a pull-mode delivery batch's row-aligned offsets,
+    tensor/streams_plane.py) switches the counts fold to the same
+    scatter-free cumulative-sum reduction the delivery handler uses."""
+    inc_src = jnp.asarray(valid, bool)
+    if segments is not None:
+        inc = inc_src.astype(jnp.int32)
+        z = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(inc)])
+        counts = counts + (z[segments[1:]] - z[segments[:-1]])
+        slots = slots.at[slot].add(jnp.sum(inc))
+        return counts, slots
+    cap = counts.shape[0]
+    rows = jnp.asarray(rows, jnp.int32)
+    valid = inc_src & (rows >= 0) & (rows < cap)
+    inc = valid.astype(jnp.int32)
+    r = jnp.where(valid, rows, cap)  # out-of-range + mode="drop"
+    counts = counts.at[r].add(inc, mode="drop")
+    slots = slots.at[slot].add(jnp.sum(inc))
+    return counts, slots
+
+
+def fold_cms_dense(cms, counts_delta, seeds):
+    """Sketch fold from a DENSE per-row delta: one capacity-sized
+    scatter covering any number of per-tick, per-group lane folds —
+    the per-row sums land in exactly the hashed buckets ``fold_batch``
+    would have scattered lane by lane (the hash is row-keyed and adds
+    commute), so the result is bit-identical."""
+    depth, width = cms.shape
+    cap = counts_delta.shape[0]
+    h = cms_hash(jnp.arange(cap, dtype=jnp.int32), seeds, width)
+    return cms.at[jnp.arange(depth, dtype=jnp.int32)[:, None], h].add(
+        counts_delta[None, :].astype(jnp.int32))
+
+
 @partial(jax.jit, static_argnames=("cap", "width", "depth"))
 def _plan_kernel(rows, valid, seeds, cap: int, width: int, depth: int):
     """Build one batch's dense delta plan: bincount of the valid lanes

@@ -124,6 +124,13 @@ class PendingBatch:
     # counted).  Miss-path redeliveries carry the ORIGINAL stamp so the
     # recorded latency includes the redelivery wait.
     inject_tick: int = -1
+    # pull-mode delivery (tensor/streams_plane.py): row-aligned edge
+    # offsets int32[capacity + 1] — lanes are grouped by destination
+    # arena row, ``rows`` carries the per-edge destination rows, and
+    # the step's segment reductions run scatter-free.  Valid only while
+    # (generation, epoch) still match the arena; a stale batch falls
+    # back to key-addressed delivery through ``keys_dev``.
+    segments: Optional[jnp.ndarray] = None
 
     def __len__(self) -> int:
         for c in (self.rows, self.keys_host, self.keys_dev):
@@ -147,6 +154,26 @@ class _MissCheck:
     miss_count: jnp.ndarray
     args: Any
     inject_tick: int = -1  # original ledger stamp, carried to redelivery
+
+
+@dataclass
+class _FanoutCheck:
+    """A parked fan-out/subscription expansion overflow check: source
+    lanes whose ragged expansion did not fit the CSR width delivered
+    NOTHING this round (all-or-nothing per lane) and carry a device-side
+    dropped mask; at the next quiescence point the engine re-expands
+    exactly those lanes and their subscriber deliveries enqueue with the
+    ORIGINAL ``inject_tick`` (never silent loss, never a mid-tick error
+    — the ShardExchange contract, replacing FanoutOverflowError)."""
+
+    expander: Any              # DeviceFanout | DeviceSubscriptions
+    dst_type: str
+    dst_method: str
+    keys: jnp.ndarray          # int32[m] device — source keys
+    args: Any                  # the source args pytree
+    dropped: jnp.ndarray       # bool[m] device — parked source lanes
+    count: jnp.ndarray         # int32 device scalar
+    inject_tick: int = -1
 
 
 @dataclass
@@ -713,6 +740,14 @@ class TensorEngine:
         # (src_type, src_method) → (DeviceFanout, dst_type, dst_method):
         # one-to-many subscription expansion on the device (tensor/fanout.py)
         self._fanouts: Dict[Tuple[str, str], Tuple[Any, str, str]] = {}
+        # (src_type, src_method) → DeviceSubscriptions — the streams
+        # plane (tensor/streams_plane.py): stream-ingress messages fan
+        # out to the streams' subscribers, pull-mode when the publish
+        # pattern matches the bound key set, push-mode otherwise
+        self._stream_routes: Dict[Tuple[str, str], Any] = {}
+        # parked fan-out/subscription overflow checks (drained with the
+        # miss checks — one batched device read covers the family)
+        self._fanout_checks: List[_FanoutCheck] = []
         self._task: Optional[asyncio.Task] = None
         self._running = False
         self._wake: Optional[asyncio.Event] = None
@@ -764,6 +799,21 @@ class TensorEngine:
         detection all share."""
         return self.exchange is not None and \
             self.config.cross_shard_exchange
+
+    def _streams_live(self) -> bool:
+        """True when stream-subscription routes expand on device
+        (config.tensor.stream_plane) — the one predicate the unfused
+        dispatch, the fused trace, and prepare()'s re-trace detection
+        share.  Off = the host-expansion baseline the streams bench
+        A/Bs against (a live toggle re-traces, cause config_toggle)."""
+        return bool(self.config.stream_plane)
+
+    def _stream_routes_signature(self) -> Tuple:
+        """Fused-window re-trace input: registered routes + their
+        adjacency layout versions (a rebuild re-bakes the windows' CSR
+        trace constants)."""
+        return tuple((key, id(r), r.layout_version, r.mutation_version)
+                     for key, r in sorted(self._stream_routes.items()))
 
     # ================= arenas =============================================
 
@@ -975,6 +1025,39 @@ class TensorEngine:
         self._fanouts[(self._type_name(src_interface), src_method)] = (
             fanout, self._type_name(dst_interface), dst_method)
 
+    def register_subscriptions(self, src_interface, src_method: str,
+                               subscriptions) -> None:
+        """The streams plane's engine edge (tensor/streams_plane.py):
+        every message delivered to (src_interface, src_method) — the
+        stream-ingress method, rows = streams — also fans out to the
+        stream's subscribers through ``subscriptions`` into its bound
+        delivery edge.  Publishes matching the bound key set take the
+        pull path (one payload gather + one segment_sum, scatter-free);
+        everything else expands push-mode to subscriber keys with
+        overflow parking."""
+        self._stream_routes[(self._type_name(src_interface), src_method)] \
+            = subscriptions
+
+    def _route_expand_push(self, expander, dst_type: str, dst_method: str,
+                           skeys, args: Any, mask, inject_tick: int
+                           ) -> None:
+        """Shared push-expansion tail for DeviceFanout registrations and
+        stream-subscription routes: expand, enqueue the subscriber
+        deliveries, and PARK the expansion's device-side overflow mask
+        — dropped source lanes re-expand at the next quiescence point
+        with their original stamp (never a mid-tick error)."""
+        dst, gargs, valid = expander.expand(skeys, args, mask)
+        count, dropped = expander.take_drop()
+        self._fanout_checks.append(_FanoutCheck(
+            expander=expander, dst_type=dst_type, dst_method=dst_method,
+            keys=skeys, args=args, dropped=dropped, count=count,
+            inject_tick=inject_tick))
+        self.queues[(dst_type, dst_method)].append(
+            PendingBatch(args=gargs, keys_dev=dst, mask=valid,
+                         inject_tick=self.tick_number))
+        if hasattr(expander, "push_deliveries"):
+            expander.push_deliveries += 1
+
     def _run_fanout(self, type_name: str, method: str,
                     batches: List[PendingBatch]) -> None:
         fan = self._fanouts.get((type_name, method))
@@ -1011,10 +1094,8 @@ class TensorEngine:
                     "through the CSR subscription graph")
             else:
                 continue  # row-only batch with no kept keys: nothing to map
-            dst, gargs, valid = fanout.expand(skeys, b.args, mask)
-            self.queues[(dst_type, dst_method)].append(
-                PendingBatch(args=gargs, keys_dev=dst, mask=valid,
-                             inject_tick=self.tick_number))
+            self._route_expand_push(fanout, dst_type, dst_method,
+                                    skeys, b.args, mask, b.inject_tick)
 
     def _expand_resolved_fanout(self, fan, batches: List[PendingBatch],
                                 resolved: List[Tuple]) -> None:
@@ -1030,11 +1111,135 @@ class TensorEngine:
                 continue
             base = b.mask if b.mask is not None \
                 else _mask_for(b.keys_dev.shape[0])
-            dst, gargs, valid = fanout.expand(
-                b.keys_dev, b.args, base & (rows >= 0))
-            self.queues[(dst_type, dst_method)].append(
-                PendingBatch(args=gargs, keys_dev=dst, mask=valid,
+            self._route_expand_push(fanout, dst_type, dst_method,
+                                    b.keys_dev, b.args,
+                                    base & (rows >= 0), b.inject_tick)
+
+    # -- stream-subscription routes (tensor/streams_plane.py) ---------------
+
+    def _to_host_batch(self, b: PendingBatch) -> PendingBatch:
+        """Convert a device-key batch to a host-key batch (the streams
+        plane's live-disabled baseline pays the d2h; masked lanes are
+        filtered on host — host-key batches carry no mask)."""
+        if b.keys_host is not None and b.mask is None:
+            return b
+        keys = b.keys_host if b.keys_host is not None \
+            else np.asarray(b.keys_dev).astype(np.int64)
+        args = jax.tree_util.tree_map(np.asarray, b.args)
+        if b.mask is not None:
+            sel = np.asarray(b.mask)
+            keys = keys[sel]
+            args = jax.tree_util.tree_map(
+                lambda a: a if np.ndim(a) == 0 else a[sel], args)
+        return PendingBatch(args=args, keys_host=keys,
+                            no_fanout=b.no_fanout, trace=b.trace,
+                            inject_tick=b.inject_tick)
+
+    def _run_stream_routes_pre(self, type_name: str, method: str,
+                               batches: List[PendingBatch]
+                               ) -> List[PendingBatch]:
+        """Pre-resolve half of the stream-route expansion, mirroring
+        _run_fanout: host-key publishes expand here (activation precedes
+        apply on the host path), device-key publishes expand after
+        resolution.  With the plane live-disabled this is the HOST
+        baseline: publishes convert to host batches and the adjacency
+        walks in numpy — the per-event-era delivery path the streams
+        bench A/Bs the device plane against."""
+        route = self._stream_routes.get((type_name, method))
+        if route is None:
+            return batches
+
+        def expand_on_host(b2: PendingBatch) -> None:
+            route.published_events += len(b2)
+            dst_keys, src_idx = route.host_expand(b2.keys_host)
+            if len(dst_keys) == 0:
+                return
+            gargs = jax.tree_util.tree_map(
+                lambda a: a if np.ndim(a) == 0
+                else np.asarray(a)[src_idx], b2.args)
+            if isinstance(gargs, dict) and "src_key" not in gargs:
+                gargs = {**gargs,
+                         "src_key": (b2.keys_host[src_idx]
+                                     % np.int64(KEY_SENTINEL))
+                         .astype(np.int32)}
+            self.queues[(route.type_name, route.method)].append(
+                PendingBatch(args=gargs,
+                             keys_host=dst_keys.astype(np.int64),
                              inject_tick=self.tick_number))
+            route.delivered_events += len(dst_keys)
+
+        if not self._streams_live():
+            out: List[PendingBatch] = []
+            for b in batches:
+                if b.no_fanout or (b.keys_host is None
+                                   and b.keys_dev is None):
+                    out.append(b)
+                    continue
+                b2 = self._to_host_batch(b)
+                out.append(b2)
+                expand_on_host(b2)
+            return out
+        for b in batches:
+            if b.no_fanout or b.keys_dev is not None \
+                    or b.keys_host is None:
+                continue  # device-key publishes expand post-resolve
+            if (b.keys_host >= KEY_SENTINEL).any() \
+                    or (b.keys_host < 0).any():
+                # wide stream identities: the device CSR is int31-keyed
+                # — deliver through the host expansion instead of
+                # erroring mid-tick (the round's other popped groups
+                # must never be lost to one wide key)
+                expand_on_host(b)
+                continue
+            route.published_events += len(b)
+            self._route_expand_push(
+                route, route.type_name, route.method,
+                jnp.asarray(b.keys_host.astype(np.int32)), b.args,
+                b.mask, b.inject_tick)
+        return batches
+
+    def _expand_resolved_stream_routes(self, route, type_name: str,
+                                       method: str,
+                                       batches: List[PendingBatch],
+                                       resolved: List[Tuple]) -> None:
+        """Device-key publish expansion, resolution-gated like
+        _expand_resolved_fanout.  A publish batch matching the route's
+        BOUND key set takes the pull path: the subscriber deliveries
+        enqueue as ONE row-addressed, segment-offset batch (payload
+        gathered per edge — zero resolution, zero scatters downstream);
+        anything else expands push-mode to subscriber keys."""
+        dst_arena = self.arena_for(route.type_name)
+        for b, (rows, _args) in zip(batches, resolved):
+            if b.no_fanout or b.keys_dev is None \
+                    or b.segments is not None:
+                continue
+            base = b.mask if b.mask is not None \
+                else _mask_for(b.keys_dev.shape[0])
+            gate = base & (rows >= 0)
+            route.published_events += len(b)
+            pull = route.pull_layout(dst_arena) \
+                if route._matches_bound(b.keys_host) else None
+            if pull is not None and pull["n_edges"] > 0:
+                lane = pull["src_lane"]
+                gargs = jax.tree_util.tree_map(
+                    lambda a: a if jnp.ndim(a) == 0
+                    else jnp.asarray(a)[lane], b.args)
+                if isinstance(gargs, dict) and "src_key" not in gargs:
+                    gargs = {**gargs, "src_key": pull["src_key"]}
+                self.queues[(route.type_name, route.method)].append(
+                    PendingBatch(
+                        args=gargs, rows=pull["rows"],
+                        keys_dev=pull["dst_key"], mask=gate[lane],
+                        segments=pull["offsets"],
+                        generation=dst_arena.generation,
+                        epoch=dst_arena.eviction_epoch,
+                        inject_tick=self.tick_number))
+                route.pull_deliveries += 1
+                route.delivered_events += pull["n_edges"]
+            else:
+                self._route_expand_push(
+                    route, route.type_name, route.method,
+                    b.keys_dev, b.args, gate, b.inject_tick)
 
     def make_injector(self, interface, method: str, keys: np.ndarray):
         """Pre-resolve a stable destination set once; subsequent injections
@@ -1209,10 +1414,15 @@ class TensorEngine:
                 # the handoff fence is deferring unseen-key activation —
                 # pace the retry loop while awaiting peers' releases
                 await asyncio.sleep(0.005)
-        # quiescence point: surface any fan-out budget overruns (the hot
-        # path parks totals on device instead of synchronizing per round)
+        # quiescence point: fold any un-taken expansion drop masks into
+        # the host stats (engine-driven expansions take theirs eagerly;
+        # this covers direct expand() users).  Parked overflow lanes
+        # were all redelivered by the drain loop above — overflow is a
+        # redelivery event now, never an error (satellite contract).
         for fanout, _, _ in self._fanouts.values():
             fanout.overflow_check()
+        for route in self._stream_routes.values():
+            route.overflow_check()
 
     # ================= event-driven completion ============================
 
@@ -1274,10 +1484,10 @@ class TensorEngine:
                                          cfg.collection_chunk_rows)
                 stages["collect"] += time.perf_counter() - t0
         if len(self._pending_checks) + len(self._exchange_checks) \
-                >= self.config.miss_check_cap:
+                + len(self._fanout_checks) >= self.config.miss_check_cap:
             # bound device memory pinned by parked optimistic checks
-            # (exchange overflow checks pin their batch's args the same
-            # way, so they count against the same cap)
+            # (exchange and fan-out overflow checks pin their batch's
+            # args the same way, so they count against the same cap)
             self._drain_checks()
         rounds = 0
         while rounds < self.config.max_rounds_per_tick:
@@ -1296,6 +1506,8 @@ class TensorEngine:
                         stages["fanout"] += time.perf_counter() - tf
                         continue
                 self._run_fanout(type_name, method, batches)
+                batches = self._run_stream_routes_pre(type_name, method,
+                                                      batches)
                 stages["fanout"] += time.perf_counter() - tf
                 self._run_group(type_name, method, batches)
             rounds += 1
@@ -1436,12 +1648,15 @@ class TensorEngine:
         """Quiescence point: activate unseen keys discovered by optimistic
         resolution and re-deliver their (and only their) messages.
         Returns True if new work was queued."""
-        if not self._pending_checks and not self._exchange_checks:
+        if not self._pending_checks and not self._exchange_checks \
+                and not self._fanout_checks:
             return False
         t0 = time.perf_counter()
         checks = self._pending_checks
         self._pending_checks = []
         requeued = self._drain_exchange_checks()
+        if self._drain_fanout_checks():
+            requeued = True
         # one batched sync for all parked counts — a single device
         # transfer regardless of how many checks are parked.  The arity
         # pads to the next power of two so the varargs jit compiles
@@ -1541,6 +1756,48 @@ class TensorEngine:
         # cumulative totals directly
         sink = self._tick_stages if self._in_tick else self.stage_seconds
         sink["miss_checks"] += time.perf_counter() - t0
+        return requeued
+
+    def _drain_fanout_checks(self) -> bool:
+        """Quiescence half of the fan-out/subscription overflow contract
+        (satellite of the streams plane): fold the parked dropped-lane
+        counts (ONE batched transfer for all parked checks) and
+        re-expand EXACTLY the dropped source lanes — their subscriber
+        deliveries enqueue with the ORIGINAL inject stamp, so the
+        latency ledger includes the redelivery wait.  Every retry round
+        completes at least one parked lane (the CSR width is never
+        smaller than a single lane's degree), so this converges without
+        a round bound.  Returns True if redeliveries were queued."""
+        if not self._fanout_checks:
+            return False
+        checks = self._fanout_checks
+        self._fanout_checks = []
+        if len(checks) == 1:
+            counts = [int(checks[0].count)]
+        else:
+            n = len(checks)
+            padded = 1 << (n - 1).bit_length()
+            xs = [c.count for c in checks] \
+                + [np.int32(0)] * (padded - n)
+            counts = np.asarray(_stack_counts(*xs))[:n].tolist()
+        requeued = False
+        for c, cnt in zip(checks, counts):
+            exp = c.expander
+            exp.dropped_lanes += int(cnt)
+            if cnt == 0:
+                continue
+            exp.redeliveries += 1
+            dst, gargs, valid = exp.expand(c.keys, c.args, c.dropped)
+            cnt2, dropped2 = exp.take_drop()
+            self._fanout_checks.append(_FanoutCheck(
+                expander=exp, dst_type=c.dst_type,
+                dst_method=c.dst_method, keys=c.keys, args=c.args,
+                dropped=dropped2, count=cnt2,
+                inject_tick=c.inject_tick))
+            self.queues[(c.dst_type, c.dst_method)].append(PendingBatch(
+                args=gargs, keys_dev=dst, mask=valid,
+                inject_tick=c.inject_tick))
+            requeued = True
         return requeued
 
     def _drain_exchange_checks(self) -> bool:
@@ -1747,6 +2004,19 @@ class TensorEngine:
         a jit dispatch on tunneled TPU runtimes, so host-side batches are
         padded in numpy and device batches are compiled at their natural
         (stable) sizes instead of being padded to buckets."""
+        seg_batches = [b for b in batches if b.segments is not None]
+        if seg_batches:
+            # pull-mode stream deliveries execute one-by-one (their
+            # lanes are pre-grouped by destination row against a
+            # specific layout stamp — merging or exchanging them would
+            # destroy the row alignment the scatter-free reductions
+            # rely on); ordinary batches in the same group keep the
+            # standard path below
+            for b in seg_batches:
+                self._run_segments_batch(type_name, method, b)
+            batches = [b for b in batches if b.segments is None]
+            if not batches:
+                return
         info = vector_type(type_name)
         arena = self.arena_for(type_name)
         stages = self._tick_stages
@@ -1799,6 +2069,10 @@ class TensorEngine:
         fan = self._fanouts.get((type_name, method))
         if fan is not None:
             self._expand_resolved_fanout(fan, batches, resolved)
+        route = self._stream_routes.get((type_name, method))
+        if route is not None and self._streams_live():
+            self._expand_resolved_stream_routes(route, type_name, method,
+                                                batches, resolved)
         # final exchange eligibility: every resolution stayed on device
         # (a stale injector falls back to host re-resolution — np rows —
         # and the group takes the legacy path this round) and every
@@ -1986,6 +2260,77 @@ class TensorEngine:
             self._deliver_results(batches, results)
             stages["results"] += time.perf_counter() - t_dr
 
+    def _run_segments_batch(self, type_name: str, method: str,
+                            b: PendingBatch) -> None:
+        """Execute one pull-mode stream delivery (tensor/streams_plane
+        .py): lanes are pre-grouped by destination arena row with
+        row-aligned offsets, so the step's fan-in reductions run
+        scatter-free and there is NOTHING to resolve — the rows were
+        baked by the adjacency build and are exactly valid while the
+        arena's (generation, eviction_epoch) stamps hold.  A stale
+        batch (rows moved/freed between enqueue and execution) falls
+        back to key-addressed delivery: the push path's device
+        resolution re-activates evicted subscribers through the miss
+        machinery, preserving the at-least-once contract."""
+        arena = self.arena_for(type_name)
+        if b.generation != arena.generation \
+                or b.epoch != arena.eviction_epoch:
+            self.queues[(type_name, method)].append(PendingBatch(
+                args=b.args, keys_dev=b.keys_dev, mask=b.mask,
+                inject_tick=b.inject_tick))
+            return
+        info = vector_type(type_name)
+        stages = self._tick_stages
+        t0 = time.perf_counter()
+        m = len(b)
+        if self._span_recorder() is not None:
+            if b.trace is not None:
+                self._tick_traces.append(b.trace)
+            self._tick_counts[f"{type_name}.{method}"] += m
+        if self.ledger.enabled and b.inject_tick >= 0:
+            # one collapsed-kernel dispatch: every lane shares the
+            # batch's delta, mask combined inside the jit
+            self.ledger.record_rows(type_name, method,
+                                    self.tick_number - b.inject_tick,
+                                    b.rows, b.mask)
+        if self.attribution.enabled:
+            # the adjacency's edge arrays are identity-stable across
+            # ticks (same build → same buffers), so the delta-plan memo
+            # applies buffered k·delta folds — near-zero steady cost
+            self.attribution.record_group(arena, type_name, method,
+                                          b.rows, b.mask,
+                                          ident=b.keys_dev)
+        self.messages_processed += m
+        t_apply = time.perf_counter()
+        stages["resolve"] += t_apply - t0
+        step = self._get_step(info, method)
+        if not self._steps_donated:
+            self.donation_fallbacks += 1
+        sig = (info.name, method, m, arena.capacity, "seg")
+        if sig in self._seen_steps:
+            new_state, results, emits, fence = step(
+                arena.state, b.rows, b.args, b.mask, b.segments)
+        else:
+            cause = self._infer_step_cause(info.name, method, sig, False)
+            t_compile = time.perf_counter()
+            new_state, results, emits, fence = step(
+                arena.state, b.rows, b.args, b.mask, b.segments)
+            self.compile_tracker.record(
+                cause, key=f"{info.name}.{method}[seg:{m}]",
+                seconds=time.perf_counter() - t_compile,
+                tick=self.tick_number)
+            self._seen_steps.add(sig)
+        arena.adopt_state(new_state)
+        self._tick_fence = fence
+        # collection liveness: a dense elementwise touch over the rows
+        # holding edges (the offsets know them) — never a lane-sized
+        # scatter-max on this path
+        arena.touch_rows_dense(b.segments, self.tick_number)
+        t_route = time.perf_counter()
+        stages["apply"] += t_route - t_apply
+        self._route_emits(emits)
+        stages["route"] += time.perf_counter() - t_route
+
     def _deliver_results(self, batches: List[PendingBatch],
                          results: Any) -> None:
         start = 0
@@ -2037,6 +2382,18 @@ class TensorEngine:
         shape re-specializing under the OTHER cross-shard-exchange flag
         is the exchange toggle; anything else is a new batch shape."""
         _t, _m, m, _cap, xch = sig
+        if xch == "seg":
+            # pull-mode stream deliveries: their lane count is the edge
+            # count, disjoint from the exchange taxonomy — a same-shape
+            # recompile under a new capacity is still a repack, a fresh
+            # shape is organic (adjacency rebuild changed the edge set)
+            seen_seg = [s for s in self._seen_steps
+                        if s[0] == type_name and s[1] == method
+                        and s[4] == "seg"]
+            if any(s[2] == m for s in seen_seg):
+                return CAUSE_GENERATION_REPACK
+            return CAUSE_NEW_METHOD if not seen_seg \
+                else CAUSE_SHAPE_CHANGE
         if (type_name, method, m) in self._reshard_forgotten:
             self._reshard_forgotten.discard((type_name, method, m))
             return CAUSE_MESH_RESHARD
@@ -2095,12 +2452,15 @@ class TensorEngine:
             return step
         handler = info.handlers[method]
 
-        def step_fn(state, rows, args, mask):
+        def step_fn(state, rows, args, mask, *segments):
             n_rows = next(iter(state.values())).shape[0]
             # named_scope labels the HLO for jax.profiler deep captures
             # (tensor/profiler.py) — trace-time only, zero runtime cost
             with jax.named_scope(f"orleans.dispatch.{info.name}.{method}"):
-                out = handler(state, Batch(rows=rows, args=args, mask=mask),
+                out = handler(state,
+                              Batch(rows=rows, args=args, mask=mask,
+                                    segments=segments[0] if segments
+                                    else None),
                               n_rows)
             # normalize handler returns: state | (state,) | (state, results)
             # | (state, results, emits)
@@ -2168,6 +2528,10 @@ class TensorEngine:
             # cross-shard routing plane (tensor/exchange.py); None off-mesh
             "exchange": self.exchange.snapshot()
             if self.exchange is not None else None,
+            # device streams plane (tensor/streams_plane.py); {} when no
+            # subscription route is registered
+            "streams": {f"{t}.{m}": r.snapshot()
+                        for (t, m), r in self._stream_routes.items()},
             # ledger health only (no device transfer here — the bucket
             # counts come from engine.ledger.snapshot(), which pays the
             # ONE d2h fetch explicitly)

@@ -16,6 +16,18 @@ message it belongs to (`searchsorted` over the offsets — the standard XLA
 ragged-expansion idiom).  Slots past the real total are masked and carry
 ``KEY_SENTINEL`` keys, which the engine's resolve kernel already drops.
 
+Overflow contract (the ShardExchange discipline, tensor/exchange.py): a
+round whose expansion needs more slots than the CSR width loses NOTHING
+and raises NOTHING mid-tick.  Source lanes whose whole expansion range
+does not fit deliver ZERO slots this round (never a partial prefix —
+that would double-deliver on retry) and come back as a device-side
+``dropped`` mask; the engine parks it like a miss-check and re-expands
+exactly those lanes at the next quiescence point with their ORIGINAL
+``inject_tick`` stamp.  Each retry round completes at least one parked
+lane (a single lane's degree never exceeds the width, which is sized to
+the live edge count), so convergence is structural.  The storage budget
+(more EDGES than ``budget``) remains a hard config error at rebuild.
+
 Mutation (follow/unfollow) is host-side control-plane; the device CSR is
 a mirror rebuilt lazily on first expand after a change — the same
 truth-on-host / mirror-on-device discipline as the arena's directory
@@ -41,11 +53,14 @@ def _expand_kernel(csr_keys, csr_offsets, csr_dst, src_keys, valid):
     """Expand [m] source messages into [budget] destination slots.
 
     Returns (dst_keys int32[budget], src_index int32[budget],
-    out_valid bool[budget], total int32) where ``src_index[j]`` is the
-    source message each slot's args are gathered from and ``total`` is
-    the true (unpadded) number of expanded messages — if it exceeds
-    ``budget`` the surplus was dropped and the caller must re-publish
-    with a larger budget."""
+    out_valid bool[budget], total int32, src_dropped bool[m],
+    n_dropped int32) where ``src_index[j]`` is the source message each
+    slot's args are gathered from and ``total`` is the true (unpadded)
+    number of expanded messages.  A source lane whose expansion range
+    extends past ``budget`` materializes NO slots (all-or-nothing per
+    lane — a partial prefix would double-deliver on redelivery) and is
+    flagged in ``src_dropped`` for the engine's park-and-redeliver
+    path."""
     n = csr_keys.shape[0]
     budget = _budget_of(csr_dst)  # static: taken from a closure-free helper
     idx = jnp.clip(jnp.searchsorted(csr_keys, src_keys), 0, n - 1)
@@ -54,16 +69,20 @@ def _expand_kernel(csr_keys, csr_offsets, csr_dst, src_keys, valid):
     start = jnp.where(hit, csr_offsets[idx], 0)
     offs = jnp.cumsum(deg)                      # inclusive: msgs ≤ i
     total = offs[-1] if offs.shape[0] else jnp.int32(0)
+    # all-or-nothing per source lane: lane i's slots are
+    # [offs[i]-deg[i], offs[i]) — it fits iff offs[i] <= budget
+    src_dropped = hit & (deg > 0) & (offs > budget)
+    n_dropped = jnp.sum(src_dropped.astype(jnp.int32))
     j = jnp.arange(budget, dtype=jnp.int32)
     src_index = jnp.searchsorted(offs, j, side="right").astype(jnp.int32)
     src_c = jnp.clip(src_index, 0, jnp.maximum(src_keys.shape[0] - 1, 0))
     before = jnp.where(src_c > 0, offs[src_c - 1], 0)
     e = start[src_c] + (j - before)
-    out_valid = j < total
+    out_valid = (j < total) & (offs[src_c] <= budget)
     dst = jnp.where(out_valid,
                     csr_dst[jnp.clip(e, 0, jnp.maximum(budget - 1, 0))],
                     KEY_SENTINEL)
-    return dst, src_c, out_valid, total
+    return dst, src_c, out_valid, total, src_dropped, n_dropped
 
 
 def _budget_of(csr_dst):
@@ -82,7 +101,11 @@ def _group_ranges(sorted_vals: np.ndarray):
 
 
 class FanoutOverflowError(RuntimeError):
-    """More expanded messages than the configured budget in one round."""
+    """More STORED edges than the configured budget (a rebuild-time
+    config error).  Per-round expansion overflow no longer raises: the
+    overflowing source lanes park with a device-side dropped mask and
+    re-deliver next tick with their original stamp (the ShardExchange
+    contract)."""
 
 
 class DeviceFanout:
@@ -100,8 +123,15 @@ class DeviceFanout:
         self._csr_keys: Optional[jnp.ndarray] = None
         self._csr_offsets: Optional[jnp.ndarray] = None
         self._csr_dst: Optional[jnp.ndarray] = None
-        # device totals parked by expand(); drained by overflow_check()
-        self._pending_totals: List[Any] = []
+        # the latest expand()'s parked overflow: (n_dropped device
+        # scalar, src_dropped device bool[m]) — consumed by the caller
+        # (engine parks a _FanoutCheck; fused folds the count into the
+        # window's miss counter).  Un-taken drops accumulate for
+        # overflow_check()'s explicit sync.
+        self._pending_drops: List[Tuple[Any, Any]] = []
+        # cumulative host-side stats, folded at drain points
+        self.dropped_lanes = 0
+        self.redeliveries = 0
 
     # -- control plane (host) ----------------------------------------------
 
@@ -162,9 +192,13 @@ class DeviceFanout:
         # expansion width: how many output slots one expand round gets.
         # Sized to the live edge count (lane-aligned), NOT the storage
         # budget — a static graph then pads < 256 dead lanes per round
-        # instead of (budget - edges).  The budget stays the hard cap so
-        # a round with duplicate src keys that needs more than `width`
-        # slots surfaces as FanoutOverflowError, not silent truncation.
+        # instead of (budget - edges).  The budget stays the hard cap on
+        # STORED edges; a round with duplicate src keys that needs more
+        # than `width` slots parks the overflowing source lanes and
+        # re-expands them at the next quiescence point (never silent
+        # truncation, never a mid-tick error).  width >= any single
+        # lane's degree (degree <= edge_count <= width), so every retry
+        # round completes at least one lane — convergence is structural.
         width = min(self.budget,
                     max(256, -(-max(1, self.edge_count) // 256) * 256))
         if not srcs:
@@ -203,21 +237,20 @@ class DeviceFanout:
         [budget], gathered args [budget,...] + ``src_key``, valid mask).
 
         Scalar arg leaves broadcast (same convention as the engine's
-        kernels).  The true expansion total stays on device; call
-        ``overflow_check()`` at a quiescence point to detect budget
-        overruns without synchronizing the hot path."""
+        kernels).  Source lanes whose expansion does not fit this
+        round's width deliver NOTHING now; their device-side dropped
+        mask parks via ``take_drop()`` (the engine re-expands exactly
+        those lanes at the next quiescence point with the original
+        inject stamp — the ShardExchange redelivery contract)."""
         if self._dirty:
             ck, co, cd = self._rebuild()
         else:
             ck, co, cd = self._csr_keys, self._csr_offsets, self._csr_dst
         if mask is None:
             mask = _ones_mask(src_keys.shape[0])
-        dst, src_index, out_valid, total = _expand_kernel(
-            ck, co, cd, src_keys, mask)
-        # pair the total with THIS round's width — a rebuild before the
-        # next overflow_check may change the width, and comparing old
-        # totals against a new width would mask (or invent) overflows
-        self._pending_totals.append((total, cd.shape[0]))
+        dst, src_index, out_valid, _total, src_dropped, n_dropped = \
+            _expand_kernel(ck, co, cd, src_keys, mask)
+        self._pending_drops.append((n_dropped, src_dropped))
         gathered = jax.tree_util.tree_map(
             lambda a: a if jnp.ndim(a) == 0 else jnp.asarray(a)[src_index],
             args)
@@ -225,19 +258,22 @@ class DeviceFanout:
             gathered = {**gathered, "src_key": src_keys[src_index]}
         return dst, gathered, out_valid
 
+    def take_drop(self) -> Tuple[Any, Any]:
+        """(n_dropped device scalar, src_dropped device bool[m]) of the
+        expand() that just ran — the engine parks these like a
+        miss-check; a fused window folds the count into its miss
+        counter instead (rollback + unfused replay redelivers)."""
+        return self._pending_drops.pop()
+
     def overflow_check(self) -> int:
-        """Synchronize parked totals; raises FanoutOverflowError if any
-        round expanded past its round's output width (messages were
-        dropped)."""
-        totals, self._pending_totals = self._pending_totals, []
-        worst = 0
-        for total, width in totals:
-            t = int(total)
-            worst = max(worst, t)
-            if t > width:
-                raise FanoutOverflowError(
-                    f"expansion needed {t} slots, width {width} "
-                    f"(budget {self.budget})")
-        return worst
-
-
+        """Synchronize any un-taken parked drop masks (direct expand()
+        users — tests, manual drivers) and fold them into the host-side
+        ``dropped_lanes`` stat.  Returns the total dropped-lane count
+        observed.  No longer raises: per-round overflow re-delivers
+        through the engine's park path instead of erroring mid-run."""
+        drops, self._pending_drops = self._pending_drops, []
+        total = 0
+        for n_dropped, _mask in drops:
+            total += int(n_dropped)
+        self.dropped_lanes += total
+        return total

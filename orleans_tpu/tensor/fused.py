@@ -152,6 +152,11 @@ class FusedTickProgram:
         # skewed window fails verify() and replays unfused (exactness
         # over throughput, the standing fused contract).
         self._exchange_on = False
+        # stream-subscription routes (tensor/streams_plane.py): the
+        # live toggle and every route's adjacency layout version are
+        # baked at build time; prepare() re-traces on either moving
+        self._streams_on = False
+        self._stream_sig: "Tuple | None" = None
         # donation (config.donate_state, default on): the window takes
         # the state columns as donated inputs, so XLA double-buffers in
         # place and back-to-back windows pipeline without a host round
@@ -221,13 +226,19 @@ class FusedTickProgram:
 
     def _apply_group(self, states: Dict[str, Any], type_name: str,
                      method: str, rows, args, mask, depth: int, hist,
-                     attr):
-        """Apply one (type, method) batch and recurse into its emits and
-        registered fan-outs — the trace-time unrolling of the engine's
-        multi-round tick.  ``hist`` is the latency-ledger accumulator
-        threaded through the window (unchanged when the ledger is off);
-        ``attr`` is the workload-attribution accumulator pytree
-        (tensor/attribution.py), empty when that plane is off."""
+                     attr, segments=None, host_keys=None):
+        """Apply one (type, method) batch and recurse into its emits,
+        registered fan-outs, and registered stream-subscription routes
+        — the trace-time unrolling of the engine's multi-round tick.
+        ``hist`` is the latency-ledger accumulator threaded through the
+        window (unchanged when the ledger is off); ``attr`` is the
+        workload-attribution SCAN carry (counts + slots — the sketch is
+        folded ONCE per window from the counts delta, see ``window``),
+        empty when that plane is off.  ``segments`` marks a pull-mode
+        delivery batch (row-aligned offsets — tensor/streams_plane.py);
+        ``host_keys`` is the source pattern's host key set (depth-1
+        sources only), which the stream route uses to recognize its
+        bound publish set."""
         info = vector_type(type_name)
         handler = info.handlers[method]
         if type_name not in states:
@@ -254,23 +265,30 @@ class FusedTickProgram:
         with jax.named_scope(f"orleans.fused.{type_name}.{method}"):
             state2, _results, emits = _normalize(
                 handler(states[type_name],
-                        Batch(rows=rows, args=args, mask=mask), n_rows))
+                        Batch(rows=rows, args=args, mask=mask,
+                              segments=segments), n_rows))
         states = {**states, type_name: state2}
         if self._ledger_on:
-            # in-window latency ledger: the applied lanes accumulate at
-            # delta 0 (each tick's messages complete in their own tick)
-            # with the same one-hot + segment_sum math the unfused
-            # engine dispatches per batch — here it fuses into the scan
-            from orleans_tpu.tensor import ledger as _ledger
+            # in-window latency ledger: every applied lane lands in
+            # bucket 0 (each tick's messages complete in their own
+            # virtual tick — delta 0 by construction), so the general
+            # one-hot kernel COLLAPSES to one masked count + a scalar
+            # add.  Bit-identical to ledger.accumulate at delta 0, and
+            # it removes a per-group scatter from every scanned tick
+            # (measured as the dominant in-window plane cost on
+            # scatter-hostile backends).
             slot = self.engine.ledger.slot_for(type_name, method)
-            m = rows.shape[0]
-            hist = _ledger.accumulate(
-                hist, jnp.int32(slot), jnp.zeros(m, jnp.int32),
-                jnp.asarray(mask, bool))
+            hist = hist.at[jnp.int32(slot), 0].add(
+                jnp.sum(jnp.asarray(mask, jnp.int32)))
         if self._attr_on:
-            # in-window workload attribution: the same applied lanes
-            # fold into the traffic counts/sketch/slots — the unfused
-            # engine's per-group dispatch, fused into the scan
+            # in-window workload attribution, counts + slots only: the
+            # sketch fold moved OUT of the scan — window() re-derives
+            # it once per window from the counts delta (integer adds
+            # commute, so the result is bit-identical to per-lane
+            # folds at a fraction of the scatter cost).  Pull-mode
+            # delivery batches (segments) fold their counts with the
+            # same scatter-free cumulative-sum reduction the handler
+            # uses.
             from orleans_tpu.tensor import attribution as _attr
             att = self.engine.attribution
             counts = attr["counts"].get(type_name)
@@ -279,15 +297,11 @@ class FusedTickProgram:
                 # real window trace receives every touched arena's
                 # accumulator as an input)
                 counts = att.counts_for(type_name)
-                cms = att.cms_for(type_name)
-            else:
-                cms = attr["cms"][type_name]
-            c2, s2, sl2 = _attr.fold_batch(
-                counts, cms, attr["slots"], att._seed_arr(),
+            c2, sl2 = _attr.fold_counts(
+                counts, attr["slots"],
                 jnp.int32(att.slots.slot_for(type_name, method)),
-                rows, jnp.asarray(mask, bool))
+                rows, jnp.asarray(mask, bool), segments=segments)
             attr = {"counts": {**attr["counts"], type_name: c2},
-                    "cms": {**attr["cms"], type_name: s2},
                     "slots": sl2}
         delivered = jnp.int32(0)
         at_cap = depth >= self.engine.config.max_rounds_per_tick
@@ -317,16 +331,75 @@ class FusedTickProgram:
             fanout, dst_type, dst_method = fan
             src_keys = self._src_keys_for(type_name, rows)
             dkeys, dargs, dvalid = fanout.expand(src_keys, args, mask)
-            total, width = fanout._pending_totals.pop()
-            # expansion past the CSR width never materialized: count the
-            # overflow as misses so verify() fails loudly (the unfused
-            # path raises FanoutOverflowError for the same condition)
-            miss_total = miss_total + jnp.maximum(
-                total - jnp.int32(width), 0)
+            n_dropped, _dmask = fanout.take_drop()
+            # source lanes whose expansion overflowed the CSR width
+            # parked (delivering nothing this round): count them as
+            # misses so verify() fails loudly — the rollback's unfused
+            # replay then re-delivers them through the engine's
+            # park-and-redeliver path (never silent loss)
+            miss_total = miss_total + n_dropped
             out_batches.append((dst_type, dst_method, dkeys, dargs, dvalid))
         elif fan is not None and at_cap:
             # a fan-out the cap prevents from running would silently lose
             # deliveries — surface it via the miss counter
+            miss_total = miss_total + jnp.sum(
+                jnp.asarray(mask, jnp.int32))
+
+        # stream-subscription routes (tensor/streams_plane.py): the
+        # stream-ingress method's messages also fan out to the streams'
+        # subscribers.  Baked at build time like the ledger (a live
+        # config.stream_plane toggle re-traces, cause config_toggle).
+        route = self.engine._stream_routes.get((type_name, method)) \
+            if self._streams_on else None
+        if route is not None and not at_cap:
+            dst_arena = self.engine.arena_for(route.type_name)
+            self._note_arena(route.type_name, dst_arena)
+            pull = route.pull_layout(dst_arena) \
+                if host_keys is not None \
+                and route._matches_bound(host_keys) else None
+            if pull is not None and pull["n_edges"] > 0:
+                # pull fast path, inside the scan: one payload gather
+                # per edge + the row-aligned segment reduction in the
+                # destination handler — the CSR/offsets ride as trace
+                # constants, stamped by prepare()'s re-trace predicate
+                lane = pull["src_lane"]
+                gargs = jax.tree_util.tree_map(
+                    lambda a: a if jnp.ndim(a) == 0
+                    else jnp.asarray(a)[lane], args)
+                if isinstance(gargs, dict) and "src_key" not in gargs:
+                    gargs = {**gargs, "src_key": pull["src_key"]}
+                emask = jnp.asarray(mask, bool)[lane]
+                delivered = delivered + jnp.sum(emask.astype(jnp.int32))
+                states, sub_miss, sub_del, hist, attr = self._apply_group(
+                    states, route.type_name, route.method,
+                    pull["rows"], gargs, emask, depth + 1, hist, attr,
+                    segments=pull["offsets"])
+                miss_total = miss_total + sub_miss
+                delivered = delivered + sub_del
+            else:
+                # push path in-window: expand to subscriber keys and
+                # resolve like any emit; overflowing source lanes fold
+                # into the miss counter (rollback + unfused replay
+                # redelivers them — the DeviceFanout contract)
+                src_keys = self._src_keys_for(type_name, rows)
+                dkeys, dargs, dvalid = route.expand(
+                    src_keys, args, jnp.asarray(mask, bool))
+                n_dropped, _dmask = route.take_drop()
+                miss_total = miss_total + n_dropped
+                out_batches.append((route.type_name, route.method,
+                                    dkeys, dargs, dvalid))
+        elif route is not None and at_cap:
+            miss_total = miss_total + jnp.sum(
+                jnp.asarray(mask, jnp.int32))
+        elif not self._streams_on \
+                and (type_name, method) in self.engine._stream_routes:
+            # the plane is live-DISABLED but a route exists: its
+            # deliveries belong to the host-expansion path, which a
+            # compiled window cannot run — count every source lane as a
+            # miss so verify() fails and the rollback's unfused replay
+            # delivers through _run_stream_routes_pre (fusion is
+            # effectively off for routed sources while the toggle is
+            # off; silently verifying would LOSE every delivery)
             miss_total = miss_total + jnp.sum(
                 jnp.asarray(mask, jnp.int32))
 
@@ -385,6 +458,22 @@ class FusedTickProgram:
         self._attr_sig = self.engine.attribution.build_signature()
         # cross-shard exchange: same bake-at-build discipline
         self._exchange_on = self.engine._exchange_live()
+        # stream-subscription routes (tensor/streams_plane.py): bake the
+        # live toggle and warm every route's pull layout EAGERLY — a
+        # rebuild under the trace would produce trace-local mirrors, so
+        # pull_layout refuses to rebuild there and the trace would bake
+        # the push path for a pattern the engine runs pulled
+        self._streams_on = self.engine._streams_live()
+        if self._streams_on:
+            for _key, route in self.engine._stream_routes.items():
+                route.pull_layout(self.engine.arena_for(route.type_name))
+                if route._push_dirty or route._push is None:
+                    # warm the push CSR too: an in-trace rebuild would
+                    # bump layout_version AFTER the signature below is
+                    # captured, and the next prepare() would spuriously
+                    # re-trace the whole window a second time
+                    route._rebuild_push()
+        self._stream_sig = self.engine._stream_routes_signature()
 
         def apply_all(states, per_source_args, hist, attr):
             miss_tot = jnp.int32(0)
@@ -393,7 +482,7 @@ class FusedTickProgram:
                 states, miss, dd, hist, attr = self._apply_group(
                     states, src.type_name, src.method, src_rows[i],
                     per_source_args[i], masks[i], depth=1, hist=hist,
-                    attr=attr)
+                    attr=attr, host_keys=src.keys)
                 miss_tot = miss_tot + miss
                 del_tot = del_tot + dd
             return states, miss_tot, del_tot, hist, attr
@@ -426,8 +515,8 @@ class FusedTickProgram:
                 states: Dict[str, Any] = {
                     s.type_name: s.arena.state for s in self.sources}
                 hist0 = jnp.zeros(self._hist_shape, jnp.int32)
-                attr0 = self.attr_state_in(
-                    [s.type_name for s in self.sources])
+                attr0 = self._scan_attr(self.attr_state_in(
+                    [s.type_name for s in self.sources]))
                 _states, miss, _d, _h, _a = apply_all(
                     states, args_per_source, hist0, attr0)
                 return miss
@@ -443,6 +532,8 @@ class FusedTickProgram:
 
         def window(states, statics, stackeds, totals_in, hist_in,
                    attr_in):
+            scan_attr_in = self._scan_attr(attr_in)
+
             def one_tick(carry, args_ts):
                 states, hist, attr = carry
                 # static leaves (identical every tick) ride OUTSIDE the
@@ -454,7 +545,25 @@ class FusedTickProgram:
                     states, merged, hist, attr)
                 return (states, hist, attr), (miss, delivered)
             (states, hist, attr), (misses, delivered) = jax.lax.scan(
-                one_tick, (states, hist_in, attr_in), tuple(stackeds))
+                one_tick, (states, hist_in, scan_attr_in),
+                tuple(stackeds))
+            if attr_in:
+                # sketch fold, ONCE per window: the scan carried only
+                # counts + slots; the CMS re-derives from each arena's
+                # counts delta (same hashed row buckets, integer adds
+                # commute — bit-identical to per-lane folds, at one
+                # capacity-sized scatter per window instead of one
+                # lane-sized scatter per group per tick)
+                from orleans_tpu.tensor import attribution as _attr
+                seeds = self.engine.attribution._seed_arr()
+                cms_out = {
+                    t: _attr.fold_cms_dense(
+                        attr_in["cms"][t],
+                        attr["counts"].get(t, attr_in["counts"][t])
+                        - attr_in["counts"][t], seeds)
+                    for t in attr_in["cms"]}
+                attr = {"counts": attr["counts"], "cms": cms_out,
+                        "slots": attr["slots"]}
             # totals accumulate ON DEVICE across runs: verify() then
             # reads one 2-element buffer no matter how many windows ran
             # (each completion observation costs ~100ms on tunneled
@@ -477,6 +586,15 @@ class FusedTickProgram:
             return {}
         return self.engine.attribution.device_state_in(
             touched if touched is not None else self._touched)
+
+    @staticmethod
+    def _scan_attr(attr_in):
+        """The slice of the attribution pytree that rides the scan
+        carry: counts + slots.  The sketch stays OUTSIDE the scan and
+        folds once per window from the counts delta (see window)."""
+        if not attr_in:
+            return {}
+        return {"counts": attr_in["counts"], "slots": attr_in["slots"]}
 
     def prepare(self, stacked_args: Any, static_args: Any = None) -> None:
         """Re-resolve the source rows and re-trace if any touched arena
@@ -511,7 +629,12 @@ class FusedTickProgram:
         elif self._hist_shape != (MAX_SLOTS, engine.ledger.n_buckets) \
                 or self._ledger_on != engine.ledger.enabled \
                 or self._attr_sig != engine.attribution.build_signature() \
-                or self._exchange_on != engine._exchange_live():
+                or self._exchange_on != engine._exchange_live() \
+                or self._streams_on != engine._streams_live() \
+                or self._stream_sig != engine._stream_routes_signature():
+            # stream-plane toggles AND adjacency rebuilds both land
+            # here: the window bakes the CSR/offsets as trace
+            # constants, so a layout_version bump must re-trace
             cause = CAUSE_CONFIG_TOGGLE
         elif self._built_donate != donate_target:
             # the compiled window baked the other donation mode (live

@@ -660,6 +660,11 @@ class ClusterInjector:
         self.keys = keys
         self.n = len(keys)
         self._ring_version = -1
+        # overlapped h2d (BatchInjector.stage parity): the staged slab
+        # for the next inject(); the all-local fast path forwards the
+        # staging to the wrapped BatchInjector so the device copy rides
+        # under the current tick's compute
+        self._staged: Optional[Any] = None
         self._rebuild()
 
     def _rebuild(self) -> None:
@@ -682,8 +687,34 @@ class ClusterInjector:
                 self.keys if self._all_local
                 else self.keys[self._local_idx])
 
-    def inject(self, args: Any, want_results: bool = False
+    def stage(self, args: Any) -> Any:
+        """Overlapped h2d, the BatchInjector.stage contract: start the
+        next injection's device copy now.  On the all-local fast path
+        (single-owner key set — every single-silo cluster) the wrapped
+        BatchInjector stages for real; split key sets keep the payload
+        host-side and partition it at inject as before."""
+        self._staged = args
+        if self._all_local and self._local is not None \
+                and self._ring_version == self.router.silo.ring.version:
+            self._local.stage(args)
+        return args
+
+    def inject(self, args: Any = None, want_results: bool = False
                ) -> Optional[asyncio.Future]:
+        if args is None:
+            args, self._staged = self._staged, None
+            if args is None:
+                raise ValueError("inject() with no args needs a staged "
+                                 "slab — call stage(args) first")
+            if self._all_local and not want_results \
+                    and self._ring_version \
+                    == self.router.silo.ring.version \
+                    and self._local is not None \
+                    and self._local._staged is not None:
+                # consume the device-staged slab zero-copy
+                return self._local.inject()
+        else:
+            self._staged = None  # an explicit injection supersedes
         if self._ring_version != self.router.silo.ring.version:
             self._rebuild()
         if self._all_local and not want_results:

@@ -95,11 +95,23 @@ class Batch(NamedTuple):
 
     ``rows`` is -1 for padding entries; scatter helpers drop them via XLA's
     out-of-bounds-drop semantics, so handlers rarely need ``mask``.
+
+    ``segments`` is the PULL-MODE fan-in layout (tensor/streams_plane.py):
+    when present, the batch's lanes are grouped by destination row and
+    ``segments`` holds row-aligned edge offsets — ``int32[n_rows + 1]``,
+    lane range of arena row r is ``[segments[r], segments[r+1])`` (empty
+    for rows with no messages).  ``seg_sum``/``seg_max`` then reduce with
+    a cumulative scan + two gathers instead of a scatter, which on
+    scatter-hostile backends (CPU; measured ~50x) is the difference
+    between the streams plane's ≥10M events/s and the per-lane floor.
     """
 
     rows: jnp.ndarray          # int32[M], -1 = padding
     args: Any                  # pytree of [M, ...]
     mask: jnp.ndarray          # bool[M]
+    # row-aligned pull-mode offsets (int32[n_rows + 1]); None = lanes are
+    # in arbitrary order and reductions take the scatter path
+    segments: Optional[jnp.ndarray] = None
 
 
 @dataclass
@@ -139,17 +151,69 @@ jax.tree_util.register_pytree_node(
 # segment helpers (fan-in combiners)
 # ---------------------------------------------------------------------------
 
-def seg_sum(values: jnp.ndarray, rows: jnp.ndarray, n_rows: int) -> jnp.ndarray:
+def seg_sum(values: jnp.ndarray, rows: jnp.ndarray, n_rows: int,
+            segments: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Sum ``values`` per destination row; padding rows (-1) are dropped.
 
     The batched analog of mailbox fan-in: all messages to one grain in a
     tick combine associatively (reference behavior: sequential mailbox
-    drain — for commutative updates the result is identical)."""
+    drain — for commutative updates the result is identical).
+
+    With ``segments`` (a Batch.segments row-aligned offsets vector —
+    lanes grouped by destination row), the reduction is PULL-MODE: one
+    cumulative sum over the lanes plus two [n_rows]-sized gathers.  No
+    scatter touches the device, so the cost is O(lanes) of vectorizable
+    work instead of O(lanes) of serialized scatter updates — the streams
+    plane's "one gather + segment_sum per tick" contract.  ``rows`` is
+    ignored on this path (the offsets already address every row).
+
+    Precision caveat (pull mode only): the prefix sum's magnitude grows
+    with the WHOLE batch, so float32 per-segment differences carry
+    absolute error ~eps32 * total — integer reductions are bit-exact
+    (addition is associative), floats are near-exact for small batches
+    but drift at scale.  Exactness-checked handlers (the streams
+    samples' delivery checksums) should reduce integers."""
+    if segments is not None:
+        z = jnp.concatenate(
+            [jnp.zeros(1, values.dtype), jnp.cumsum(values)])
+        return z[segments[1:]] - z[segments[:-1]]
     safe = jnp.where(rows >= 0, rows, n_rows)
     return jax.ops.segment_sum(values, safe, num_segments=n_rows + 1)[:n_rows]
 
 
-def seg_max(values: jnp.ndarray, rows: jnp.ndarray, n_rows: int) -> jnp.ndarray:
+def seg_max(values: jnp.ndarray, rows: jnp.ndarray, n_rows: int,
+            segments: Optional[jnp.ndarray] = None,
+            fill=0) -> jnp.ndarray:
+    """Max of ``values`` per destination row (padding rows dropped).
+
+    Pull-mode (``segments``): a SEGMENTED cumulative max — the classic
+    (flag, value) associative scan with the segment-start flags derived
+    from the offsets — then one gather at each row's segment end.
+    Rows with no lanes read ``fill`` (the scatter path's empty segments
+    read segment_max's identity, the dtype minimum — pass ``fill`` when
+    the handler adds the delta to live state and empty must be neutral)."""
+    if segments is not None:
+        m = values.shape[0]
+        # segment-start flags from the offsets: lane j starts a segment
+        # iff some non-empty row's range begins at j.  Scatter-free —
+        # the offsets are sorted, so membership is two searchsorteds
+        # (keeping this path scatter-clean is its entire point)
+        lanes = jnp.arange(m, dtype=segments.dtype)
+        starts = jnp.searchsorted(segments[:-1], lanes, side="right") \
+            > jnp.searchsorted(segments[:-1], lanes, side="left")
+
+        def combine(a, b):
+            af, av = a
+            bf, bv = b
+            return af | bf, jnp.where(bf, bv, jnp.maximum(av, bv))
+
+        _, cmax = jax.lax.associative_scan(combine, (starts, values))
+        z = jnp.concatenate([jnp.full(1, fill, values.dtype), cmax])
+        # row r's max sits at lane segments[r+1] - 1 (its last lane);
+        # empty rows gather index segments[r] - 1 + 1 == segments[r]
+        # via the guard below and read fill
+        ends = jnp.where(segments[1:] > segments[:-1], segments[1:], 0)
+        return z[ends]
     safe = jnp.where(rows >= 0, rows, n_rows)
     return jax.ops.segment_max(values, safe, num_segments=n_rows + 1)[:n_rows]
 

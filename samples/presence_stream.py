@@ -27,7 +27,8 @@ async def run_presence_stream_load(silo, provider_name: str = "pstream",
                                    n_games: Optional[int] = None,
                                    n_slabs: int = 10,
                                    events_per_slab: Optional[int] = None,
-                                   seed: int = 0) -> Dict[str, float]:
+                                   seed: int = 0,
+                                   steady: bool = False) -> Dict[str, float]:
     """Produce ``n_slabs`` slab items of heartbeats into the stream
     queue and drain them through the tensor sink into PresenceGrain —
     measuring the QUEUE→ENGINE pipeline (enqueue, pull, slab assembly,
@@ -51,8 +52,20 @@ async def run_presence_stream_load(silo, provider_name: str = "pstream",
     stream_id = StreamId(provider=provider_name, namespace="presence-hb",
                          key=0)
     slabs = []
+    # ``steady``: every player heartbeats once per slab (ONE shared key
+    # column across slabs, payloads vary) — the queue-fed twin of the
+    # engine bench's injector pattern.  The pulling agent's sink then
+    # engages its cached-row injector (resolved once, h2d staged under
+    # the previous slab's compute) and the attribution plane's delta
+    # plans memoize, so the pipeline measures the queue, not repeated
+    # cold-resolution.  Default (steady=False) keeps the legacy random
+    # destinations.
+    steady_idx = rng.permutation(
+        np.arange(events_per_slab, dtype=np.int64) % n_players) \
+        if steady else None
     for t in range(n_slabs):
-        idx = rng.integers(0, n_players, events_per_slab)
+        idx = steady_idx if steady \
+            else rng.integers(0, n_players, events_per_slab)
         slabs.append({
             "key": idx.astype(np.int64),
             "game": (idx % n_games).astype(np.int32),

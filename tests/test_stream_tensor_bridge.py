@@ -119,6 +119,30 @@ def test_sink_crash_resume_over_sqlite(run, tmp_path):
             await asyncio.wait_for(delivered_at_least(provider, 4),
                                    timeout=10)
 
+            # the durable ack batches into the next pull cycle's
+            # combined transaction now — wait for the cursor to cover
+            # the delivered slabs before killing, so this test keeps
+            # exercising what it always did (resume from a QUIESCENT
+            # acked cursor).  Killing inside the ack window instead
+            # exercises tail REDELIVERY, whose at-least-once retries
+            # can reorder old events behind newer production — an LWW
+            # assertion cannot hold there by design.
+            import sqlite3
+
+            q = provider.mapper.queue_for(sid)
+
+            async def cursor_at_least(seq):
+                while True:
+                    with sqlite3.connect(db) as conn:
+                        row = conn.execute(
+                            "SELECT cursor FROM stream_cursors WHERE "
+                            "queue_id=?", (q,)).fetchone()
+                    if row is not None and row[0] >= seq:
+                        return
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(cursor_at_least(4), timeout=10)
+
             cluster.kill_silo(s0)  # no goodbye: cursor is whatever is acked
             s1 = await cluster.start_additional_silo()
             provider1 = s1.stream_providers["pq"]
@@ -130,8 +154,22 @@ def test_sink_crash_resume_over_sqlite(run, tmp_path):
                     "key": keys, "v": np.full(n, t + 1, np.int32)}])
             await asyncio.wait_for(delivered_at_least(provider1, 2),
                                    timeout=15)
-            await s1.tensor_engine.flush()
 
+            # the durable ack batches into the NEXT pull cycle's
+            # combined transaction now, so a hard kill can leave an
+            # un-acked DELIVERED tail — the replacement agent
+            # redelivers it (at-least-once), and those redeliveries
+            # count toward the 2 above.  Wait on the OUTCOME instead:
+            # the post-crash slabs' last-writer value must land.
+            async def value_settled():
+                while True:
+                    await s1.tensor_engine.flush()
+                    v, _c = _lww_rows(s1, keys)
+                    if (v == 6).all():
+                        return
+                    await asyncio.sleep(0.01)
+
+            await asyncio.wait_for(value_settled(), timeout=15)
             value, count = _lww_rows(s1, keys)
             # the new silo's arena state restarted empty (no storage
             # attached): at LEAST the post-crash slabs applied here, plus
