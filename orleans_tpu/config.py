@@ -287,13 +287,61 @@ class TensorEngineConfig:
     # multichip bench A/Bs against.  Live-toggleable (fused windows
     # re-trace, cause config_toggle).
     cross_shard_exchange: bool = True
-    # per-(src shard, dst shard) bucket floor (lanes): small batches pad
-    # to at least this so bucket sizes don't churn compiles
+    # when the STRUCTURED formulation (bucket-by-shard + all_to_all)
+    # actually runs: "auto" engages it only on a real accelerator
+    # interconnect — on a host-virtual mesh (forced CPU device count:
+    # one process, one memory, collectives are synchronized memcpies)
+    # the structured region's per-op overhead exceeds the unstructured
+    # scatter it replaces at every measured width (the multichip
+    # bench's exchange_attribution carries the numbers), so auto plans
+    # IDENTITY there: batches pass through untouched, delivery rides
+    # the same implicit collectives as exchange-off, exactness
+    # unconditional, and a sampled probe (exchange_probe_interval)
+    # keeps the demand estimators + cross-traffic counters honest.
+    # "always"/"never" force either side (exactness/overflow suites pin
+    # "always" so the structured machinery stays covered on CPU rigs).
+    # Live-reloadable: fused windows re-trace via the plan signature.
+    exchange_structured: str = "auto"
+    # when the structured path is disengaged, every Nth eligible batch
+    # still runs a measure-only classification (stats parked, nothing
+    # redelivered) so route.* counters and the occupancy estimates
+    # stay fresh at 1/N of the classification cost
+    exchange_probe_interval: int = 8
+    # ---- occupancy-sized exchange buckets (tensor/exchange.py) ----
+    # Size per-(src,dst) buckets from MEASURED per-site demand instead
+    # of the worst-case formula: caps quantize onto a small ladder
+    # ({2^k} ∪ {3·2^(k-1)}), grow immediately on overflow (the parked
+    # redelivery path is the correctness net while the estimate lags a
+    # traffic shift) and shrink only after exchange_shrink_patience calm
+    # drains.  Off = every exchange pays the worst-case pad (the old
+    # formulation, kept as the A/B baseline).
+    exchange_occupancy_sizing: bool = True
+    # granted cap = ladder_ceil(measured peak demand × headroom): the
+    # skew allowance above the observed per-destination peak
+    exchange_headroom: float = 1.5
+    # consecutive drains below the current grant before a cap shrinks
+    # (growth is immediate; shrink hysteresis stops compile flapping)
+    exchange_shrink_patience: int = 4
+    # fused source batches with static key sets are PACKED home-shard-
+    # local on the host at window build (one gather outside the scan):
+    # their cross-shard demand is zero by construction, so the source
+    # leg's exchange short-circuits to the cap-0 classification pass —
+    # no sort, no all_to_all, output width == input width
+    exchange_align_sources: bool = True
+    # unfused path: at round start, pre-dispatch the exchange for every
+    # queued batch whose resolution is already cached, so the
+    # all_to_all of tick t+1's cross traffic runs under tick t's
+    # compute (exact — the exchange reads no arena state); the credit
+    # shows as route.exchange_overlap_s
+    exchange_overlap: bool = True
+    # worst-case FALLBACK plan, used only before any demand observation
+    # lands for a site: per-(src,dst) bucket floor (lanes) …
     exchange_pad_quantum: int = 256
-    # bucket size relative to the uniform share L/n_shards: 2.0 absorbs
-    # 2x destination skew before lanes overflow into redelivery (the
-    # engine re-delivers dropped lanes with their original inject stamp;
-    # a fused window counts them as misses and rolls back)
+    # … times the skew allowance over the uniform share L/n_shards
+    # (2.0 absorbs 2x destination skew before lanes overflow into
+    # redelivery; the engine re-delivers dropped lanes with their
+    # original inject stamp, a fused window counts them as misses and
+    # rolls back)
     exchange_capacity_factor: float = 2.0
     # device streams plane (tensor/streams_plane.py): registered
     # stream-subscription routes expand ON DEVICE — pull-mode (one

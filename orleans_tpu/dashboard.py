@@ -223,6 +223,20 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                 "exchanges": int(_counter_total(merged, "route.exchanges")),
                 "exchange_seconds": round(
                     _counter_total(merged, "route.exchange_s"), 4),
+                "overlap_seconds": round(
+                    _counter_total(merged, "route.exchange_overlap_s"),
+                    4),
+                # per-source gauge: the worst (lowest) utilization any
+                # silo reports — padding waste is a per-engine property
+                "bucket_utilization": round(min(
+                    (v for by_src in gauges.get(
+                        "route.exchange_util", {}).values()
+                     for v in by_src.values()), default=1.0), 4),
+                "caps": {
+                    (lk.split("=", 1)[1] if "=" in lk else lk):
+                        max(by_src.values(), default=0.0)
+                    for lk, by_src in gauges.get(
+                        "route.exchange_cap", {}).items()},
             },
             "latency_ticks": latency,
             "latency_budget_s": budget,
@@ -302,7 +316,9 @@ def render_text(view: Dict[str, Any]) -> str:
             f"cross-shard (on device): {xs['exchanged_messages']} msgs "
             f"across shards / {xs['delivered_messages']} exchanged, "
             f"{xs['dropped_redelivered']} overflow-redelivered, "
-            f"{xs['exchanges']} dispatches")
+            f"{xs['exchanges']} dispatches, "
+            f"util {xs.get('bucket_utilization', 1.0)}, "
+            f"overlap {xs.get('overlap_seconds', 0.0)}s")
     if c["latency_ticks"]:
         budget = c.get("latency_budget_s", 0.0)
         header = "latency (device ledger, per type.method"

@@ -132,7 +132,9 @@ declare("router.slab_merge_ratio", KIND_GAUGE, "ratio",
 declare("route.cross_shard_msgs", KIND_COUNTER, "messages",
         "messages exchanged to a DIFFERENT mesh shard on device "
         "(all_to_all lanes; the traffic the host slab path no longer "
-        "carries)")
+        "carries).  Exact when the structured exchange is engaged; a "
+        "disengaged (identity-mode) silo reports a probe-sampled "
+        "estimate scaled to totals")
 declare("route.delivered_msgs", KIND_COUNTER, "messages",
         "messages delivered through the cross-shard exchange "
         "(local + cross-shard lanes, bucket overflows excluded)")
@@ -144,6 +146,20 @@ declare("route.exchanges", KIND_COUNTER, "dispatches",
 declare("route.exchange_s", KIND_COUNTER, "seconds",
         "cumulative host wall time in the exchange stage (dispatch "
         "side; the device cost shows as the 'exchange' tick phase)")
+declare("route.exchange_util", KIND_GAUGE, "ratio",
+        "bucket utilization: live input lanes over the padded "
+        "post-exchange lanes every downstream kernel pays for — "
+        "occupancy-sized caps hold this near 1 (worst-case caps ran "
+        "it at ~0.12)")
+declare("route.exchange_overlap_s", KIND_COUNTER, "seconds",
+        "overlap credit: wall time pre-dispatched exchanges had to "
+        "run under the preceding groups' compute before their "
+        "consuming group collected them")
+declare("route.exchange_cap", KIND_GAUGE, "lanes",
+        "occupancy-sized bucket cap toward one destination shard "
+        "(label 'shard'): the ladder rung the measured peak demand "
+        "quantizes to with headroom, maxed over sites — 0 means no "
+        "cross-shard demand observed")
 declare("arena.shard_occupancy", KIND_GAUGE, "rows",
         "live rows in one mesh shard block (labels 'arena', 'shard') — "
         "the per-shard balance behind the multichip bench")

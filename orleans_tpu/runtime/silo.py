@@ -773,8 +773,20 @@ class Silo:
                       "delivered_msgs": xs["delivered_msgs"],
                       "exchange_dropped": xs["dropped_msgs"],
                       "exchanges": xs["exchanges_run"],
-                      "exchange_s": xs["exchange_seconds"]},
+                      "exchange_s": xs["exchange_seconds"],
+                      "exchange_overlap_s": xs["overlap_seconds"]},
                      None, "route.")
+                reg.gauge("route.exchange_util").set(
+                    xs["bucket_utilization"])
+                if fan:
+                    mgr.track_metric("route.exchange_util",
+                                     xs["bucket_utilization"],
+                                     {"silo": self.name})
+                # per-destination occupancy-sized caps (the sizing
+                # signal the exchange plans from)
+                for shard, cap in eng.exchange.cap_gauges().items():
+                    reg.gauge("route.exchange_cap",
+                              {"shard": str(shard)}).set(cap)
             for (src_t, src_m), route in eng._stream_routes.items():
                 ss = route.snapshot()
                 emit({"published_events": ss["published_events"],

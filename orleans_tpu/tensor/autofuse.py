@@ -380,7 +380,7 @@ class AutoFuser:
                 states, statics0, stacked0,
                 jnp.zeros(2, jnp.int32),
                 self.engine.ledger.device_hist_in(),
-                prog.attr_state_in()).compile()
+                prog.attr_state_in(), prog.xneed_state_in()).compile()
             prog._reshard_count = self.engine.reshard_count
             # churn attribution: the engagement's AOT lower+compile is
             # the one fused site where the FULL lowering wall time is

@@ -131,6 +131,13 @@ class PendingBatch:
     # (generation, epoch) still match the arena; a stale batch falls
     # back to key-addressed delivery through ``keys_dev``.
     segments: Optional[jnp.ndarray] = None
+    # cross-shard exchange overlap (tensor/exchange.py): the round-start
+    # pre-dispatch pass stores (rows2, args2, mask2, dropped, stats,
+    # generation, epoch, rows_identity, t_dispatch) here so the
+    # all_to_all of this batch runs under the PRECEDING groups' compute;
+    # _run_group consumes it only when the stamps and the resolved rows
+    # identity still match (a stale pre-exchange is silently recomputed)
+    pre_exchange: Optional[Tuple] = None
 
     def __len__(self) -> int:
         for c in (self.rows, self.keys_host, self.keys_dev):
@@ -186,11 +193,21 @@ class _ExchangeCheck:
 
     type_name: str
     method: str
-    keys: jnp.ndarray          # int32[m] device — redelivery addresses
-    args: Any                  # the PRE-exchange args pytree
-    dropped: jnp.ndarray       # bool[m] device
-    stats: jnp.ndarray         # int32[3] device (cross, dropped, delivered)
+    keys: Optional[jnp.ndarray]  # int32[m] device — redelivery addresses
+    args: Any                    # the PRE-exchange args pytree
+    dropped: Optional[jnp.ndarray]  # bool[m] device
+    # int32[3 + n_shards] device: (cross, dropped, delivered) sums plus
+    # the per-destination bucket demand the occupancy estimator feeds on
+    stats: jnp.ndarray
     inject_tick: int = -1
+    # a disengaged-exchange probe: stats fold at drain, but the batch
+    # delivered through the normal path — NOTHING may redeliver
+    measure_only: bool = False
+    # probe sampling factor: the probe runs on 1-in-N eligible groups,
+    # so its COUNT stats scale by N at fold time to stay an unbiased
+    # estimate comparable with engaged-mode exact totals (the demand
+    # tail is a per-drain peak, never scaled)
+    scale: int = 1
 
 
 @jax.jit
@@ -1495,6 +1512,9 @@ class TensorEngine:
             if not pending:
                 break
             self.queues = defaultdict(list)
+            if self._exchange_live() and self.config.exchange_overlap \
+                    and self.router is None and self.exchange.engaged():
+                self._pre_exchange_round(pending, stages)
             for (type_name, method), batches in pending.items():
                 tf = time.perf_counter()
                 if self.router is not None:
@@ -1800,6 +1820,49 @@ class TensorEngine:
             requeued = True
         return requeued
 
+    def _pre_exchange_round(self, pending, stages) -> None:
+        """Exchange OVERLAP, unfused path (tensor/exchange.py): at round
+        start, dispatch the cross-shard exchange for every queued batch
+        whose resolution is ALREADY CACHED (injector fast path) — the
+        exchange is a pure function of (rows, args, mask), independent
+        of arena state, so moving tick t+1's cross traffic while the
+        preceding groups' kernels still run on device is exact by
+        construction.  The consuming group verifies the stamps and the
+        rows identity before using the result; anything stale silently
+        recomputes inline.  Clustered silos skip this (a batch may ship
+        to another silo before it runs — the pre-dispatch would be
+        wasted device work)."""
+        t0 = time.perf_counter()
+        did = False
+        for (type_name, method), batches in pending.items():
+            if len(batches) != 1:
+                continue
+            b = batches[0]
+            if (b.future is not None or b.keys_dev is None
+                    or b.keys_wide is not None or b.rows is None
+                    or b.segments is not None
+                    or b.pre_exchange is not None):
+                continue
+            arena = self.arenas.get(type_name)
+            if arena is None or arena.sharding is None:
+                continue
+            if b.generation != arena.generation \
+                    or b.epoch != arena.eviction_epoch:
+                continue
+            if not exchangeable_args(b.args, len(b)):
+                continue
+            base = b.mask if b.mask is not None else _mask_for(len(b))
+            r2, a2, m2, dropped, stats, run_cost = \
+                self.exchange.dispatch(
+                    arena, b.rows, b.args, base,
+                    site=(type_name, method), defer_stats=True)
+            b.pre_exchange = (r2, a2, m2, dropped, stats,
+                              arena.generation, arena.eviction_epoch,
+                              b.rows, time.perf_counter(), run_cost)
+            did = True
+        if did:
+            stages["exchange"] += time.perf_counter() - t0
+
     def _drain_exchange_checks(self) -> bool:
         """Quiescence half of the cross-shard exchange: fold the parked
         device stat vectors (ONE batched transfer for all parked checks,
@@ -1815,15 +1878,19 @@ class TensorEngine:
         else:
             n = len(checks)
             padded = 1 << (n - 1).bit_length()
+            width = int(checks[0].stats.shape[0])
             xs = [c.stats for c in checks] \
-                + [np.zeros(3, np.int32)] * (padded - n)
+                + [np.zeros(width, np.int32)] * (padded - n)
             stats = np.asarray(_stack_counts(*xs))[:n]
         xch = self.exchange
         requeued = False
         for c, row in zip(checks, stats):
             if xch is not None:
-                xch.fold_stats(row)
-            if int(row[1]) == 0:
+                # the demand tail sizes future caps for THIS site —
+                # occupancy-sized buckets (tensor/exchange.py)
+                xch.fold_stats(row, site=(c.type_name, c.method),
+                               scale=c.scale)
+            if c.measure_only or int(row[1]) == 0:
                 continue
             if xch is not None:
                 xch.redeliveries += 1
@@ -2039,7 +2106,8 @@ class TensorEngine:
         # candidates moves past that decision so dropped lanes are never
         # counted before they deliver.
         maybe_exchange = (
-            self._exchange_live() and arena.sharding is not None
+            self._exchange_live() and self.exchange.engaged()
+            and arena.sharding is not None
             and all(b.future is None and b.keys_dev is not None
                     and b.keys_wide is None for b in batches))
         ledger = self.ledger
@@ -2149,6 +2217,34 @@ class TensorEngine:
         t_x = time.perf_counter()
         stages["resolve"] += t_x - t_res
 
+        if (self._exchange_live() and not self.exchange.engaged()
+                and arena.sharding is not None
+                and not isinstance(rows, np.ndarray)
+                and all(b.future is None and b.keys_dev is not None
+                        and b.keys_wide is None for b in batches)
+                and all(exchangeable_args(b.args, len(b))
+                        for b in batches)):
+            # DISENGAGED exchange (identity — tensor/exchange.py): the
+            # batch delivers through the implicit-collective path, but
+            # every Nth ELIGIBLE group — same eligibility as the
+            # engaged path, so the sampled counters estimate exactly
+            # the traffic the structured formulation would carry —
+            # runs a measure-only classification, keeping the
+            # cross-traffic counters and occupancy estimates honest at
+            # 1/N of the classification cost
+            xch = self.exchange
+            interval = max(1, self.config.exchange_probe_interval)
+            scale = xch.probe_scale((type_name, method), interval)
+            if scale:
+                base = mask if mask is not None \
+                    else _mask_for(rows.shape[0])
+                self._exchange_checks.append(_ExchangeCheck(
+                    type_name=type_name, method=method, keys=None,
+                    args=None, dropped=None,
+                    stats=xch._probe(arena, rows, base,
+                                     (type_name, method)),
+                    measure_only=True, scale=scale))
+
         exchanged = False
         if will_exchange and not isinstance(rows, np.ndarray):
             # cross-shard exchange (tensor/exchange.py): bucket by
@@ -2162,8 +2258,29 @@ class TensorEngine:
             base = mask if mask is not None \
                 else _mask_for(rows.shape[0])
             orig_args = args
-            rows, args, mask, dropped, stats = self.exchange.dispatch(
-                arena, rows, args, base)
+            pre = batches[0].pre_exchange if len(batches) == 1 else None
+            if pre is not None and pre[5] == arena.generation \
+                    and pre[6] == arena.eviction_epoch \
+                    and rows is pre[7]:
+                # exchange overlap: the round-start pre-dispatch already
+                # moved this batch's cross traffic — its all_to_all ran
+                # under the preceding groups' compute.  The credit is
+                # the wall the device had to hide it in; the deferred
+                # run counters fold now (a consumed pre-dispatch IS the
+                # batch's one exchange).
+                rows, args, mask, dropped, stats = pre[:5]
+                self.exchange.fold_dispatch(pre[9])
+                self.exchange.note_overlap(time.perf_counter() - pre[8])
+            else:
+                if pre is not None:
+                    # stale pre-dispatch: its counters were deferred
+                    # and are dropped with it — the inline recompute
+                    # below is the batch's one counted exchange
+                    self.exchange.pre_discards += 1
+                rows, args, mask, dropped, stats = self.exchange.dispatch(
+                    arena, rows, args, base, site=(type_name, method))
+            if len(batches) == 1:
+                batches[0].pre_exchange = None
             # the ORIGINAL inject stamp rides the check: overflow lanes
             # redeliver with it, so their recorded latency includes the
             # redelivery wait (min over the group's stamped batches —

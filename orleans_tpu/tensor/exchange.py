@@ -14,8 +14,7 @@ for true cross-process hops:
    destination shard (``rows // shard_capacity``; identical to the
    directory's `shard_of_keys` hash by construction — the agreement is
    property-tested) and packs messages into a ``[n_shards, cap]`` send
-   buffer, ``cap`` pow2-padded so compile count stays O(log n) under
-   varying load;
+   buffer;
 2. **exchange** — ONE ``lax.all_to_all`` over the mesh axis moves every
    bucket to its owner (inside the compiled program: the fused window
    threads this through its ``lax.scan``);
@@ -23,16 +22,33 @@ for true cross-process hops:
    the existing step kernel's scatter/segment-sum applies them without
    further communication.
 
+**Occupancy-sized buckets** (the perf contract): ``cap`` is NOT a
+worst-case bound.  Every exchange measures the per-destination bucket
+demand on device (``need`` — the true lane count wanting each bucket,
+overflow included) and a per-(type, method) estimator quantizes the
+observed peak onto a small ladder ({2^k} ∪ {3·2^(k-1)}, ≤33% overshoot,
+O(log) rungs): caps GROW immediately when demand overflows (the parked
+redelivery below is the correctness net while the estimate lags) and
+SHRINK only after ``exchange_shrink_patience`` calm drains, so steady
+traffic never churns compiles.  A site whose measured demand is zero
+plans ``cap == 0`` and the exchange short-circuits to a classification
+pass — no sort, no all_to_all, output width == input width — which is
+also what a host-side shard-ALIGNED batch (``align_plan``) gets by
+construction.  Before measurement lands, ``plan`` falls back to the old
+worst-case formula (``pad_quantum`` / ``capacity_factor``), so the first
+dispatch is always safe.
+
 Exactness across the bounded buckets: a lane that does not fit its
-bucket (``cap`` overflow under skew) is never silently lost — the
-send side computes a per-lane ``dropped`` mask, the engine parks it like
-an optimistic miss-check, and the dropped lanes re-deliver next tick
-through the exact same path with their ORIGINAL ``inject_tick`` stamp
-(the latency ledger therefore includes the redelivery wait, same
-contract as the miss path).  Inside a fused window the dropped count
-folds into the window's miss counter instead: a nonzero count fails
-``verify()`` and the auto-fuser rolls back and replays unfused —
-transparency never costs exactness.
+bucket (``cap`` overflow under skew, or ANY cross lane while the
+estimate says 0) is never silently lost — the send side computes a
+per-lane ``dropped`` mask, the engine parks it like an optimistic
+miss-check, and the dropped lanes re-deliver next tick through the
+exact same path with their ORIGINAL ``inject_tick`` stamp (the latency
+ledger therefore includes the redelivery wait, same contract as the
+miss path).  Inside a fused window the dropped count folds into the
+window's miss counter instead: a nonzero count fails ``verify()`` and
+the auto-fuser rolls back and replays unfused — transparency never
+costs exactness.
 
 Ordering caveat (same as host-batch padding): the exchange permutes lane
 order within a (type, method) batch.  Delivery SETS are preserved
@@ -53,27 +69,127 @@ import numpy as np
 
 from jax.sharding import PartitionSpec
 
+#: estimator site key: the (type_name, method) a batch executes as —
+#: caps are per-site because a source leg and its emit leg can have
+#: wildly different cross-shard demand (an aligned injection has none;
+#: its fan-in delivery carries the workload's whole cross ratio)
+Site = Tuple[str, str]
+
 
 def pow2ceil(n: int) -> int:
     return 1 << max(0, int(n) - 1).bit_length()
 
 
+def classify_lanes(rows, mask, shard_capacity: int, L: int, n: int):
+    """THE destination-classification algebra, shared by every path
+    that asks "which lanes are home?" (the structured per-shard body,
+    the cap-0 fast paths, and the disengaged probe): inputs are the
+    PADDED global (or per-shard) lanes; returns ``(valid, dest, local,
+    cross)``.  ``chunk`` is position // L — identical to the shard_map
+    split by construction.  Kept free of reductions so the lean in-scan
+    caller pays nothing it did not ask for; demand wants
+    ``demand_per_dest`` on top."""
+    m_pad = rows.shape[0]
+    chunk = jnp.arange(m_pad, dtype=jnp.int32) // L
+    valid = mask & (rows >= 0)
+    dest = jnp.where(valid, rows // shard_capacity, n)
+    local = valid & (dest == chunk)
+    cross = valid & ~local
+    return valid, dest, local, cross
+
+
+def demand_per_dest(cross, dest, n: int):
+    """Per-destination-shard lane demand (int32[n]) — the occupancy
+    estimator's input; a global count when computed outside shard_map
+    (an upper bound on the per-(src,dst) bucket need — growth-safe,
+    refined by the next measured structured drain)."""
+    return jax.ops.segment_sum(
+        cross.astype(jnp.int32), jnp.where(cross, dest, n),
+        num_segments=n + 1)[:n]
+
+
+def ladder_ceil(n: int) -> int:
+    """Smallest ladder rung ≥ n, rungs {2^k} ∪ {3·2^(k-1)}
+    (1, 2, 3, 4, 6, 8, 12, 16, 24, ...): ≤33% overshoot where pow2
+    pays up to 100%, still O(log) distinct values so the compile set
+    under varying demand stays bounded.  0 maps to 0."""
+    n = int(n)
+    if n <= 0:
+        return 0
+    p = pow2ceil(n)
+    three = 3 * (p // 4)
+    return three if three >= n else p
+
+
+class _SiteEstimator:
+    """Measured bucket demand for one (type, method) exchange site.
+
+    Tracks the per-destination-shard demand peak and grants a quantized
+    cap: growth is immediate (an undersized grant only costs a parked
+    redelivery, but staying undersized would cost one EVERY tick);
+    shrink waits for ``patience`` consecutive calm observations below
+    half the grant, so a noisy steady state never flaps compiles."""
+
+    def __init__(self, n_shards: int) -> None:
+        self.n_shards = n_shards
+        self.peak = np.zeros(n_shards, np.int64)    # all-time, for gauges
+        self._window = np.zeros(n_shards, np.int64)  # since last decision
+        self._obs = 0
+        self.grant: Optional[int] = None
+        self.observations = 0
+
+    def observe(self, need: np.ndarray, headroom: float,
+                patience: int) -> bool:
+        """Fold one drained need vector; returns True when the grant
+        changed (the caller bumps the exchange's plan version)."""
+        need = np.asarray(need, np.int64)
+        self.peak = np.maximum(self.peak, need)
+        self._window = np.maximum(self._window, need)
+        self._obs += 1
+        self.observations += 1
+        want = ladder_ceil(int(np.ceil(float(need.max()) * headroom))) \
+            if need.max() > 0 else 0
+        if self.grant is None or want > self.grant:
+            self.grant = want
+            self._window = np.zeros(self.n_shards, np.int64)
+            self._obs = 0
+            return True
+        if self._obs >= max(1, int(patience)):
+            calm = ladder_ceil(int(np.ceil(float(self._window.max())
+                                           * headroom)))
+            self._window = np.zeros(self.n_shards, np.int64)
+            self._obs = 0
+            if calm < self.grant:
+                self.grant = calm
+                return True
+        return False
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"grant": self.grant,
+                "peak_need": self.peak.tolist(),
+                "observations": self.observations}
+
+
 class ShardExchange:
     """Per-engine exchange plane: builds and caches the jitted exchange
-    programs (one per (batch size, capacity, shard layout) — batch sizes
-    are stable in steady state, and ``cap`` is pow2-padded) and holds the
-    device-side stat accumulators the engine drains at quiescence.
-
-    ``capacity_factor`` sizes the per-(src, dst) bucket relative to the
-    uniform share ``L / n_shards``: 2.0 tolerates 2x destination skew
-    before any lane overflows into redelivery.  ``pad_quantum`` floors
-    the bucket so tiny batches don't churn compiles."""
+    programs (one per (batch size, cap, shard layout) — batch sizes are
+    stable in steady state and cap moves on the quantized ladder only)
+    and holds the device-side stat accumulators the engine drains at
+    quiescence."""
 
     def __init__(self, engine) -> None:
         self.engine = engine
         self.mesh = engine.mesh
         self.axis = engine.config.mesh_axis
         self.n_shards = engine.n_shards
+        self._platform = str(
+            np.asarray(self.mesh.devices).flat[0].platform)
+        # disengaged-probe pacing, PER SITE: one measure-only
+        # classification per exchange_probe_interval occurrences of
+        # each (type, method) — a single global clock would alias with
+        # the deterministic per-tick group rotation and could leave a
+        # site permanently unsampled
+        self._probe_clocks: Dict[Site, int] = {}
         # cumulative stats (folded from device at drain points)
         self.exchanges_run = 0
         self.cross_shard_msgs = 0
@@ -81,11 +197,45 @@ class ShardExchange:
         self.dropped_msgs = 0
         self.redeliveries = 0
         self.exchange_seconds = 0.0
-        self._jit_cache: Dict[Tuple[int, int, int], Any] = {}
+        # bucket utilization: logical input lanes vs the padded output
+        # lanes every downstream kernel pays for — THE number the
+        # occupancy sizing moves (worst-case caps ran this at ~0.12)
+        self.live_lanes = 0
+        self.padded_lanes = 0
+        # overlap: wall time pre-dispatched exchanges spent running
+        # under other work before their consuming group needed them
+        self.overlap_seconds = 0.0
+        self.overlap_hits = 0
+        # pre-dispatched exchanges that went stale before consumption
+        # (their counters were never folded — the inline recompute's
+        # were, so dispatch telemetry counts each logical batch once)
+        self.pre_discards = 0
+        # occupancy-sized caps: per-site estimators + a version the
+        # fused plan signature watches (any grant move re-traces, cause
+        # bucket_growth — never a silent per-tick recompile)
+        self.estimators: Dict[Site, _SiteEstimator] = {}
+        self.cap_version = 0
+        self._jit_cache: Dict[Tuple[int, int, int, int], Any] = {}
+        #: global widths THIS plane produced (exchange outputs, aligned
+        #: layouts): only these keep their exact per-shard split in
+        #: plan() — an organic batch that merely happens to be
+        #: n-divisible still quantizes onto the ladder, so the compile
+        #: set stays O(log) under drifting sizes.  Bounded: derived
+        #: from ladder L x ladder cap combinations.
+        self._transport_widths: set = set()
+        #: shapes already compiled with SOME cap — a new cap for a seen
+        #: (L, shard_capacity, leaves) is a re-quantization, recorded
+        self._seen_shapes: Dict[Tuple[int, int, int], set] = {}
+        # trace capture: fused builds drain this to account in-window
+        # exchange shapes for the utilization counters
+        self.trace_log: List[Tuple[Site, int, int]] = []
 
     def adopt_stats(self, prev: "Optional[ShardExchange]") -> None:
-        """Carry cumulative counters across a mesh reshard (the engine
-        rebuilds the exchange; the perf trajectory must not reset)."""
+        """Carry cumulative counters AND the demand estimators across a
+        mesh reshard when the shard count is unchanged (the engine
+        rebuilds the exchange; the perf trajectory must not reset).  A
+        reshard to a DIFFERENT shard count invalidates the per-dest
+        vectors — estimators restart from the safe fallback plan."""
         if prev is None:
             return
         self.exchanges_run = prev.exchanges_run
@@ -94,21 +244,203 @@ class ShardExchange:
         self.dropped_msgs = prev.dropped_msgs
         self.redeliveries = prev.redeliveries
         self.exchange_seconds = prev.exchange_seconds
+        self.live_lanes = prev.live_lanes
+        self.padded_lanes = prev.padded_lanes
+        self.overlap_seconds = prev.overlap_seconds
+        self.overlap_hits = prev.overlap_hits
+        self.pre_discards = prev.pre_discards
+        self.cap_version = prev.cap_version + 1
+        if prev.n_shards == self.n_shards:
+            self.estimators = prev.estimators
+            self._transport_widths = set(prev._transport_widths)
 
-    # -- planning ------------------------------------------------------------
+    # -- engagement (structured vs identity) --------------------------------
 
-    def plan(self, m: int) -> Tuple[int, int]:
+    def engaged(self) -> bool:
+        """Whether the STRUCTURED formulation (bucket + all_to_all)
+        runs at all.  "auto" engages it only over a real accelerator
+        interconnect: on a host-virtual mesh every collective is a
+        synchronized memcpy inside one process, so the structured
+        region costs strictly more than the implicit-collective
+        scatter it replaces (measured at every width — the multichip
+        bench's exchange_attribution).  Disengaged, the exchange is
+        IDENTITY: delivery rides the same implicit collectives as
+        exchange-off (unconditionally exact), and the sampled probe
+        keeps the demand estimators + cross-traffic counters honest."""
+        mode = getattr(self.engine.config, "exchange_structured", "auto")
+        if mode == "always":
+            return True
+        if mode == "never":
+            return False
+        return self._platform != "cpu"
+
+    def note_transport_width(self, w: int) -> None:
+        """Register a global width this plane produced (exchange output
+        or aligned layout) — plan() keeps such widths' exact per-shard
+        split instead of re-quantizing them."""
+        self._transport_widths.add(int(w))
+
+    def probe_scale(self, site: Site, interval: int) -> int:
+        """Advance the site's probe clock; 0 = this occurrence is not
+        probed, otherwise the SAMPLING SCALE for the measure-only
+        classification — the number of occurrences (inclusive) the
+        probe stands in for, so every occurrence is covered by exactly
+        one probe's scale window and the folded counters stay exact-in-
+        expectation even for short runs.  A site's FIRST occurrence
+        always probes (scale 1): telemetry and the demand estimate
+        exist from the start instead of after interval-1 silent
+        groups."""
+        pending = self._probe_clocks.get(site)
+        if pending is None:
+            self._probe_clocks[site] = 0
+            return 1
+        pending += 1
+        if pending >= max(1, interval):
+            self._probe_clocks[site] = 0
+            return pending
+        self._probe_clocks[site] = pending
+        return 0
+
+    def _probe(self, arena, rows, mask, site: Site) -> Any:
+        """Measure-only classification for a disengaged exchange: one
+        async jit returning the int32[3+n] stats vector (cross, 0,
+        valid, per-dest demand) — the batch itself is untouched and
+        delivers through the normal path, so the parked check must
+        never redeliver (measure_only)."""
+        n = self.n_shards
+        m = int(rows.shape[0])
+        shard_capacity = int(arena.shard_capacity)
+        L = m // n if m in self._transport_widths and m % n == 0 \
+            else ladder_ceil(-(-m // n))
+        key = ("probe", L, shard_capacity)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            m_pad = n * L
+
+            def call(rows, mask):
+                def pad(x, fill):
+                    if x.shape[0] == m_pad:
+                        return x
+                    return jnp.pad(x, [(0, m_pad - x.shape[0])],
+                                   constant_values=fill)
+                rows_p = pad(jnp.asarray(rows, jnp.int32), -1)
+                mask_p = pad(jnp.asarray(mask, bool), False)
+                valid, dest, _local, cross = classify_lanes(
+                    rows_p, mask_p, shard_capacity, L, n)
+                # probe semantics: cross lanes DELIVER (through the
+                # implicit-collective path) — counted as cross traffic,
+                # never as drops
+                return jnp.concatenate([jnp.stack([
+                    jnp.sum(cross.astype(jnp.int32)),
+                    jnp.int32(0),
+                    jnp.sum(valid.astype(jnp.int32)),
+                ]), demand_per_dest(cross, dest, n)])
+            fn = jax.jit(call)
+            self._jit_cache[key] = fn
+        return fn(jnp.asarray(rows), mask)
+
+    # -- occupancy-sized planning -------------------------------------------
+
+    def observe_need(self, site: Site, need: np.ndarray) -> None:
+        """Fold one drained per-destination demand vector for a site."""
+        cfg = self.engine.config
+        est = self.estimators.get(site)
+        if est is None:
+            est = self.estimators[site] = _SiteEstimator(self.n_shards)
+        if est.observe(np.asarray(need), cfg.exchange_headroom,
+                       cfg.exchange_shrink_patience):
+            self.cap_version += 1
+
+    def grant_for(self, site: Optional[Site]) -> Optional[int]:
+        if site is None or not self.engine.config.exchange_occupancy_sizing:
+            return None
+        est = self.estimators.get(site)
+        return None if est is None else est.grant
+
+    def plan(self, m: int, site: Optional[Site] = None
+             ) -> Tuple[int, int]:
         """(per-shard lanes L, per-(src,dst) bucket cap) for an m-lane
-        batch.  Both pow2 so the compile set under varying batch sizes is
-        O(log n); cap is clamped to L (a bucket can never need more than
-        one shard's whole slice)."""
+        batch.  Both ladder-quantized so the compile set under varying
+        batch sizes/demand is O(log n); cap is clamped to L (a bucket
+        can never need more than one shard's whole slice).  A site with
+        a measured grant uses it; an unmeasured site falls back to the
+        worst-case formula (``pad_quantum`` floor × ``capacity_factor``
+        skew allowance) so the first dispatch never drops avoidably.
+        (Host-ALIGNED batches never reach plan(): the fused build skips
+        the exchange for them entirely — fused.py `_apply_group`.)"""
         n = self.n_shards
         cfg = self.engine.config
-        L = pow2ceil(-(-m // n))
+        # a width THIS plane produced keeps its exact per-shard split:
+        # it is a transport shape (the n·W output of an upstream
+        # exchange) or an aligned layout (n·La) — re-quantizing would
+        # shift every lane out of its home chunk and re-cross traffic
+        # that is already placed.  Such widths are static per window /
+        # key set AND registered (`_transport_widths`), so they carry
+        # no compile-churn pressure; every other size — including
+        # organic batches that merely happen to be n-divisible —
+        # quantizes onto the ladder, keeping the compile set O(log)
+        # under drifting population.
+        L = m // n if m in self._transport_widths and m % n == 0 \
+            else ladder_ceil(-(-m // n))
+        grant = self.grant_for(site)
+        if grant is not None:
+            return L, min(L, grant)
         cap = min(L, pow2ceil(max(
             int(cfg.exchange_pad_quantum),
             int(L / n * cfg.exchange_capacity_factor))))
         return L, cap
+
+    def plan_signature(self, sites) -> Tuple:
+        """What a fused window's baked exchange plans depend on: the
+        occupancy toggle, the fallback knobs, and the current grant per
+        site the window exchanges.  prepare() re-traces when this moves
+        (cause ``bucket_growth`` — re-quantization is attributed, never
+        a silent recompile)."""
+        cfg = self.engine.config
+        return (self.engaged(),
+                bool(cfg.exchange_occupancy_sizing),
+                int(cfg.exchange_pad_quantum),
+                float(cfg.exchange_capacity_factor),
+                tuple((s, self.grant_for(s)) for s in sorted(sites)))
+
+    # -- host-side shard alignment ------------------------------------------
+
+    def align_plan(self, rows_np: np.ndarray, shard_capacity: int,
+                   quantum: int = 16) -> Optional[Dict[str, Any]]:
+        """Pack a KNOWN row set home-shard-local on the host: lanes are
+        permuted so shard s's slice of the padded batch holds only rows
+        s owns — the fused build then SKIPS the exchange for this
+        source entirely (zero sort, zero all_to_all, zero
+        classification; staleness re-traces through the generation/
+        epoch discipline before the packing can rot).  Returns None
+        when any row is invalid (callers keep the dynamic path).
+
+        ``take`` is the gather map from aligned lane → original lane
+        (-1 = padding); per-shard width La is quantized to ``quantum``
+        multiples (alignment is static per key set, so there is no
+        compile-churn pressure pushing it to pow2 — a tighter pad wins
+        downstream width)."""
+        rows_np = np.asarray(rows_np)
+        if rows_np.ndim != 1 or rows_np.size == 0 or (rows_np < 0).any():
+            return None
+        n = self.n_shards
+        dest = rows_np // int(shard_capacity)
+        if (dest >= n).any():
+            return None
+        counts = np.bincount(dest, minlength=n)
+        La = max(quantum, -(-int(counts.max()) // quantum) * quantum)
+        take = np.full(n * La, -1, np.int64)
+        order = np.argsort(dest, kind="stable")
+        off = 0
+        for s in range(n):
+            lanes = order[off:off + counts[s]]
+            take[s * La:s * La + len(lanes)] = lanes
+            off += counts[s]
+        rows_aligned = np.where(take >= 0, rows_np[np.clip(take, 0, None)],
+                                -1).astype(np.int32)
+        return {"L": La, "m": int(rows_np.size),
+                "take": take.astype(np.int32),
+                "rows": rows_aligned}
 
     # -- the per-shard program (pure jax; traced into jit or a fused scan) ---
 
@@ -117,14 +449,26 @@ class ShardExchange:
         """The exchange body at padded size ``n * L``: returns
         ``(recv_rows, recv_leaves, recv_mask, dropped, stats)`` where
         ``dropped`` is a bool[n*L] mask in INPUT lane order (slice back
-        to m) and ``stats`` is an int32[3] (cross_shard, dropped,
-        delivered) summed over shards."""
+        to m) and ``stats`` is an int32[3 + n]: (cross_shard, dropped,
+        delivered) summed over shards followed by the per-destination
+        bucket demand maxed over shards — the estimator's input.
+
+        ``cap == 0`` is the packed fast path: classification only (one
+        compare + masks), cross lanes drop into redelivery, and the
+        output width equals the input width — an aligned or all-local
+        batch pays nothing for having the exchange in its program."""
         from jax.experimental.shard_map import shard_map
 
         n = self.n_shards
         axis = self.axis
         m_pad = n * L
-        W = pow2ceil(L + n * cap)  # output lanes per shard
+        # output lanes per shard: EXACT — local slice + the received
+        # buckets, no rung padding.  A downstream exchange (the emit leg
+        # of this batch) sees a global width divisible by n and keeps
+        # the per-shard split as-is (plan()'s n-divisible rule), so the
+        # re-slice stays aligned with THIS exchange's shard boundaries
+        # by construction — the accounting test pins it.
+        W = L + n * cap
 
         def pad_to(x, fill):
             if x.shape[0] == m_pad:
@@ -135,6 +479,30 @@ class ShardExchange:
         rows = pad_to(jnp.asarray(rows, jnp.int32), -1)
         mask = pad_to(jnp.asarray(mask, bool), False)
         leaves = [pad_to(jnp.asarray(x), 0) for x in leaves]
+
+        if cap == 0:
+            # packed fast path, WITHOUT shard_map: a zero-cap site has
+            # no buckets and no all_to_all, so the classification is
+            # plain elementwise algebra GSPMD partitions natively —
+            # on op-count-bound virtual meshes the shard_map wrapper
+            # itself is the dominant cost of an empty exchange.  Local
+            # lanes deliver in place; any cross lane (the estimate says
+            # there are none) drops into redelivery; the demand vector
+            # is the GLOBAL per-destination count — an upper bound on
+            # the per-(src,dst) bucket demand, so a traffic shift grows
+            # the cap at least far enough (the next measured drain
+            # refines it downward).
+            _valid, dest, local, cross = classify_lanes(
+                rows, mask, shard_capacity, L, n)
+            # cap-0 semantics: cross lanes DROP into redelivery
+            # (stats[1]) — the estimate said there were none
+            stats = jnp.concatenate([jnp.stack([
+                jnp.int32(0),
+                jnp.sum(cross.astype(jnp.int32)),
+                jnp.sum(local.astype(jnp.int32)),
+            ]), demand_per_dest(cross, dest, n)])
+            recv_rows = jnp.where(local, rows, -1)
+            return recv_rows, leaves, local, cross, stats
 
         def per_shard(rows_l, mask_l, *leaves_l):
             my = jax.lax.axis_index(axis)
@@ -148,7 +516,12 @@ class ShardExchange:
             # volume — and the bucket pressure `cap` must absorb —
             # scales with the cross-shard ratio, not the batch size
             local = valid & (dest == my)
-            sdest_in = jnp.where(valid & ~local, dest, n)
+            cross = valid & ~local
+            # per-destination bucket demand (overflow INCLUDED): the
+            # occupancy signal the estimator sizes future caps from —
+            # here per SOURCE shard (reduced by max outside shard_map)
+            need = demand_per_dest(cross, dest, n)
+            sdest_in = jnp.where(cross, dest, n)
             order = jnp.argsort(sdest_in)  # ties keep relative order
             sdest = sdest_in[order]
             start = jnp.searchsorted(sdest,
@@ -174,31 +547,21 @@ class ShardExchange:
                     split_axis=0, concat_axis=0)
                 return r.reshape((n * cap,) + x.shape[2:])
 
-            # output per-shard width pads to pow2: a DOWNSTREAM exchange
-            # (the emit leg of this batch) re-slices the global output
-            # into pow2 per-shard runs, and only a pow2 width keeps
-            # those slices aligned with THIS exchange's shard boundaries
-            # — misaligned slices would re-cross lanes that are already
-            # home (correct but wasteful; the accounting test pins it)
-            tail = W - (L + n * cap)
             recv_rows = jnp.concatenate(
-                [jnp.where(local, rows_l, -1), a2a(send_rows),
-                 jnp.full(tail, -1, jnp.int32)])
+                [jnp.where(local, rows_l, -1), a2a(send_rows)])
             recv_leaves = [
-                jnp.concatenate(
-                    [x, a2a(s),
-                     jnp.zeros((tail,) + x.shape[1:], x.dtype)])
+                jnp.concatenate([x, a2a(s)])
                 for x, s in zip(leaves_l, send_leaves)]
             recv_mask = recv_rows >= 0
             # dropped mask back in input lane order
             dropped_sorted = (sdest < n) & (pos >= cap)
             dropped_l = jnp.zeros(L, bool).at[order].set(dropped_sorted)
             n_dropped = jnp.sum(dropped_sorted.astype(jnp.int32))
-            stats = jnp.stack([
-                jnp.sum((valid & ~local).astype(jnp.int32)),
+            stats = jnp.concatenate([jnp.stack([
+                jnp.sum(cross.astype(jnp.int32)),
                 n_dropped,
                 jnp.sum(valid.astype(jnp.int32)) - n_dropped,
-            ])[None, :]  # [1, 3]: per-shard partial, summed outside
+            ]), need])[None, :]  # [1, 3 + n]: per-shard, reduced outside
             return (recv_rows, recv_mask, dropped_l, stats, *recv_leaves)
 
         P = PartitionSpec
@@ -210,43 +573,90 @@ class ShardExchange:
                        out_specs=out_specs, check_rep=False)
         recv_rows, recv_mask, dropped, stats, *recv_leaves = fn(
             rows, mask, *leaves)
-        return (recv_rows, recv_leaves, recv_mask, dropped,
-                jnp.sum(stats, axis=0))
+        # counts SUM across shards; per-dest demand is a MAX (the bucket
+        # is per (src, dst) pair, so the cap must cover the worst src)
+        stats = jnp.concatenate([jnp.sum(stats[:, :3], axis=0),
+                                 jnp.max(stats[:, 3:], axis=0)])
+        return recv_rows, recv_leaves, recv_mask, dropped, stats
 
     # -- fused-path entry (called under an active trace) ---------------------
 
-    def apply_traced(self, shard_capacity: int, rows, args: Any, mask):
+    def apply_traced(self, site: Site, shard_capacity: int, rows, args: Any,
+                     mask):
         """Exchange inside a fused window trace: returns
-        ``(rows2, args2, mask2, dropped_count)`` — the dropped count
-        folds into the window's device-side miss counter so a capacity
-        overflow fails ``verify()`` (rollback + unfused replay) instead
-        of losing lanes.  A group whose args are not lane-aligned (slab
-        -style handlers consuming a whole buffer per tick, e.g. the
-        twitter dispatcher) passes through untouched — permuting rows
-        away from such args would break the handler's row↔buffer
-        correspondence."""
+        ``(rows2, args2, mask2, dropped_count, need)`` — the dropped
+        count folds into the window's device-side miss counter so a
+        capacity overflow fails ``verify()`` (rollback + unfused replay)
+        instead of losing lanes, and ``need`` (int32[n]) rides the
+        window's xneed accumulator so steady fused traffic keeps the
+        site's occupancy estimate honest in BOTH directions.  A group
+        whose args are not lane-aligned (slab-style handlers consuming a
+        whole buffer per tick, e.g. the twitter dispatcher) passes
+        through untouched — permuting rows away from such args would
+        break the handler's row↔buffer correspondence."""
         m = rows.shape[0]
+        n = self.n_shards
         if not exchangeable_args(args, m):
-            return rows, args, mask, jnp.int32(0)
-        L, cap = self.plan(m)
+            return rows, args, mask, jnp.int32(0), \
+                jnp.zeros(n, jnp.int32)
+        L, cap = self.plan(m, site=site)
+        if cap == 0:
+            # LEAN in-scan fast path: classification + the miss count,
+            # nothing else — the per-tick demand reductions of the full
+            # stats vector are cross-device collectives inside the
+            # scan, measured as the entire residual cost of an empty
+            # exchange on op-count-bound meshes.  A traffic shift here
+            # fails verify() (dropped ≠ 0), the rollback's unfused
+            # replay re-delivers, and ITS drained stats grow the cap —
+            # the estimator's slow feedback half; the fused fast path
+            # never pays for a possibility that isn't happening.
+            m_pad = n * L
+
+            def pad(x, fill):
+                if x.shape[0] == m_pad:
+                    return x
+                widths = [(0, m_pad - x.shape[0])] + \
+                    [(0, 0)] * (x.ndim - 1)
+                return jnp.pad(x, widths, constant_values=fill)
+
+            rows_p = pad(jnp.asarray(rows, jnp.int32), -1)
+            mask_p = pad(jnp.asarray(mask, bool), False)
+            args_p = jax.tree_util.tree_map(
+                lambda a: a if jnp.ndim(a) == 0
+                else pad(jnp.asarray(a), 0), args)
+            _valid, _dest, local, cross = classify_lanes(
+                rows_p, mask_p, shard_capacity, L, n)
+            dropped = jnp.sum(cross.astype(jnp.int32))
+            self.trace_log.append((site, int(m), m_pad))
+            self.note_transport_width(m_pad)
+            return (jnp.where(local, rows_p, -1), args_p, local,
+                    dropped, jnp.zeros(n, jnp.int32))
         leaves, treedef, scalar_ix = _split_leaves(args, m)
         rows2, leaves2, mask2, _dropped, stats = self._traced(
             rows, leaves, mask, shard_capacity, L, cap)
         args2 = _join_leaves(treedef, scalar_ix, leaves2)
-        return rows2, args2, mask2, stats[1]
+        self.trace_log.append((site, int(m), int(rows2.shape[0])))
+        self.note_transport_width(int(rows2.shape[0]))
+        return rows2, args2, mask2, stats[1], stats[3:]
 
     # -- unfused-path entry (jitted dispatch; stats parked on device) --------
 
-    def dispatch(self, arena, rows, args: Any, mask):
+    def dispatch(self, arena, rows, args: Any, mask,
+                 site: Optional[Site] = None,
+                 defer_stats: bool = False):
         """One async exchange dispatch for an unfused batch.  Returns
         ``(rows2, args2, mask2, dropped_mask, stats)`` with the dropped
-        mask and the int32[3] stats still ON DEVICE — the engine parks
+        mask and the int32[3+n] stats still ON DEVICE — the engine parks
         them (like a miss-check) and reads everything in one batched
-        transfer at the next quiescence point."""
+        transfer at the next quiescence point.  ``defer_stats`` (the
+        round-start pre-dispatch) appends a run-cost tuple to the
+        return and folds NO counters — the consumer calls
+        ``fold_dispatch`` on use or drops the result (stale), so a
+        logical batch counts exactly once either way."""
         t0 = time.perf_counter()
         m = int(rows.shape[0])
         shard_capacity = int(arena.shard_capacity)
-        L, cap = self.plan(m)
+        L, cap = self.plan(m, site=site)
         leaves, treedef, scalar_ix = _split_leaves(args, m)
         key = (L, cap, shard_capacity, len(leaves))
         fn = self._jit_cache.get(key)
@@ -256,18 +666,90 @@ class ShardExchange:
                                     shard_capacity, L, cap)
             fn = jax.jit(call)
             self._jit_cache[key] = fn
+            shape = (L, shard_capacity, len(leaves))
+            seen = self._seen_shapes.setdefault(shape, set())
+            if seen:
+                # same batch shape, new cap: the occupancy estimate
+                # re-quantized the bucket — attribute the recompile
+                # (tensor/profiler.py churn taxonomy) so a flapping
+                # estimate can never hide as organic shape churn
+                from orleans_tpu.tensor.profiler import CAUSE_BUCKET_GROWTH
+                self.engine.compile_tracker.record(
+                    CAUSE_BUCKET_GROWTH,
+                    key=f"exchange[{L}]cap{sorted(seen)[-1]}->{cap}",
+                    tick=self.engine.tick_number)
+            seen.add(cap)
         rows2, leaves2, mask2, dropped, stats = fn(
             jnp.asarray(rows), mask, *leaves)
         args2 = _join_leaves(treedef, scalar_ix, leaves2)
+        self.note_transport_width(int(rows2.shape[0]))
+        if defer_stats:
+            # pre-dispatch path: the consumer folds the run counters
+            # (or discards them with the result — a stale pre-exchange
+            # must not double-count the inline recompute's batch)
+            return rows2, args2, mask2, dropped[:m], stats, \
+                (m, int(rows2.shape[0]), time.perf_counter() - t0)
         self.exchanges_run += 1
+        self.live_lanes += m
+        self.padded_lanes += int(rows2.shape[0])
         self.exchange_seconds += time.perf_counter() - t0
         return rows2, args2, mask2, dropped[:m], stats
 
-    def fold_stats(self, stats_host: np.ndarray) -> None:
-        """Accumulate one drained [3] stats vector."""
-        self.cross_shard_msgs += int(stats_host[0])
-        self.dropped_msgs += int(stats_host[1])
-        self.delivered_msgs += int(stats_host[2])
+    def fold_dispatch(self, run_cost: Tuple[int, int, float]) -> None:
+        """Fold a deferred pre-dispatch's run counters at consumption
+        (see ``dispatch(defer_stats=True)``)."""
+        m, padded, dt = run_cost
+        self.exchanges_run += 1
+        self.live_lanes += m
+        self.padded_lanes += padded
+        self.exchange_seconds += dt
+
+    def fold_stats(self, stats_host: np.ndarray,
+                   site: Optional[Site] = None,
+                   scale: int = 1) -> None:
+        """Accumulate one drained [3 + n] stats vector; the demand tail
+        feeds the site's occupancy estimator.  ``scale > 1`` marks a
+        SAMPLED disengaged-mode probe (1-in-scale groups measured):
+        count stats multiply up to stay an unbiased estimate comparable
+        with engaged-mode exact totals; the demand tail is a peak, not
+        a sum, and never scales."""
+        self.cross_shard_msgs += int(stats_host[0]) * scale
+        self.dropped_msgs += int(stats_host[1]) * scale
+        self.delivered_msgs += int(stats_host[2]) * scale
+        if site is not None and len(stats_host) > 3:
+            self.observe_need(site, np.asarray(stats_host[3:]))
+
+    def fold_fused_shapes(self, shapes, n_ticks: int) -> None:
+        """Account a fused window run's in-window exchanges (shapes were
+        captured at trace time): utilization + run counters, no device
+        traffic."""
+        for _site, m_in, m_out in shapes:
+            self.exchanges_run += n_ticks
+            self.live_lanes += m_in * n_ticks
+            self.padded_lanes += m_out * n_ticks
+
+    def note_overlap(self, seconds: float) -> None:
+        self.overlap_seconds += max(0.0, seconds)
+        self.overlap_hits += 1
+
+    def utilization(self) -> float:
+        """Live input lanes over padded output lanes — how much of the
+        width every post-exchange kernel pays for is real traffic."""
+        return self.live_lanes / self.padded_lanes \
+            if self.padded_lanes else 1.0
+
+    def cap_gauges(self) -> Dict[int, int]:
+        """Per-destination-shard occupancy-sized cap (the ladder rung
+        the measured peak demand for that shard quantizes to, maxed
+        over sites) — the ``route.exchange_cap{shard}`` gauge."""
+        cfg = self.engine.config
+        out = {s: 0 for s in range(self.n_shards)}
+        for est in self.estimators.values():
+            for s in range(self.n_shards):
+                rung = ladder_ceil(int(np.ceil(
+                    float(est.peak[s]) * cfg.exchange_headroom)))
+                out[s] = max(out[s], rung)
+        return out
 
     def snapshot(self) -> Dict[str, Any]:
         return {
@@ -279,6 +761,13 @@ class ShardExchange:
             "redeliveries": self.redeliveries,
             "exchange_seconds": round(self.exchange_seconds, 6),
             "compiled_programs": len(self._jit_cache),
+            "bucket_utilization": round(self.utilization(), 4),
+            "overlap_seconds": round(self.overlap_seconds, 6),
+            "overlap_hits": self.overlap_hits,
+            "pre_discards": self.pre_discards,
+            "cap_version": self.cap_version,
+            "sites": {f"{t}.{m}": est.snapshot()
+                      for (t, m), est in self.estimators.items()},
         }
 
 

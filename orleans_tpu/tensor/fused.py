@@ -37,7 +37,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from orleans_tpu.tensor.exchange import exchangeable_args
 from orleans_tpu.tensor.profiler import (
+    CAUSE_BUCKET_GROWTH,
     CAUSE_CONFIG_TOGGLE,
     CAUSE_EPOCH_MISMATCH,
     CAUSE_GENERATION_REPACK,
@@ -128,6 +130,20 @@ class FusedTickProgram:
         self._touched: List[str] = []
         self._compiled: Callable | None = None
         self._totals = None  # device [miss, delivered] since last verify
+        # cross-shard exchange occupancy feedback: the per-site
+        # per-destination bucket-demand maxima the window accumulated on
+        # device ({site: int32[n_shards]}; read with _totals at verify
+        # and folded into the exchange's estimators — fused steady
+        # traffic keeps the caps honest in both directions)
+        self._xneed = None
+        self._exchange_sites: List[str] = []
+        self._exchange_shapes: List[Tuple] = []
+        self._site_keys: Dict[str, Tuple[str, str]] = {}
+        self._exchange_plan_sig: "Tuple | None" = None
+        # host-side shard alignment plans per source (or None): baked
+        # take/rows/mask constants that pack the source batch
+        # home-shard-local so its exchange runs the cap-0 fast path
+        self._align: List[Any] = [None] * len(self.sources)
         # latency-ledger integration (tensor/ledger.py): when the owning
         # engine's ledger is enabled at BUILD time, the window program
         # threads the [slots, buckets] histogram through its scan and
@@ -226,7 +242,8 @@ class FusedTickProgram:
 
     def _apply_group(self, states: Dict[str, Any], type_name: str,
                      method: str, rows, args, mask, depth: int, hist,
-                     attr, segments=None, host_keys=None):
+                     attr, xneed, segments=None, host_keys=None,
+                     aligned: bool = False):
         """Apply one (type, method) batch and recurse into its emits,
         registered fan-outs, and registered stream-subscription routes
         — the trace-time unrolling of the engine's multi-round tick.
@@ -234,11 +251,15 @@ class FusedTickProgram:
         window (unchanged when the ledger is off); ``attr`` is the
         workload-attribution SCAN carry (counts + slots — the sketch is
         folded ONCE per window from the counts delta, see ``window``),
-        empty when that plane is off.  ``segments`` marks a pull-mode
-        delivery batch (row-aligned offsets — tensor/streams_plane.py);
-        ``host_keys`` is the source pattern's host key set (depth-1
-        sources only), which the stream route uses to recognize its
-        bound publish set."""
+        empty when that plane is off.  ``xneed`` is the exchange's
+        per-site bucket-demand accumulator ({site: int32[n_shards]},
+        max-merged — the occupancy estimator's fused-path feedback).
+        ``segments`` marks a pull-mode delivery batch (row-aligned
+        offsets — tensor/streams_plane.py); ``host_keys`` is the source
+        pattern's host key set (depth-1 sources only), which the stream
+        route uses to recognize its bound publish set; ``aligned`` marks
+        a source batch the build packed home-shard-local (its exchange
+        plans cap 0 — the classification-only fast path)."""
         info = vector_type(type_name)
         handler = info.handlers[method]
         if type_name not in states:
@@ -249,7 +270,18 @@ class FusedTickProgram:
         n_rows = next(iter(states[type_name].values())).shape[0]
         miss_total = jnp.int32(0)
         xch = self.engine.exchange
-        if self._exchange_on and xch is not None:
+        if self._exchange_on and xch is not None and not aligned \
+                and xch.engaged():
+            # aligned sources SKIP the exchange entirely: the build
+            # packed every lane into its home chunk from concrete rows,
+            # and any layout move (grow/compact/eviction/reshard) re-
+            # traces through prepare()'s generation/epoch discipline
+            # before the constants can go stale — an in-scan
+            # classification would re-prove a static fact every tick.
+            # A DISENGAGED exchange (identity mode — host-virtual mesh)
+            # traces nothing at all: the window IS the exchange-off
+            # program, and a live engagement flip re-traces through the
+            # plan signature.
             arena = self.engine.arena_for(type_name)
             if arena.sharding is not None:
                 # cross-shard exchange INSIDE the window: sources and
@@ -257,9 +289,16 @@ class FusedTickProgram:
                 # their kernel; bucket-overflow lanes count as misses
                 # (the window is then non-exact and replays unfused —
                 # no in-window redelivery path exists by design)
-                rows, args, mask, dropped = xch.apply_traced(
-                    int(arena.shard_capacity), rows, args, mask)
+                site = (type_name, method)
+                rows, args, mask, dropped, need = xch.apply_traced(
+                    site, int(arena.shard_capacity), rows, args, mask)
                 miss_total = miss_total + dropped
+                skey = f"{type_name}.{method}"
+                if skey in xneed:
+                    xneed = {**xneed,
+                             skey: jnp.maximum(xneed[skey], need)}
+                else:  # discovery pass only — window pre-populates
+                    xneed = {**xneed, skey: need}
         # named_scope labels the window HLO for jax.profiler deep
         # captures (tensor/profiler.py) — trace-time only
         with jax.named_scope(f"orleans.fused.{type_name}.{method}"):
@@ -370,10 +409,11 @@ class FusedTickProgram:
                     gargs = {**gargs, "src_key": pull["src_key"]}
                 emask = jnp.asarray(mask, bool)[lane]
                 delivered = delivered + jnp.sum(emask.astype(jnp.int32))
-                states, sub_miss, sub_del, hist, attr = self._apply_group(
-                    states, route.type_name, route.method,
-                    pull["rows"], gargs, emask, depth + 1, hist, attr,
-                    segments=pull["offsets"])
+                states, sub_miss, sub_del, hist, attr, xneed = \
+                    self._apply_group(
+                        states, route.type_name, route.method,
+                        pull["rows"], gargs, emask, depth + 1, hist,
+                        attr, xneed, segments=pull["offsets"])
                 miss_total = miss_total + sub_miss
                 delivered = delivered + sub_del
             else:
@@ -410,7 +450,7 @@ class FusedTickProgram:
             for _, _, _ekeys, _eargs, emask in out_batches:
                 miss_total = miss_total + jnp.sum(
                     jnp.asarray(emask, jnp.int32))
-            return states, miss_total, delivered, hist, attr
+            return states, miss_total, delivered, hist, attr, xneed
 
         for dst_type, dst_method, ekeys, eargs, emask in out_batches:
             dst_arena = self.engine.arena_for(dst_type)
@@ -418,12 +458,13 @@ class FusedTickProgram:
             from orleans_tpu.tensor.engine import resolve_rows_on_device
             drows, miss = resolve_rows_on_device(dst_arena, ekeys, emask)
             delivered = delivered + jnp.sum(jnp.asarray(emask, jnp.int32))
-            states, sub_miss, sub_del, hist, attr = self._apply_group(
-                states, dst_type, dst_method, drows, eargs,
-                drows >= 0, depth + 1, hist, attr)
+            states, sub_miss, sub_del, hist, attr, xneed = \
+                self._apply_group(
+                    states, dst_type, dst_method, drows, eargs,
+                    drows >= 0, depth + 1, hist, attr, xneed)
             miss_total = miss_total + miss + sub_miss
             delivered = delivered + sub_del
-        return states, miss_total, delivered, hist, attr
+        return states, miss_total, delivered, hist, attr, xneed
 
     def _src_keys_for(self, type_name: str, rows):
         arena = self.engine.arena_for(type_name)
@@ -445,8 +486,6 @@ class FusedTickProgram:
 
         examples = example_args_t if self._is_multi() \
             else [example_args_t]
-        src_rows = [s.rows for s in self.sources]
-        masks = [ones_mask(len(s.keys)) for s in self.sources]
         # latency ledger: bake the decision at build time (a live toggle
         # takes effect on the next re-trace); the hist shape is part of
         # the compiled signature, so prepare() re-traces when it changes
@@ -458,6 +497,49 @@ class FusedTickProgram:
         self._attr_sig = self.engine.attribution.build_signature()
         # cross-shard exchange: same bake-at-build discipline
         self._exchange_on = self.engine._exchange_live()
+        # packed cross-lanes (tensor/exchange.py): a source whose key
+        # set is static for the window's lifetime is PACKED home-shard-
+        # local here, on the host, once — its in-scan exchange then
+        # plans cap 0 (classification only: no sort, no all_to_all,
+        # output width == input width).  Sources feeding a stream route
+        # keep their lane order (pull layouts precompute per-edge
+        # source lanes against the bound key order).
+        self._align = [None] * len(self.sources)
+        if self._exchange_on \
+                and self.engine.exchange.engaged() \
+                and self.engine.config.exchange_align_sources:
+            for i, s in enumerate(self.sources):
+                arena = self.engine.arena_for(s.type_name)
+                if arena.sharding is None \
+                        or (s.type_name, s.method) \
+                        in self.engine._stream_routes \
+                        or not exchangeable_args(examples[i],
+                                                 len(s.keys)):
+                    continue
+                plan = self.engine.exchange.align_plan(
+                    np.asarray(s.rows), int(arena.shard_capacity))
+                if plan is None:
+                    continue
+                # the aligned layout is a transport width: this
+                # source's EMIT batches inherit it, and their exchange
+                # must keep the per-shard split exact
+                self.engine.exchange.note_transport_width(
+                    len(plan["rows"]))
+                self._align[i] = {
+                    "take": jnp.asarray(
+                        np.clip(plan["take"], 0, None).astype(np.int32)),
+                    "rows": jnp.asarray(plan["rows"]),
+                    "mask": jnp.asarray(plan["take"] >= 0),
+                }
+        src_rows = [al["rows"] if al is not None else s.rows
+                    for al, s in zip(self._align, self.sources)]
+        masks = [al["mask"] if al is not None
+                 else ones_mask(len(s.keys))
+                 for al, s in zip(self._align, self.sources)]
+        # the discovery/trace examples must match the lane layout the
+        # window's gather produces
+        examples = [self._align_tree(i, ex, axis=0)
+                    for i, ex in enumerate(examples)]
         # stream-subscription routes (tensor/streams_plane.py): bake the
         # live toggle and warm every route's pull layout EAGERLY — a
         # rebuild under the trace would produce trace-local mirrors, so
@@ -475,17 +557,18 @@ class FusedTickProgram:
                     route._rebuild_push()
         self._stream_sig = self.engine._stream_routes_signature()
 
-        def apply_all(states, per_source_args, hist, attr):
+        def apply_all(states, per_source_args, hist, attr, xneed):
             miss_tot = jnp.int32(0)
             del_tot = jnp.int32(0)
             for i, src in enumerate(self.sources):
-                states, miss, dd, hist, attr = self._apply_group(
+                states, miss, dd, hist, attr, xneed = self._apply_group(
                     states, src.type_name, src.method, src_rows[i],
                     per_source_args[i], masks[i], depth=1, hist=hist,
-                    attr=attr, host_keys=src.keys)
+                    attr=attr, xneed=xneed, host_keys=src.keys,
+                    aligned=self._align[i] is not None)
                 miss_tot = miss_tot + miss
                 del_tot = del_tot + dd
-            return states, miss_tot, del_tot, hist, attr
+            return states, miss_tot, del_tot, hist, attr, xneed
 
         def reset_discovery() -> None:
             self._generations = {s.type_name: s.arena.generation
@@ -507,9 +590,15 @@ class FusedTickProgram:
         # A FRESH closure per iteration: discovery works by side effect
         # (_note_arena), and jax caches traces by function identity — a
         # reused closure would hit the cache and silently skip the trace.
+        xch = self.engine.exchange
         while True:
             known = set(self.engine.arenas)
             reset_discovery()
+            if xch is not None:
+                # the discovery trace walks every exchange site —
+                # capture them (and their in/out widths) for the xneed
+                # accumulator layout + the utilization counters
+                xch.trace_log = []
 
             def discover(args_per_source):
                 states: Dict[str, Any] = {
@@ -517,8 +606,8 @@ class FusedTickProgram:
                 hist0 = jnp.zeros(self._hist_shape, jnp.int32)
                 attr0 = self._scan_attr(self.attr_state_in(
                     [s.type_name for s in self.sources]))
-                _states, miss, _d, _h, _a = apply_all(
-                    states, args_per_source, hist0, attr0)
+                _states, miss, _d, _h, _a, _x = apply_all(
+                    states, args_per_source, hist0, attr0, {})
                 return miss
 
             jax.eval_shape(discover, examples)
@@ -529,24 +618,43 @@ class FusedTickProgram:
                 self.engine.arenas.pop(name)
                 self.engine.arena_for(name)  # eager, concrete columns
         touched = list(self._touched)
+        shapes = list(xch.trace_log) \
+            if (self._exchange_on and xch is not None) else []
+        self._exchange_shapes = shapes
+        self._site_keys: Dict[str, Tuple[str, str]] = {}
+        for site, _mi, _mo in shapes:
+            self._site_keys.setdefault(f"{site[0]}.{site[1]}", site)
+        self._exchange_sites = list(self._site_keys)
+        self._exchange_plan_sig = xch.plan_signature(
+            list(self._site_keys.values())) \
+            if (self._exchange_on and xch is not None) else None
 
         def window(states, statics, stackeds, totals_in, hist_in,
-                   attr_in):
+                   attr_in, xneed_in):
             scan_attr_in = self._scan_attr(attr_in)
+            # packed sources: ONE gather per leaf per window (outside
+            # the scan) re-lays the natural-order inputs home-shard-
+            # local; the per-tick exchange inside the scan then runs
+            # the cap-0 fast path
+            statics = [self._align_tree(i, statics[i], axis=0)
+                       for i in range(len(self.sources))]
+            stackeds = [self._align_tree(i, stackeds[i], axis=1)
+                        for i in range(len(self.sources))]
 
             def one_tick(carry, args_ts):
-                states, hist, attr = carry
+                states, hist, attr, xneed = carry
                 # static leaves (identical every tick) ride OUTSIDE the
                 # scan xs: slicing a [T, m] stack per iteration costs
                 # real bandwidth; a closed-over [m] array costs nothing
                 merged = [{**statics[i], **args_ts[i]}
                           for i in range(len(self.sources))]
-                states, miss, delivered, hist, attr = apply_all(
-                    states, merged, hist, attr)
-                return (states, hist, attr), (miss, delivered)
-            (states, hist, attr), (misses, delivered) = jax.lax.scan(
-                one_tick, (states, hist_in, scan_attr_in),
-                tuple(stackeds))
+                states, miss, delivered, hist, attr, xneed = apply_all(
+                    states, merged, hist, attr, xneed)
+                return (states, hist, attr, xneed), (miss, delivered)
+            (states, hist, attr, xneed), (misses, delivered) = \
+                jax.lax.scan(
+                    one_tick, (states, hist_in, scan_attr_in, xneed_in),
+                    tuple(stackeds))
             if attr_in:
                 # sketch fold, ONCE per window: the scan carried only
                 # counts + slots; the CMS re-derives from each arena's
@@ -568,10 +676,10 @@ class FusedTickProgram:
             # reads one 2-element buffer no matter how many windows ran
             # (each completion observation costs ~100ms on tunneled
             # runtimes, so per-window reads would dominate).  The ledger
-            # hist and the attribution pytree likewise stay on device
-            # until an explicit snapshot.
+            # hist, the attribution pytree and the exchange demand
+            # maxima likewise stay on device until an explicit snapshot.
             return states, totals_in + jnp.stack(
-                [jnp.sum(misses), jnp.sum(delivered)]), hist, attr
+                [jnp.sum(misses), jnp.sum(delivered)]), hist, attr, xneed
 
         self._touched = touched
         self._built_donate = self.donate
@@ -586,6 +694,54 @@ class FusedTickProgram:
             return {}
         return self.engine.attribution.device_state_in(
             touched if touched is not None else self._touched)
+
+    def _align_tree(self, i: int, tree: Any, axis: int) -> Any:
+        """Gather one source's args into its packed home-shard-local
+        lane order (no-op for unaligned sources).  ``axis=0`` for
+        natural [m, ...] leaves (statics / single-tick examples),
+        ``axis=1`` for stacked [T, m, ...] leaves — a stacked leaf of
+        rank 1 is a per-tick scalar and passes through untouched."""
+        al = self._align[i]
+        if al is None:
+            return tree
+        take = al["take"]
+
+        def gather(a):
+            if jnp.ndim(a) == 0:
+                return a
+            if axis == 0:
+                return jnp.asarray(a)[take]
+            if jnp.ndim(a) == 1:
+                return a
+            return jnp.asarray(a)[:, take]
+
+        return jax.tree_util.tree_map(gather, tree)
+
+    def xneed_state_in(self):
+        """The exchange demand accumulator a window run (or the
+        auto-fuser's AOT lower) passes as ``xneed_in`` — empty when the
+        exchange was off at build time, so the signature stays
+        stable."""
+        if not self._exchange_on or not self._exchange_sites:
+            return {}
+        if self._xneed is not None:
+            return self._xneed
+        n = self.engine.n_shards
+        return {k: jnp.zeros(n, jnp.int32) for k in self._exchange_sites}
+
+    def _fold_xneed(self) -> None:
+        """Read the accumulated per-site bucket demand (one small
+        transfer per site, at an existing sync point) into the
+        exchange's occupancy estimators — the fused path's half of the
+        cap-sizing feedback loop."""
+        xn, self._xneed = self._xneed, None
+        xch = self.engine.exchange
+        if not xn or xch is None:
+            return
+        for skey, vec in xn.items():
+            site = self._site_keys.get(skey)
+            if site is not None:
+                xch.observe_need(site, np.asarray(vec))
 
     @staticmethod
     def _scan_attr(attr_in):
@@ -642,7 +798,20 @@ class FusedTickProgram:
             # re-trace; the step-program twin clears _step_cache for
             # the same reason
             cause = CAUSE_CONFIG_TOGGLE
+        elif self._exchange_on and engine.exchange is not None \
+                and self._exchange_plan_sig != engine.exchange \
+                .plan_signature(list(self._site_keys.values())):
+            # an exchange cap re-quantized (the occupancy estimator
+            # moved a grant, or the sizing knobs were live-reloaded):
+            # the window baked the old bucket widths as trace constants.
+            # Re-trace HERE, cause-coded — grants only move at drain/
+            # verify boundaries (estimators fold there), so a steady
+            # stream can never recompile per tick
+            cause = CAUSE_BUCKET_GROWTH
         if cause is not None:
+            # fold pending demand observations under the OLD site
+            # layout before the rebuild replaces it
+            self._fold_xneed()
             self._donate = donate_target
             for s in self.sources:
                 s.rows = jnp.asarray(s.arena.resolve_rows(s.keys))
@@ -683,13 +852,20 @@ class FusedTickProgram:
         states = {n: engine.arena_for(n).state for n in self._touched}
         totals_in = self._totals if self._totals is not None \
             else jnp.zeros(2, dtype=jnp.int32)
-        new_states, self._totals, hist_out, attr_out = self._compiled(
-            states, statics, stackeds, totals_in,
-            engine.ledger.device_hist_in(), self.attr_state_in())
+        new_states, self._totals, hist_out, attr_out, xneed_out = \
+            self._compiled(
+                states, statics, stackeds, totals_in,
+                engine.ledger.device_hist_in(), self.attr_state_in(),
+                self.xneed_state_in())
         if self._ledger_on:
             engine.ledger.device_hist_out(hist_out)
         if self._attr_on:
             engine.attribution.device_state_out(attr_out)
+        if self._exchange_on:
+            self._xneed = xneed_out
+            if engine.exchange is not None:
+                engine.exchange.fold_fused_shapes(
+                    self._exchange_shapes, n_ticks)
         for n in self._touched:
             # double-buffer flip: donated windows consumed the inputs;
             # the outputs are the live columns now (layout validated)
@@ -722,7 +898,11 @@ class FusedTickProgram:
         messages_processed (run() counts only source injections eagerly —
         delivery counts live on device until this sync).  ONE 2-element
         device read regardless of how many windows ran since the last
-        verify (the on-device totals accumulator)."""
+        verify (the on-device totals accumulator).  Also folds the
+        accumulated exchange bucket demand into the occupancy
+        estimators — an in-window bucket overflow both fails the window
+        AND grows the cap, so the re-traced window is exact again."""
+        self._fold_xneed()
         if self._totals is None:
             return 0
         totals = np.asarray(self._totals)
