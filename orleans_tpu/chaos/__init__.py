@@ -19,6 +19,7 @@ from orleans_tpu.chaos.invariants import (
     check_arena_conservation,
     check_at_least_once,
     check_dead_letter_accounting,
+    check_durability_accounting,
     check_membership_convergence,
     check_single_activation,
     wait_for_at_least_once,
@@ -45,6 +46,7 @@ __all__ = [
     "check_arena_conservation",
     "check_at_least_once",
     "check_dead_letter_accounting",
+    "check_durability_accounting",
     "check_membership_convergence",
     "check_single_activation",
     "wait_for_at_least_once",

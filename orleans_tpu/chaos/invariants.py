@@ -249,6 +249,114 @@ def check_mesh_single_activation(engine) -> Dict[str, Any]:
     return report
 
 
+def check_durability_accounting(engine,
+                                expected: Optional[Dict[tuple, Dict[str,
+                                                                    Any]]]
+                                = None,
+                                recover_stats: Optional[Dict[str, Any]]
+                                = None,
+                                rto_bound_s: Optional[float] = None
+                                ) -> Dict[str, Any]:
+    """The durable state plane's no-acknowledged-loss ledger
+    (tensor/checkpoint.py):
+
+    1. **Manifest integrity** — every blob the committed manifest
+       references is readable (the blobs-first/manifest-last commit
+       order makes a dangling reference impossible; one appearing means
+       the contract broke), and journal segment sequences per site are
+       strictly increasing with consistent lane totals.
+    2. **Counter algebra** — per site, appended == committed + pending
+       (nothing vanishes between the ring and the sealed segments).
+    3. **Zero acknowledged-write loss** (when ``expected`` is given):
+       for each ``(type_name, key)`` the restored arena state equals
+       the oracle's value for every checked field — the oracle is the
+       scenario's host replay over exactly the ACKNOWLEDGED horizon
+       (``plane.durable_horizon()``), so any committed update missing
+       from the restored state is a violation.
+    4. **Recovery-time objective** (when ``recover_stats`` +
+       ``rto_bound_s`` are given): the recovery's wall seconds are
+       within the bound.
+    """
+    import numpy as np
+
+    plane = engine.checkpointer
+    if not plane.enabled:
+        raise InvariantViolation(
+            "durability accounting checked on an engine without a "
+            "snapshot store (the scenario must attach one)")
+    manifest = plane.store.read_manifest()
+    if manifest is None:
+        raise InvariantViolation("no committed manifest (the scenario "
+                                 "must have committed a recovery point)")
+    blobs_checked = 0
+    rec = manifest.get("recovery") or {}
+    for entry in ([rec.get("full")] if rec.get("full") else []) \
+            + list(rec.get("deltas") or []):
+        for name, ref in entry["arenas"].items():
+            for blob in [ref["meta"]] + list(ref["parts"]):
+                if plane.store.get_blob(blob) is None:
+                    raise InvariantViolation(
+                        f"manifest references missing snapshot blob "
+                        f"{blob!r} (blobs-first commit order broken)")
+                blobs_checked += 1
+    for site_key, j in (manifest.get("journal") or {}).items():
+        seqs = [s["seq"] for s in j["segments"]]
+        if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+            raise InvariantViolation(
+                f"journal site {site_key}: segment seqs not strictly "
+                f"increasing: {seqs}")
+        for s in j["segments"]:
+            got = plane.store.get_blob(s["blob"])
+            if got is None:
+                raise InvariantViolation(
+                    f"manifest references missing journal blob "
+                    f"{s['blob']!r}")
+            _arrays, meta = got
+            if meta.get("lanes") != s["lanes"]:
+                raise InvariantViolation(
+                    f"journal segment {s['blob']!r}: manifest says "
+                    f"{s['lanes']} lanes, blob says {meta.get('lanes')}")
+            blobs_checked += 1
+    for site in plane.journal.sites.values():
+        if site.appended_lanes != site.committed_lanes \
+                + site.segment_lanes:
+            raise InvariantViolation(
+                f"journal site {site.key}: appended "
+                f"{site.appended_lanes} != committed "
+                f"{site.committed_lanes} + pending {site.segment_lanes}")
+    mismatches: Dict[str, Any] = {}
+    checked_keys = 0
+    if expected:
+        for (type_name, key), fields in expected.items():
+            arena = engine.arenas.get(type_name)
+            row = arena.read_row(int(key)) if arena is not None else None
+            if row is None:
+                mismatches[f"{type_name}:{key}"] = "not restored"
+                continue
+            for fname, want in fields.items():
+                got_v = np.asarray(row[fname])
+                if not np.array_equal(got_v, np.asarray(want)):
+                    mismatches[f"{type_name}:{key}.{fname}"] = {
+                        "restored": got_v.tolist(),
+                        "acknowledged": np.asarray(want).tolist()}
+            checked_keys += 1
+        if mismatches:
+            raise InvariantViolation(
+                f"acknowledged-write loss: restored state diverges from "
+                f"the committed-horizon oracle: {mismatches}")
+    rto_s = None
+    if recover_stats is not None:
+        rto_s = float(recover_stats.get("seconds", 0.0))
+        if rto_bound_s is not None and rto_s > rto_bound_s:
+            raise InvariantViolation(
+                f"recovery-time objective missed: recovery took "
+                f"{rto_s:.3f}s > bound {rto_bound_s}s")
+    return {"ok": True, "blobs_checked": blobs_checked,
+            "keys_checked": checked_keys,
+            "recovery_s": rto_s,
+            "horizon": plane.durable_horizon()}
+
+
 def check_exchange_accounting(engine) -> Dict[str, Any]:
     """The exchange's no-silent-loss ledger: after quiescence, every
     bucket-overflow lane must have been re-delivered (parked checks all

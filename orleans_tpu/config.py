@@ -361,6 +361,40 @@ class TensorEngineConfig:
     # max parked optimistic miss-checks before a forced (synchronizing)
     # drain — bounds device memory pinned by deferred delivery checks
     miss_check_cap: int = 16
+    # ---- durable state plane (tensor/checkpoint.py) ----
+    # full-arena columnar checkpoint cadence (ticks; 0 = explicit
+    # only): a consistent cut pinned at a tick boundary as ONE compiled
+    # device copy per arena, then drained device→host in chunks BETWEEN
+    # ticks — live traffic keeps running against the real columns while
+    # the pin streams out (asynchronous-snapshot discipline).  Engaged
+    # only when a SnapshotStore is attached.
+    ckpt_full_every_ticks: int = 0
+    # attribution-driven incremental deltas between fulls (ticks; 0 =
+    # none): only rows whose traffic counts moved since the last
+    # committed cut re-checkpoint — cold rows ride the last full.  A
+    # generation change (rows moved) promotes the next delta to a full.
+    ckpt_delta_every_ticks: int = 0
+    # rows per drain chunk: one d2h gather of every field family per
+    # chunk (bounds both a slice's stall and the gather's compile set)
+    ckpt_chunk_rows: int = 65536
+    # per-tick snapshot-drain pause budget (seconds); <= 0 drains the
+    # whole pinned snapshot in one slice — the synchronous baseline the
+    # durability bench A/Bs against.  Live-reloadable.
+    ckpt_pause_budget_s: float = 0.005
+    # device journal ring capacity per journaled (type, method) site
+    # (lanes, pow2-rounded).  A batch that would overflow the ring
+    # seals the open segment first (counted journal.ring_overflows);
+    # a batch wider than the ring grows it.
+    journal_ring_lanes: int = 65536
+    # journal segment seal cadence (ticks; 0 = seal only at
+    # checkpoints / ring overflow / explicit flush).  Sealing is the
+    # durability acknowledgement point: ring lanes beyond the last
+    # sealed segment are the documented loss window of a hard kill.
+    journal_flush_every_ticks: int = 0
+    # recover from the snapshot store's manifest at silo startup
+    # (runtime/silo.py start: restore arenas + fold-replay the journal
+    # tail BEFORE serving traffic); off = manual recover() only
+    durable_recovery: bool = True
     # periodic arena write-back cadence (ticks; 0 = only explicit
     # checkpoints): bounds the state-loss window when a silo is KILLED
     # (no goodbye, no graceful handoff write-back) to at most this many

@@ -238,6 +238,43 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                     for lk, by_src in gauges.get(
                         "route.exchange_cap", {}).items()},
             },
+            "durability": {
+                # durable state plane (tensor/checkpoint.py): commit
+                # volume is cluster-summed; the age/pending gauges are
+                # per-engine properties, so the WORST silo reports
+                "full_snapshots": int(
+                    _counter_total(merged, "ckpt.full_snapshots")),
+                "delta_snapshots": int(
+                    _counter_total(merged, "ckpt.delta_snapshots")),
+                "rows_written": int(
+                    _counter_total(merged, "ckpt.rows_written")),
+                "bytes_written": int(
+                    _counter_total(merged, "ckpt.bytes_written")),
+                "journal_segments": int(
+                    _counter_total(merged, "journal.segments")),
+                "journal_appended_lanes": int(
+                    _counter_total(merged, "journal.appended_lanes")),
+                "replayed_lanes": int(
+                    _counter_total(merged, "journal.replayed_lanes")),
+                "restored_rows": int(
+                    _counter_total(merged, "ckpt.restored_rows")),
+                # -1 = "no recovery point yet" and is the WORST value
+                # (unbounded loss window): any silo reporting it must
+                # dominate the cluster row, not be masked by a max()
+                "age_ticks": (lambda vs: -1.0 if not vs
+                              or min(vs) < 0 else max(vs))(
+                    [v for by_src in gauges.get("ckpt.age_ticks",
+                                                {}).values()
+                     for v in by_src.values()]),
+                "pending_lanes": max(
+                    (v for by_src in gauges.get("journal.pending_lanes",
+                                                {}).values()
+                     for v in by_src.values()), default=0.0),
+                "max_pause_s": max(
+                    (v for by_src in gauges.get("ckpt.max_pause_s",
+                                                {}).values()
+                     for v in by_src.values()), default=0.0),
+            },
             "latency_ticks": latency,
             "latency_budget_s": budget,
             "seconds_per_tick": round(spt, 6),
@@ -332,6 +369,21 @@ def render_text(view: Dict[str, Any]) -> str:
                 row += " budget " + ("HONORED" if ps["honored"]
                                      else "MISSED")
             lines.append(row)
+    du = c.get("durability", {})
+    if du.get("full_snapshots") or du.get("journal_segments") \
+            or du.get("restored_rows"):
+        lines.append(
+            f"durability: {du['full_snapshots']} full + "
+            f"{du['delta_snapshots']} delta snapshots "
+            f"({du['rows_written']} rows, "
+            f"{du['bytes_written'] / 1e6:.1f}MB), "
+            f"journal {du['journal_segments']} segments / "
+            f"{du['journal_appended_lanes']} lanes "
+            f"(pending {int(du.get('pending_lanes', 0))}), "
+            f"recovery-point age {int(du.get('age_ticks', -1))} ticks"
+            + (f", restored {du['restored_rows']} rows"
+               f" + replayed {du['replayed_lanes']} lanes"
+               if du.get("restored_rows") else ""))
     pl = c.get("pipeline", {})
     if pl.get("overlap_s") or pl.get("inflight") \
             or pl.get("donation_fallbacks"):

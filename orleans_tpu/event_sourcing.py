@@ -12,6 +12,15 @@ memory/file/sqlite/sharded — can back a journal).  The in-memory view is
 rebuilt on activation by replaying the journal through the grain's
 ``apply_event`` (or per-type ``apply_<EventClassName>`` methods), which is
 exactly the reference's StateTransition dynamic dispatch.
+
+This is the HOST-path tier: one storage commit per raised event, right
+for ordinary grains with human-scale event rates.  Vector grains get
+the same contract at batch granularity from the durable state plane
+(``tensor/checkpoint.py``): ``engine.register_journal`` journals a
+(type, method) ingress site's whole per-tick batch in one append,
+seals durable SEGMENTS instead of per-event writes, and fold-replays
+one engine tick per journaled tick on crash recovery
+(``samples/banking.py`` is the worked example).
 """
 
 from __future__ import annotations

@@ -195,6 +195,55 @@ declare("stream.redeliveries", KIND_COUNTER, "rounds",
         "overflow redelivery rounds run for parked publish lanes "
         "(label 'route')")
 
+# -- durable state plane (tensor/checkpoint.py) ------------------------------
+declare("ckpt.full_snapshots", KIND_COUNTER, "snapshots",
+        "full-arena columnar snapshots committed durable (consistent "
+        "cuts pinned at a tick boundary, drained between ticks)")
+declare("ckpt.delta_snapshots", KIND_COUNTER, "snapshots",
+        "attribution-driven incremental deltas committed durable "
+        "(only rows whose traffic counts moved since the last cut)")
+declare("ckpt.rows_written", KIND_COUNTER, "rows",
+        "arena rows written into committed snapshots (full + delta)")
+declare("ckpt.bytes_written", KIND_COUNTER, "bytes",
+        "snapshot blob bytes written to the snapshot store")
+declare("ckpt.restored_rows", KIND_COUNTER, "rows",
+        "arena rows restored by crash recovery")
+declare("ckpt.age_ticks", KIND_GAUGE, "ticks",
+        "ticks since the last COMMITTED recovery point — the live "
+        "loss-window bound a hard kill would pay (-1 = no recovery "
+        "point yet)")
+declare("ckpt.pause_p99_s", KIND_GAUGE, "seconds",
+        "p99 over recent checkpoint-plane per-tick pauses (pin + "
+        "budgeted drain slices + journal seals)")
+declare("ckpt.max_pause_s", KIND_GAUGE, "seconds",
+        "worst checkpoint-plane per-tick pause since engine start")
+declare("ckpt.dirty_rows", KIND_GAUGE, "rows",
+        "rows the last incremental delta selected (attribution-counts "
+        "moved | use clock advanced | key changed since the pin)")
+declare("ckpt.restore_s", KIND_GAUGE, "seconds",
+        "wall seconds of the last crash recovery (snapshot restore + "
+        "journal fold-replay + re-anchor) — the recovery-time gauge "
+        "the RTO bound judges")
+declare("journal.appended_lanes", KIND_COUNTER, "lanes",
+        "message lanes appended to device journal rings at ingress "
+        "(write-ahead; durability lands at segment seal)")
+declare("journal.segments", KIND_COUNTER, "segments",
+        "journal segments sealed durable (blob + manifest committed) "
+        "— the acknowledgement events of the durability contract")
+declare("journal.ring_overflows", KIND_COUNTER, "flushes",
+        "journal appends that crossed the buffered-lane bound and "
+        "forced a mid-tick segment seal (size journal_ring_lanes to "
+        "keep this 0 in steady state)")
+declare("journal.replayed_lanes", KIND_COUNTER, "lanes",
+        "journal lanes fold-replayed by crash recovery (one engine "
+        "tick per journaled tick, never per-event)")
+declare("journal.flush_s", KIND_COUNTER, "seconds",
+        "cumulative host wall time sealing journal segments (the d2h "
+        "ring drain + blob write + manifest commit)")
+declare("journal.pending_lanes", KIND_GAUGE, "lanes",
+        "lanes in open journal rings NOT yet sealed durable — the "
+        "journal half of the loss window a hard kill would pay")
+
 # -- transport links (runtime/transport per-link stats) ----------------------
 for _n, _u, _d in (
         ("frames_sent", "frames", "wire frames sent on this link"),

@@ -97,6 +97,8 @@ FAMILIES: Dict[str, Tuple[str, str, Optional[str]]] = {
     "attribution": ("ATTRIBUTION", "attribution_metrics",
                     "ATTRIBUTION_BENCH.json"),
     "streams": ("STREAMS", "streams_metrics", "STREAMS_BENCH.json"),
+    "durability": ("DURABILITY", "durability_metrics",
+                   "DURABILITY_BENCH.json"),
 }
 
 

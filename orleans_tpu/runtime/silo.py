@@ -297,6 +297,9 @@ class Silo:
                                               profiler=self.config.profiler)
         else:
             self.tensor_engine = None
+        # durable state plane: the last startup recovery's stats (None
+        # until a recovery ran — tensor/checkpoint.py recover())
+        self.last_recovery: Optional[Dict[str, Any]] = None
         # cross-silo vector data plane: clustered silos partition vector
         # batches by ring owner and ship remote partitions as slabs
         # (tensor/router.py; single-activation enforcement)
@@ -346,6 +349,13 @@ class Silo:
             if start is not None:
                 await start()
         if self.tensor_engine is not None:
+            ck = self.tensor_engine.checkpointer
+            if ck.enabled and self.config.tensor.durable_recovery:
+                # durable state plane: rebuild arenas from the latest
+                # committed recovery point + fold-replay the journal
+                # tail BEFORE serving traffic (tensor/checkpoint.py) —
+                # crash recovery is a startup stage, like storage init
+                self.last_recovery = await ck.recover()
             self.tensor_engine.start()
         if self.load_publisher is not None:
             self.load_publisher.start()
@@ -402,6 +412,12 @@ class Silo:
                 # (reference: graceful Shutdown deactivates all grains
                 # through their storage bridge, Silo.cs:642-770)
                 await self.tensor_engine.checkpoint()
+            if self.tensor_engine is not None \
+                    and self.tensor_engine.checkpointer.enabled:
+                # durable state plane: seal the journal + commit a final
+                # full snapshot so the recovery point equals the
+                # terminal state exactly (a graceful stop loses nothing)
+                self.tensor_engine.checkpointer.checkpoint_full()
             if self.membership_oracle is not None:
                 await self.membership_oracle.leave()
         self.catalog.stop_collector()
@@ -798,6 +814,35 @@ class Silo:
                       "dropped_lanes": ss["dropped_lanes"],
                       "redeliveries": ss["redeliveries"]},
                      {"route": f"{src_t}.{src_m}"}, "stream.")
+            ck = eng.checkpointer
+            if ck.enabled:
+                # durable state plane: checkpoint / journal health +
+                # the committed-recovery-point age (the live
+                # loss-window gauge the dashboard's durability row
+                # renders)
+                emit({"full_snapshots": ck.full_snapshots,
+                      "delta_snapshots": ck.delta_snapshots,
+                      "rows_written": ck.rows_written,
+                      "bytes_written": ck.bytes_written,
+                      "restored_rows": ck.restored_rows},
+                     None, "ckpt.")
+                reg.gauge("ckpt.age_ticks").set(float(ck.age_ticks()))
+                reg.gauge("ckpt.pause_p99_s").set(ck.pause_p99_s())
+                reg.gauge("ckpt.max_pause_s").set(ck.max_pause_s)
+                reg.gauge("ckpt.dirty_rows").set(
+                    float(ck.last_dirty_rows))
+                reg.gauge("ckpt.restore_s").set(ck.last_restore_s)
+                js = ck.journal.snapshot()
+                emit({"appended_lanes": sum(
+                          s["appended_lanes"]
+                          for s in js["sites"].values()),
+                      "segments": js["segments_committed"],
+                      "ring_overflows": js["ring_overflows"],
+                      "replayed_lanes": js["replayed_lanes"],
+                      "flush_s": js["flush_seconds"]},
+                     None, "journal.")
+                reg.gauge("journal.pending_lanes").set(
+                    float(js["pending_lanes"]))
             emit({"messages_processed": eng.messages_processed,
                   "ticks": eng.ticks_run,
                   "compiles": eng.compile_count(),

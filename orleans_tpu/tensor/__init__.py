@@ -42,8 +42,18 @@ from orleans_tpu.tensor.persistence import (
     StorageProviderVectorStore,
     VectorStore,
 )
+from orleans_tpu.tensor.checkpoint import (
+    CheckpointPlane,
+    FileSnapshotStore,
+    MemorySnapshotStore,
+    SnapshotStore,
+)
 
 __all__ = [
+    "CheckpointPlane",
+    "FileSnapshotStore",
+    "MemorySnapshotStore",
+    "SnapshotStore",
     "FileVectorStore",
     "MemoryVectorStore",
     "StorageProviderVectorStore",

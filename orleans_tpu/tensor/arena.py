@@ -893,6 +893,163 @@ class GrainArena:
             self._activate_keys(fresh)
         return len(fresh)
 
+    # -- durable state plane (tensor/checkpoint.py) --------------------------
+
+    def export_layout(self) -> Dict[str, Any]:
+        """Host-side identity metadata of a consistent cut: everything a
+        restore needs to reconstruct ROW IDENTITY exactly — the key→row
+        map, free-list high-water marks, generation, eviction epoch and
+        the host use clock (the device clock rides the pinned state
+        tree).  Copies, so the live arena can keep mutating while the
+        snapshot drains."""
+        return {
+            "capacity": int(self.capacity),
+            "n_shards": int(self.n_shards),
+            "shard_capacity": int(self.shard_capacity),
+            "generation": int(self.generation),
+            "eviction_epoch": int(self.eviction_epoch),
+            "live_count": int(self.live_count),
+            "has_wide_keys": bool(self.has_wide_keys),
+            "key_of_row": self._key_of_row.copy(),
+            "last_use_tick": self.last_use_tick.copy(),
+            "shard_next": self._shard_next.copy(),
+        }
+
+    def _rebuild_free_lists(self) -> None:
+        """Free lists from first principles: every sub-high-water slot
+        not holding a key is free.  LIFO ORDER is not reconstructed
+        (it only biases future allocation toward cache-warm slots, it
+        never affects identity) — restored lists are ascending."""
+        self._free = []
+        for s in range(self.n_shards):
+            base = s * self.shard_capacity
+            hw = int(self._shard_next[s])
+            blk = np.arange(base, base + hw, dtype=np.int64)
+            self._free.append(blk[self._key_of_row[blk] < 0])
+
+    def adopt_layout(self, meta: Dict[str, Any], key_of_row: np.ndarray,
+                     last_use_tick: np.ndarray,
+                     shard_next: np.ndarray) -> None:
+        """Restore a FULL snapshot's layout onto this (empty, freshly
+        restarted) arena: exact key→row map, high-water marks, free
+        lists, generation and eviction epoch.  Columns re-initialize to
+        field inits; ``scatter_restore`` then lands the snapshot rows.
+        A mesh-shape mismatch is the caller's to resolve (restore at
+        the recorded layout, then ``reshard`` — identity necessarily
+        changes with the mesh)."""
+        self._settle_owner_chain()
+        if self.live_count:
+            raise RuntimeError(
+                f"arena {self.info.name}: adopt_layout needs an empty "
+                f"arena (restore happens before traffic)")
+        recorded_shards = int(meta["n_shards"])
+        if recorded_shards != self.n_shards:
+            # restore unsharded at the recorded layout; the caller
+            # reshards onto the live mesh after the columns land
+            self.sharding = None
+        self.n_shards = recorded_shards
+        self.shard_capacity = int(meta["shard_capacity"])
+        self.capacity = int(meta["capacity"])
+        self._key_of_row = np.asarray(key_of_row, dtype=np.int64).copy()
+        self._shard_next = np.asarray(shard_next, dtype=np.int64).copy()
+        self.last_use_tick = np.asarray(last_use_tick,
+                                        dtype=np.int64).copy()
+        self._rebuild_free_lists()
+        self.live_count = int((self._key_of_row >= 0).sum())
+        self.generation = int(meta["generation"])
+        self.eviction_epoch = int(meta["eviction_epoch"])
+        self.has_wide_keys = bool(meta.get("has_wide_keys", False))
+        self._init_state_columns(self.capacity)
+        self.last_use_dev = self._dev_zeros_i32(self.capacity)
+        self._dirty = True
+        self._dev_index_stale = True
+        self._dev_dense_stale = True
+        self._dev_wide_stale = True
+        self._dev_sorted_keys = None
+        self._dev_sorted_rows = None
+        self._dev_dense = None
+        self._dev_wide = None
+
+    def adopt_delta(self, meta: Dict[str, Any], rows: np.ndarray,
+                    keys: np.ndarray, live_keys: np.ndarray,
+                    shard_next: np.ndarray,
+                    last_use_tick: Optional[np.ndarray] = None) -> None:
+        """Advance a restored layout by one incremental delta: free keys
+        no longer live at the delta's cut, re-home keys that moved slots
+        (evict + reactivate between checkpoints), place the dirty
+        (row, key) set at its EXACT recorded rows — legal because deltas
+        never span a generation change (row moves promote the next
+        checkpoint to a full).  Freed slots scrub to field inits, the
+        free-list invariant every reuse path assumes."""
+        self._settle_owner_chain()
+        if int(meta["generation"]) != self.generation \
+                or int(meta["capacity"]) != self.capacity:
+            raise RuntimeError(
+                f"arena {self.info.name}: delta layout mismatch "
+                f"(generation {meta['generation']} vs {self.generation})"
+                f" — deltas must not span a row move")
+        rows = np.asarray(rows, dtype=np.int64)
+        keys = np.asarray(keys, dtype=np.int64)
+        # 1. keys dead at the delta's cut leave (no write-back — the
+        #    snapshot IS the storage)
+        cur_live = np.nonzero(self._key_of_row >= 0)[0]
+        dead = cur_live[~np.isin(self._key_of_row[cur_live], live_keys)]
+        # 2. stale slots of keys that MOVED since the base snapshot
+        lookup, found = self.lookup_rows(keys)
+        moved = found & (lookup.astype(np.int64) != rows)
+        stale = lookup[moved].astype(np.int64)
+        freed = np.unique(np.concatenate([dead, stale]))
+        if len(freed):
+            self._key_of_row[freed] = -1
+            self.last_use_tick[freed] = 0
+            idx = jnp.asarray(_pow2_pad(freed, self.capacity))
+            for name, f in self.info.state_fields.items():
+                self.state[name] = self.state[name].at[idx].set(
+                    jnp.full(f.shape, f.init, dtype=f.dtype),
+                    mode="drop")
+            self.last_use_dev = self.last_use_dev.at[idx].set(
+                0, mode="drop")
+        # 3. the dirty set lands at its recorded rows
+        self._key_of_row[rows] = keys
+        if last_use_tick is not None:
+            # the delta meta records the FULL host use clock at its cut
+            # — without it, restored rows would keep the BASE snapshot's
+            # stale clocks and the first idle sweep after recovery could
+            # evict rows that were hot at the crash
+            self.last_use_tick = np.asarray(last_use_tick,
+                                            dtype=np.int64).copy()
+        self._shard_next = np.asarray(shard_next, dtype=np.int64).copy()
+        self._rebuild_free_lists()
+        self.live_count = int((self._key_of_row >= 0).sum())
+        self.eviction_epoch = int(meta["eviction_epoch"])
+        self._dirty = True
+        self._dev_index_stale = True
+        self._dev_dense_stale = True
+        self._dev_wide_stale = True
+
+    def scatter_restore(self, rows: np.ndarray,
+                        columns: Dict[str, np.ndarray],
+                        last_use_dev: np.ndarray) -> None:
+        """Land one snapshot chunk: scatter the gathered columns (and
+        the device use clock) back at their exact rows.  pow2-padded
+        with out-of-range fill so chunk counts reuse O(log n) compiled
+        scatters (the ``_free_rows`` discipline)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        if len(rows) == 0:
+            return
+        idx = jnp.asarray(_pow2_pad(rows, self.capacity))
+        m = len(np.asarray(idx))
+        n = len(rows)
+        for name, f in self.info.state_fields.items():
+            vals = np.zeros((m, *f.shape), dtype=f.dtype)
+            vals[:n] = np.asarray(columns[name], dtype=f.dtype)
+            self.state[name] = self.state[name].at[idx].set(
+                jnp.asarray(vals), mode="drop")
+        dev = np.zeros(m, dtype=np.int32)
+        dev[:n] = np.asarray(last_use_dev, dtype=np.int32)
+        self.last_use_dev = self.last_use_dev.at[idx].set(
+            jnp.asarray(dev), mode="drop")
+
     # -- host access (debug / persistence / host-path interop) --------------
 
     def read_row(self, key: int) -> Optional[Dict[str, np.ndarray]]:

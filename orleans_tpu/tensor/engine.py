@@ -51,6 +51,7 @@ from orleans_tpu.core.grain import MethodInfo
 from orleans_tpu.ids import GrainId
 from orleans_tpu.tensor.arena import GrainArena
 from orleans_tpu.tensor.attribution import WorkloadAttribution
+from orleans_tpu.tensor.checkpoint import CheckpointPlane
 from orleans_tpu.tensor.exchange import exchangeable_args
 from orleans_tpu.tensor.ledger import DeviceLatencyLedger, SlotRegistry
 from orleans_tpu.tensor.memledger import DeviceMemoryLedger
@@ -649,7 +650,8 @@ class TensorEngine:
                  initial_capacity: int = 1024,
                  store: Optional[Any] = None,
                  metrics: Optional[MetricsConfig] = None,
-                 profiler: Optional[ProfilerConfig] = None) -> None:
+                 profiler: Optional[ProfilerConfig] = None,
+                 snapshot_store: Optional[Any] = None) -> None:
         self.silo = silo
         self.config = config or TensorEngineConfig()
         # on-device latency ledger (tensor/ledger.py): per-(type, method)
@@ -745,6 +747,13 @@ class TensorEngine:
         # batches parked by the handoff fence during a tick's rounds;
         # re-queued at tick end so they retry next tick, not next round
         self._fence_deferred: List[Tuple[Tuple[str, str], PendingBatch]] = []
+        # the durable state plane (tensor/checkpoint.py): full-arena
+        # columnar checkpoints pinned at tick boundaries + device
+        # journal + crash recovery.  Engaged by attaching a
+        # SnapshotStore (constructor or checkpointer.attach_store);
+        # _journal_sites is the O(1) ingress-hook predicate.
+        self.checkpointer = CheckpointPlane(self, snapshot_store)
+        self._journal_sites: set = set()
         # cross-silo slab router (tensor/router.py); attached by the silo
         # in cluster mode.  When set, batch entry points partition keys by
         # ring owner and only locally-owned keys ever activate here
@@ -1025,9 +1034,23 @@ class TensorEngine:
                                  keys_host=np.asarray(keys, dtype=np.int64),
                                  future=future, trace=trace,
                                  inject_tick=self.tick_number)
+        if (type_name, method) in self._journal_sites:
+            # durable state plane: journal the ingress BEFORE it can
+            # execute (write-ahead — the device ring append is one
+            # dispatch; durability lands at segment seal)
+            self.checkpointer.journal_ingress(type_name, method, batch)
         self.queues[(type_name, method)].append(batch)
         self._wake_up()
         return future
+
+    def register_journal(self, interface, method: str) -> None:
+        """Mark (interface, method) as a JOURNALED ingress site: every
+        batch entering through send_batch/enqueue/injectors appends to
+        the device journal ring, seals into durable segments, and
+        fold-replays after a crash (tensor/checkpoint.py).  The device
+        tier of event_sourcing.py's JournaledGrain — per-tick batched
+        appends instead of per-event storage commits."""
+        self.checkpointer.register_journal(interface, method)
 
     def register_fanout(self, src_interface, src_method: str, fanout,
                         dst_interface, dst_method: str) -> None:
@@ -1539,6 +1562,11 @@ class TensorEngine:
         t_cp = self.maybe_periodic_checkpoint()
         if t_cp:
             stages["checkpoint"] += t_cp
+        # durable state plane: start/advance a due snapshot drain under
+        # its pause budget + keep the journal segment cadence
+        t_ck = self.checkpointer.on_tick()
+        if t_ck:
+            stages["checkpoint"] += t_ck
         dt = time.perf_counter() - t0
         self._in_tick = False
         for k, v in stages.items():
@@ -2662,6 +2690,9 @@ class TensorEngine:
             "phases": self.profiler.snapshot(),
             "compile_attribution": self.compile_tracker.snapshot(),
             "memory": self.memledger.snapshot(),
+            # durable state plane (tensor/checkpoint.py): checkpoint /
+            # journal health + the committed-recovery-point age
+            "durability": self.checkpointer.snapshot(),
         }
 
 
@@ -2789,11 +2820,16 @@ class BatchInjector:
             self._refresh()
         future = asyncio.get_running_loop().create_future() \
             if want_results else None
-        self.engine.queues[(self.type_name, self.method)].append(
-            PendingBatch(args=args, rows=self.rows, future=future,
-                         keys_host=self.keys, keys_dev=self._keys_dev,
-                         generation=self.generation, epoch=self.epoch,
-                         inject_tick=self.engine.tick_number))
+        batch = PendingBatch(args=args, rows=self.rows, future=future,
+                             keys_host=self.keys, keys_dev=self._keys_dev,
+                             generation=self.generation, epoch=self.epoch,
+                             inject_tick=self.engine.tick_number)
+        if (self.type_name, self.method) in self.engine._journal_sites:
+            # journaled ingress (tensor/checkpoint.py): write-ahead ring
+            # append before the batch can execute
+            self.engine.checkpointer.journal_ingress(
+                self.type_name, self.method, batch)
+        self.engine.queues[(self.type_name, self.method)].append(batch)
         self.engine._wake_up()
         return future
 

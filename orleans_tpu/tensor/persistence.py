@@ -27,6 +27,41 @@ import numpy as np
 from orleans_tpu.ids import GrainId, type_code_of
 
 
+def fsync_write(path: str, writer, binary: bool = True) -> None:
+    """Crash-safe file replace: write to a same-directory temp file,
+    fsync the DATA, atomically rename over the destination, fsync the
+    DIRECTORY.  A kill (or power loss) at any byte offset leaves either
+    the old file or the new one — never a torn final path.  ``writer``
+    receives the open temp file object.  Shared by every durable write
+    in the storage plane (FileVectorStore records, FileSnapshotStore
+    blobs, manifest commits)."""
+    d = os.path.dirname(path) or "."
+    base = os.path.basename(path)
+    tmp = os.path.join(d, f".{base}.tmp")
+    try:
+        with open(tmp, "wb" if binary else "w") as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    # the rename itself must be durable: fsync the containing directory
+    # (no-op on platforms without O_DIRECTORY semantics)
+    try:
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
 class VectorStore:
     """Bulk per-row storage contract for vector-grain arenas.
 
@@ -112,7 +147,15 @@ class MemoryVectorStore(VectorStore):
 
 class FileVectorStore(VectorStore):
     """One ``.npz`` per row under ``root/<type>/<key>.npz`` — the simple
-    durable backend (checkpoints survive the process)."""
+    durable backend (checkpoints survive the process).
+
+    Crash safety: every record write rides ``fsync_write`` — temp file
+    in the same directory, data fsync, atomic rename, directory fsync —
+    so a kill mid-write (the chaos storage seam's scenario) leaves the
+    previous record intact and never a torn final path.  The old
+    formulation renamed without any fsync: after an OS crash the rename
+    could land while the data blocks had not, reading back as a
+    truncated npz."""
 
     def __init__(self, root: str) -> None:
         self.root = root
@@ -135,16 +178,18 @@ class FileVectorStore(VectorStore):
     def write_many(self, type_name, keys, rows):
         d = self._dir(type_name)
         for k, row in zip(keys, rows):
-            tmp = os.path.join(d, f".{int(k)}.tmp.npz")  # savez appends .npz
-            np.savez(tmp, **{n: np.asarray(v) for n, v in row.items()})
-            os.replace(tmp, os.path.join(d, f"{int(k)}.npz"))
+            fsync_write(
+                os.path.join(d, f"{int(k)}.npz"),
+                lambda f, row=row: np.savez(
+                    f, **{n: np.asarray(v) for n, v in row.items()}))
 
     def write_many_columnar(self, type_name, keys, columns):
         d = self._dir(type_name)
         for i, k in enumerate(keys):
-            tmp = os.path.join(d, f".{int(k)}.tmp.npz")
-            np.savez(tmp, **{n: c[i] for n, c in columns.items()})
-            os.replace(tmp, os.path.join(d, f"{int(k)}.npz"))
+            fsync_write(
+                os.path.join(d, f"{int(k)}.npz"),
+                lambda f, i=i: np.savez(
+                    f, **{n: c[i] for n, c in columns.items()}))
 
     def delete_many(self, type_name, keys):
         d = self._dir(type_name)

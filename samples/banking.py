@@ -1,0 +1,193 @@
+"""Banking sample — the durable-state workload riding the device journal.
+
+The scenario class the durable state plane opens (ROADMAP item 5:
+banking / inventory / game state — anything where a crash must not lose
+acknowledged writes): every account is a vector-grain row holding an
+INTEGER balance, deposits and transfers arrive as batched commands, and
+the ingress sites are JOURNALED (``engine.register_journal``) — each
+tick's command batch appends to the device journal ring in one op, seals
+into durable segments, and fold-replays after a crash.  Integer folds
+are exactly associative, so restored state is BIT-exact against the
+host oracle at the acknowledged horizon — the property the durability
+bench and chaos tier assert.
+
+Transfers exercise the interesting recovery path: the debit executes at
+the ingress site and the credit is an EMIT to the destination account —
+on replay the handler re-emits, so the downstream leg is reconstructed
+by re-execution, never separately journaled (the event-sourcing shape:
+journal the commands, fold the effects).
+
+Parity thread: the host path's ``event_sourcing.py`` JournaledGrain
+(reference: OrleansEventSourcing, JournaledGrain.cs:34) commits one
+storage write per raised event; this is the same contract — state is a
+fold over a durable event log — at per-tick batch granularity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.core.grain import batched_method
+from orleans_tpu.tensor import (
+    Batch,
+    Emit,
+    VectorGrain,
+    field,
+    seg_sum,
+    vector_grain,
+)
+
+
+@vector_grain
+class AccountGrain(VectorGrain):
+    """One bank account per row — integer state only (bit-exactness is
+    the durability contract's currency)."""
+
+    balance = field(jnp.int32, 0)
+    credits = field(jnp.int32, 0)     # deposits + received transfers
+    debits = field(jnp.int32, 0)      # sent transfers
+
+    @batched_method
+    @staticmethod
+    def deposit(state, batch: Batch, n_rows: int):
+        rows, args = batch.rows, batch.args
+        live = (rows >= 0).astype(jnp.int32)
+        return {
+            **state,
+            "balance": state["balance"]
+            + seg_sum(args["amount"], rows, n_rows),
+            "credits": state["credits"] + seg_sum(live, rows, n_rows),
+        }, None, ()
+
+    @batched_method
+    @staticmethod
+    def transfer(state, batch: Batch, n_rows: int):
+        """Debit the source row, credit the destination via an emit —
+        the two-leg command whose second leg recovery reconstructs by
+        re-execution."""
+        rows, args = batch.rows, batch.args
+        live = (rows >= 0).astype(jnp.int32)
+        state = {
+            **state,
+            "balance": state["balance"]
+            - seg_sum(args["amount"], rows, n_rows),
+            "debits": state["debits"] + seg_sum(live, rows, n_rows),
+        }
+        emit = Emit(interface="AccountGrain", method="credit",
+                    keys=args["dst"],
+                    args={"amount": args["amount"]},
+                    mask=batch.mask)
+        return state, None, (emit,)
+
+    @batched_method
+    @staticmethod
+    def credit(state, batch: Batch, n_rows: int):
+        rows, args = batch.rows, batch.args
+        live = (rows >= 0).astype(jnp.int32)
+        return {
+            **state,
+            "balance": state["balance"]
+            + seg_sum(args["amount"], rows, n_rows),
+            "credits": state["credits"] + seg_sum(live, rows, n_rows),
+        }, None, ()
+
+
+class BankOracle:
+    """Host replay oracle: numpy fold of the SAME commands, applied in
+    the same per-tick grouping.  ``expect()`` renders the per-key state
+    the restored arena must equal bit-for-bit at any command prefix."""
+
+    def __init__(self, n_accounts: int) -> None:
+        self.n = n_accounts
+        self.balance = np.zeros(n_accounts, dtype=np.int64)
+        self.credits = np.zeros(n_accounts, dtype=np.int64)
+        self.debits = np.zeros(n_accounts, dtype=np.int64)
+
+    def apply(self, event: Dict) -> None:
+        keys = event["keys"]
+        if event["method"] == "deposit":
+            np.add.at(self.balance, keys, event["amount"])
+            np.add.at(self.credits, keys, 1)
+        elif event["method"] == "transfer":
+            np.add.at(self.balance, keys, -event["amount"])
+            np.add.at(self.debits, keys, 1)
+            np.add.at(self.balance, event["dst"], event["amount"])
+            np.add.at(self.credits, event["dst"], 1)
+        else:
+            raise ValueError(event["method"])
+
+    def expect(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
+        k = np.asarray(keys)
+        return {"balance": self.balance[k].astype(np.int32),
+                "credits": self.credits[k].astype(np.int32),
+                "debits": self.debits[k].astype(np.int32)}
+
+    def total(self) -> int:
+        """Conservation invariant: transfers move, deposits mint — the
+        cluster-wide balance equals total deposited."""
+        return int(self.balance.sum())
+
+
+def make_events(n_accounts: int, n_ticks: int, lanes: int,
+                seed: int = 0, transfer_every: int = 3
+                ) -> List[Dict]:
+    """Deterministic command stream: one batch per tick, every
+    ``transfer_every``-th a transfer batch, the rest deposits."""
+    rng = np.random.default_rng(seed)
+    events = []
+    for t in range(n_ticks):
+        keys = rng.integers(0, n_accounts, lanes).astype(np.int64)
+        amount = rng.integers(1, 50, lanes).astype(np.int32)
+        if transfer_every > 0 and t % transfer_every == transfer_every - 1:
+            events.append({"method": "transfer", "keys": keys,
+                           "amount": amount,
+                           "dst": rng.integers(0, n_accounts, lanes)
+                           .astype(np.int32)})
+        else:
+            events.append({"method": "deposit", "keys": keys,
+                           "amount": amount})
+    return events
+
+
+def register_banking_journal(engine) -> None:
+    """Journal the two INGRESS sites.  ``credit`` is deliberately not
+    journaled — it is reachable only as a transfer's emit, and replay
+    reconstructs it by re-executing the transfer."""
+    engine.register_journal("AccountGrain", "deposit")
+    engine.register_journal("AccountGrain", "transfer")
+
+
+async def run_banking_load(engine, events: List[Dict],
+                           oracle: Optional[BankOracle] = None,
+                           ticks_per_event: int = 1) -> Dict:
+    """Drive the command stream, one batch per tick (the journal's
+    per-tick grouping contract), folding the oracle in step."""
+    import time
+    t0 = time.perf_counter()
+    for ev in events:
+        args = {"amount": ev["amount"]}
+        if ev["method"] == "transfer":
+            args["dst"] = ev["dst"]
+        engine.send_batch("AccountGrain", ev["method"], ev["keys"], args)
+        for _ in range(ticks_per_event):
+            engine.run_tick()
+        if oracle is not None:
+            oracle.apply(ev)
+    await engine.flush()
+    return {"events": len(events),
+            "lanes": int(sum(len(e["keys"]) for e in events)),
+            "seconds": time.perf_counter() - t0}
+
+
+def read_accounts(engine, keys: np.ndarray) -> Dict[str, np.ndarray]:
+    """Per-key state gathered from the arena (host view)."""
+    arena = engine.arena_for("AccountGrain")
+    rows, found = arena.lookup_rows(np.asarray(keys, dtype=np.int64))
+    assert found.all(), "unactivated account probed"
+    out = {}
+    for name in ("balance", "credits", "debits"):
+        out[name] = np.asarray(arena.state[name])[rows]
+    return out
