@@ -21,13 +21,14 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from orleans_tpu import codec as codec_mod
 from orleans_tpu import spans as _spans
 from orleans_tpu.core import context as ctx
 from orleans_tpu.core.factory import GrainFactory
 from orleans_tpu.core.grain import InterfaceInfo, MethodInfo, get_interface
 from orleans_tpu.core.reference import GrainReference, bind_runtime
-from orleans_tpu.codec import default_manager as codec
-from orleans_tpu.ids import GrainId
+from orleans_tpu.codec import RpcFrame, default_manager as codec
+from orleans_tpu.ids import GrainCategory, GrainId
 from orleans_tpu.runtime.messaging import (
     Category,
     Direction,
@@ -39,7 +40,9 @@ from orleans_tpu.runtime.gateway import (
     _rebase_expiration_inbound,
     _with_ttl,
     read_gateway_frame,
+    read_gateway_frame_any,
     write_gateway_frame,
+    write_gateway_rpc_frame,
 )
 from orleans_tpu.runtime.runtime_client import (
     CallbackData,
@@ -59,9 +62,16 @@ class GrainClient:
                  retry_budget_capacity: float = 32.0,
                  retry_budget_fill: float = 0.1,
                  trace_enabled: bool = True,
-                 trace_sample_rate: float = 0.01) -> None:
+                 trace_sample_rate: float = 0.01,
+                 rpc_fastpath: bool = True) -> None:
         from orleans_tpu.resilience import BackoffPolicy, RetryBudget
         self.client_id = GrainId.client(uuid.uuid4())
+        # batched RPC fastpath over TCP gateways (runtime/rpc.py): one
+        # coalesced calls-frame per event-loop iteration per
+        # (type, method); sampled traces / ambient request contexts /
+        # non-int-keyed grains keep the per-message frames
+        self.rpc_fastpath = rpc_fastpath
+        self._pending_trace = None
         self.response_timeout = response_timeout
         # gateway control-frame reply wait (hoisted from the old
         # hard-coded 10.0 so tests/chaos plans can tighten it)
@@ -111,7 +121,8 @@ class GrainClient:
             retry_budget_capacity=config.retry_budget_capacity,
             retry_budget_fill=config.retry_budget_fill,
             trace_enabled=config.trace_enabled,
-            trace_sample_rate=config.trace_sample_rate)
+            trace_sample_rate=config.trace_sample_rate,
+            rpc_fastpath=config.rpc_fastpath)
 
     # ================= connection =========================================
 
@@ -185,10 +196,22 @@ class GrainClient:
                      ) -> Optional[asyncio.Future]:
         timeout = timeout if timeout is not None else self.response_timeout
         self.retry_budget.on_request()
-        # trace ingress: ambient (a test/driver that set one) or freshly
-        # minted + head-sampled; the send span's id rides the exported
-        # context so the gateway/silo hops parent under it
-        trace = self.spans.ingress()
+        gateway = self._next_gateway()
+        # batched RPC fastpath: eligible calls coalesce into ONE
+        # calls-frame per loop iteration on this gateway socket instead
+        # of one Message frame each (runtime/rpc.py; the gateway feeds
+        # them to the silo coalescer as key/args columns)
+        if self._rpc_eligible(gateway, target_grain, method):
+            return gateway.submit_rpc(
+                iface, method, target_grain.n1,
+                tuple(codec.deep_copy(a) for a in args), timeout)
+        # trace ingress: ambient (a test/driver that set one), a
+        # decision stashed by the eligibility probe, or freshly minted +
+        # head-sampled; the send span's id rides the exported context
+        # so the gateway/silo hops parent under it
+        trace, self._pending_trace = (
+            (self._pending_trace, None) if self._pending_trace is not None
+            else (self.spans.ingress(), None))
         span = None
         if trace is not None and trace.get("sampled"):
             span = self.spans.start(f"send {method.name}", "client.send",
@@ -211,7 +234,6 @@ class GrainClient:
             request_context=request_context,
             expiration=time.monotonic() + timeout,
         )
-        gateway = self._next_gateway()
         if method.one_way:
             gateway.submit(msg)
             self.spans.finish(span, one_way=True)
@@ -223,6 +245,32 @@ class GrainClient:
         self.callbacks[msg.id] = cb
         gateway.submit(msg)
         return future
+
+    def _rpc_eligible(self, gateway, target_grain: GrainId,
+                      method: MethodInfo) -> bool:
+        """Admission check for the client-side batched fastpath: the
+        gateway handle must speak rpc frames (TCP), the method must be
+        a plain host call, the key must fit the int64 column, and the
+        call must carry no ambient context and no sampled trace (those
+        keep the full per-message fidelity)."""
+        if not self.rpc_fastpath or method.batched:
+            return False
+        if not hasattr(gateway, "submit_rpc"):
+            return False  # in-process Gateway handle: per-message edge
+        if (target_grain.key_ext is not None or target_grain.n0 != 0
+                or target_grain.category != GrainCategory.GRAIN):
+            return False
+        if ctx._request_context.get() is not None:
+            return False
+        rec = self.spans
+        if rec.enabled:
+            trace = rec.ingress()
+            if trace is not None and trace.get("sampled"):
+                # reuse the minted head-sampling decision on the
+                # per-message path (a second draw would square the rate)
+                self._pending_trace = trace
+                return False
+        return True
 
     def _on_timeout(self, message_id: int) -> None:
         cb = self.callbacks.pop(message_id, None)
@@ -390,6 +438,95 @@ class GrainClient:
             await gateway.disconnect_client(ref.grain_id)
 
 
+#: exact scalar types a whole window may share one encoded args blob
+#: for (type() identity, NOT isinstance: bool-vs-int and 1-vs-1.0 must
+#: never collapse — and an ndarray arg must never reach a tuple ==,
+#: whose elementwise result would raise out of the flush callback)
+_RPC_COMMONABLE = frozenset((str, int, float, bool, bytes, type(None)))
+
+
+def _rpc_common_args(entries) -> Optional[tuple]:
+    """The one args tuple every pending call shares, or None.  Exact:
+    same arity, same VALUE and same TYPE per position, scalars only."""
+    first = entries[0][1]
+    if not all(type(a) in _RPC_COMMONABLE for a in first):
+        return None
+    for e in entries[1:]:
+        args = e[1]
+        if len(args) != len(first):
+            return None
+        for a, b in zip(args, first):
+            if type(a) is not type(b) or a != b:
+                return None
+    return first
+
+
+class _RpcBatch:
+    """One in-flight batched-RPC window on a gateway socket: the
+    positional futures its results frame resolves, plus ONE deadline
+    watchdog for the whole window (re-armed, never a timer per call)."""
+
+    __slots__ = ("handle", "batch_id", "futures", "deadlines",
+                 "_loop", "_timer", "_done")
+
+    def __init__(self, handle: "TcpGatewayHandle", batch_id: int,
+                 futures: list, deadlines: list, loop) -> None:
+        self.handle = handle
+        self.batch_id = batch_id
+        self.futures = futures
+        self.deadlines = deadlines
+        self._loop = loop
+        self._timer = None
+        self._done = False
+        self._arm()
+
+    def _arm(self) -> None:
+        if self._done:
+            return
+        pending = [d for f, d in zip(self.futures, self.deadlines)
+                   if not f.done()]
+        if not pending:
+            return
+        self._timer = self._loop.call_later(
+            max(0.0, min(pending) - time.monotonic()), self._fire)
+
+    def _fire(self) -> None:
+        now = time.monotonic()
+        for fut, deadline in zip(self.futures, self.deadlines):
+            if not fut.done() and now >= deadline:
+                fut.set_exception(RequestTimeoutError(
+                    f"batched rpc call timed out after its TTL "
+                    f"(gateway {self.handle.host}:{self.handle.port})"))
+        self._arm()
+
+    def _finish(self) -> None:
+        self._done = True
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def resolve(self, frame) -> None:
+        self._finish()
+        statuses = frame.statuses
+        common = frame.values is None
+        for i, fut in enumerate(self.futures):
+            if fut.done():
+                continue  # watchdog beat the frame
+            value = frame.common_value if common else frame.values[i]
+            if int(statuses[i]) == codec_mod.RPC_STATUS_OK:
+                fut.set_result(value)
+            else:
+                exc = value if isinstance(value, BaseException) \
+                    else RuntimeError(repr(value))
+                fut.set_exception(exc)
+
+    def fail(self, exc: Exception) -> None:
+        self._finish()
+        for fut in self.futures:
+            if not fut.done():
+                fut.set_exception(exc)
+
+
 class TcpGatewayHandle:
     """Client side of one gateway socket (reference:
     GatewayConnection + the proxied handshake,
@@ -415,6 +552,14 @@ class TcpGatewayHandle:
         # vector batch_id → result future (out-of-order safe)
         self._batch_waiters: Dict[int, asyncio.Future] = {}
         self._next_batch_id = 0
+        # batched RPC fastpath state: (iface, method) → negotiated
+        # rpc_id; rpc_id → pending calls this loop iteration; batch_id →
+        # in-flight window awaiting its results frame
+        self._rpc_ids: Dict[tuple, int] = {}
+        self._next_rpc_id = 0
+        self._rpc_pending: Dict[int, list] = {}
+        self._rpc_flush_scheduled = False
+        self._rpc_batches: Dict[int, _RpcBatch] = {}
 
     @classmethod
     async def open(cls, host: str, port: int, client_id: GrainId,
@@ -442,9 +587,13 @@ class TcpGatewayHandle:
         """(reference: OutsideRuntimeClient.RunClientMessagePump :315)"""
         try:
             while True:
-                frame = await read_gateway_frame(self._reader)
+                frame = await read_gateway_frame_any(self._reader)
                 if isinstance(frame, Message):
                     self._on_message(_rebase_expiration_inbound(frame))
+                elif isinstance(frame, RpcFrame):
+                    batch = self._rpc_batches.pop(frame.batch_id, None)
+                    if batch is not None:
+                        batch.resolve(frame)
                 elif isinstance(frame, dict) \
                         and frame.get("op") == "batch_result":
                     waiter = self._batch_waiters.pop(frame["batch_id"],
@@ -482,11 +631,105 @@ class TcpGatewayHandle:
                 if not waiter.done():
                     waiter.set_exception(ConnectionError(
                         f"gateway {self.host}:{self.port} disconnected"))
+            # and the batched-rpc windows: unflushed pending calls plus
+            # every in-flight window awaiting its results frame
+            self._fail_rpc_state(ConnectionError(
+                f"gateway {self.host}:{self.port} disconnected"))
 
     def submit(self, msg: Message) -> None:
         if not self.alive:
             raise ConnectionError(f"gateway {self.host}:{self.port} is down")
         write_gateway_frame(self._writer, _with_ttl(msg))
+
+    # -- batched RPC fastpath ----------------------------------------------
+
+    def submit_rpc(self, iface: InterfaceInfo, minfo: MethodInfo,
+                   key: int, args: tuple,
+                   timeout: float) -> Optional[asyncio.Future]:
+        """Queue one call onto this socket's pending window; everything
+        submitted in the same event-loop iteration flushes as ONE
+        calls-frame per (type, method) — asyncio.gather bursts coalesce
+        whole.  First sight of a (type, method) announces its
+        dictionary id ({"op": "rpc_bind"}) on the same ordered stream."""
+        if not self.alive:
+            raise ConnectionError(f"gateway {self.host}:{self.port} is down")
+        dict_key = (iface.name, minfo.name)
+        rpc_id = self._rpc_ids.get(dict_key)
+        if rpc_id is None:
+            self._next_rpc_id += 1
+            rpc_id = self._next_rpc_id
+            self._rpc_ids[dict_key] = rpc_id
+            write_gateway_frame(self._writer, {
+                "op": "rpc_bind", "rpc_id": rpc_id,
+                "iface": iface.name, "method": minfo.name})
+        future = None
+        if not minfo.one_way:
+            future = asyncio.get_running_loop().create_future()
+        self._rpc_pending.setdefault(rpc_id, []).append(
+            (key, args, future, time.monotonic() + timeout))
+        if not self._rpc_flush_scheduled:
+            self._rpc_flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush_rpc)
+        return future
+
+    def _flush_rpc(self) -> None:
+        import numpy as np
+
+        self._rpc_flush_scheduled = False
+        pending, self._rpc_pending = self._rpc_pending, {}
+        if not pending:
+            return
+        if self._writer is None or self._writer.is_closing():
+            exc = ConnectionError(
+                f"gateway {self.host}:{self.port} is down")
+            for entries in pending.values():
+                for _, _, fut, _ in entries:
+                    if fut is not None and not fut.done():
+                        fut.set_exception(exc)
+            return
+        now = time.monotonic()
+        loop = asyncio.get_running_loop()
+        for rpc_id, entries in pending.items():
+            n = len(entries)
+            keys = np.fromiter((e[0] for e in entries),
+                               dtype=np.uint64, count=n)
+            # REMAINING TTL per call — negative stays negative so a
+            # caller-expired call still dead-letters at the silo
+            ttls = np.fromiter((e[3] - now for e in entries),
+                               dtype=np.float64, count=n)
+            args_list: Optional[list] = [e[1] for e in entries]
+            common = _rpc_common_args(entries)
+            if common is not None:
+                args_list = None
+            one_way = entries[0][2] is None
+            batch_id = 0
+            if not one_way:
+                self._next_batch_id += 1
+                batch_id = self._next_batch_id
+                self._rpc_batches[batch_id] = _RpcBatch(
+                    self, batch_id, [e[2] for e in entries],
+                    [e[3] for e in entries], loop)
+            try:
+                segments = codec_mod.encode_rpc_calls(
+                    codec, rpc_id, batch_id, keys, ttls, args_list,
+                    common_args=common, one_way=one_way)
+                write_gateway_rpc_frame(self._writer, segments)
+            except Exception as exc:  # noqa: BLE001 — an unencodable
+                # window must fail ITS callers, not hang their futures
+                # behind an "Exception in callback" log
+                batch = self._rpc_batches.pop(batch_id, None)
+                if batch is not None:
+                    batch.fail(exc)
+
+    def _fail_rpc_state(self, exc: Exception) -> None:
+        pending, self._rpc_pending = self._rpc_pending, {}
+        for entries in pending.values():
+            for _, _, fut, _ in entries:
+                if fut is not None and not fut.done():
+                    fut.set_exception(exc)
+        batches, self._rpc_batches = self._rpc_batches, {}
+        for batch in batches.values():
+            batch.fail(exc)
 
     def send_client_batch(self, type_name: str, method: str, keys, args,
                           want_results: bool = False

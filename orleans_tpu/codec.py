@@ -119,6 +119,9 @@ class Writer:
     def f64(self, v: float) -> None:
         self._buf.write(struct.pack("<d", v))
 
+    def u8(self, v: int) -> None:
+        self._buf.write(bytes((v & 0xFF,)))
+
     def u64(self, v: int) -> None:
         self._buf.write(struct.pack("<Q", v & 0xFFFFFFFFFFFFFFFF))
 
@@ -165,6 +168,11 @@ class Reader:
     def f64(self) -> float:
         v = struct.unpack_from("<d", self._data, self._pos)[0]
         self._pos += 8
+        return v
+
+    def u8(self) -> int:
+        v = self._data[self._pos]
+        self._pos += 1
         return v
 
     def u64(self) -> int:
@@ -788,6 +796,384 @@ def decode_slab_frame(manager: SerializationManager,
 
 
 default_manager.register(SlabLeafRef, name="orleans.SlabLeafRef")
+
+
+# ======================= host RPC fast-path wire format =====================
+#
+# The control-plane analog of the slab format above: ONE gateway frame
+# carries a whole window of RPC calls to a negotiated (type, method)
+# dictionary id.  The fixed header is struct-packed (no token-stream walk),
+# int keys and per-call TTLs travel as raw little-endian columns the
+# receiver views with np.frombuffer, and ndarray args/results ride as
+# length-delimited raw segments exactly like slab leaves — steady-state
+# calls do NO per-field Python marshalling on either side.  Values the
+# fast tags can't express fall back to the general token-stream codec
+# INSIDE the frame (tag _RPC_GENERAL) so the frame as a whole never
+# degrades; the property test in tests/test_rpc.py pins roundtrip
+# equivalence between the two encodings.
+
+RPC_WIRE_VERSION = 1
+RPC_KIND_CALLS = 0
+RPC_KIND_RESULTS = 1
+
+#: per-call result statuses in a results frame
+RPC_STATUS_OK = 0
+RPC_STATUS_ERROR = 1
+RPC_STATUS_EXPIRED = 2
+
+# value tags (the fixed fast path; _RPC_GENERAL embeds the full codec)
+_RPC_NONE = 0
+_RPC_TRUE = 1
+_RPC_FALSE = 2
+_RPC_INT = 3
+_RPC_FLOAT = 4
+_RPC_STR = 5
+_RPC_BYTES = 6
+_RPC_NDARRAY = 7      # varint index into the frame's raw segments
+_RPC_GENERAL = 8      # length-prefixed general-codec bytes (fallback)
+
+_RPC_FLAG_COMMON = 1  # one args/value blob shared by every call
+_RPC_FLAG_TTL = 2     # per-call remaining-TTL f64 column present
+_RPC_FLAG_ONE_WAY = 4
+
+
+def _rpc_write_value(manager: SerializationManager, w: Writer,
+                     arrays: list, v: Any) -> bool:
+    """Append one value to the stream; ndarrays go to ``arrays`` (raw
+    segments).  Returns True when the value needed the general-codec
+    fallback tag (the ``rpc.fastpath_fallbacks``-adjacent signal the
+    gateway counts)."""
+    if v is None:
+        w.u8(_RPC_NONE)
+        return False
+    if v is True:
+        w.u8(_RPC_TRUE)
+        return False
+    if v is False:
+        w.u8(_RPC_FALSE)
+        return False
+    t = type(v)
+    if t is int:
+        w.u8(_RPC_INT)
+        w.varint(v)
+        return False
+    if t is float:
+        w.u8(_RPC_FLOAT)
+        w.f64(v)
+        return False
+    if t is str:
+        w.u8(_RPC_STR)
+        w.string(v)
+        return False
+    if t is bytes:
+        w.u8(_RPC_BYTES)
+        w.raw(v)
+        return False
+    if isinstance(v, np.ndarray) and not v.dtype.hasobject:
+        w.u8(_RPC_NDARRAY)
+        w.varint(len(arrays))
+        # the ORIGINAL array goes in: the manifest must record its true
+        # shape (ascontiguousarray would promote 0-d to 1-d — the slab
+        # encoder's lesson); contiguity is handled at segment build
+        arrays.append(v)
+        return False
+    w.u8(_RPC_GENERAL)
+    w.raw(manager.serialize(v))
+    return True
+
+
+def _rpc_read_value(manager: SerializationManager, r: Reader) -> Any:
+    """Read one value; ndarray references come back as
+    :class:`_RpcArrayRef` placeholders (the manifest — and therefore
+    the segment views — trails the value region), resolved by the
+    frame decoder once the raw segments are mapped."""
+    tag = r.u8()
+    if tag == _RPC_NONE:
+        return None
+    if tag == _RPC_TRUE:
+        return True
+    if tag == _RPC_FALSE:
+        return False
+    if tag == _RPC_INT:
+        return r.varint()
+    if tag == _RPC_FLOAT:
+        return r.f64()
+    if tag == _RPC_STR:
+        return r.string()
+    if tag == _RPC_BYTES:
+        return bytes(r.raw())
+    if tag == _RPC_NDARRAY:
+        return _RpcArrayRef(r.varint())
+    if tag == _RPC_GENERAL:
+        return manager.deserialize(bytes(r.raw()))
+    raise SerializationError(f"unknown rpc value tag {tag}")
+
+
+def _rpc_write_values(manager: SerializationManager, w: Writer,
+                      arrays: list, values: Tuple[Any, ...]) -> int:
+    w.varint(len(values))
+    fallbacks = 0
+    for v in values:
+        if _rpc_write_value(manager, w, arrays, v):
+            fallbacks += 1
+    return fallbacks
+
+
+def _rpc_read_values(manager: SerializationManager,
+                     r: Reader) -> Tuple[Any, ...]:
+    n = r.varint()
+    return tuple(_rpc_read_value(manager, r) for _ in range(n))
+
+
+def _rpc_manifest_and_segments(w: Writer, arrays: list) -> list:
+    """Close a frame header: write the array manifest, return the full
+    segment list (header first, raw buffers appended verbatim)."""
+    w.varint(len(arrays))
+    segments: list = []
+    for a in arrays:
+        # manifest from the ORIGINAL shape; contiguity fixed after
+        # (ascontiguousarray promotes 0-d to 1-d — slab-codec lesson)
+        w.string(str(a.dtype))
+        w.varint(a.ndim)
+        for d in a.shape:
+            w.varint(d)
+        if not a.flags.c_contiguous:
+            a = np.ascontiguousarray(a)
+        segments.append(_raw_view(a))
+    return [w.getvalue()] + segments
+
+
+def encode_rpc_calls(manager: SerializationManager, rpc_id: int,
+                     batch_id: int, keys: np.ndarray,
+                     ttls: Optional[np.ndarray],
+                     args_list: Optional[list],
+                     common_args: Optional[Tuple[Any, ...]] = None,
+                     one_way: bool = False) -> list:
+    """Encode one calls frame as bytes-like segments.
+
+    ``keys`` is the uint64 grain-key column; ``ttls`` (optional) the
+    per-call REMAINING-TTL f64 column (the receiver rebases each on its
+    own clock — per call, never per frame); args are either one
+    ``common_args`` tuple every call shares or an ``args_list`` of
+    per-call tuples.  ``batch_id`` 0 means no results frame is wanted
+    (one-way window)."""
+    keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+    n = int(keys.shape[0])
+    flags = 0
+    if common_args is not None:
+        flags |= _RPC_FLAG_COMMON
+    if ttls is not None:
+        flags |= _RPC_FLAG_TTL
+    if one_way:
+        flags |= _RPC_FLAG_ONE_WAY
+    w = Writer()
+    w.varint(RPC_WIRE_VERSION)
+    w.u8(RPC_KIND_CALLS)
+    w.varint(rpc_id)
+    w.varint(batch_id)
+    w.varint(n)
+    w.u8(flags)
+    arrays: list = [keys]
+    if ttls is not None:
+        ttl_col = np.ascontiguousarray(np.asarray(ttls, dtype=np.float64))
+        if ttl_col.shape[0] != n:
+            raise SerializationError("rpc calls frame: ttl column length "
+                                     f"{ttl_col.shape[0]} != {n} calls")
+        arrays.append(ttl_col)
+    if common_args is not None:
+        _rpc_write_values(manager, w, arrays, common_args)
+    else:
+        if args_list is None or len(args_list) != n:
+            raise SerializationError(
+                "rpc calls frame: args_list must carry one tuple per call")
+        for args in args_list:
+            _rpc_write_values(manager, w, arrays, args)
+    return _rpc_manifest_and_segments(w, arrays)
+
+
+def encode_rpc_results(manager: SerializationManager, batch_id: int,
+                       statuses: np.ndarray, values: Optional[list],
+                       common_value: Any = None,
+                       common: bool = False) -> list:
+    """Encode one results frame: the uint8 status column plus either one
+    shared value (``common=True`` — e.g. a window of identical replies)
+    or one value per call."""
+    statuses = np.ascontiguousarray(np.asarray(statuses, dtype=np.uint8))
+    n = int(statuses.shape[0])
+    w = Writer()
+    w.varint(RPC_WIRE_VERSION)
+    w.u8(RPC_KIND_RESULTS)
+    w.varint(0)
+    w.varint(batch_id)
+    w.varint(n)
+    w.u8(_RPC_FLAG_COMMON if common else 0)
+    arrays: list = [statuses]
+    if common:
+        _rpc_write_value(manager, w, arrays, common_value)
+    else:
+        if values is None or len(values) != n:
+            raise SerializationError(
+                "rpc results frame: values must carry one entry per call")
+        for v in values:
+            _rpc_write_value(manager, w, arrays, v)
+    return _rpc_manifest_and_segments(w, arrays)
+
+
+class RpcFrame:
+    """Decoded rpc fast-path frame (calls or results)."""
+
+    __slots__ = ("kind", "rpc_id", "batch_id", "n", "one_way",
+                 "keys", "ttls", "common_args", "args_list",
+                 "statuses", "common_value", "values")
+
+    def __init__(self) -> None:
+        self.kind = RPC_KIND_CALLS
+        self.rpc_id = 0
+        self.batch_id = 0
+        self.n = 0
+        self.one_way = False
+        self.keys = None
+        self.ttls = None
+        self.common_args = None
+        self.args_list = None
+        self.statuses = None
+        self.common_value = None
+        self.values = None
+
+
+def decode_rpc_frame(manager: SerializationManager,
+                     payload: bytes) -> RpcFrame:
+    """Decode one rpc fast-path frame body.  Key/TTL/status columns and
+    ndarray values come back as read-only ``np.frombuffer`` views over
+    ``payload`` — no per-call decode loop touches their bytes.  Any
+    malformation raises :class:`SerializationError`."""
+    try:
+        r = Reader(payload)
+        version = r.varint()
+        if version != RPC_WIRE_VERSION:
+            raise SerializationError(
+                f"unsupported rpc wire version {version}")
+        out = RpcFrame()
+        out.kind = r.u8()
+        if out.kind not in (RPC_KIND_CALLS, RPC_KIND_RESULTS):
+            raise SerializationError(f"unknown rpc frame kind {out.kind}")
+        out.rpc_id = r.varint()
+        out.batch_id = r.varint()
+        out.n = r.varint()
+        if out.n < 0:
+            raise SerializationError(f"negative rpc call count {out.n}")
+        flags = r.u8()
+        out.one_way = bool(flags & _RPC_FLAG_ONE_WAY)
+        common = bool(flags & _RPC_FLAG_COMMON)
+        has_ttl = bool(flags & _RPC_FLAG_TTL)
+        # the value region references arrays by INDEX and the manifest
+        # trails it — values parse to _RpcArrayRef placeholders first,
+        # resolved below once the raw segment views are mapped
+        arrays: list = []
+        common_is_set = False
+        if out.kind == RPC_KIND_CALLS:
+            if common:
+                out.common_args = _rpc_read_values(manager, r)
+            else:
+                out.args_list = [_rpc_read_values(manager, r)
+                                 for _ in range(out.n)]
+        else:
+            if common:
+                out.common_value = _rpc_read_value(manager, r)
+                common_is_set = True
+            else:
+                out.values = [_rpc_read_value(manager, r)
+                              for _ in range(out.n)]
+        # manifest + raw segments
+        n_arrays = r.varint()
+        if n_arrays < 0:
+            raise SerializationError(f"negative rpc array count {n_arrays}")
+        specs = []
+        for _ in range(n_arrays):
+            dtype = np.dtype(r.string())
+            if dtype.hasobject:
+                raise SerializationError(
+                    f"refusing object ndarray dtype {dtype!r}")
+            ndim = r.varint()
+            if not 0 <= ndim <= _SLAB_MAX_NDIM:
+                raise SerializationError(f"bad rpc array ndim {ndim}")
+            shape = tuple(r.varint() for _ in range(ndim))
+            if any(d < 0 for d in shape):
+                raise SerializationError(f"negative rpc dim in {shape}")
+            specs.append((dtype, shape))
+        buf = memoryview(payload)
+        offset = r.pos
+        for dtype, shape in specs:
+            count = int(np.prod(shape, dtype=np.int64))
+            nbytes = count * dtype.itemsize
+            if offset + nbytes > len(buf):
+                raise SerializationError(
+                    "rpc frame truncated: manifest wants "
+                    f"{nbytes} bytes at offset {offset}, frame has "
+                    f"{len(buf)}")
+            arrays.append(np.frombuffer(buf[offset:offset + nbytes],
+                                        dtype=dtype).reshape(shape))
+            offset += nbytes
+        if offset != len(buf):
+            raise SerializationError(
+                f"rpc frame has {len(buf) - offset} trailing bytes")
+        # implicit leading columns
+        idx = 0
+        if out.kind == RPC_KIND_CALLS:
+            out.keys = arrays[idx]
+            idx += 1
+            if out.keys.dtype != np.uint64 or out.keys.shape != (out.n,):
+                raise SerializationError("rpc calls frame: bad key column")
+            if has_ttl:
+                out.ttls = arrays[idx]
+                idx += 1
+                if out.ttls.dtype != np.float64 \
+                        or out.ttls.shape != (out.n,):
+                    raise SerializationError(
+                        "rpc calls frame: bad ttl column")
+        else:
+            out.statuses = arrays[idx]
+            idx += 1
+            if out.statuses.dtype != np.uint8 \
+                    or out.statuses.shape != (out.n,):
+                raise SerializationError(
+                    "rpc results frame: bad status column")
+            if common_is_set:
+                out.common_value = _rpc_resolve_one(out.common_value,
+                                                    arrays)
+        # value streams recorded array INDICES; resolve them now that
+        # the segment views exist
+        if out.common_args is not None:
+            out.common_args = _rpc_resolve_refs(out.common_args, arrays)
+        if out.args_list is not None:
+            out.args_list = [_rpc_resolve_refs(a, arrays)
+                             for a in out.args_list]
+        if out.values is not None:
+            out.values = [_rpc_resolve_one(v, arrays) for v in out.values]
+        return out
+    except SerializationError:
+        raise
+    except Exception as exc:  # noqa: BLE001 — corrupt bytes surface as one
+        # typed rejection, never a partial decode
+        raise SerializationError(f"malformed rpc frame: {exc!r}") from exc
+
+
+class _RpcArrayRef:
+    """Placeholder for an array referenced before the manifest parses."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int) -> None:
+        self.index = index
+
+
+def _rpc_resolve_one(v: Any, arrays: list) -> Any:
+    return arrays[v.index] if isinstance(v, _RpcArrayRef) else v
+
+
+def _rpc_resolve_refs(values: Tuple[Any, ...],
+                      arrays: list) -> Tuple[Any, ...]:
+    return tuple(_rpc_resolve_one(v, arrays) for v in values)
 
 
 def serializable(cls: Type) -> Type:

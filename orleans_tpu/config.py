@@ -56,6 +56,29 @@ class MessagingConfig:
 
 
 @dataclass
+class RpcConfig:
+    """Batched host-RPC plane knobs (orleans_tpu/runtime/rpc.py).  No
+    reference analog — the reference's Gateway/Dispatcher forward one
+    Message at a time; this is the coalesced-window rebuild of that
+    control path (the same batching move dispatch itself got)."""
+
+    # hosted-client/gateway calls ride the coalescer + pre-resolved
+    # invoke tables instead of the per-message pipeline.  Live-
+    # reloadable (silo.update_config); OFF is the A/B baseline the rpc
+    # bench tier measures against.  Sampled traces, chaos injection,
+    # shed pressure and grain-to-grain calls always fall back to the
+    # per-message path regardless of this flag.
+    fastpath_enabled: bool = True
+    # max calls per coalesced (type, method) window; a longer run
+    # splits into consecutive windows (per-sender FIFO still holds)
+    max_window: int = 8192
+    # ingress-ring bound: submissions past this many pending calls are
+    # refused back to the per-message path (its mailbox/shed machinery
+    # is the real backpressure surface)
+    max_pending: int = 131072
+
+
+@dataclass
 class ResilienceConfig:
     """Overload containment & failure isolation knobs (orleans_tpu/
     resilience.py + limits.ShedController).  No single reference analog —
@@ -456,6 +479,7 @@ class SiloConfig:
     directory: DirectoryConfig = field(default_factory=DirectoryConfig)
     collection: CollectionConfig = field(default_factory=CollectionConfig)
     messaging: MessagingConfig = field(default_factory=MessagingConfig)
+    rpc: RpcConfig = field(default_factory=RpcConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
@@ -504,3 +528,9 @@ class ClientConfig:
     # this rate; error/timeout spans record regardless
     trace_enabled: bool = True
     trace_sample_rate: float = 0.01
+    # batched RPC fastpath over TCP gateways: eligible calls coalesce
+    # into one calls-frame per event-loop iteration (negotiated
+    # (type, method) dictionary + zero-copy codec); ineligible calls
+    # (string/uuid keys, sampled traces, one-off control ops) ride the
+    # per-message frames unchanged
+    rpc_fastpath: bool = True

@@ -211,6 +211,30 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                 "cross_silo_messages": int(
                     _counter_total(merged, "router.messages_received")),
             },
+            # batched host RPC plane (runtime/rpc.py): how much of the
+            # front-door traffic rides coalesced invoke windows, how
+            # deep the windows run, and what fell back per message
+            "rpc": {
+                "fastpath_hits": int(
+                    _counter_total(merged, "rpc.fastpath_hits")),
+                "fastpath_fallbacks": int(
+                    _counter_total(merged, "rpc.fastpath_fallbacks")),
+                "windows": int(_counter_total(merged, "rpc.windows")),
+                "expired": int(_counter_total(merged, "rpc.expired")),
+                # per-silo interval means: report the worst (smallest)
+                # NONZERO window depth — a silo serving no front-door
+                # traffic publishes 0.0, which is "no signal", not
+                # "degenerated to per-message" — and the worst
+                # (largest) coalesce wait
+                "ingress_batch_size": round(min(
+                    (v for by_src in gauges.get(
+                        "rpc.ingress_batch_size", {}).values()
+                     for v in by_src.values() if v > 0), default=0.0), 1),
+                "coalesce_wait_s": round(max(
+                    (v for by_src in gauges.get(
+                        "rpc.coalesce_wait_s", {}).values()
+                     for v in by_src.values()), default=0.0), 6),
+            },
             # device-resident cross-shard routing (tensor/exchange.py):
             # traffic that crossed mesh shards WITHOUT leaving the device
             "cross_shard": {
@@ -347,6 +371,15 @@ def render_text(view: Dict[str, Any]) -> str:
         f"ticks ({t['engine_msgs_per_sec']} msg/s of tick time); "
         f"host rpc: {t['host_requests']}; "
         f"cross-silo: {t['cross_silo_messages']}")
+    rpc = c.get("rpc", {})
+    if rpc.get("fastpath_hits") or rpc.get("fastpath_fallbacks"):
+        lines.append(
+            f"rpc (batched host path): {rpc['fastpath_hits']} window "
+            f"calls / {rpc['fastpath_fallbacks']} per-message fallbacks "
+            f"over {rpc['windows']} windows "
+            f"(batch {rpc.get('ingress_batch_size', 0.0)}, "
+            f"wait {rpc.get('coalesce_wait_s', 0.0)}s, "
+            f"{rpc.get('expired', 0)} expired)")
     xs = c.get("cross_shard", {})
     if xs.get("exchanges"):
         lines.append(

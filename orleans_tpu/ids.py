@@ -164,7 +164,15 @@ class GrainId:
         return jenkins_hash(buf)
 
     def __hash__(self) -> int:
-        return hash((self.type_code, self.n0, self.n1, int(self.category), self.key_ext))
+        # cached: grain ids are interned and key every hot dict in the
+        # runtime (directory, invoke tables, callback maps) — rebuilding
+        # the 5-tuple per lookup was measurable at batched-RPC rates
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = hash((self.type_code, self.n0, self.n1,
+                      int(self.category), self.key_ext))
+            object.__setattr__(self, "_hash", h)
+        return h
 
     def __eq__(self, other: object) -> bool:
         if not isinstance(other, GrainId):

@@ -128,6 +128,28 @@ for _n, _u, _d in (
 declare("router.slab_merge_ratio", KIND_GAUGE, "ratio",
         "fragments per wire frame (>1 = sender aggregation engaged)")
 
+# -- batched host RPC plane (runtime/rpc.py RpcCoalescer) --------------------
+declare("rpc.ingress_batch_size", KIND_GAUGE, "calls",
+        "mean coalesced-window size over the last collection interval "
+        "(1.0 = the plane is degenerating to per-message dispatch)")
+declare("rpc.coalesce_wait_s", KIND_GAUGE, "seconds",
+        "mean ingress-ring wait from submit to window execution start "
+        "(the latency the batching itself adds; one event-loop "
+        "iteration in steady state)")
+declare("rpc.fastpath_hits", KIND_COUNTER, "calls",
+        "calls executed through a pre-resolved invoke-table window "
+        "(no Message object, no per-call task, no per-field codec)")
+declare("rpc.fastpath_fallbacks", KIND_COUNTER, "calls",
+        "coalesced calls handed back to the per-message pipeline "
+        "(cold/busy/remote activation, chaos injection, shed pressure, "
+        "sampled trace) — the general path stays the correctness net")
+declare("rpc.windows", KIND_COUNTER, "windows",
+        "coalesced (type, method) windows executed")
+declare("rpc.expired", KIND_COUNTER, "calls",
+        "coalesced calls whose per-call TTL lapsed before execution "
+        "(dead-lettered with reason expired, EXPIRED rejection to the "
+        "caller — never silently dropped)")
+
 # -- device-resident cross-shard routing (tensor/exchange.py) ----------------
 declare("route.cross_shard_msgs", KIND_COUNTER, "messages",
         "messages exchanged to a DIFFERENT mesh shard on device "

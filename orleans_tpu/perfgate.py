@@ -99,6 +99,7 @@ FAMILIES: Dict[str, Tuple[str, str, Optional[str]]] = {
     "streams": ("STREAMS", "streams_metrics", "STREAMS_BENCH.json"),
     "durability": ("DURABILITY", "durability_metrics",
                    "DURABILITY_BENCH.json"),
+    "rpc": ("RPC", "rpc_metrics", "RPC_BENCH.json"),
 }
 
 
@@ -366,7 +367,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "'streams' compares STREAMS_r*.json / "
                              "STREAMS_BENCH.json against "
                              "'streams_metrics' (exactness flags use "
-                             "direction 'flag')")
+                             "direction 'flag'); 'rpc' compares "
+                             "RPC_r*.json / RPC_BENCH.json against "
+                             "'rpc_metrics'")
     parser.add_argument("--all-families", action="store_true",
                         help="evaluate EVERY family in one invocation "
                              "(the one CI gate entrypoint): combined "

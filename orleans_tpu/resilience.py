@@ -112,6 +112,11 @@ class RetryBudget:
     def on_request(self) -> None:
         self.tokens = min(self.capacity, self.tokens + self.fill_rate)
 
+    def on_requests(self, n: int) -> None:
+        """Deposit for ``n`` first attempts in one capped add (the
+        batched RPC plane's per-window accounting — identical totals)."""
+        self.tokens = min(self.capacity, self.tokens + self.fill_rate * n)
+
     def try_spend(self) -> bool:
         if not self.enabled:
             return True

@@ -32,6 +32,21 @@ from orleans_tpu.runtime.messaging import (
 )
 
 
+#: exact types that never need the response copy barrier (type()
+#: membership — an isinstance chain per call was measurable at
+#: batched-RPC rates)
+_IMMUTABLE_RESULTS = frozenset((str, int, float, bool, bytes, type(None),
+                                complex))
+
+
+def _observe_window_turn(t: "asyncio.Task") -> None:
+    """Mark a promoted window turn's exception retrieved (outcomes
+    already reached the caller through the reply future — same
+    discipline as activation._observe_turn)."""
+    if not t.cancelled():
+        t.exception()
+
+
 class DeadlockError(Exception):
     """Call-chain cycle detected (reference: DeadlockException;
     Dispatcher.CheckDeadlock :345)."""
@@ -48,6 +63,11 @@ class Dispatcher:
         self.rejection_injection_rate = 0.0
         self._inject_rng = None
         self.metrics = silo.metrics
+        # batched host RPC plane: pre-resolved (type, method) → turn
+        # entrypoint tables (runtime/rpc.py; invalidated on the
+        # catalog's deactivation epoch)
+        from orleans_tpu.runtime.rpc import InvokeTable
+        self.invoke_table = InvokeTable(silo)
 
     @property
     def catalog(self):
@@ -268,6 +288,285 @@ class Dispatcher:
             return None
         msg.target_activation = act.activation_id
         return act
+
+    # ======================= batched invoke windows ========================
+
+    async def invoke_window(self, window) -> None:
+        """Execute one coalesced (type, method) window of host RPC calls
+        (runtime/rpc.py): resolve the turn entrypoint ONCE from the
+        invoke table, then run every call as an inline gated turn — no
+        Message object, no per-call task, no per-call codec hop.  Per-
+        call reply futures resolve from this one batched completion.
+
+        The per-message pipeline stays the correctness net: a call
+        whose activation is cold, busy, remote, mid-deactivation, or
+        whose entrypoint is unknown falls back per call (counted as
+        ``rpc.fastpath_fallbacks``) and resolves through the normal
+        response path."""
+        from orleans_tpu.codec import default_manager as codec
+        from orleans_tpu.core import context as gctx
+        from orleans_tpu.core.reference import _current_runtime, bind_runtime
+        from orleans_tpu.runtime.rpc import _WindowWatchdog
+
+        silo = self.silo
+        coal = silo.rpc
+        calls = window.calls
+        entry = self.invoke_table.resolve(window.type_code,
+                                          window.method.name)
+        metrics = silo.metrics
+        loop = asyncio.get_running_loop()
+        # tracing: ONE batched span per window (the engine's tick-span
+        # discipline — never a span per call on the fast path; sampled
+        # per-call traces fall back before reaching the coalescer)
+        rec = silo.spans
+        span = None
+        if rec.enabled:
+            trace = rec.begin_trace()
+            if trace is not None and trace.get("sampled"):
+                span = rec.start(f"rpc window {window.method.name}",
+                                 "rpc.window", trace,
+                                 method=window.method.name,
+                                 calls=len(calls))
+        watchdog = _WindowWatchdog(loop, calls, self._expire_call)
+        rt_token = bind_runtime(self.runtime_client)
+        valid = ActivationState.VALID
+        # stateless workers pick replicas per call, unknown entrypoints
+        # surface their AttributeError through the normal invoke path,
+        # and live shed pressure applies PER MESSAGE — all three send
+        # the window's calls down the per-message pipeline
+        fast_ok = (entry.func is not None and entry.class_info is not None
+                   and not entry.class_info.stateless_worker
+                   and silo.shed_controller.level <= 0.0)
+        hits = 0
+        promoted = 0
+        acts = entry.acts
+        method_name = window.method.name
+        deep_copy = codec.deep_copy
+        get_activation = self.catalog.get_activation
+        # per-call contextvar discipline: one SET per call (the next
+        # call's set overwrites it), one reset for the whole window —
+        # the drain task owns this context, nothing else reads it
+        # between calls
+        act_var = gctx._current_activation
+        chain_var = gctx._call_chain
+        act_token = act_var.set(None)
+        chain_token = chain_var.set(())
+        t_start = time.monotonic()
+        try:
+            for call in calls:
+                fut = call.future
+                if fut is not None and fut.done():
+                    continue  # watchdog already expired it
+                if call.deadline is not None and t_start > call.deadline:
+                    # checked against the window-start clock (one read
+                    # per window); the watchdog owns mid-window lapses
+                    self._expire_call(call)
+                    continue
+                if not fast_ok:
+                    self._window_fallback(call, loop)
+                    continue
+                cached = acts.get(call.grain_id)
+                if cached is None or cached[0].state is not valid:
+                    act = get_activation(call.grain_id)
+                    if act is None or act.state is not valid:
+                        self._window_fallback(call, loop)
+                        continue
+                    cached = (act, getattr(act.grain_instance,
+                                           method_name))
+                    acts[call.grain_id] = cached
+                act, bound = cached
+                if act.running or act.waiting:
+                    # the mailbox owns ordering once anything is queued
+                    # or a turn is in flight (reentrancy included).
+                    # local=True: the activation IS here — deliver
+                    # synchronously so the queued work is visible to
+                    # the shed depth signal without an addressing hop
+                    self._window_fallback(call, loop, local=True)
+                    continue
+                # inline gated turn: reserve the admission gate exactly
+                # like ActivationData._start_turn, minus the task.  The
+                # FIRST coroutine step runs eagerly; a method that
+                # completes without suspending (the steady-state shape)
+                # resolves inline, one that awaits real IO is PROMOTED
+                # to a task and the window moves on — a slow turn must
+                # never serialize its window-mates, and its queued
+                # followers must stay visible to the shed controller.
+                act.running[id(call)] = call
+                act_var.set(act)
+                chain_var.set((call.grain_id,))
+                hits += 1
+                coro = bound(*call.args)
+                try:
+                    yielded = coro.send(None)
+                except StopIteration as stop:
+                    if fut is not None and not fut.done():
+                        result = stop.value
+                        # same copy barrier as the per-message response
+                        # (exact scalar types skip the isinstance chain);
+                        # an uncopyable result fails ITS call only
+                        if type(result) in _IMMUTABLE_RESULTS:
+                            fut.set_result(result)
+                        else:
+                            try:
+                                fut.set_result(deep_copy(result))
+                            except Exception as exc:  # noqa: BLE001
+                                fut.set_exception(exc)
+                    act.running.pop(id(call), None)
+                    act.last_use = t_start
+                    if (act.waiting or act._closure_waiters
+                            or act._deactivate_on_idle):
+                        act._pump()
+                except Exception as exc:  # noqa: BLE001 — user faults
+                    # flow to the caller, exactly like invoke()
+                    metrics.turns_faulted += 1
+                    if fut is not None:
+                        if not fut.done():
+                            fut.set_exception(exc)
+                    else:
+                        silo.logger.warn(
+                            f"one-way rpc turn failed on "
+                            f"{call.grain_id}: {exc!r}")
+                    act.running.pop(id(call), None)
+                    act.last_use = t_start
+                    if (act.waiting or act._closure_waiters
+                            or act._deactivate_on_idle):
+                        act._pump()
+                else:
+                    # suspended mid-turn: promote.  The gate stays
+                    # reserved (same-activation followers queue on the
+                    # mailbox), the task inherits this context snapshot
+                    # (current activation/chain are correct for nested
+                    # sends after the suspension point).
+                    promoted += 1
+                    task = loop.create_task(self._finish_window_turn(
+                        coro, yielded, act, call))
+                    task.add_done_callback(_observe_window_turn)
+        finally:
+            act_var.reset(act_token)
+            chain_var.reset(chain_token)
+            _current_runtime.reset(rt_token)
+            watchdog.cancel()
+            coal.fastpath_hits += hits
+            if hits:
+                metrics.turns_executed += hits
+                # one wall read amortized over the window: per-call turn
+                # latency is window wall / calls (same method back to
+                # back — the collapse is sub-bucket on the log2 scale).
+                # Only SYNCHRONOUS completions record here; promoted
+                # turns record their real duration in
+                # _finish_window_turn (recording them twice inflated
+                # the ledger's count)
+                n_sync = hits - promoted
+                if n_sync:
+                    metrics.turn_latency.add_many(
+                        (time.monotonic() - t_start) / len(calls),
+                        n_sync)
+            if span is not None:
+                rec.finish(span, hits=hits)
+
+    async def _finish_window_turn(self, coro, yielded, act, call) -> None:
+        """Drive a promoted (suspended-mid-turn) window call to
+        completion: resolve its future, release the admission gate,
+        pump the mailbox — the task-shaped tail of invoke_window's
+        inline turn."""
+        from orleans_tpu.codec import default_manager as codec
+        from orleans_tpu.runtime.rpc import drive_started_turn
+
+        silo = self.silo
+        fut = call.future
+        t0 = time.monotonic()
+        try:
+            result = await drive_started_turn(coro, yielded)
+        except Exception as exc:  # noqa: BLE001 — user faults flow to
+            # the caller, exactly like invoke()
+            self.metrics.turns_faulted += 1
+            if fut is not None:
+                if not fut.done():
+                    fut.set_exception(exc)
+            else:
+                silo.logger.warn(f"one-way rpc turn failed on "
+                                 f"{call.grain_id}: {exc!r}")
+        else:
+            silo.metrics.turn_latency.add(time.monotonic() - t0)
+            if fut is not None and not fut.done():
+                try:
+                    fut.set_result(codec.deep_copy(result))
+                except Exception as exc:  # noqa: BLE001 — an uncopyable
+                    # result fails its caller, never strands the future
+                    fut.set_exception(exc)
+        finally:
+            act.running.pop(id(call), None)
+            act.last_use = time.monotonic()
+            act._pump()
+
+    def _expire_call(self, call) -> None:
+        """Per-call TTL enforcement inside the batched plane: an expired
+        coalesced call dead-letters with reason expired and answers an
+        EXPIRED (non-retryable) rejection — identical semantics to an
+        expired Message hitting receive_message."""
+        from orleans_tpu.runtime.runtime_client import RejectionError
+
+        self.silo.rpc.expired += 1
+        self.metrics.expired_dropped += 1
+        direction = (Direction.ONE_WAY if call.future is None
+                     else Direction.REQUEST)
+        record = Message(
+            category=Category.APPLICATION, direction=direction,
+            sending_silo=self.silo.address, sending_grain=call.sender,
+            target_grain=call.grain_id, interface_id=call.iface_id,
+            method_id=call.method.method_id, method_name=call.method.name,
+            expiration=call.deadline)
+        self.silo.dead_letters.record(
+            record, REASON_EXPIRED, "expired in rpc ingress")
+        if call.future is not None and not call.future.done():
+            call.future.set_exception(RejectionError(
+                RejectionType.EXPIRED, "request expired in rpc ingress"))
+
+    def _window_fallback(self, call, loop, local: bool = False) -> None:
+        """Hand one coalesced call back to the per-message pipeline
+        (cold/busy/remote activation): build the Message it never had
+        and correlate its reply onto the SAME future the coalesced
+        caller holds.  ``local=True`` (the target activation is known
+        to live on THIS silo) pre-addresses the message so delivery —
+        including the shed admission gate — runs synchronously instead
+        of behind an addressing task."""
+        from orleans_tpu.runtime.runtime_client import CallbackData
+
+        self.silo.rpc.fastpath_fallbacks += 1
+        method = call.method
+        msg = Message(
+            category=Category.APPLICATION,
+            direction=(Direction.ONE_WAY if call.future is None
+                       else Direction.REQUEST),
+            sending_silo=self.silo.address,
+            # the reply must resolve THIS silo's callback table (the
+            # coalesced caller's future) — never route out the gateway
+            # socket the original sender is connected on, so the sender
+            # identity here is the silo's own hosted-client id
+            # (call.sender keeps the real client for FIFO grouping)
+            sending_grain=self.silo.client_grain_id,
+            target_grain=call.grain_id,
+            interface_id=call.iface_id,
+            method_id=method.method_id,
+            method_name=method.name,
+            args=call.args,
+            is_read_only=method.read_only,
+            is_always_interleave=method.always_interleave,
+            expiration=call.deadline,
+        )
+        if local:
+            msg.target_silo = self.silo.address
+        if call.future is None:
+            self.send_message(msg)
+            return
+        rc = self.runtime_client
+        cb = CallbackData(future=call.future, message=msg)
+        if call.deadline is not None:
+            cb.timeout_handle = loop.call_later(
+                max(0.0, call.deadline - time.monotonic()),
+                rc._on_timeout, msg.id)
+        rc.callbacks[msg.id] = cb
+        self.send_message(msg)
 
     # ======================= send path =====================================
 

@@ -26,14 +26,19 @@ import struct
 import time
 from typing import Any, Callable, Dict, Optional
 
+from orleans_tpu import codec as codec_mod
 from orleans_tpu import spans as _spans
-from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.codec import RpcFrame, default_manager as codec
 from orleans_tpu.ids import ActivationAddress, ActivationId, GrainId
 from orleans_tpu.runtime.messaging import Direction, Message
 
 #: gateway wire framing: 4-byte magic + 4-byte length, codec payload.
 #: Payloads are either a Message or a control dict {"op": ...}.
 GATEWAY_MAGIC = 0x4F43  # "OC" — distinct from silo-to-silo frames
+#: rpc fast-path frames (codec.encode_rpc_calls/results): same 8-byte
+#: header, but the payload is the fixed-header batched-call format —
+#: NEVER walked by the token-stream codec
+GATEWAY_RPC_MAGIC = 0x4F52  # "OR"
 
 
 class Gateway:
@@ -153,6 +158,25 @@ class Gateway:
         return engine.send_batch(type_name, method, keys, args,
                                  want_results=want_results)
 
+    def submit_calls(self, calls: list) -> None:
+        """Batched RPC ingress (the per-call analog of ``submit_batch``):
+        a whole window of host-grain calls from a wired client enters
+        the silo as ONE batch — the coalescer groups them into
+        (type, method) invoke windows.  When the batched plane is not
+        accepting (live-disabled, ring at bound) every call degrades to
+        the per-message pipeline — same replies, counted as
+        fallbacks — so a gateway never refuses traffic the silo could
+        serve."""
+        coal = self.silo.rpc
+        if coal.accepting():
+            for call in calls:
+                coal.submit(call)
+        else:
+            loop = asyncio.get_running_loop()
+            dispatcher = self.silo.dispatcher
+            for call in calls:
+                dispatcher._window_fallback(call, loop)
+
     def send_client_batch(self, type_name: str, method: str, keys, args,
                           want_results: bool = False):
         """In-process client edge for vector slabs — wire-fidelity
@@ -187,20 +211,164 @@ def write_gateway_frame(writer: asyncio.StreamWriter, payload: Any) -> None:
     writer.write(struct.pack("<II", GATEWAY_MAGIC, len(blob)) + blob)
 
 
+def write_gateway_rpc_frame(writer: asyncio.StreamWriter,
+                            segments: list) -> None:
+    """Scatter-write one rpc fast-path frame: header + raw segments go
+    out back to back — array payload bytes are memoryviews over the
+    source buffers, never joined into a fresh bytes object here."""
+    total = sum(len(memoryview(s).cast("B")) for s in segments)
+    writer.write(struct.pack("<II", GATEWAY_RPC_MAGIC, total))
+    for s in segments:
+        writer.write(s)
+
+
 async def read_gateway_frame(reader: asyncio.StreamReader) -> Any:
+    """Read one control/Message frame (handshake surfaces); rpc
+    fast-path frames are rejected here — pumps that speak both use
+    :func:`read_gateway_frame_any`."""
+    frame = await read_gateway_frame_any(reader)
+    if isinstance(frame, RpcFrame):
+        raise ValueError("unexpected rpc fast-path frame on a "
+                         "control-only read")
+    return frame
+
+
+async def read_gateway_frame_any(reader: asyncio.StreamReader) -> Any:
+    """Read one gateway frame of either flavor: token-stream payloads
+    decode through the general codec, rpc fast-path payloads through
+    the fixed-header decoder (returns :class:`codec.RpcFrame`)."""
     header = await reader.readexactly(8)
     magic, length = struct.unpack("<II", header)
-    if magic != GATEWAY_MAGIC:
-        raise ValueError(f"bad gateway frame magic {magic:#x}")
-    return codec.deserialize(await reader.readexactly(length))
+    payload = await reader.readexactly(length)
+    if magic == GATEWAY_MAGIC:
+        return codec.deserialize(payload)
+    if magic == GATEWAY_RPC_MAGIC:
+        return codec_mod.decode_rpc_frame(codec, payload)
+    raise ValueError(f"bad gateway frame magic {magic:#x}")
 
 
 def _rebase_expiration_inbound(msg: Message) -> Message:
     if isinstance(msg, Message) and msg.expiration is not None:
         # wire carries remaining TTL → rebase on this host's clock
-        # (same discipline as TcpTransport silo frames)
+        # (same discipline as TcpTransport silo frames).  Batched rpc
+        # frames carry a remaining-TTL COLUMN and rebase per call in
+        # _handle_rpc_calls — one frame-level rebase would hand every
+        # call the first call's deadline.
         msg.expiration = time.monotonic() + msg.expiration
     return msg
+
+
+class _RpcBinding:
+    """One negotiated rpc dictionary entry on a gateway connection:
+    rpc_id → (interface, method, key→GrainId memo).  The client
+    assigns ids and announces each once ({"op": "rpc_bind"}); the
+    ordered stream guarantees the binding lands before any calls frame
+    that uses it."""
+
+    __slots__ = ("iface", "minfo", "_gids")
+
+    def __init__(self, iface, minfo) -> None:
+        self.iface = iface
+        self.minfo = minfo
+        self._gids: Dict[int, GrainId] = {}
+
+    def gid(self, key: int) -> GrainId:
+        g = self._gids.get(key)
+        if g is None:
+            from orleans_tpu.core.grain import grain_id_for
+            g = grain_id_for(self.iface.cls, key)
+            self._gids[key] = g
+        return g
+
+
+def _resolve_rpc_binding(frame: dict) -> _RpcBinding:
+    from orleans_tpu.core.grain import get_interface
+    iface = get_interface(frame["iface"])
+    minfo = iface.methods_by_name.get(frame["method"])
+    if minfo is None:
+        raise KeyError(f"{frame['iface']} has no grain method "
+                       f"{frame['method']!r}")
+    if minfo.batched:
+        raise ValueError("batched (vector) methods ride the "
+                         "vector_batch slab op, not the rpc fast path")
+    return _RpcBinding(iface, minfo)
+
+
+_RPC_SHARED_SAFE = (str, int, float, bool, bytes, type(None))
+#: exact scalar types a results frame may collapse to one shared value
+_RPC_COMMON_RESULT_TYPES = frozenset((str, int, float, bool, bytes,
+                                      type(None)))
+
+
+def _rpc_args_shared_safe(args) -> bool:
+    """True when one decoded args tuple may be handed to EVERY call of
+    a common-args frame: immutable scalars and the decoder's read-only
+    ndarray views share safely; anything mutable (a GENERAL-decoded
+    list/dict) must deep-copy per call to keep the per-message path's
+    isolation barrier."""
+    import numpy as np
+    for a in args:
+        if isinstance(a, _RPC_SHARED_SAFE):
+            continue
+        if isinstance(a, np.ndarray) and not a.flags.writeable:
+            continue
+        return False
+    return True
+
+
+async def _rpc_reply(writer: asyncio.StreamWriter, batch_id: int,
+                     futures: list) -> None:
+    """Resolve one calls-frame's futures into ONE results frame: status
+    column + values (collapsed to a single shared value when the whole
+    window answered identically — the steady-state helloworld shape)."""
+    import numpy as np
+
+    from orleans_tpu.runtime.messaging import RejectionType
+    from orleans_tpu.runtime.runtime_client import RejectionError
+
+    results = await asyncio.gather(*futures, return_exceptions=True)
+    if writer.is_closing():
+        return
+    n = len(results)
+    statuses = np.zeros(n, dtype=np.uint8)
+    clean = True
+    for i, res in enumerate(results):
+        if isinstance(res, BaseException):
+            clean = False
+            if isinstance(res, RejectionError) \
+                    and res.rejection == RejectionType.EXPIRED:
+                statuses[i] = codec_mod.RPC_STATUS_EXPIRED
+            else:
+                statuses[i] = codec_mod.RPC_STATUS_ERROR
+    common = False
+    if clean and n > 1:
+        first = results[0]
+        # exact TYPE identity before ==: bool/int/float must never
+        # collapse into each other, and the type check short-circuits
+        # before an ndarray result could reach == (whose elementwise
+        # answer would raise here and strand the whole reply frame)
+        ftype = type(first)
+        if ftype in _RPC_COMMON_RESULT_TYPES:
+            common = all(type(r) is ftype and r == first
+                         for r in results)
+    try:
+        if common:
+            segments = codec_mod.encode_rpc_results(
+                codec, batch_id, statuses, None,
+                common_value=results[0], common=True)
+        else:
+            segments = codec_mod.encode_rpc_results(
+                codec, batch_id, statuses, list(results))
+    except Exception as exc:  # noqa: BLE001 — an unencodable result
+        # must cost an error REPLY, never a frame that was never sent
+        # (the client's futures would idle out their deadlines)
+        statuses[:] = codec_mod.RPC_STATUS_ERROR
+        segments = codec_mod.encode_rpc_results(
+            codec, batch_id, statuses, None,
+            common_value=RuntimeError(
+                f"rpc reply not wire-serializable: {exc!r}"),
+            common=True)
+    write_gateway_rpc_frame(writer, segments)
 
 
 def _with_ttl(msg: Message) -> Message:
@@ -234,11 +402,72 @@ class GatewayAcceptor:
             w.close()
         self._conns.clear()
 
+    def _handle_rpc_calls(self, gateway: "Gateway",
+                          writer: asyncio.StreamWriter,
+                          client_id: GrainId,
+                          rpc_bindings: Dict[int, Optional["_RpcBinding"]],
+                          frame) -> None:
+        """One decoded calls frame → one batch into the coalescer → one
+        results frame (task) resolving every per-call future from the
+        batched completion.  TTLs rebase PER CALL on this host's clock
+        — the frame-level rebase bug class the regression test in
+        tests/test_rpc.py pins."""
+        from orleans_tpu.runtime.rpc import _Call
+
+        if frame.kind != codec_mod.RPC_KIND_CALLS:
+            raise ValueError("client sent a results frame")
+        loop = asyncio.get_running_loop()
+        want = frame.batch_id != 0 and not frame.one_way
+        binding = rpc_bindings.get(frame.rpc_id)
+        if binding is None:
+            if want:
+                import numpy as np
+                err = RuntimeError(
+                    f"rpc_id {frame.rpc_id} is not usably bound on this "
+                    "connection")
+                segments = codec_mod.encode_rpc_results(
+                    codec, frame.batch_id,
+                    np.full(frame.n, codec_mod.RPC_STATUS_ERROR,
+                            dtype=np.uint8),
+                    None, common_value=err, common=True)
+                write_gateway_rpc_frame(writer, segments)
+            return
+        now = time.monotonic()
+        keys = frame.keys
+        ttls = frame.ttls
+        common_args = frame.common_args
+        share_ok = common_args is None or _rpc_args_shared_safe(common_args)
+        minfo, iface_id = binding.minfo, binding.iface.interface_id
+        gid = binding.gid
+        futures: list = []
+        calls: list = []
+        for i in range(frame.n):
+            if common_args is not None:
+                args = common_args if share_ok else \
+                    tuple(codec.deep_copy(a) for a in common_args)
+            else:
+                args = frame.args_list[i]
+            deadline = now + float(ttls[i]) if ttls is not None else None
+            fut = loop.create_future() if want else None
+            if fut is not None:
+                futures.append(fut)
+            calls.append(_Call(gid(int(keys[i])), minfo, iface_id, args,
+                               fut, deadline, client_id))
+        gateway.submit_calls(calls)
+        if want:
+            task = loop.create_task(
+                _rpc_reply(writer, frame.batch_id, futures))
+            task.add_done_callback(lambda t: t.cancelled()
+                                   or t.exception())
+
     async def _on_conn(self, reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
         gateway: Gateway = self.silo.system_targets.get("gateway")
         self._conns.add(writer)
         registered: list = []  # client + observer ids bound to this socket
+        # negotiated rpc dictionary: rpc_id → binding (None = announced
+        # but unresolvable; its calls answer error result frames)
+        rpc_bindings: Dict[int, Optional[_RpcBinding]] = {}
         try:
             hello = await read_gateway_frame(reader)
             if not (isinstance(hello, dict) and hello.get("op") == "hello"):
@@ -256,13 +485,30 @@ class GatewayAcceptor:
                                          "silo": str(self.silo.address)})
 
             while True:
-                frame = await read_gateway_frame(reader)
+                frame = await read_gateway_frame_any(reader)
                 if isinstance(frame, Message):
                     gateway.submit(_rebase_expiration_inbound(frame),
                                    already_wired=True)
+                elif isinstance(frame, RpcFrame):
+                    self._handle_rpc_calls(gateway, writer, client_id,
+                                           rpc_bindings, frame)
                 elif isinstance(frame, dict):
                     op = frame.get("op")
-                    if op == "vector_batch":
+                    if op == "rpc_bind":
+                        # dictionary negotiation: resolve once, every
+                        # later calls frame is int-keyed.  A bad bind
+                        # costs an error reply + error results for its
+                        # calls, never the connection.
+                        rpc_id = frame.get("rpc_id")
+                        try:
+                            rpc_bindings[rpc_id] = \
+                                _resolve_rpc_binding(frame)
+                        except Exception as exc:  # noqa: BLE001
+                            rpc_bindings[rpc_id] = None
+                            write_gateway_frame(writer, {
+                                "op": "error", "for": "rpc_bind",
+                                "rpc_id": rpc_id, "error": repr(exc)})
+                    elif op == "vector_batch":
                         # ONE slab in, ONE slab (of results) out — the
                         # codec's first-class ndarray tokens carry the
                         # tensors; nothing per-message anywhere.  A bad

@@ -64,6 +64,22 @@ class CallbackData:
     span: Any = None
 
 
+#: distinct from None — send_request's fastpath probe must be able to
+#: return None (a one-way call accepted by the coalescer)
+_FASTPATH_DECLINED = object()
+
+from orleans_tpu.ids import GrainCategory as _GrainCategory  # noqa: E402
+from orleans_tpu.runtime.rpc import _Call  # noqa: E402 — hot path: a
+# function-level import costs ~µs per call at batched-RPC rates
+
+_CAT_GRAIN = _GrainCategory.GRAIN
+_CAT_KEY_EXT = _GrainCategory.KEY_EXT_GRAIN
+#: exact types that never need the copy barrier (type() membership — an
+#: isinstance chain per arg was measurable at batched-RPC rates)
+_IMMUTABLE_ARGS = frozenset((str, int, float, bool, bytes, type(None),
+                             complex))
+
+
 def _send_kind(msg: Message) -> str:
     """Span kind of a send hop, recoverable from the message alone (the
     retroactive-failure path has no open span to read it from): hosted
@@ -99,6 +115,9 @@ class InsideRuntimeClient:
         self.backoff = BackoffPolicy(
             base=r.backoff_base, cap=r.backoff_cap,
             seed=zlib.crc32(silo.name.encode()))
+        # a head-sampling decision minted by the rpc-fastpath probe and
+        # handed to the per-message path (one draw per call, never two)
+        self._pending_trace = None
 
     # wired lazily by Silo
     @property
@@ -154,6 +173,18 @@ class InsideRuntimeClient:
             return fut
         timeout = timeout if timeout is not None else self.response_timeout
         sender = ctx.current_activation()
+        # batched RPC fastpath (runtime/rpc.py): hosted-CLIENT calls
+        # coalesce into invoke-table windows instead of becoming
+        # per-call Messages.  Grain-to-grain calls (call chains,
+        # deadlock detection), sampled traces (full per-hop spans),
+        # chaos injection, live shed pressure, and exotic targets all
+        # keep the per-message pipeline — the fastpath only takes the
+        # steady-state front-door traffic it can serve bit-identically.
+        if sender is None:
+            fut = self._try_rpc_fastpath(target_grain, iface, method,
+                                         args, timeout)
+            if fut is not _FASTPATH_DECLINED:
+                return fut
         sending_grain = sender.grain_id if sender is not None \
             else self.silo.client_grain_id
         chain = ctx.current_call_chain()
@@ -168,7 +199,9 @@ class InsideRuntimeClient:
         # The send span's id rides the exported context so the receiving
         # hop parents under it (orleans_tpu/spans.py).
         rec = self.silo.spans
-        trace = rec.ingress()
+        trace, self._pending_trace = (
+            (self._pending_trace, None) if self._pending_trace is not None
+            else (rec.ingress(), None))
         span = None
         if trace is not None and trace.get("sampled"):
             # attrs are only materialized for sampled traces — the
@@ -210,6 +243,60 @@ class InsideRuntimeClient:
         cb.timeout_handle = loop.call_later(timeout, self._on_timeout, msg.id)
         self.callbacks[msg.id] = cb
         self.dispatcher.send_message(msg)
+        return future
+
+    def _try_rpc_fastpath(self, target_grain: GrainId, iface: InterfaceInfo,
+                          method: MethodInfo, args: Tuple[Any, ...],
+                          timeout: float):
+        """Admission check + submit for the batched RPC plane.  Returns
+        the reply future (None for an accepted one-way) or the
+        ``_FASTPATH_DECLINED`` sentinel when this call must ride the
+        per-message pipeline."""
+        silo = self.silo
+        coal = silo.rpc
+        if coal is None:
+            return _FASTPATH_DECLINED
+        cfg = coal.cfg
+        if not cfg.fastpath_enabled or len(coal._ring) >= cfg.max_pending:
+            return _FASTPATH_DECLINED
+        cat = target_grain.category
+        if cat is not _CAT_GRAIN and cat is not _CAT_KEY_EXT:
+            return _FASTPATH_DECLINED  # system targets / client ids
+        if (silo.dispatcher._inject_rng is not None
+                or silo.message_center._drop_fn is not None):
+            # chaos injection is PER-MESSAGE semantics — the batched
+            # plane hands the whole flow back rather than approximating
+            # it.  (Shed pressure is consulted per WINDOW at execution,
+            # where the level actually applies — invoke_window.)
+            return _FASTPATH_DECLINED
+        if ctx._request_context.get() is not None:
+            # an ambient RequestContext must flow to the turn; only the
+            # per-message envelope carries it
+            return _FASTPATH_DECLINED
+        rec = silo.spans
+        if rec.enabled and rec.sample_rate > 0.0 \
+                and rec._rng.random() < rec.sample_rate:
+            # head-sampled: this call pays the full per-hop span path;
+            # the minted decision is REUSED by send_request (a second
+            # draw would square the sample rate).  The unsampled
+            # majority allocates no trace dict at all.
+            self._pending_trace = {"trace_id": _spans._getrandbits(63),
+                                   "span_id": "", "sampled": True}
+            return _FASTPATH_DECLINED
+        # requests_sent / retry-budget deposits batch per drained window
+        # (RpcCoalescer._drain) — identical totals, no per-call RMW here
+        future = None
+        if not method.one_way:
+            future = asyncio.get_running_loop().create_future()
+        for a in args:
+            if type(a) not in _IMMUTABLE_ARGS:
+                # copy barrier only when something can actually mutate —
+                # the all-scalar tuple (fresh from *args) passes as-is
+                args = tuple(map(codec.deep_copy, args))
+                break
+        coal.submit(_Call(
+            target_grain, method, iface.interface_id, args, future,
+            time.monotonic() + timeout, silo.client_grain_id))
         return future
 
     def _on_timeout(self, message_id: int) -> None:

@@ -186,6 +186,10 @@ class Silo:
         self.dispatcher = Dispatcher(self)
         self.dispatcher.perform_deadlock_detection = \
             self.config.messaging.deadlock_detection
+        # batched host RPC plane (runtime/rpc.py): ingress ring +
+        # coalesced invoke windows for hosted-client/gateway calls
+        from orleans_tpu.runtime.rpc import RpcCoalescer
+        self.rpc = RpcCoalescer(self)
         self.placement_manager = PlacementDirectorsManager(self)
         self.factory = GrainFactory()
         self.max_forward_count = self.config.messaging.max_forward_count
@@ -626,7 +630,12 @@ class Silo:
     def _pending_request_depth(self) -> int:
         """Silo-wide pending-turn count (sum of activation mailbox
         depths) — the shed controller's queue-depth signal.  Sampled
-        (memoized) by the controller, not per message."""
+        (memoized) by the controller, not per message.  The batched-RPC
+        ingress ring is deliberately NOT counted: it drains within one
+        loop iteration (a transient buffer, not standing backlog) and
+        anything that can't start a turn lands in a mailbox right here
+        — sustained pressure is mailbox depth, same as before the
+        batched plane."""
         return sum(len(a.waiting)
                    for a in self.catalog.directory.by_activation.values())
 
@@ -745,6 +754,22 @@ class Silo:
               "requests_resent": self.metrics.requests_resent,
               "turns_executed": self.metrics.turns_executed},
              None, "host.")
+        # batched host RPC plane: hits/fallbacks/expiry counters plus
+        # the interval-mean window shape gauges (collect_interval is
+        # the mutating read this collector alone owns)
+        rs = self.rpc.snapshot()
+        ri = self.rpc.collect_interval()
+        emit({"fastpath_hits": rs["fastpath_hits"],
+              "fastpath_fallbacks": rs["fastpath_fallbacks"],
+              "expired": rs["expired"],
+              "windows": rs["windows"]}, None, "rpc.")
+        reg.gauge("rpc.ingress_batch_size").set(ri["ingress_batch_size"])
+        reg.gauge("rpc.coalesce_wait_s").set(ri["coalesce_wait_s"])
+        if fan:
+            mgr.track_metric("rpc.ingress_batch_size",
+                             ri["ingress_batch_size"], {"silo": self.name})
+            mgr.track_metric("rpc.coalesce_wait_s",
+                             ri["coalesce_wait_s"], {"silo": self.name})
         # host turn latency: mirror the SiloMetrics ns-bucket histogram
         # into the registry's log2 layout (same octave scheme, base 1ns)
         tl = self.metrics.turn_latency

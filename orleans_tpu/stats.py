@@ -35,6 +35,16 @@ class Histogram:
         ns = max(1, int(value * 1e9))
         self.buckets[min(63, ns.bit_length() - 1)] += 1
 
+    def add_many(self, value: float, n: int) -> None:
+        """Record ``n`` observations of one value in O(1) — the batched
+        RPC plane amortizes one wall-clock read over a whole invoke
+        window (per-call latencies inside a window are the same method
+        back to back; the spread the collapse loses is sub-bucket)."""
+        self.count += n
+        self.total += value * n
+        ns = max(1, int(value * 1e9))
+        self.buckets[min(63, ns.bit_length() - 1)] += n
+
     def percentile(self, p: float) -> float:
         """Approximate percentile from log buckets (upper bound of bucket)."""
         if self.count == 0:

@@ -286,8 +286,22 @@ def test_tensor_statistics_fanout(run):
     """Tick-engine counters (throughput, true latency percentiles, arena
     sizes) flow through the management surface."""
 
+    def patient_liveness(name):
+        # the presence load's jit compiles stall the event loop for
+        # longer than the default test liveness budget (probe 0.1s × 2
+        # missed) — under file-level cache timing both silos could vote
+        # each other DEAD mid-test and the fan-out read an empty
+        # membership view.  This test is about the management surface,
+        # not liveness: give probes compile-sized patience.
+        cfg = TestingCluster._default_config(name)
+        cfg.liveness.probe_period = 1.0
+        cfg.liveness.probe_timeout = 2.0
+        cfg.liveness.num_missed_probes_limit = 5
+        return cfg
+
     async def main():
-        cluster = await TestingCluster(n_silos=2).start()
+        cluster = await TestingCluster(
+            n_silos=2, config_factory=patient_liveness).start()
         try:
             await cluster.wait_for_liveness_convergence()
             # put some tensor traffic on silo 0's engine
