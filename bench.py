@@ -3498,6 +3498,246 @@ async def _rpc_tier(smoke: bool) -> dict:
     return out
 
 
+async def _rebalance_tier(smoke: bool) -> dict:
+    """The closed-loop rebalance tier (``--workload rebalance``): a
+    Zipf hot spot pinned to ONE mesh shard collapses aggregate msg/s
+    (the exchange's occupancy-sized cap is driven by the MAX
+    per-destination demand, so a burning destination shard widens every
+    shard's padded plan — a structural, sustained cost, measured here
+    compile-settled); the rebalance controller, reading ONLY the
+    attribution plane's own telemetry, migrates the hot grains off the
+    burning shard (one batched columnar wave) and throughput recovers
+    to ≥0.9x the uniform-load baseline — no human input.  The
+    controller-OFF side of the A/B is the sustained multi-round
+    collapse published beside it.  ``slo.*`` burn is judged with the
+    catalog formula (surely-over ledger buckets vs the latency budget)
+    per segment: burning during the collapse, back under 1.0 after
+    recovery.  Delivery conservation is asserted EXACTLY across the
+    whole run (every injected lane delivers once, through collapse,
+    migration and recovery).  Discipline: every kernel path (including
+    each segment's exchange-cap plan) warms before its measured
+    segment; run uncontended."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from orleans_tpu.chaos.invariants import check_mesh_single_activation
+    from orleans_tpu.config import MetricsConfig, RebalanceConfig
+    from orleans_tpu.runtime.rebalancer import (
+        RebalanceController,
+        interval_latency_burn,
+    )
+    from orleans_tpu.tensor.arena import shard_of_keys
+    from orleans_tpu.tensor.engine import TensorEngine
+    from samples.routing import (
+        RouteSink,    # noqa: F401 — registers the vector grains
+        RouteSource,  # noqa: F401
+        build_ratio_destinations,
+        sink_keys,
+    )
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        devices = jax.devices("cpu")
+    n_dev = min(8, len(devices))
+    if n_dev < 2:
+        raise RuntimeError("rebalance tier needs a multi-device mesh")
+    mesh = Mesh(np.array(devices[:n_dev]), ("grains",))
+
+    n_src, n_sink = 131_072, 256
+    warm, ticks, rounds = (6, 3, 2) if smoke else (10, 4, 3)
+    hot_pool_n, hot_exp = 24, 0.5
+
+    mc = MetricsConfig(attribution_top_k=32)
+    engine = TensorEngine(mesh=mesh, initial_capacity=1024, metrics=mc)
+    engine.config.auto_fusion_ticks = 0
+    engine.config.tick_interval = 0.0
+    # the structured exchange is the resource the hot spot saturates;
+    # "auto" disengages it on host-virtual meshes, so pin it like the
+    # exactness/overflow suites do
+    engine.config.exchange_structured = "always"
+
+    sources = np.arange(n_src, dtype=np.int64)
+    sinks = sink_keys(n_sink)
+    engine.arena_for("RouteSource").reserve(n_src)
+    engine.arena_for("RouteSource").resolve_rows(sources)
+    engine.arena_for("RouteSink").reserve(n_sink)
+    engine.arena_for("RouteSink").resolve_rows(sinks)
+    rng = np.random.default_rng(20260805)
+    values = rng.integers(1, 8, n_src).astype(np.float32)
+    uniform_dst = build_ratio_destinations(sources, sinks, n_dev,
+                                           1.0 - 1.0 / n_dev, seed=1)
+    shard0 = sinks[shard_of_keys(sinks, n_dev) == 0]
+    pool = shard0[:min(hot_pool_n, len(shard0))]
+    zw = 1.0 / np.arange(1, len(pool) + 1) ** hot_exp
+    zw /= zw.sum()
+    hot_dst = rng.choice(pool, n_src, p=zw)
+    injector = engine.make_injector("RouteSource", "send", sources)
+    vv = jnp.asarray(values)
+    injected_lanes = 0
+
+    async def drive(dst_dev, n: int) -> float:
+        nonlocal injected_lanes
+        t0 = time.perf_counter()
+        for _ in range(n):
+            injector.inject({"dst": dst_dev, "v": vv})
+            injected_lanes += n_src
+            await engine.drain_queues()
+        await engine.flush()
+        return time.perf_counter() - t0
+
+    async def measure(dst, warm_ticks: int) -> tuple:
+        """Warm the pattern's kernel paths (cap growth/shrink re-traces
+        settle here), then best-of-``rounds`` closed-loop rate + the
+        best round's seconds-per-tick."""
+        dd = jnp.asarray(dst.astype(np.int32))
+        await drive(dd, warm_ticks)
+        best, best_spt = 0.0, 0.0
+        for _ in range(rounds):
+            elapsed = await drive(dd, ticks)
+            rate = 2 * n_src * ticks / elapsed
+            if rate > best:
+                best, best_spt = rate, elapsed / ticks
+        return best, best_spt
+
+    # ---- 1. uniform-load baseline ------------------------------------
+    uniform_rate, spt_u = await measure(uniform_dst, warm)
+    # latency budget: 1.25x the uniform pace — uniform holds it, the
+    # collapsed pace (≥1.5x) burns it (slo.* catalog semantics)
+    budget = 1.25 * spt_u
+    engine.config.target_tick_latency = budget
+
+    # ---- 2. the hot spot: sustained collapse (controller OFF) --------
+    prev_counts = np.asarray(engine.ledger.fetch_counts())
+    hot_rounds = []
+    dd_hot = jnp.asarray(hot_dst.astype(np.int32))
+    await drive(dd_hot, warm)  # cap-growth re-traces settle OUTSIDE
+    for _ in range(rounds):
+        elapsed = await drive(dd_hot, ticks)
+        hot_rounds.append(round(2 * n_src * ticks / elapsed, 1))
+    hot_rate = max(hot_rounds)
+    burn_hot, prev_counts = interval_latency_burn(
+        engine, mc.slo_latency_error_budget, prev_counts,
+        spt=2 * n_src / hot_rate)
+    caps_hot = dict(engine.exchange.cap_gauges()) \
+        if engine.exchange is not None else {}
+
+    # ---- 3. the controller closes the loop ---------------------------
+    ctrl = RebalanceController(engine=engine, config=RebalanceConfig(
+        enabled=True, trigger_share=0.3, hysteresis_intervals=2,
+        cooldown_intervals=0, move_budget=hot_pool_n,
+        min_interval_msgs=1024))
+    detect_interval = None
+    calm = 0
+    for interval in range(12):
+        await drive(dd_hot, 2)
+        moved = await ctrl.run_once()
+        if moved and detect_interval is None:
+            detect_interval = interval
+        calm = calm + 1 if (detect_interval is not None
+                            and moved == 0) else 0
+        if calm >= 2:
+            break
+    rows, _ = engine.arenas["RouteSink"].lookup_rows(pool)
+    pool_spread = np.bincount(
+        rows.astype(np.int64)
+        // engine.arenas["RouteSink"].shard_capacity,
+        minlength=n_dev)
+
+    # ---- 4. recovered rate (same hot pattern, migrated placement) ----
+    # extra warm: the shrink-patience window + the tighter-cap re-trace
+    # must land outside the measured rounds
+    recovered_rate, spt_r = await measure(
+        hot_dst, warm + engine.config.exchange_shrink_patience)
+    burn_recovered, prev_counts = interval_latency_burn(
+        engine, mc.slo_latency_error_budget, prev_counts, spt=spt_r)
+    caps_recovered = dict(engine.exchange.cap_gauges()) \
+        if engine.exchange is not None else {}
+
+    # ---- exactness: conservation + placement invariant ---------------
+    sink_arena = engine.arenas["RouteSink"]
+    srows, sfound = sink_arena.lookup_rows(sinks)
+    assert sfound.all()
+    received = int(np.asarray(
+        sink_arena.state["received"])[srows].astype(np.int64).sum())
+    conservation_exact = bool(received == injected_lanes)
+    mesh_check = check_mesh_single_activation(engine)
+
+    out = {
+        "workload": "rebalance",
+        "smoke": smoke,
+        "mesh_devices": n_dev,
+        "sizes": {"sources": n_src, "sinks": n_sink,
+                  "hot_pool": int(len(pool)), "zipf_exponent": hot_exp,
+                  "ticks_per_round": ticks, "rounds": rounds},
+        "uniform_msgs_per_sec": round(uniform_rate, 1),
+        "hot_msgs_per_sec": hot_rate,
+        "hot_rounds_msgs_per_sec": hot_rounds,
+        "collapse_ratio": round(hot_rate / uniform_rate, 4),
+        "collapse_observed": bool(hot_rate / uniform_rate <= 0.8),
+        "recovered_msgs_per_sec": round(recovered_rate, 1),
+        "recovery_ratio": round(recovered_rate / uniform_rate, 4),
+        "recovery_met": bool(recovered_rate / uniform_rate >= 0.9),
+        "slo": {
+            "budget_s": round(budget, 6),
+            "error_budget": mc.slo_latency_error_budget,
+            "burn_hot": round(burn_hot, 2),
+            "burn_recovered": round(burn_recovered, 2),
+            "slo_recovered": bool(burn_hot > 1.0
+                                  and burn_recovered <= 1.0),
+        },
+        "controller": {
+            "detect_interval": detect_interval,
+            "grains_moved": ctrl.grains_moved,
+            "moves_applied": ctrl.moves_applied,
+            "max_move_pause_s": round(ctrl.max_move_pause_s, 4),
+            "pool_shard_spread": pool_spread.tolist(),
+            "migration_pins": len(
+                engine.arenas["RouteSink"]._shard_override),
+            "decisions": list(ctrl.decisions),
+            **ctrl.planner.snapshot(),
+        },
+        "exchange_caps": {"hot": caps_hot, "recovered": caps_recovered},
+        "delivery_conservation_exact": conservation_exact,
+        "mesh_single_activation": mesh_check["ok"],
+        "ab_contract": "controller-OFF = the sustained hot_rounds "
+                       "collapse; controller-ON = the SAME pattern "
+                       "after the controller's own decisions; both "
+                       "against the uniform baseline on this rig, "
+                       "compile-settled, best-of-round",
+    }
+    try:
+        from orleans_tpu.perfgate import run_gate
+        out["perfgate"] = run_gate("PERF_BASELINE.json", artifact=out,
+                                   artifact_name="<this run>",
+                                   family="rebalance")
+    except Exception as exc:  # noqa: BLE001 — same degrade as _guard
+        out["perfgate"] = {"status": "error",
+                           "error": f"{type(exc).__name__}: {exc}"}
+    if smoke:
+        if not conservation_exact:
+            raise RuntimeError(
+                f"rebalance smoke: delivery conservation broke "
+                f"({received} received vs {injected_lanes} injected)")
+        if not out["collapse_observed"]:
+            raise RuntimeError(
+                f"rebalance smoke: no collapse "
+                f"(ratio {out['collapse_ratio']})")
+        if not out["recovery_met"]:
+            raise RuntimeError(
+                f"rebalance smoke: recovery "
+                f"{out['recovery_ratio']} < 0.9x uniform")
+        if not out["slo"]["slo_recovered"]:
+            raise RuntimeError(
+                f"rebalance smoke: slo burn did not recover "
+                f"({out['slo']})")
+        if ctrl.grains_moved == 0:
+            raise RuntimeError("rebalance smoke: controller never acted")
+    return out
+
+
 async def _trace_overhead_section(smoke: bool) -> dict:
     """The tracing-plane cost proof: the SAME host-path RPC workload with
     tracing disabled (the baseline — by definition 0% overhead) vs
@@ -3763,7 +4003,7 @@ def main() -> None:
                                  "degraded", "collection", "metrics",
                                  "profile", "multichip", "latency",
                                  "attribution", "streams", "durability",
-                                 "rpc"),
+                                 "rpc", "rebalance"),
                         default="presence")
     parser.add_argument("--no-slab-aggregation", action="store_true",
                         help="cluster workload: disable the sender-side "
@@ -3800,12 +4040,12 @@ def main() -> None:
         from orleans_tpu.chaos.report import main as chaos_main
         sys.exit(chaos_main(["--seed", "1234", "--repeat", "2"]))
 
-    if args.workload == "multichip" \
+    if args.workload in ("multichip", "rebalance") \
             and os.environ.get("ORLEANS_TPU_MULTICHIP_TPU") != "1":
-        # the tier needs an 8-device mesh; on a 1-device (tunneled) rig
-        # re-exec on the virtual CPU platform exactly like the driver's
-        # dryrun.  ORLEANS_TPU_MULTICHIP_TPU=1 skips the dance on a real
-        # multi-device accelerator.
+        # these tiers need an 8-device mesh; on a 1-device (tunneled)
+        # rig re-exec on the virtual CPU platform exactly like the
+        # driver's dryrun.  ORLEANS_TPU_MULTICHIP_TPU=1 skips the dance
+        # on a real multi-device accelerator.
         import subprocess
 
         import __graft_entry__ as graft
@@ -3814,7 +4054,7 @@ def main() -> None:
             env["ORLEANS_TPU_DRYRUN_CHILD"] = "1"
             here = os.path.dirname(os.path.abspath(__file__))
             argv = [sys.executable, os.path.abspath(__file__),
-                    "--workload", "multichip"] \
+                    "--workload", args.workload] \
                 + (["--smoke"] if args.smoke else [])
             sys.exit(subprocess.run(argv, env=env, cwd=here).returncode)
 
@@ -4285,6 +4525,9 @@ def main() -> None:
     async def run_rpc() -> dict:
         return await _rpc_tier(args.smoke)
 
+    async def run_rebalance() -> dict:
+        return await _rebalance_tier(args.smoke)
+
     runners = {"presence": run, "chirper": run_chirper,
                "gpstracker": run_gps, "twitter": run_twitter,
                "helloworld": run_hello, "cluster": run_cluster,
@@ -4292,7 +4535,8 @@ def main() -> None:
                "metrics": run_metrics, "profile": run_profile,
                "multichip": run_multichip, "latency": run_latency,
                "attribution": run_attribution, "streams": run_streams,
-               "durability": run_durability, "rpc": run_rpc}
+               "durability": run_durability, "rpc": run_rpc,
+               "rebalance": run_rebalance}
     result = asyncio.run(runners[args.workload]())
     # every artifact carries its rig: perfgate warns when comparing
     # rounds measured on differing rigs instead of silently banding them
@@ -4345,6 +4589,11 @@ def main() -> None:
         # DURABILITY_r*.json)
         with open("DURABILITY_BENCH.json", "w") as f:
             f.write(json.dumps(result, indent=1) + "\n")
+    if args.workload == "rebalance":
+        # the structured closed-loop-rebalance artifact (perfgate
+        # --family rebalance falls back to it)
+        with open("REBALANCE_BENCH.json", "w") as f:
+            json.dump(result, f, indent=1, default=str)
     if args.workload == "rpc":
         # the structured host-RPC artifact (perfgate --family rpc falls
         # back to it until driver rounds carry RPC_r*.json)

@@ -221,7 +221,6 @@ def check_mesh_single_activation(engine) -> Dict[str, Any]:
     granularity."""
     import numpy as np
 
-    from orleans_tpu.tensor.arena import shard_of_keys
     report: Dict[str, Any] = {"ok": True, "arenas": {}}
     for name, arena in engine.arenas.items():
         keys = arena.keys()
@@ -237,7 +236,11 @@ def check_mesh_single_activation(engine) -> Dict[str, Any]:
                 f"arena {name!r} index inconsistent: "
                 f"{int((~found).sum())} live keys fail lookup")
         shards = rows // arena.shard_capacity
-        expected = shard_of_keys(uniq, arena.n_shards)
+        # the expected shard is the stable hash OVERRIDDEN by any live
+        # migration pin (arena.home_shards) — a rebalanced grain's home
+        # IS its migrated block, and an unpinned stray is still a
+        # directory/arena disagreement
+        expected = arena.home_shards(uniq)
         strays = uniq[shards != expected]
         if len(strays):
             raise InvariantViolation(
@@ -245,7 +248,9 @@ def check_mesh_single_activation(engine) -> Dict[str, Any]:
                 f"{strays[:20].tolist()} resident outside their home "
                 f"shard block (directory/arena disagreement)")
         report["arenas"][name] = {"live": int(arena.live_count),
-                                  "n_shards": int(arena.n_shards)}
+                                  "n_shards": int(arena.n_shards),
+                                  "migration_pins":
+                                      len(arena._shard_override)}
     return report
 
 

@@ -4,7 +4,7 @@
 ``bench.py --chaos-smoke``) runs the canonical short scenario on a
 3-silo ChaosCluster — storage flakes + injected CAS conflicts + one
 NaN-poisoned slab under live traffic, then partition → heal → hard-kill
-— checks all six invariants (including the durable-state-plane
+— checks all seven invariants (including the durable-state-plane
 kill-mid-traffic recovery scenario), and emits a JSON report alongside the
 BENCH_*.json artifacts.  The report carries the (seed, plan) pair and
 the deterministic trace signature, so a failing run is replayable
@@ -215,6 +215,208 @@ async def durability_kill_scenario(seed: int,
     return report
 
 
+async def migration_storm_scenario(seed: int,
+                                   pause_bound_s: float = 2.0
+                                   ) -> Dict[str, Any]:
+    """The closed-loop rebalance plane's storm smoke: forced MASS
+    MIGRATION during traffic, at both granularities.
+
+    Leg 1 (intra-engine): seeded deposit traffic over a 4-shard-block
+    ledger arena interleaved with random mass-migration waves
+    (``engine.migrate_keys`` — shard blocks are a logical row layout,
+    so this leg is deterministic on any device count), then
+    ``check_mesh_single_activation`` (placement honors the migration
+    pins) and balances asserted EXACTLY equal to a never-migrated
+    oracle engine fed the same injection sequence — migration moves
+    rows, never state.
+
+    Leg 2 (cluster): deposit traffic over a 2-silo in-proc cluster
+    with cross-silo migration waves (override broadcast + state-slab
+    adoption), a silo JOIN mid-traffic (ring-change handoff pushes the
+    moved keys' state), and a graceful DRAIN (the leaver migrates its
+    residents out) — single-activation across survivors, zero
+    acknowledged-write loss vs the host oracle over every
+    (quiesce-acknowledged) deposit, every per-wave migration pause
+    under ``pause_bound_s`` (after a warm wave absorbs the one-time
+    kernel compiles)."""
+    import time as _time
+
+    import numpy as np
+
+    from orleans_tpu.chaos.invariants import (
+        InvariantViolation,
+        check_mesh_single_activation,
+    )
+    from orleans_tpu.config import TensorEngineConfig
+    from orleans_tpu.tensor import TensorEngine
+
+    define_chaos_ledger()
+    rng = np.random.default_rng(seed)
+    pauses: List[float] = []
+
+    def _balances(engine, keys) -> np.ndarray:
+        arena = engine.arenas["ChaosLedger"]
+        rows, found = arena.lookup_rows(keys)
+        if not found.all():
+            raise InvariantViolation(
+                f"migration storm: {int((~found).sum())} keys lost")
+        return np.asarray(arena.state["balance"])[rows]
+
+    # ---- leg 1: intra-engine mass migration under traffic -------------
+    cfg = TensorEngineConfig(tick_interval=0.0, auto_fusion_ticks=0)
+    engine = TensorEngine(config=cfg)
+    engine.n_shards = 4  # logical shard blocks (no mesh required)
+    oracle = TensorEngine(config=cfg)
+    keys = np.arange(256, dtype=np.int64)
+    total = np.zeros(256, dtype=np.int64)
+    # warm wave: the pow2 gather/scatter kernels compile once here so
+    # the measured storm pauses reflect the steady state
+    engine.send_batch("ChaosLedger", "deposit", keys,
+                      {"amount": np.zeros(256, np.int32)})
+    engine.run_tick()
+    oracle.send_batch("ChaosLedger", "deposit", keys,
+                      {"amount": np.zeros(256, np.int32)})
+    oracle.run_tick()
+    engine.migrate_keys("ChaosLedger", keys[:8],
+                        rng.integers(0, 4, 8))
+    waves = 0
+    for t in range(24):
+        amounts = rng.integers(1, 100, 256).astype(np.int32)
+        total += amounts
+        for e in (engine, oracle):
+            e.send_batch("ChaosLedger", "deposit", keys,
+                         {"amount": amounts})
+            e.run_tick()
+        if t % 4 == 1:
+            movers = rng.choice(keys, 48, replace=False)
+            dst = rng.integers(0, 4, 48)
+            t0 = _time.perf_counter()
+            engine.migrate_keys("ChaosLedger", movers, dst)
+            pauses.append(_time.perf_counter() - t0)
+            waves += 1
+    await engine.flush()
+    await oracle.flush()
+    mesh_report = check_mesh_single_activation(engine)
+    got = _balances(engine, keys)
+    want = _balances(oracle, keys)
+    if not np.array_equal(got, want) \
+            or not np.array_equal(got.astype(np.int64), total):
+        raise InvariantViolation(
+            "migration storm: migrated balances diverge from the "
+            "never-migrated oracle")
+    mesh_leg = {
+        "waves": waves,
+        "grains_migrated": int(engine.grains_migrated),
+        "pins": len(engine.arenas["ChaosLedger"]._shard_override),
+        "exact_vs_oracle": True,
+        "mesh_single_activation": mesh_report["ok"],
+    }
+
+    # ---- leg 2: cluster storm (waves + join + drain) ------------------
+    from orleans_tpu.testing.cluster import TestingCluster
+
+    cluster = await TestingCluster(n_silos=2).start()
+    cluster_leg: Dict[str, Any]
+    try:
+        ckeys = np.arange(1000, 1096, dtype=np.int64)
+        ctotal = np.zeros(len(ckeys), dtype=np.int64)
+
+        def residents(s):
+            a = s.tensor_engine.arenas.get("ChaosLedger")
+            return [] if a is None else \
+                sorted(set(a.keys().tolist()) & set(ckeys.tolist()))
+
+        async def drive(n: int) -> None:
+            nonlocal ctotal
+            for _ in range(n):
+                amounts = rng.integers(1, 50, len(ckeys)).astype(np.int32)
+                ctotal += amounts
+                cluster.silos[0].tensor_engine.send_batch(
+                    "ChaosLedger", "deposit", ckeys,
+                    {"amount": amounts})
+                await cluster.quiesce_engines()
+
+        await drive(4)
+        # warm cross-silo wave, then measured waves
+        s0, s1 = cluster.silos[0], cluster.silos[1]
+        warm = residents(s0)[:4]
+        if warm:
+            await s0.vector_router.migrate_keys_out(
+                "ChaosLedger", np.asarray(warm, np.int64), s1.address)
+        cross_moved = 0
+        for _ in range(3):
+            src, dst = (s0, s1) if rng.random() < 0.5 else (s1, s0)
+            res = residents(src)
+            if not res:
+                continue
+            movers = rng.choice(np.asarray(res, np.int64),
+                                min(16, len(res)), replace=False)
+            t0 = _time.perf_counter()
+            cross_moved += await src.vector_router.migrate_keys_out(
+                "ChaosLedger", movers, dst.address)
+            pauses.append(_time.perf_counter() - t0)
+            await drive(2)
+        # JOIN mid-traffic: ring-change handoff pushes moved state
+        s2 = await cluster.start_additional_silo()
+        await cluster.wait_for_liveness_convergence()
+        await drive(3)
+        # DRAIN mid-traffic: the leaver migrates its residents out
+        t0 = _time.perf_counter()
+        await cluster.stop_silo(s1)
+        pauses.append(_time.perf_counter() - t0)
+        await drive(3)
+        survivors = [s for s in cluster.silos if s is not s1]
+        seen: Dict[int, int] = {}
+        for s in survivors:
+            for k in residents(s):
+                seen[k] = seen.get(k, 0) + 1
+        doubled = [k for k, n in seen.items() if n > 1]
+        if doubled:
+            raise InvariantViolation(
+                f"migration storm: keys {doubled[:10]} live on "
+                f"multiple silos after join+drain")
+        if sorted(seen) != ckeys.tolist():
+            raise InvariantViolation(
+                f"migration storm: {len(ckeys) - len(seen)} keys "
+                f"resident nowhere after join+drain")
+        got = np.zeros(len(ckeys), dtype=np.int64)
+        for s in survivors:
+            a = s.tensor_engine.arenas.get("ChaosLedger")
+            res = residents(s)
+            if a is None or not res:
+                continue
+            rows, found = a.lookup_rows(np.asarray(res, np.int64))
+            vals = np.asarray(a.state["balance"])[rows]
+            idx = np.searchsorted(ckeys, np.asarray(res, np.int64))
+            got[idx] = vals
+        if not np.array_equal(got, ctotal):
+            raise InvariantViolation(
+                "migration storm: acknowledged deposits lost across "
+                "cross-silo waves / join / drain")
+        cluster_leg = {
+            "cross_silo_grains": int(cross_moved),
+            "join_adopted": len(residents(s2)),
+            "zero_acknowledged_loss": True,
+            "single_activation": True,
+        }
+    finally:
+        await cluster.stop()
+
+    worst_pause = max(pauses) if pauses else 0.0
+    if worst_pause > pause_bound_s:
+        raise InvariantViolation(
+            f"migration storm: worst per-wave pause {worst_pause:.3f}s "
+            f"exceeds the {pause_bound_s}s bound")
+    return {
+        "ok": True,
+        "mesh_leg": mesh_leg,
+        "cluster_leg": cluster_leg,
+        "migration_waves": len(pauses),
+        "worst_pause_s": round(worst_pause, 4),
+        "pause_bound_s": pause_bound_s,
+    }
+
+
 def smoke_plan(seed: int):
     """The canonical smoke scenario: finite pinned fault rules (fully
     deterministic trace signature), then partition → heal → hard-kill."""
@@ -241,7 +443,7 @@ def smoke_plan(seed: int):
 
 
 async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
-    """One full smoke run; returns the report dict (``ok`` = all six
+    """One full smoke run; returns the report dict (``ok`` = all seven
     invariants held).  Invariant violations are reported, not raised —
     the caller (CLI / bench step) decides the exit code."""
     import numpy as np
@@ -323,7 +525,7 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
         live_engine.send_batch("ChaosCounter", "poke", keys,
                                {"v": np.zeros(64, np.float32)})
 
-        # -- the six invariants ----------------------------------------
+        # -- the seven invariants ---------------------------------------
         def _run(name, result):
             invariants[name] = result
 
@@ -364,6 +566,14 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
                  await durability_kill_scenario(seed))
         except (InvariantViolation, AssertionError) as exc:
             _run("durability_accounting", {"ok": False, "error": str(exc)})
+        # the closed-loop rebalance plane's storm (seeded, its own
+        # engines + cluster — mass migration at both granularities
+        # under traffic, plus join + drain, beside the durability kill)
+        try:
+            _run("migration_storm",
+                 await migration_storm_scenario(seed))
+        except (InvariantViolation, AssertionError) as exc:
+            _run("migration_storm", {"ok": False, "error": str(exc)})
 
         # flight-recorder evidence: every silo's ring (dead silos too —
         # their in-memory spans ARE the crash evidence), correlated by
@@ -376,7 +586,7 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
         await cluster.stop()
 
     ok = all(v.get("ok") for v in invariants.values()) \
-        and len(invariants) == 6
+        and len(invariants) == 7
     return {
         "metric": "chaos_smoke",
         "ok": ok,

@@ -220,6 +220,68 @@ class ProfilerConfig:
 
 
 @dataclass
+class RebalanceConfig:
+    """Closed-loop rebalance knobs (runtime/rebalancer.py): the
+    actuator that consumes the attribution plane's HotSet / skew /
+    ``slo.*`` burn signals and ACTS — batched live migration of hot
+    grains off burning shards (engine.migrate_keys), cross-silo moves,
+    and elastic scale-out/in state handoff.  Off by default: the
+    controller changes placement, which benches/tests must opt into.
+    Live-reloadable (silo.update_config re-pushes into the running
+    controller)."""
+
+    enabled: bool = False
+    # decision cadence (seconds).  Each interval the controller diffs
+    # the attribution plane's per-shard traffic sums, judges skew
+    # against the trigger, and (past hysteresis) plans one move wave.
+    interval_s: float = 0.5
+    # interval max-shard traffic share that ARMS a move (uniform share
+    # is 1/n_shards; the effective trigger never drops below
+    # 1.25/n_shards so a balanced mesh can never be "burning")
+    trigger_share: float = 0.25
+    # consecutive over-trigger intervals before the first move — a
+    # one-interval blip (a batch boundary, a compile stall) must not
+    # shuffle grains
+    hysteresis_intervals: int = 2
+    # intervals to hold off after a move wave: the moved traffic needs
+    # time to show up in the telemetry before re-judging (convergence,
+    # not thrash)
+    cooldown_intervals: int = 2
+    # grains migrated per wave per arena — bounds both the move pause
+    # and how much placement can churn per interval
+    move_budget: int = 16
+    # hot-set entries below this traffic share never move (moving cold
+    # grains costs an epoch bump and buys nothing)
+    min_grain_share: float = 0.0005
+    # intervals with fewer messages than this are idle — no judgement,
+    # hysteresis disarms (skew over noise traffic is meaningless)
+    min_interval_msgs: int = 1024
+    # when the latency SLO burn rate exceeds this, the share trigger
+    # halves (floor 1.25/n_shards): a burning SLO justifies acting on
+    # milder skew
+    slo_burn_trigger: float = 1.0
+    # ---- cross-silo leg (clustered silos only) ----
+    # move hot grains to a less-loaded PEER silo when this silo's SLO
+    # burns and a peer has capacity headroom (placement overrides +
+    # state-slab push, tensor/router.py)
+    cross_silo: bool = False
+    # peers whose reported arena occupancy ratio exceeds this are not
+    # migration targets (satellite: the load report carries occupancy +
+    # memory headroom so the controller sees REMOTE capacity)
+    peer_occupancy_ceiling: float = 0.85
+    # ---- elastic scale-out/in (tensor/router.py + silo.stop) ----
+    # ring change (a silo JOINING): the old owner pushes moved keys'
+    # state directly to the new owner (adopt_grains slab) instead of
+    # evict-through-store-and-miss — state survives even storeless, and
+    # the new owner never pays a first-touch store read
+    handoff_migration: bool = True
+    # graceful stop: migrate every resident grain out to its post-leave
+    # ring owner BEFORE leaving membership (a draining silo hands its
+    # residents over; survivors serve them without a miss)
+    drain_migration: bool = True
+
+
+@dataclass
 class RemindersConfig:
     """(reference: GlobalConfiguration reminder service section :84)"""
 
@@ -484,6 +546,7 @@ class SiloConfig:
     tracing: TracingConfig = field(default_factory=TracingConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     profiler: ProfilerConfig = field(default_factory=ProfilerConfig)
+    rebalance: RebalanceConfig = field(default_factory=RebalanceConfig)
     reminders: RemindersConfig = field(default_factory=RemindersConfig)
     tensor: TensorEngineConfig = field(default_factory=TensorEngineConfig)
     extra: Dict[str, Any] = field(default_factory=dict)

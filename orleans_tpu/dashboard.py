@@ -299,6 +299,26 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                                                 {}).values()
                      for v in by_src.values()), default=0.0),
             },
+            # closed-loop rebalance (runtime/rebalancer.py): is the
+            # actuator acting, how much placement moved, and the worst
+            # single-wave pause any silo paid
+            "rebalance": {
+                "intervals": int(
+                    _counter_total(merged, "rebalance.intervals")),
+                "moves": int(_counter_total(merged, "rebalance.moves")),
+                "grains_moved": int(
+                    _counter_total(merged, "rebalance.grains_moved")),
+                "cross_silo_grains": int(
+                    _counter_total(merged, "rebalance.cross_silo_grains")),
+                "migrations": int(
+                    _counter_total(merged, "rebalance.migrations")),
+                "migrated_grains": int(
+                    _counter_total(merged, "rebalance.migrated_grains")),
+                "max_move_pause_s": max(
+                    (v for by_src in gauges.get("rebalance.move_pause_s",
+                                                {}).values()
+                     for v in by_src.values()), default=0.0),
+            },
             "latency_ticks": latency,
             "latency_budget_s": budget,
             "seconds_per_tick": round(spt, 6),
@@ -417,6 +437,16 @@ def render_text(view: Dict[str, Any]) -> str:
             + (f", restored {du['restored_rows']} rows"
                f" + replayed {du['replayed_lanes']} lanes"
                if du.get("restored_rows") else ""))
+    rb = c.get("rebalance", {})
+    if rb.get("migrations") or rb.get("intervals"):
+        lines.append(
+            f"rebalance: {rb.get('moves', 0)} waves / "
+            f"{rb.get('grains_moved', 0)} grains moved"
+            f" (+{rb.get('cross_silo_grains', 0)} cross-silo), "
+            f"{rb.get('migrations', 0)} migrations total "
+            f"({rb.get('migrated_grains', 0)} grains), "
+            f"worst pause {rb.get('max_move_pause_s', 0.0):.4f}s over "
+            f"{rb.get('intervals', 0)} intervals")
     pl = c.get("pipeline", {})
     if pl.get("overlap_s") or pl.get("inflight") \
             or pl.get("donation_fallbacks"):

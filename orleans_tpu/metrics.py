@@ -123,7 +123,16 @@ for _n, _u, _d in (
         ("slab_fragments", "fragments",
          "pre-aggregation slab fragments offered to senders"),
         ("slab_frames", "frames", "post-aggregation wire frames sent"),
-        ("slab_bounces", "slabs", "slab frames bounced by byte caps")):
+        ("slab_bounces", "slabs", "slab frames bounced by byte caps"),
+        ("grains_migrated_out", "grains",
+         "grains live-migrated to peer silos (placement override + "
+         "adopt_grains state slab)"),
+        ("grains_adopted", "grains",
+         "live-migrated grains adopted from peers (state landed, no "
+         "store read)"),
+        ("adopt_conflicts", "grains",
+         "adoption slab entries already live locally (first-writer-"
+         "wins; the single-activation race surfaced, never doubled)")):
     declare(f"router.{_n}", KIND_COUNTER, _u, _d)
 declare("router.slab_merge_ratio", KIND_GAUGE, "ratio",
         "fragments per wire frame (>1 = sender aggregation engaged)")
@@ -390,6 +399,33 @@ declare("slo.drop_error_budget", KIND_GAUGE, "ratio",
 declare("slo.healthy", KIND_GAUGE, "bool",
         "1 when every burn rate is within budget on this silo, else 0 "
         "— the dashboard's one-look cluster-health answer")
+
+# -- closed-loop rebalance (runtime/rebalancer.py; dashboard row) ------------
+declare("rebalance.intervals", KIND_COUNTER, "intervals",
+        "controller decision intervals run (signals read + judged)")
+declare("rebalance.moves", KIND_COUNTER, "waves",
+        "shard-leg move waves applied (one batched migrate_keys per "
+        "wave)")
+declare("rebalance.grains_moved", KIND_COUNTER, "grains",
+        "grains the controller migrated between device-shard blocks")
+declare("rebalance.cross_silo_grains", KIND_COUNTER, "grains",
+        "grains the controller migrated to a peer silo (placement "
+        "override + state-slab push)")
+declare("rebalance.skipped", KIND_COUNTER, "intervals",
+        "intervals the controller judged and chose NOT to act (label "
+        "'reason': idle / below_trigger / hysteresis / cooldown / "
+        "no_candidates — the convergence-not-thrash counters)")
+declare("rebalance.trigger_share", KIND_GAUGE, "ratio",
+        "the burning shard's interval traffic share at the last applied "
+        "move (what the controller acted on)")
+declare("rebalance.move_pause_s", KIND_GAUGE, "seconds",
+        "worst single migration wave pause so far (the bounded-pause "
+        "contract the chaos storm asserts)")
+declare("rebalance.migrations", KIND_COUNTER, "waves",
+        "batched live-migration operations on this engine from ANY "
+        "source (controller, ring-change handoff, drain)")
+declare("rebalance.migrated_grains", KIND_COUNTER, "grains",
+        "grains live-migrated on this engine from any source")
 
 # -- host control path (stats.SiloMetrics mirror) ----------------------------
 declare("host.requests_sent", KIND_COUNTER, "requests",

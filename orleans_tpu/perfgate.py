@@ -100,6 +100,8 @@ FAMILIES: Dict[str, Tuple[str, str, Optional[str]]] = {
     "durability": ("DURABILITY", "durability_metrics",
                    "DURABILITY_BENCH.json"),
     "rpc": ("RPC", "rpc_metrics", "RPC_BENCH.json"),
+    "rebalance": ("REBALANCE", "rebalance_metrics",
+                  "REBALANCE_BENCH.json"),
 }
 
 

@@ -86,6 +86,12 @@ class Catalog:
         self._collector_task: Optional[asyncio.Task] = None
         self.deactivations_count = 0
         self.activations_count = 0
+        self.migrations_count = 0
+        # grains mid-migration (migrate_activation): local re-creation
+        # holds until the move settles, else a message arriving between
+        # the directory unregister and the target's registration would
+        # re-activate the grain HERE and the target would lose the race
+        self._migrations_pending: Dict[GrainId, asyncio.Future] = {}
 
     @property
     def runtime(self):
@@ -116,6 +122,15 @@ class Catalog:
             if (old.state == ActivationState.DEACTIVATING
                     and old.deactivation_task is not None):
                 await asyncio.shield(old.deactivation_task)
+        pending = self._migrations_pending.get(grain_id)
+        if pending is not None:
+            # mid-migration: the new home registers between our
+            # unregister and this create — wait for the move to settle,
+            # then defer to wherever the directory says it landed
+            await asyncio.shield(pending)
+            addr = await self.silo.grain_directory.full_lookup(grain_id)
+            if addr is not None and addr.silo != self.silo.address:
+                raise DuplicateActivationError(addr)
         return await self.create_activation(grain_id)
 
     async def create_activation(self, grain_id: GrainId) -> ActivationData:
@@ -253,6 +268,39 @@ class Catalog:
             pass
         act.state = ActivationState.INVALID
         self.directory.remove(act)
+        # live migration (migrate_activation): the new home activates
+        # HERE — after the old registration is gone (its register_single
+        # can win) and BEFORE the stragglers reroute (they then resolve
+        # straight to the target instead of racing placement).  State
+        # is persisted first so the target's activation read sees this
+        # activation's final state — the handoff-fence ordering at
+        # host-grain granularity.
+        target = getattr(act, "migration_target", None)
+        if target is not None:
+            bridge = getattr(act.grain_instance, "_storage", None)
+            try:
+                if bridge is not None and bridge.provider is not None:
+                    await bridge.write_state()
+            except Exception:
+                # surfaced, not swallowed: a silently-failed final
+                # persist would hand the new home STALE storage state
+                # with zero diagnostic.  The migration still proceeds —
+                # the last successful persist is what any deactivation
+                # path would have left behind anyway.
+                self.silo.logger.warn(
+                    f"migration of {act.grain_id}: final state persist "
+                    f"failed — the new home reads the last successful "
+                    f"write", code=2933)
+            try:
+                await self.silo.system_rpc(target, "catalog",
+                                           "activate_grain",
+                                           (act.grain_id,))
+            except Exception:
+                # stragglers fall back to ordinary placement
+                self.silo.logger.warn(
+                    f"migration of {act.grain_id}: proactive "
+                    f"activation on {target} failed — next call "
+                    f"re-places the grain", code=2934)
         for cb in act.on_destroyed:
             cb()
         # reroute any stragglers that queued during deactivation
@@ -261,6 +309,50 @@ class Catalog:
             msg, _ = act.waiting.popleft()
             msg.target_activation = None
             self.silo.dispatcher.resend_message(msg)
+
+    # -- live migration (deactivate-with-state-handoff → reactivate) --------
+
+    async def migrate_activation(self, grain_id: GrainId,
+                                 target_silo) -> bool:
+        """Live migration of a host-path activation: deactivate here
+        (through ``_deactivate``, which BUMPS ``deactivations_count`` —
+        the host path's eviction epoch: the batched RPC plane's
+        pre-resolved invoke tables key their (activation, bound-method)
+        cache on it, so no coalesced window ever invokes the dead
+        activation), persist the final state once every in-flight turn
+        has drained, then proactively reactivate on ``target_silo`` so
+        the next call re-resolves to the grain's new home instead of
+        paying a fresh placement decision.  Returns True when the new
+        home is registered."""
+        if target_silo == self.silo.address:
+            return False
+        act = self.get_activation(grain_id)
+        if act is None:
+            return False
+        # the hint _deactivate honors: persist state, then activate on
+        # the target BETWEEN directory unregister and the straggler
+        # reroute — queued/in-flight calls resolve straight to the new
+        # home instead of racing a fresh placement decision
+        act.migration_target = target_silo
+        settled: asyncio.Future = \
+            asyncio.get_running_loop().create_future()
+        self._migrations_pending[grain_id] = settled
+        try:
+            self.schedule_deactivation(act)
+            if act.deactivation_task is None:
+                # not VALID (racing create/deactivate): nothing was
+                # scheduled — clear the hint, or an unrelated
+                # deactivation hours later would ship the grain to a
+                # target no rebalance decision asked for
+                act.migration_target = None
+                return False
+            await asyncio.shield(act.deactivation_task)
+        finally:
+            self._migrations_pending.pop(grain_id, None)
+            settled.set_result(None)
+        self.migrations_count += 1
+        addr = await self.silo.grain_directory.full_lookup(grain_id)
+        return addr is not None and addr.silo == target_silo
 
     async def deactivate_all(self) -> None:
         """Graceful shutdown: deactivate everything

@@ -42,6 +42,15 @@ class SiloRuntimeStatistics:
     # detection signal ROADMAP item 4's rebalancer consumes.  Same
     # broadcast, same reasoning; empty when attribution is off.
     hot_set: Optional[list] = None
+    # per-arena occupancy {type: {"live", "capacity"}} — the rebalance
+    # controller's REMOTE-capacity signal: a cross-silo move needs to
+    # know the target can absorb the grains, and gauges only cover the
+    # local silo.  None when the tensor plane is off.
+    arena_occupancy: Optional[dict] = None
+    # device-HBM headroom ratio from the memory ledger (None = the
+    # backend exposes no memory stats): a peer below its low watermark
+    # is no migration target no matter how idle it looks
+    memory_headroom: Optional[float] = None
 
 
 def collect_silo_statistics(silo) -> SiloRuntimeStatistics:
@@ -52,9 +61,17 @@ def collect_silo_statistics(silo) -> SiloRuntimeStatistics:
     enqueued = sum(len(a.waiting)
                    for a in silo.catalog.directory.by_activation.values())
     tensor_rows = 0
+    arena_occupancy = None
+    memory_headroom = None
     if silo.tensor_engine is not None:
-        tensor_rows = sum(a.live_count
-                          for a in silo.tensor_engine.arenas.values())
+        eng = silo.tensor_engine
+        tensor_rows = sum(a.live_count for a in eng.arenas.values())
+        # remote-capacity signal for the rebalance controller: host-side
+        # counters only — no device transfer on the broadcast path
+        arena_occupancy = {name: {"live": int(a.live_count),
+                                  "capacity": int(a.capacity)}
+                           for name, a in eng.arenas.items()}
+        memory_headroom = eng.memledger.snapshot().get("headroom")
     metrics = silo.collect_metrics() if silo.config.metrics.enabled \
         else None
     return SiloRuntimeStatistics(
@@ -69,6 +86,8 @@ def collect_silo_statistics(silo) -> SiloRuntimeStatistics:
         # moves every tick, so a live read here would be an ungated
         # blocking device fetch per broadcast
         hot_set=silo.hot_set(),
+        arena_occupancy=arena_occupancy,
+        memory_headroom=memory_headroom,
     )
 
 

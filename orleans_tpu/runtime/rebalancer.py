@@ -1,0 +1,440 @@
+"""Closed-loop rebalance: the actuator for the attribution plane.
+
+PR 10 built exactly the input a rebalancer needs — the HotSet contract,
+``skew.*`` per-shard traffic shares, ``slo.*`` burn rates — and until
+now a human read the dashboard and nothing acted: a Zipf hot spot pins
+one shard while the rest of the mesh idles.  This module closes the
+loop (ROADMAP item 2; reference analog: Orleans' placement + ring
+rebalance over the virtual-actor directory, MSR-TR-2014-41):
+
+* ``RebalancePlanner`` — the PURE decision core: per interval it judges
+  each arena's per-shard traffic shares against the trigger (hysteresis
+  so a one-interval blip never moves grains, cooldown so a move wave's
+  effect lands in the telemetry before re-judging, a per-interval move
+  budget so placement churn is bounded) and plans which hot grains
+  leave the burning shard for the coolest ones.  No engine, no silo —
+  the unit tests drive it with synthetic HotSet/skew fixtures.
+* ``RebalanceController`` — the wiring: diffs the attribution plane's
+  cumulative telemetry into interval signals, resolves hot keys to
+  their CURRENT shard, applies planned moves through the batched
+  live-migration primitive (``engine.migrate_keys`` — one columnar
+  gather/scatter per wave, never per-grain Python), and optionally
+  moves hot grains to a less-loaded PEER silo (the cross-silo leg,
+  tensor/router.py placement overrides + state-slab push) when this
+  silo's SLO burns and the gossiped load reports show remote capacity.
+
+Every decision is counted (``rebalance.*`` catalog rows) and kept in a
+bounded decision ring for the dashboard/flight recorder.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "ArenaSignals",
+    "Move",
+    "RebalancePlanner",
+    "RebalanceController",
+    "interval_latency_burn",
+]
+
+
+@dataclass
+class ArenaSignals:
+    """One arena's interval telemetry, as the planner consumes it."""
+
+    arena: str
+    n_shards: int
+    # traffic per shard THIS interval (cumulative diffs, clamped >= 0)
+    interval_shard_msgs: np.ndarray
+    # hot-set entries with their key's CURRENT shard resolved:
+    # [{"key", "msgs", "share", "shard"}] sorted hottest-first
+    hot: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class Move:
+    """One planned wave: ``keys[i]`` migrates to ``dst_shards[i]``."""
+
+    arena: str
+    keys: np.ndarray
+    dst_shards: np.ndarray
+    src_shard: int
+    share: float          # the burning shard's interval share
+    trigger: float        # the effective trigger it beat
+    reason: str
+
+
+class RebalancePlanner:
+    """The pure decision core (see module docstring).  State held
+    between ``plan`` calls: consecutive-over-trigger counts (hysteresis)
+    and post-move cooldowns, both per arena."""
+
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg
+        self._over: Dict[str, int] = {}
+        self._cooldown: Dict[str, int] = {}
+        self.intervals = 0
+        self.moves_planned = 0
+        self.skipped_idle = 0
+        self.skipped_below_trigger = 0
+        self.skipped_hysteresis = 0
+        self.skipped_cooldown = 0
+        self.skipped_no_candidates = 0
+
+    def effective_trigger(self, n_shards: int, slo_burn: float) -> float:
+        """The share that arms a move: the configured trigger, halved
+        while the latency SLO burns (milder skew justifies acting when
+        the budget is already bleeding), floored at 1.25x the uniform
+        share so a balanced mesh can never read as burning."""
+        trigger = self.cfg.trigger_share
+        if slo_burn > self.cfg.slo_burn_trigger:
+            trigger = trigger / 2.0
+        return max(1.25 / max(1, n_shards), trigger)
+
+    def plan(self, signals: List[ArenaSignals],
+             slo_burn: float = 0.0) -> List[Move]:
+        self.intervals += 1
+        moves: List[Move] = []
+        for sig in signals:
+            if sig.n_shards <= 1:
+                continue
+            total = int(sig.interval_shard_msgs.sum())
+            if total < self.cfg.min_interval_msgs:
+                # idle interval: no judgement, hysteresis DISARMS (skew
+                # over noise traffic is meaningless)
+                self._over[sig.arena] = 0
+                self.skipped_idle += 1
+                continue
+            shares = sig.interval_shard_msgs / float(total)
+            burning = int(np.argmax(shares))
+            share = float(shares[burning])
+            trigger = self.effective_trigger(sig.n_shards, slo_burn)
+            if share <= trigger:
+                self._over[sig.arena] = 0
+                self.skipped_below_trigger += 1
+                continue
+            cd = self._cooldown.get(sig.arena, 0)
+            if cd > 0:
+                # cooling down after a wave: the moved traffic needs an
+                # interval or two to show in the telemetry — re-judging
+                # now would thrash (hysteresis stays ARMED: sustained
+                # skew resumes acting the moment the cooldown ends)
+                self._cooldown[sig.arena] = cd - 1
+                self.skipped_cooldown += 1
+                continue
+            over = self._over.get(sig.arena, 0) + 1
+            self._over[sig.arena] = over
+            if over < self.cfg.hysteresis_intervals:
+                self.skipped_hysteresis += 1
+                continue
+            movers = [h for h in sig.hot
+                      if h.get("shard") == burning
+                      and h.get("share", 0.0) >= self.cfg.min_grain_share]
+            movers = movers[:max(0, int(self.cfg.move_budget))]
+            if not movers:
+                self.skipped_no_candidates += 1
+                continue
+            # destinations: greedy share-aware packing — each mover
+            # (hottest first) lands on the destination with the least
+            # ACCUMULATED load (background interval share + already-
+            # assigned movers' shares).  Share-blind round-robin would
+            # re-concentrate the hot ranks (hottest + every wrap-around
+            # mate on one shard) and mint a new hot spot; the exchange
+            # cap is sized by the MAX per-destination demand, so the
+            # packing's max is what recovery is bounded by.
+            order = [int(s) for s in np.argsort(shares, kind="stable")
+                     if int(s) != burning]
+            load = {s: float(shares[s]) for s in order}
+            dst = []
+            for h in movers:
+                s = min(order, key=lambda x: load[x])
+                dst.append(s)
+                load[s] += max(0.0, float(h.get("share", 0.0)))
+            dst = np.asarray(dst, dtype=np.int64)
+            moves.append(Move(
+                arena=sig.arena,
+                keys=np.array([int(h["key"]) for h in movers],
+                              dtype=np.int64),
+                dst_shards=dst,
+                src_shard=burning,
+                share=share,
+                trigger=trigger,
+                reason=f"shard {burning} interval share "
+                       f"{share:.3f} > trigger {trigger:.3f} for "
+                       f"{over} intervals"))
+            self.moves_planned += 1
+            self._over[sig.arena] = 0
+            self._cooldown[sig.arena] = self.cfg.cooldown_intervals
+        return moves
+
+    def snapshot(self) -> Dict[str, int]:
+        return {
+            "intervals": self.intervals,
+            "moves_planned": self.moves_planned,
+            "skipped_idle": self.skipped_idle,
+            "skipped_below_trigger": self.skipped_below_trigger,
+            "skipped_hysteresis": self.skipped_hysteresis,
+            "skipped_cooldown": self.skipped_cooldown,
+            "skipped_no_candidates": self.skipped_no_candidates,
+        }
+
+
+def interval_latency_burn(engine, error_budget: float,
+                          prev_counts: Optional[np.ndarray],
+                          spt: Optional[float] = None) -> tuple:
+    """Latency-SLO burn over an INTERVAL of the device ledger (the
+    silo's ``_publish_slo`` judges the cumulative distribution; the
+    controller must react to what happened SINCE its last decision, so
+    it diffs the bucket counts).  Returns ``(burn, counts)`` where
+    ``counts`` is the cumulative array to pass back next interval.
+    ``spt`` overrides the ticks→seconds clock (the bench passes the
+    interval's own seconds-per-tick so one segment's burn is judged at
+    that segment's pace, not the run-cumulative mean).  Burn 0.0 when
+    there is no budget, no ledger, or no traffic."""
+    from orleans_tpu.metrics import bucket_bounds
+    budget = engine.config.target_tick_latency
+    if budget <= 0 or not engine.ledger.enabled or not engine.ticks_run:
+        return 0.0, prev_counts
+    counts = np.asarray(engine.ledger.fetch_counts())
+    delta = counts
+    if prev_counts is not None and prev_counts.shape == counts.shape:
+        delta = np.maximum(counts - prev_counts, 0)
+    window = int(delta.sum())
+    if window == 0 or error_budget <= 0:
+        return 0.0, counts
+    if spt is None:
+        spt = engine.tick_seconds / engine.ticks_run
+    if spt <= 0:
+        return 0.0, counts
+    bounds = bucket_bounds(1.0, engine.ledger.n_buckets)
+    over_buckets = [k for k, (lo, _hi) in enumerate(bounds)
+                    if lo * spt > budget]
+    over = int(delta[:, over_buckets].sum()) if over_buckets else 0
+    return over / window / error_budget, counts
+
+
+class RebalanceController:
+    """Wires the planner to a live engine (and optionally its silo —
+    the cross-silo leg and the ``rebalance.*`` publication need one;
+    the shard leg runs engine-only, which is how the bench drives it).
+    """
+
+    def __init__(self, silo=None, engine=None, config=None) -> None:
+        self.silo = silo
+        self.engine = engine if engine is not None \
+            else (silo.tensor_engine if silo is not None else None)
+        if self.engine is None:
+            raise ValueError("RebalanceController needs an engine")
+        self.cfg = config if config is not None \
+            else silo.config.rebalance
+        self.planner = RebalancePlanner(self.cfg)
+        # cumulative baselines diffed into interval signals
+        self._prev_shard_msgs: Dict[str, np.ndarray] = {}
+        self._prev_ledger_counts: Optional[np.ndarray] = None
+        # acted-on accounting (the planner counts decisions; these count
+        # what actually happened to the arena)
+        self.moves_applied = 0
+        self.grains_moved = 0
+        self.cross_silo_moves = 0
+        self.cross_silo_grains = 0
+        self.last_trigger_share = 0.0
+        self.last_slo_burn = 0.0
+        self.last_move_pause_s = 0.0
+        self.max_move_pause_s = 0.0
+        self.decisions: deque = deque(maxlen=64)
+        self._task: Optional[asyncio.Task] = None
+
+    # -- signal collection --------------------------------------------------
+
+    def _signals(self) -> List[ArenaSignals]:
+        eng = self.engine
+        att = eng.attribution
+        if not att.enabled:
+            return []
+        snap = att.snapshot(cache=True)
+        signals: List[ArenaSignals] = []
+        for name, a in snap["arenas"].items():
+            arena = eng.arenas.get(name)
+            if arena is None or arena.n_shards <= 1:
+                continue
+            cum = np.asarray(a["shard_msgs"], dtype=np.int64)
+            prev = self._prev_shard_msgs.get(name)
+            # clamped diff: retirement (eviction/migration moves counts
+            # from the live column to the per-key mirror) and reshard
+            # folds shrink the cumulative sums — a negative delta is
+            # accounting motion, not negative traffic
+            delta = np.maximum(cum - prev, 0) \
+                if prev is not None and prev.shape == cum.shape else cum
+            self._prev_shard_msgs[name] = cum
+            hot = []
+            if len(a["hot"]):
+                keys = np.array([int(h["key"]) for h in a["hot"]],
+                                dtype=np.int64)
+                rows, found = arena.lookup_rows(keys)
+                shards = rows.astype(np.int64) // arena.shard_capacity
+                for h, s, ok in zip(a["hot"], shards.tolist(),
+                                    found.tolist()):
+                    if ok:
+                        hot.append({**h, "shard": int(s)})
+            signals.append(ArenaSignals(
+                arena=name, n_shards=arena.n_shards,
+                interval_shard_msgs=delta, hot=hot))
+        return signals
+
+    def _slo_burn(self) -> float:
+        mc = self.silo.config.metrics if self.silo is not None \
+            else self.engine.metrics_config
+        burn, self._prev_ledger_counts = interval_latency_burn(
+            self.engine, mc.slo_latency_error_budget,
+            self._prev_ledger_counts)
+        self.last_slo_burn = burn
+        return burn
+
+    # -- one decision interval ----------------------------------------------
+
+    async def run_once(self) -> int:
+        """One closed-loop interval: read signals, plan, act.  Returns
+        grains moved (shard leg + cross-silo leg)."""
+        signals = self._signals()
+        burn = self._slo_burn()
+        moves = self.planner.plan(signals, slo_burn=burn)
+        moved_total = 0
+        for mv in moves:
+            t0 = time.perf_counter()
+            moved = self.engine.migrate_keys(mv.arena, mv.keys,
+                                            mv.dst_shards)
+            pause = time.perf_counter() - t0
+            self.last_move_pause_s = pause
+            self.max_move_pause_s = max(self.max_move_pause_s, pause)
+            self.last_trigger_share = mv.share
+            if moved:
+                self.moves_applied += 1
+                self.grains_moved += moved
+                moved_total += moved
+            self.decisions.append({
+                "t": time.time(), "leg": "shard", "arena": mv.arena,
+                "src_shard": mv.src_shard, "grains": moved,
+                "share": round(mv.share, 4),
+                "trigger": round(mv.trigger, 4),
+                "pause_s": round(pause, 6), "reason": mv.reason})
+        if self.cfg.cross_silo and self.silo is not None:
+            moved_total += await self._cross_silo_leg(burn)
+        return moved_total
+
+    async def _cross_silo_leg(self, burn: float) -> int:
+        """Move hot grains to a less-loaded PEER when this silo's SLO
+        burns and the gossiped load reports (satellite: they carry
+        arena occupancy + memory headroom) show remote capacity."""
+        silo = self.silo
+        router = silo.vector_router
+        if router is None or not hasattr(router, "migrate_keys_out") \
+                or burn <= self.cfg.slo_burn_trigger:
+            return 0
+        target = self._pick_peer()
+        if target is None:
+            return 0
+        hot = silo.hot_set()
+        if not hot:
+            return 0
+        moved = 0
+        budget = max(0, int(self.cfg.move_budget))
+        by_arena: Dict[str, List[int]] = {}
+        for h in hot[:budget]:
+            if h.get("share", 0.0) >= self.cfg.min_grain_share:
+                by_arena.setdefault(h["arena"], []).append(int(h["key"]))
+        for arena, keys in by_arena.items():
+            t0 = time.perf_counter()
+            n = await router.migrate_keys_out(
+                arena, np.asarray(keys, dtype=np.int64), target)
+            pause = time.perf_counter() - t0
+            self.last_move_pause_s = pause
+            self.max_move_pause_s = max(self.max_move_pause_s, pause)
+            if n:
+                self.cross_silo_moves += 1
+                self.cross_silo_grains += n
+                moved += n
+            self.decisions.append({
+                "t": time.time(), "leg": "silo", "arena": arena,
+                "target": str(target), "grains": n,
+                "burn": round(burn, 3), "pause_s": round(pause, 6)})
+        return moved
+
+    def _pick_peer(self) -> Optional[Any]:
+        """Least-loaded live peer by reported arena occupancy ratio,
+        skipping peers above the occupancy ceiling or with no capacity
+        report yet (the load broadcast is the only channel — the
+        controller never guesses about remote capacity)."""
+        silo = self.silo
+        lp = silo.load_publisher
+        if lp is None:
+            return None
+        best, best_ratio = None, None
+        for addr, st in lp.periodic_stats.items():
+            if addr == silo.address or not silo.is_silo_alive(addr):
+                continue
+            occ = getattr(st, "arena_occupancy", None)
+            if occ is None:
+                continue
+            live = sum(o.get("live", 0) for o in occ.values())
+            cap = sum(o.get("capacity", 0) for o in occ.values())
+            ratio = (live / cap) if cap else 0.0
+            headroom = getattr(st, "memory_headroom", None)
+            if ratio >= self.cfg.peer_occupancy_ceiling:
+                continue
+            if headroom is not None and headroom < 0.05:
+                continue
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = addr, ratio
+        return best
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is not None:
+            return
+        from orleans_tpu.utils.async_utils import spawn_in_fresh_context
+        self._task = spawn_in_fresh_context(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(max(0.01, self.cfg.interval_s))
+            try:
+                if self.cfg.enabled:
+                    await self.run_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one bad interval must
+                # not kill the loop for the silo's life (the load
+                # publisher's reasoning); the next interval re-reads
+                # fresh signals
+                if self.silo is not None:
+                    self.silo.logger.warn(
+                        "rebalance interval failed; retrying next "
+                        "interval", code=2930)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            **self.planner.snapshot(),
+            "moves_applied": self.moves_applied,
+            "grains_moved": self.grains_moved,
+            "cross_silo_moves": self.cross_silo_moves,
+            "cross_silo_grains": self.cross_silo_grains,
+            "last_trigger_share": self.last_trigger_share,
+            "last_slo_burn": self.last_slo_burn,
+            "last_move_pause_s": self.last_move_pause_s,
+            "max_move_pause_s": self.max_move_pause_s,
+            "decisions": list(self.decisions),
+        }

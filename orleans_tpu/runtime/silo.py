@@ -86,6 +86,14 @@ class _CatalogTarget:
     async def activation_count(self) -> int:
         return len(self.silo.catalog.directory)
 
+    async def activate_grain(self, grain_id) -> bool:
+        """Proactive activation — the receive half of host-grain live
+        migration (catalog.migrate_activation): the grain's new home
+        activates it (directory registers here) before any caller's
+        next message needs a placement decision."""
+        act = await self.silo.catalog.get_or_create_activation(grain_id)
+        return act is not None
+
 
 class Silo:
     """(reference: Silo.cs:59)"""
@@ -304,6 +312,15 @@ class Silo:
         # durable state plane: the last startup recovery's stats (None
         # until a recovery ran — tensor/checkpoint.py recover())
         self.last_recovery: Optional[Dict[str, Any]] = None
+        # closed-loop rebalance (runtime/rebalancer.py): consumes the
+        # attribution plane's HotSet/skew/slo.* signals and ACTS via
+        # batched live migration.  Always constructed with an engine so
+        # the config toggle can flip live; the loop itself gates on
+        # config.rebalance.enabled every interval.
+        self.rebalancer = None
+        if self.tensor_engine is not None:
+            from orleans_tpu.runtime.rebalancer import RebalanceController
+            self.rebalancer = RebalanceController(self)
         # cross-silo vector data plane: clustered silos partition vector
         # batches by ring owner and ship remote partitions as slabs
         # (tensor/router.py; single-activation enforcement)
@@ -365,6 +382,8 @@ class Silo:
             self.load_publisher.start()
         if self.cache_maintainer is not None:
             self.cache_maintainer.start()
+        if self.rebalancer is not None:
+            self.rebalancer.start()
         # bootstrap providers: app startup logic inside the live silo
         # (reference: Silo.cs:542-552 — after stream providers start)
         for name, (provider, cfg) in self.bootstrap_providers.items():
@@ -391,6 +410,8 @@ class Silo:
             self.load_publisher.stop()
         if self.cache_maintainer is not None:
             self.cache_maintainer.stop()
+        if self.rebalancer is not None:
+            self.rebalancer.stop()
         if self.tensor_engine is not None:
             await self.tensor_engine.stop(drain=graceful)
         # reminder timers must die on ANY stop — a zombie service would
@@ -422,6 +443,15 @@ class Silo:
                 # full snapshot so the recovery point equals the
                 # terminal state exactly (a graceful stop loses nothing)
                 self.tensor_engine.checkpointer.checkpoint_full()
+            if (self.vector_router is not None
+                    and self.config.rebalance.drain_migration
+                    and hasattr(self.vector_router, "drain_migrate_out")):
+                # elastic scale-IN: migrate every resident grain to its
+                # post-leave ring owner BEFORE the membership goodbye —
+                # survivors adopt the state directly (no first-touch
+                # store miss; works even storeless).  The checkpoint
+                # above remains the durable net if a push is lost.
+                await self.vector_router.drain_migrate_out()
             if self.membership_oracle is not None:
                 await self.membership_oracle.leave()
         self.catalog.stop_collector()
@@ -941,6 +971,30 @@ class Silo:
                                   {"method": method}, base=1.0,
                                   n_buckets=led.n_buckets
                                   ).set_counts(h["counts"])
+            # closed-loop rebalance: the controller's decision counters
+            # + the engine's migration totals (any source — controller,
+            # ring-change handoff, drain)
+            if self.rebalancer is not None:
+                rb = self.rebalancer.snapshot()
+                emit({"intervals": rb["intervals"],
+                      "moves": rb["moves_applied"],
+                      "grains_moved": rb["grains_moved"],
+                      "cross_silo_grains": rb["cross_silo_grains"]},
+                     None, "rebalance.")
+                for reason in ("idle", "below_trigger", "hysteresis",
+                               "cooldown", "no_candidates"):
+                    n = rb[f"skipped_{reason}"]
+                    if n:
+                        reg.counter("rebalance.skipped",
+                                    {"reason": reason}).set_total(n)
+                reg.gauge("rebalance.trigger_share").set(
+                    rb["last_trigger_share"])
+                reg.gauge("rebalance.move_pause_s").set(
+                    rb["max_move_pause_s"])
+                reg.counter("rebalance.migrations").set_total(
+                    eng.migrations)
+                reg.counter("rebalance.migrated_grains").set_total(
+                    eng.grains_migrated)
             att = eng.attribution
             if due:
                 if att.enabled:

@@ -138,6 +138,7 @@ class GrainArena:
         self.store = store
         self.evicted_count = 0
         self.restored_count = 0
+        self.migrated_count = 0
         self.n_shards = max(1, n_shards)
         # capacity must divide evenly into shard blocks
         per_shard = max(1, -(-capacity // self.n_shards))
@@ -166,6 +167,17 @@ class GrainArena:
         # host-side directory partition: key → row
         self._key_of_row = np.full(self.capacity, -1, dtype=np.int64)
         self._shard_next = np.zeros(self.n_shards, dtype=np.int64)
+        # live-migration placement pins (key → shard): keys moved off
+        # their hash-home shard by ``migrate_keys``.  Consulted by
+        # ``_activate_keys`` so an evict→reactivate cycle returns a
+        # migrated grain to its MIGRATED home, not its hash home; the
+        # rebalance controller's moves would otherwise silently undo on
+        # the first idle sweep.  Cleared by ``reshard`` — a mesh change
+        # re-homes every key and stale pins would fight the new layout.
+        self._shard_override: Dict[int, int] = {}
+        # sorted (keys, shards) mirror for home_shards' vectorized
+        # lookup; None = rebuild on next use (every pin mutation resets)
+        self._override_sorted = None
         # per-shard free lists (LIFO): rows freed by deactivation are
         # reused in place by later activations instead of repacking the
         # block — the tensor-path analog of the reference collector's
@@ -480,6 +492,68 @@ class GrainArena:
         self.last_use_tick[rows[rows >= 0]] = tick
         return rows
 
+    def home_shards(self, keys: np.ndarray) -> np.ndarray:
+        """Which shard block each key activates in: the stable hash,
+        overridden per key by any live-migration pin.  The override
+        lookup is one vectorized searchsorted over a sorted mirror of
+        the (small) pinned set, cached until the pins mutate — this
+        sits on the hot activation path, so a long-lived pin set must
+        not pay a rebuild per batch; the unpinned common case pays a
+        truthiness check."""
+        shards = shard_of_keys(keys, self.n_shards)
+        if self._shard_override:
+            if self._override_sorted is None:
+                ok = np.fromiter(self._shard_override.keys(),
+                                 dtype=np.int64,
+                                 count=len(self._shard_override))
+                ov = np.fromiter(self._shard_override.values(),
+                                 dtype=np.int64,
+                                 count=len(self._shard_override))
+                order = np.argsort(ok)
+                self._override_sorted = (ok[order], ov[order])
+            ok, ov = self._override_sorted
+            idx = np.minimum(np.searchsorted(ok, keys), len(ok) - 1)
+            hit = ok[idx] == keys
+            shards[hit] = ov[idx[hit]]
+        return shards
+
+    def _take_rows(self, shards: np.ndarray) -> np.ndarray:
+        """Allocate one slot per entry of ``shards`` (free-list LIFO
+        reuse first — most-recently-freed slots are the likeliest still
+        resident in device cache — then the bump pointer) WITHOUT
+        binding keys: the allocation half of ``_activate_keys``, shared
+        with ``migrate_keys`` (which must copy state into the slots
+        before the key map flips).  Callers guarantee capacity."""
+        rows = np.empty(len(shards), dtype=np.int64)
+        for s in np.unique(shards):
+            sel = np.nonzero(shards == s)[0]
+            parts = []
+            reuse = min(len(sel), len(self._free[s]))
+            if reuse:
+                parts.append(self._free[s][-reuse:])
+                self._free[s] = self._free[s][:-reuse]
+            fresh = len(sel) - reuse
+            if fresh:
+                start = int(self._shard_next[s])
+                base = s * self.shard_capacity
+                parts.append(np.arange(start, start + fresh) + base)
+                self._shard_next[s] += fresh
+            rows[sel] = np.concatenate(parts) if len(parts) > 1 \
+                else parts[0]
+        return rows
+
+    def _ensure_capacity(self, need_per_shard: np.ndarray) -> None:
+        """Grow until every shard block can absorb ``need_per_shard``
+        more rows.  Free-list slots count as available — freed rows are
+        reused in place before the bump pointer advances, so steady
+        churn (activate/evict cycles) never grows the arena."""
+        free_counts = np.array([len(f) for f in self._free],
+                               dtype=np.int64)
+        while np.any(self._shard_next
+                     + np.maximum(need_per_shard - free_counts, 0)
+                     > self.shard_capacity):
+            self._grow()  # remaps the free lists; free_counts unchanged
+
     def _activate_keys(self, keys: np.ndarray) -> None:
         if len(keys) and int(keys.min()) < 0:
             # the row map's free-slot sentinel is -1: the grain key
@@ -490,34 +564,11 @@ class GrainArena:
                 f"[0, 2**63); got {int(keys.min())}")
         if len(keys) and int(keys.max()) >= 2**31 - 1:
             self.has_wide_keys = True
-        shards = shard_of_keys(keys, self.n_shards)
-        # capacity per shard counts free-list slots as available — freed
-        # rows are reused in place before the bump pointer advances, so
-        # steady churn (activate/evict cycles) never grows the arena
-        counts = np.bincount(shards, minlength=self.n_shards)
-        free_counts = np.array([len(f) for f in self._free], dtype=np.int64)
-        while np.any(self._shard_next + np.maximum(counts - free_counts, 0)
-                     > self.shard_capacity):
-            self._grow()  # remaps the free lists; free_counts unchanged
-        for s in range(self.n_shards):
-            ks = keys[shards == s]
-            if len(ks) == 0:
-                continue
-            parts = []
-            reuse = min(len(ks), len(self._free[s]))
-            if reuse:
-                # LIFO: most-recently-freed slots first (their columns
-                # are the likeliest still resident in device cache)
-                parts.append(self._free[s][-reuse:])
-                self._free[s] = self._free[s][:-reuse]
-            fresh = len(ks) - reuse
-            if fresh:
-                start = int(self._shard_next[s])
-                base = s * self.shard_capacity
-                parts.append(np.arange(start, start + fresh) + base)
-                self._shard_next[s] += fresh
-            rows = np.concatenate(parts) if len(parts) > 1 else parts[0]
-            self._key_of_row[rows] = ks
+        shards = self.home_shards(keys)
+        self._ensure_capacity(np.bincount(shards,
+                                          minlength=self.n_shards))
+        rows = self._take_rows(shards)
+        self._key_of_row[rows] = keys
         self.live_count += len(keys)
         self._dirty = True
         if self.store is not None:
@@ -804,6 +855,93 @@ class GrainArena:
         self._dirty = True
         self.generation += 1
 
+    # -- live migration (batched deactivate-with-state-handoff) --------------
+
+    def migrate_keys(self, keys: np.ndarray, dst_shards,
+                     pin: bool = True) -> int:
+        """Batched LIVE MIGRATION: move k grains into explicit
+        destination shard blocks as ONE columnar device gather/scatter
+        per state column — never per-grain Python.  Semantically an
+        atomic deactivate-with-state-handoff → reactivate on the target
+        shard: the freed slots return to their shard free lists
+        scrubbed (the clean-on-free invariant), the eviction epoch
+        bumps — in-flight batches holding pre-move rows re-validate
+        their stamps and re-deliver through the existing miss machinery,
+        so single-activation holds throughout (a key is never resident
+        in two rows; the map flips old→new in one host step) — and
+        attribution retires the movers' counts per KEY (the eviction
+        discipline: totals survive the move, a reused slot never
+        inherits them).  ``pin`` records the move in the shard-override
+        map so an evict→reactivate cycle returns the grain to its
+        migrated home.  Generation is PRESERVED: surviving rows stay
+        put, so resolved-row caches over unmigrated keys stay valid.
+        Returns grains actually moved."""
+        self._settle_owner_chain()
+        keys = np.asarray(keys, dtype=np.int64)
+        dst = np.broadcast_to(np.asarray(dst_shards, dtype=np.int64),
+                              keys.shape).copy()
+        keys, first = np.unique(keys, return_index=True)
+        dst = dst[first]  # duplicate keys: first destination wins
+        if len(keys) and (int(dst.min()) < 0
+                          or int(dst.max()) >= self.n_shards):
+            raise ValueError(
+                f"arena {self.info.name}: migration destination shard "
+                f"out of range [0, {self.n_shards})")
+        rows, found = self.lookup_rows(keys)
+        cur = rows.astype(np.int64) // self.shard_capacity
+        sel = found & (dst != cur)
+        keys, dst = keys[sel], dst[sel]
+        if len(keys) == 0:
+            return 0
+        # capacity FIRST: _grow moves rows, so the source rows resolve
+        # after any growth (destination demand counted conservatively —
+        # the movers' own slots free only after the copy)
+        self._ensure_capacity(np.bincount(dst, minlength=self.n_shards))
+        src_rows, found = self.lookup_rows(keys)
+        assert found.all()
+        src_rows = src_rows.astype(np.int64)
+        att = self._attribution()
+        if att is not None:
+            # retire the movers' traffic per key BEFORE the move (the
+            # on_evict discipline): counts follow the KEY through the
+            # retired mirror, and the freed slot restarts at zero
+            att.on_evict(self, src_rows, keys)
+        for route in self._stream_routes():
+            # subscriptions SURVIVE a migration (unlike eviction) — the
+            # route only needs its row-addressed pull layout rebuilt
+            route.on_migrate(self, keys)
+        new_rows = self._take_rows(dst)
+        # the columnar move: one compiled gather+scatter per column.
+        # Source pads with row 0 (harmlessly gathered), destination
+        # pads with capacity (mode="drop" discards those lanes); both
+        # pad to the same pow2 so the compile set stays O(log n).
+        src_idx = jnp.asarray(_pow2_pad(src_rows, 0))
+        dst_idx = jnp.asarray(_pow2_pad(new_rows, self.capacity))
+        for name in self.info.state_fields:
+            col = self.state[name]
+            self.state[name] = col.at[dst_idx].set(col[src_idx],
+                                                   mode="drop")
+        self.last_use_dev = self.last_use_dev.at[dst_idx].set(
+            self.last_use_dev[src_idx], mode="drop")
+        # host identity flips in one step: new rows bind, old rows free
+        self.last_use_tick[new_rows] = self.last_use_tick[src_rows]
+        self._key_of_row[new_rows] = keys
+        self._key_of_row[src_rows] = -1
+        self._free_rows(src_rows)
+        home = shard_of_keys(keys, self.n_shards)
+        for k, d, h in zip(keys.tolist(), dst.tolist(), home.tolist()):
+            if pin and d != h:
+                self._shard_override[k] = d
+            else:
+                # moved back to (or landing on) its hash home: drop the
+                # pin — reactivation falls through to the stable hash
+                self._shard_override.pop(k, None)
+        self._override_sorted = None
+        self.migrated_count += len(keys)
+        self.eviction_epoch += 1
+        self._dirty = True
+        return len(keys)
+
     # -- elasticity (reference: GrainDirectoryHandoffManager.cs:141) ---------
 
     def reshard(self, n_shards: int, sharding: Optional[Any] = None) -> None:
@@ -827,6 +965,11 @@ class GrainArena:
         last_use = self.effective_last_use()[live_rows]
         host_state = self.rows_to_host(live_rows) if len(live_rows) else {}
 
+        # a mesh change re-homes EVERY key by the stable hash: stale
+        # migration pins would fight the new layout (and the rebalance
+        # controller re-derives moves from post-reshard telemetry)
+        self._shard_override = {}
+        self._override_sorted = None
         self.n_shards = max(1, n_shards)
         self.sharding = sharding
         per_shard = max(1, -(-max(self.capacity, len(keys) * 2)
@@ -913,6 +1056,12 @@ class GrainArena:
             "key_of_row": self._key_of_row.copy(),
             "last_use_tick": self.last_use_tick.copy(),
             "shard_next": self._shard_next.copy(),
+            # live-migration pins ride the cut: a restore must rebuild
+            # placement identity exactly (a migrated grain evicted and
+            # reactivated AFTER recovery still lands on its migrated
+            # shard).  int-keyed dict of small cardinality — JSON-safe.
+            "shard_override": {int(k): int(v) for k, v
+                               in self._shard_override.items()},
         }
 
     def _rebuild_free_lists(self) -> None:
@@ -959,6 +1108,9 @@ class GrainArena:
         self.generation = int(meta["generation"])
         self.eviction_epoch = int(meta["eviction_epoch"])
         self.has_wide_keys = bool(meta.get("has_wide_keys", False))
+        self._shard_override = {int(k): int(v) for k, v in
+                                meta.get("shard_override", {}).items()}
+        self._override_sorted = None
         self._init_state_columns(self.capacity)
         self.last_use_dev = self._dev_zeros_i32(self.capacity)
         self._dirty = True
@@ -1022,6 +1174,12 @@ class GrainArena:
         self._rebuild_free_lists()
         self.live_count = int((self._key_of_row >= 0).sum())
         self.eviction_epoch = int(meta["eviction_epoch"])
+        if "shard_override" in meta:
+            # migrations between pins changed placement identity: the
+            # delta's recorded pin set replaces the base snapshot's
+            self._shard_override = {int(k): int(v) for k, v in
+                                    meta["shard_override"].items()}
+            self._override_sorted = None
         self._dirty = True
         self._dev_index_stale = True
         self._dev_dense_stale = True

@@ -740,6 +740,11 @@ class TensorEngine:
         # off): the pipeline still works, but XLA can no longer
         # double-buffer state in place
         self.donation_fallbacks = 0
+        # live migration accounting (migrate_keys): batched move
+        # operations and grains moved — the rebalance controller's
+        # actuator counters, published as rebalance.* by the silo
+        self.migrations = 0
+        self.grains_migrated = 0
         self._pending_checks: List[_MissCheck] = []
         # parked cross-shard exchange overflow checks (drained with the
         # miss checks — one batched device read covers both families)
@@ -888,6 +893,29 @@ class TensorEngine:
             total += evicted
             if evicted == 0:
                 return total
+
+    def migrate_keys(self, type_name: str, keys: np.ndarray,
+                     dst_shards, pin: bool = True) -> int:
+        """Batched live migration of grains between device-shard blocks
+        (the rebalance controller's actuator — runtime/rebalancer.py):
+        one columnar gather/scatter moves k grains' rows, the eviction
+        epoch bumps so in-flight resolved batches re-validate, and the
+        move is pinned so evict→reactivate cycles honor it
+        (arena.migrate_keys).  Parked optimistic checks drain FIRST:
+        their redeliveries re-resolve against the post-move index, the
+        same at-least-once net every row-lifecycle event rides.
+        Returns grains actually moved."""
+        arena = self.arenas.get(type_name)
+        if arena is None:
+            return 0
+        if self._pending_checks or self._exchange_checks \
+                or self._fanout_checks:
+            self._drain_checks()
+        moved = arena.migrate_keys(keys, dst_shards, pin=pin)
+        if moved:
+            self.migrations += 1
+            self.grains_migrated += moved
+        return moved
 
     async def reshard(self, mesh: Optional[jax.sharding.Mesh]) -> None:
         """Re-lay every arena over a new mesh — the data-plane elasticity
@@ -2667,6 +2695,13 @@ class TensorEngine:
             "arenas": {name: a.live_count for name, a in self.arenas.items()},
             "evicted": sum(a.evicted_count for a in self.arenas.values()),
             "restored": sum(a.restored_count for a in self.arenas.values()),
+            # live migration (migrate_keys): batched moves + grains
+            # moved + per-arena placement pins still active
+            "migrations": self.migrations,
+            "grains_migrated": self.grains_migrated,
+            "migration_pins": {name: len(a._shard_override)
+                               for name, a in self.arenas.items()
+                               if a._shard_override},
             "collection": self.collector.snapshot(),
             "fragmentation": {name: round(a.fragmentation(), 4)
                               for name, a in self.arenas.items()},

@@ -136,6 +136,19 @@ class VectorRouter:
         # recurring-slab injector cache (see _inject_local)
         self._slab_injectors: Dict[Tuple, Any] = {}
         self._slab_key_counts: Dict[Tuple, int] = {}
+        # -- placement overrides (live migration across silos) -------------
+        # type_name → {key: SiloAddress}: keys the rebalance plane moved
+        # OFF their ring-hash owner.  partition() applies them after the
+        # hash, so every entry point (host batches, miss activation,
+        # slab arrivals) gets the same one answer — the directory's
+        # "exception table" for migrated vector grains.  Scoped to the
+        # current membership VIEW: any ring change clears them (keys
+        # re-home by hash; the handoff migration moves state to match).
+        self._placement: Dict[str, Dict[int, SiloAddress]] = {}
+        self._placement_arrays_cache: Dict[str, Tuple] = {}
+        self.grains_migrated_out = 0
+        self.grains_adopted = 0
+        self.adopt_conflicts = 0
         # -- handoff fence (ordering for ownership moves) ------------------
         # A ring change moves key ranges between silos, but old and new
         # owners process the change at independent times: the new owner's
@@ -189,7 +202,9 @@ class VectorRouter:
         rings short-circuit to all-local (zero hashing cost)."""
         ring = self.silo.ring
         keys = np.asarray(keys, dtype=np.int64)
-        if len(ring._members) <= 1 and self._my_index(ring.members) == 0:
+        ov = self._placement.get(type_name)
+        if len(ring._members) <= 1 and self._my_index(ring.members) == 0 \
+                and not ov:
             return np.ones(len(keys), dtype=bool), {}
         from orleans_tpu.tensor.vector_grain import vector_type
         info = vector_type(type_name)
@@ -197,6 +212,26 @@ class VectorRouter:
                                     category=int(GrainCategory.GRAIN))
         owner_idx, members = ring.owners_of_hashes(points)
         my = self._my_index(members)
+        if ov:
+            # live-migration overrides beat the hash (the directory's
+            # exception table): one vectorized membership test over the
+            # small pinned set, then per-hit rewrites
+            pk, pt = self._placement_arrays(type_name)
+            idx = np.minimum(np.searchsorted(pk, keys), len(pk) - 1)
+            hits = np.nonzero(pk[idx] == keys)[0]
+            if len(hits):
+                members = list(members)
+                midx = {m: i for i, m in enumerate(members)}
+                owner_idx = owner_idx.copy()
+                for i in hits:
+                    t = pt[int(idx[i])]
+                    j = midx.get(t)
+                    if j is None:
+                        members.append(t)
+                        j = len(members) - 1
+                        midx[t] = j
+                    owner_idx[i] = j
+                my = midx.get(self.silo.address, -1)
         local_mask = owner_idx == my
         remote: Dict[SiloAddress, np.ndarray] = {}
         if not local_mask.all():
@@ -205,6 +240,31 @@ class VectorRouter:
                     continue
                 remote[members[int(o)]] = np.nonzero(owner_idx == o)[0]
         return local_mask, remote
+
+    def _placement_arrays(self, type_name: str) -> Tuple:
+        """Sorted (keys int64[], targets list) mirror of one type's
+        placement overrides, cached until the override set mutates."""
+        cached = self._placement_arrays_cache.get(type_name)
+        ov = self._placement.get(type_name, {})
+        if cached is not None and cached[2] == len(ov):
+            return cached[0], cached[1]
+        pk = np.fromiter(ov.keys(), dtype=np.int64, count=len(ov))
+        order = np.argsort(pk)
+        pk = pk[order]
+        vals = list(ov.values())
+        pt = [vals[int(i)] for i in order]
+        self._placement_arrays_cache[type_name] = (pk, pt, len(ov))
+        return pk, pt
+
+    def register_placement(self, type_name: str, keys: np.ndarray,
+                           target: SiloAddress) -> None:
+        """Record live-migration placement overrides (idempotent; the
+        broadcast applies them on every silo so ownership has one
+        answer everywhere)."""
+        ov = self._placement.setdefault(type_name, {})
+        for k in np.asarray(keys, dtype=np.int64).tolist():
+            ov[int(k)] = target
+        self._placement_arrays_cache.pop(type_name, None)
 
     def owns_key(self, type_name: str, key: int) -> bool:
         local, _ = self.partition(type_name,
@@ -559,36 +619,301 @@ class VectorRouter:
             type_name, method, keys, _host_args(args), local_mask, remote,
             hops=hops)
 
-    # ================= handoff (ring change) ==============================
+    # ================= live migration (cross-silo) ========================
 
-    def on_ring_changed(self) -> None:
-        """Arena half of directory handoff (reference:
-        GrainDirectoryHandoffManager.cs:141): rows whose keys this silo no
-        longer owns are written back (when a store is attached) and
-        evicted; the new owner re-activates them from the store on first
-        touch (activation stage 2, Catalog.cs:731)."""
+    def _ship_adopt(self, target: SiloAddress, type_name: str,
+                    keys: np.ndarray,
+                    columns: Dict[str, np.ndarray]) -> None:
+        """One-way adopt_grains frame: a migrated partition's state slab
+        (key column + every state column, the same columnar shape the
+        checkpoint drain writes).  Sent on the same link as (and
+        therefore FIFO-before) any later handoff release, so a peer's
+        first-touch miss after the release finds the keys already
+        adopted."""
+        from orleans_tpu.ids import GrainId, SystemTargetCodes
+        from orleans_tpu.runtime.messaging import (
+            Category,
+            Direction,
+            Message,
+        )
+        self.silo.message_center.send_message(Message(
+            category=Category.SYSTEM,
+            direction=Direction.ONE_WAY,
+            sending_silo=self.silo.address,
+            sending_grain=self.silo.client_grain_id,
+            target_silo=target,
+            target_grain=GrainId.system_target(
+                int(SystemTargetCodes.VECTOR_ROUTER)),
+            method_name="adopt_grains",
+            args=(type_name, np.asarray(keys, dtype=np.int64),
+                  {n: np.asarray(c) for n, c in columns.items()},
+                  self.silo.address),
+        ))
+
+    async def adopt_grains(self, type_name: str, keys, columns,
+                           sender: SiloAddress) -> int:
+        """Receive a live-migrated partition: register the placement
+        override (this silo now OWNS these keys — the one-answer
+        contract) and land the pushed state at freshly allocated rows.
+        First-writer-wins on keys already live here (the
+        register_single discipline; counted as adopt_conflicts).  The
+        store is bypassed — a migration is a MOVE, not a re-activation:
+        reading persisted rows underneath the pushed state would
+        resurrect the old owner's last write-back over its final
+        state."""
+        keys = np.asarray(keys, dtype=np.int64)
+        eng = self.engine
+        arena = eng.arena_for(type_name)
+        self.register_placement(type_name, keys, self.silo.address)
+        _rows, found = arena.lookup_rows(keys)
+        conflicts = int(found.sum())
+        fresh = ~found
+        n = int(fresh.sum())
+        if n:
+            fidx = np.nonzero(fresh)[0]
+            store = arena.store
+            arena.store = None
+            try:
+                arena._activate_keys(keys[fidx])
+            finally:
+                arena.store = store
+            rows, ok = arena.lookup_rows(keys[fidx])
+            assert ok.all()
+            arena.scatter_restore(
+                rows.astype(np.int64),
+                {name: np.asarray(col)[fidx]
+                 for name, col in columns.items()},
+                np.zeros(n, dtype=np.int32))
+            # adopted rows stamp THIS engine's clock: the sender's tick
+            # counter is meaningless here, and "just migrated" is
+            # exactly "just touched" for the idle collector
+            arena.last_use_tick[rows] = eng.tick_number
+            eng.migrations += 1
+            eng.grains_migrated += n
+        self.grains_adopted += n
+        self.adopt_conflicts += conflicts
+        eng._wake_up()
+        # coverage report: the sender declares the move successful only
+        # when adopted + already-live accounts for EVERY key (a
+        # tensor-less stub's 0/0 must read as failure, never success)
+        return {"adopted": n, "live": conflicts}
+
+    async def place_keys(self, type_name: str, keys,
+                         target: SiloAddress) -> bool:
+        """Peer notification of a live migration: route these keys to
+        ``target`` from now on (until the next ring change re-homes
+        them by hash)."""
+        self.register_placement(type_name, np.asarray(keys, np.int64),
+                                target)
+        return True
+
+    async def migrate_keys_out(self, type_name: str, keys: np.ndarray,
+                               target: SiloAddress) -> int:
+        """Batched live migration of resident grains to a PEER silo:
+        deactivate-with-state-handoff → reactivate on the target.
+
+        Ordering closes the lost-update race without a stop-the-world
+        fence: (1) the SOURCE registers the override and, in ONE
+        synchronous block (no await — no tick can interleave), gathers
+        the movers' columns and evicts their rows WITHOUT write-back —
+        from this instant the keys are live NOWHERE, so no state can
+        diverge from the gathered slab; local/in-flight messages to
+        them miss and re-route through the override (a slab reaching
+        the target early bounces on its hop budget until adoption —
+        the diverged-ring-view backoff machinery, not a new protocol).
+        (2) the TARGET adopts override+state atomically (one rpc).
+        (3) remaining peers learn the override; late learners just pay
+        a forward hop.  Returns grains moved."""
+        eng = self.engine
+        arena = eng.arenas.get(type_name)
+        if arena is None or target == self.silo.address:
+            return 0
+        keys = np.unique(np.asarray(keys, dtype=np.int64))
+        rows, found = arena.lookup_rows(keys)
+        keys, rows = keys[found], rows[found].astype(np.int64)
+        if len(keys) == 0:
+            return 0
+        # ---- the synchronous no-divergence block ----
+        self.register_placement(type_name, keys, target)
+        columns = arena.rows_to_host(rows)
+        arena.evict_keys(keys, write_back=False)
+        # ---------------------------------------------
+        # Adoption outcome trichotomy.  A RETURNED rpc is definitive:
+        # adopted+live covering every key = success; anything else
+        # (e.g. a tensor-less stub's 0/0) = the target provably did NOT
+        # adopt → retract + re-land, no split possible.  An EXCEPTION
+        # is AMBIGUOUS (a timeout may race a late adoption), so it
+        # retries the idempotent adopt (already-live keys count as
+        # covered); if every attempt raises, the override is KEPT and
+        # the slab goes through the store when one is attached —
+        # re-landing locally after an ambiguous send is the one path
+        # that could mint a second live copy, so it never happens.
+        reply = None
+        for _attempt in range(3):
+            try:
+                reply = await self.silo.system_rpc(
+                    target, "vector_router", "adopt_grains",
+                    (type_name, keys, columns, self.silo.address))
+                break
+            except Exception:
+                reply = None
+        covered = (reply.get("adopted", 0) + reply.get("live", 0)) \
+            if isinstance(reply, dict) else -1
+        if reply is not None and covered != len(keys):
+            # definitive non-adoption: retract the override and re-land
+            # the state HERE (the gathered slab is still the only copy)
+            ov = self._placement.get(type_name, {})
+            for k in keys.tolist():
+                ov.pop(int(k), None)
+            self._placement_arrays_cache.pop(type_name, None)
+            store = arena.store
+            arena.store = None
+            try:
+                arena._activate_keys(keys)
+            finally:
+                arena.store = store
+            back, ok = arena.lookup_rows(keys)
+            assert ok.all()
+            arena.scatter_restore(back.astype(np.int64), columns,
+                                  np.zeros(len(keys), dtype=np.int32))
+            arena.last_use_tick[back] = eng.tick_number
+            self.silo.logger.warn(
+                f"migration of {len(keys)} {type_name} grains to "
+                f"{target} refused at adoption ({covered}/{len(keys)} "
+                f"covered) — retracted locally", code=2931)
+            return 0
+        if reply is None:
+            # ambiguous: the target may yet adopt.  Route stays pointed
+            # at it; the store write below is the durable net (a target
+            # that never adopts serves the keys from first-touch store
+            # reads after the next ring change re-homes them).
+            if arena.store is not None:
+                arena.store.write_many_columnar(type_name,
+                                                keys.tolist(), columns)
+            self.silo.logger.warn(
+                f"migration of {len(keys)} {type_name} grains to "
+                f"{target}: adoption rpc failed after retries — "
+                f"override kept (re-landing could double-activate); "
+                f"state {'written through the store' if arena.store is not None else 'IN LIMBO until the target adopts or the next ring change'}",
+                code=2932)
+            return 0
+        peers = [m for m in self.silo.active_silos()
+                 if m not in (self.silo.address, target)]
+        if peers:
+            await asyncio.gather(
+                *(self.silo.system_rpc(p, "vector_router", "place_keys",
+                                       (type_name, keys, target),
+                                       timeout=5.0) for p in peers),
+                return_exceptions=True)
+        eng.migrations += 1
+        eng.grains_migrated += len(keys)
+        self.grains_migrated_out += len(keys)
+        return len(keys)
+
+    async def drain_migrate_out(self) -> int:
+        """Elastic scale-IN: migrate every resident grain to its
+        POST-LEAVE ring owner before this silo says goodbye.  Survivors
+        adopt the state directly (no first-touch store miss; state
+        survives even storeless).  Owners are computed on a ring copy
+        without this silo — the same construction the survivors' rings
+        converge to once the leave lands, at which point their
+        ring-change clear re-homes the adopted keys by hash with zero
+        movement."""
+        from orleans_tpu.runtime.ring import VirtualBucketsRing
+        from orleans_tpu.tensor.vector_grain import vector_type
+        peers = [m for m in self.silo.ring.members
+                 if m != self.silo.address
+                 and self.silo.is_silo_alive(m)]
+        if not peers:
+            return 0
+        post = VirtualBucketsRing(
+            peers[0], self.silo.config.directory.buckets_per_silo)
+        for m in peers[1:]:
+            post.add_silo(m)
+        total = 0
         for type_name, arena in self.engine.arenas.items():
             keys = arena.keys()
             if len(keys) == 0:
                 continue
-            local_mask, _ = self.partition(type_name, keys)
+            info = vector_type(type_name)
+            points = ring_hash_int_keys(
+                info.type_code, keys, category=int(GrainCategory.GRAIN))
+            owner_idx, members = post.owners_of_hashes(points)
+            for o in np.unique(owner_idx):
+                if o < 0:
+                    continue
+                sel = np.nonzero(owner_idx == o)[0]
+                rows, found = arena.lookup_rows(keys[sel])
+                assert found.all()
+                self._ship_adopt(members[int(o)], type_name, keys[sel],
+                                 arena.rows_to_host(
+                                     rows.astype(np.int64)))
+                total += len(sel)
+            # no write-back: the graceful-stop checkpoint (before this)
+            # is the durable net; the pushed slabs are the live copy
+            arena.evict_keys(keys, write_back=False)
+        self.grains_migrated_out += total
+        if total:
+            self.silo.logger.info(
+                f"drain: migrated {total} resident grains to "
+                f"{len(peers)} survivors")
+        return total
+
+    # ================= handoff (ring change) ==============================
+
+    def on_ring_changed(self) -> None:
+        """Arena half of directory handoff (reference:
+        GrainDirectoryHandoffManager.cs:141): rows whose keys this silo
+        no longer owns MIGRATE to their new owner — one columnar gather
+        + one adopt_grains slab per destination, sent BEFORE this
+        silo's fence release on the same links (FIFO: the new owner
+        adopts before its first-touch misses unfence) — then evict.
+        With a store attached the write-back still runs as the durable
+        net under the push (equal state either way; a lost one-way
+        adopt frame degrades to the old evict-and-miss path, never to
+        loss).  ``rebalance.handoff_migration=False`` restores the pure
+        evict-and-miss handoff (the A/B baseline)."""
+        # placement overrides are scoped to the membership view: keys
+        # re-home by hash and the push below moves state to match
+        if self._placement:
+            self._placement.clear()
+            self._placement_arrays_cache.clear()
+        migrate = getattr(self.silo.config, "rebalance", None)
+        migrate = migrate is not None and migrate.handoff_migration
+        for type_name, arena in self.engine.arenas.items():
+            keys = arena.keys()
+            if len(keys) == 0:
+                continue
+            local_mask, remote = self.partition(type_name, keys)
             stray = keys[~local_mask]
-            if len(stray):
-                evicted = arena.evict_keys(stray)
-                if arena.store is None:
-                    # eviction preserves single-activation either way, but
-                    # without a store the rows' state cannot follow them —
-                    # same contract as the reference's storage-less grains
-                    # (deactivation discards state), surfaced loudly
-                    self.silo.logger.warn(
-                        f"handoff: evicted {evicted} {type_name} rows "
-                        "WITHOUT write-back (no VectorStore attached) — "
-                        "their state restarts from field defaults on the "
-                        "new owner", code=2911)
-                else:
-                    self.silo.logger.info(
-                        f"handoff: evicted {evicted} {type_name} rows no "
-                        f"longer owned here")
+            if not len(stray):
+                continue
+            if migrate:
+                for target, ridx in remote.items():
+                    rows, found = arena.lookup_rows(keys[ridx])
+                    assert found.all()
+                    self._ship_adopt(target, type_name, keys[ridx],
+                                     arena.rows_to_host(
+                                         rows.astype(np.int64)))
+                self.engine.migrations += 1
+                self.engine.grains_migrated += len(stray)
+                self.grains_migrated_out += len(stray)
+            evicted = arena.evict_keys(stray)
+            if arena.store is None and not migrate:
+                # eviction preserves single-activation either way, but
+                # without a store or a push the rows' state cannot
+                # follow them — same contract as the reference's
+                # storage-less grains (deactivation discards state),
+                # surfaced loudly
+                self.silo.logger.warn(
+                    f"handoff: evicted {evicted} {type_name} rows "
+                    "WITHOUT write-back (no VectorStore attached) — "
+                    "their state restarts from field defaults on the "
+                    "new owner", code=2911)
+            else:
+                self.silo.logger.info(
+                    f"handoff: {'migrated' if migrate else 'evicted'} "
+                    f"{evicted} {type_name} rows no longer owned here")
 
     def snapshot(self) -> Dict[str, Any]:
         return {
@@ -601,6 +926,11 @@ class VectorRouter:
             "slab_fragments": self.slab_fragments,
             "slab_frames": self.slab_frames,
             "slab_bounces": self.slab_bounces,
+            # live migration across silos (placement overrides +
+            # adopt_grains state slabs)
+            "grains_migrated_out": self.grains_migrated_out,
+            "grains_adopted": self.grains_adopted,
+            "adopt_conflicts": self.adopt_conflicts,
             # > 1 means sender aggregation is doing its job (fragments
             # merged per destination per drain cycle) — THE health
             # indicator for the cross-silo data plane
@@ -642,6 +972,18 @@ class HandoffFenceStub:
             f"silo has no tensor engine (ring misconfiguration — "
             f"non-tensor silos should not own vector key ranges)",
             code=2913)
+
+    async def adopt_grains(self, type_name: str, keys, columns,
+                           sender):
+        self.silo.logger.error(
+            f"dropping {len(keys)}-grain migration slab for "
+            f"{type_name}: this silo has no tensor engine (ring "
+            f"misconfiguration — non-tensor silos should not own "
+            f"vector key ranges)", code=2913)
+        return {"adopted": 0, "live": 0}
+
+    async def place_keys(self, type_name: str, keys, target) -> bool:
+        return True  # nothing routes from here; nothing to override
 
 
 class ClusterInjector:

@@ -276,6 +276,30 @@ class DeviceSubscriptions:
             self._pull_stamp = (arena.generation,
                                 arena.eviction_epoch + 1)
 
+    def on_migrate(self, arena, keys: np.ndarray) -> None:
+        """Called by the subscriber arena's LIVE-MIGRATION path (rows
+        move, grains stay live): unlike eviction the subscriptions
+        SURVIVE — host truth and the key-addressed push CSR are
+        untouched — but the pull layout's per-edge source lanes address
+        subscriber ROWS, so any migrated subscribed key dirties it for
+        rebuild at the next publish.  With no subscribed mover, only
+        the stamp advances (the on_evict discipline: the caller bumps
+        the epoch after this hook)."""
+        if arena.info.name != self.type_name:
+            return
+        self._merge_host()
+        if len(self._sub_keys_sorted) == 0:
+            return
+        idx = np.searchsorted(self._sub_keys_sorted, keys)
+        idx = np.minimum(idx, len(self._sub_keys_sorted) - 1)
+        if (self._sub_keys_sorted[idx] == keys).any():
+            self._pull_dirty = True
+        elif self._pull is not None \
+                and self._pull_stamp == (arena.generation,
+                                         arena.eviction_epoch):
+            self._pull_stamp = (arena.generation,
+                                arena.eviction_epoch + 1)
+
     # -- pull CSC (the bound fast path) --------------------------------------
 
     def bind(self, publish_keys: np.ndarray) -> None:
