@@ -22,6 +22,7 @@ from orleans_tpu.chaos.invariants import (
     check_durability_accounting,
     check_membership_convergence,
     check_single_activation,
+    check_timer_conservation,
     wait_for_at_least_once,
 )
 from orleans_tpu.chaos.plan import (
@@ -49,5 +50,6 @@ __all__ = [
     "check_durability_accounting",
     "check_membership_convergence",
     "check_single_activation",
+    "check_timer_conservation",
     "wait_for_at_least_once",
 ]

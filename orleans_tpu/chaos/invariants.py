@@ -123,6 +123,34 @@ async def check_arena_conservation(cluster, type_name: str,
     return {"ok": True, "type": type_name, "population": len(seen)}
 
 
+def check_timer_conservation(cluster, type_name: str,
+                             expected: Iterable) -> Dict[str, Any]:
+    """Armed-timer conservation: every expected ``(key, name)`` timer is
+    armed on EXACTLY one active silo's wheel — migration and ring
+    handoff may move a timer between wheels (it rides the state slab:
+    ``timers_plane.export_keys``/``adopt_keys``) but never lose one and
+    never leave it armed twice (a doubled timer would fire twice)."""
+    want = {(int(k), str(n)) for k, n in expected}
+    seen: Dict[Any, List[str]] = defaultdict(list)
+    for silo in _active_silos(cluster):
+        eng = silo.tensor_engine
+        if eng is None:
+            continue
+        for key in {k for k, _ in want}:
+            for name, _due, _period in eng.timers.armed_for(type_name,
+                                                            key):
+                if (key, name) in want:
+                    seen[(key, name)].append(silo.name)
+    missing = sorted(want - set(seen))
+    doubled = {kn: names for kn, names in seen.items() if len(names) > 1}
+    if missing or doubled:
+        raise InvariantViolation(
+            f"armed timers not conserved for {type_name!r}: "
+            f"missing={missing[:20]} ({len(missing)} total), "
+            f"doubled={doubled}")
+    return {"ok": True, "type": type_name, "armed": len(seen)}
+
+
 def check_dead_letter_accounting(cluster) -> Dict[str, Any]:
     """Nothing vanishes without a dead-letter record.
 

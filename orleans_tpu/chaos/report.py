@@ -89,6 +89,7 @@ def define_chaos_counter() -> None:
     class ChaosCounter(VectorGrain):
         total = field(jnp.float32, 0.0)
         count = field(jnp.int32, 0)
+        reminders = field(jnp.int32, 0)
 
         @batched_method
         @staticmethod
@@ -99,6 +100,19 @@ def define_chaos_counter() -> None:
                 "total": state["total"] + seg_sum(batch.args["v"],
                                                   batch.rows, n_rows),
                 "count": state["count"] + seg_sum(
+                    live.astype(jnp.int32), batch.rows, n_rows),
+            }, None, ()
+
+        @batched_method
+        @staticmethod
+        def receive_reminder(state, batch: Batch, n_rows: int):
+            # the timers-plane delivery target (a device timer refuses
+            # to arm on a type without this handler) — counts firings so
+            # chaos scenarios can oracle exactly-once delivery
+            live = (batch.rows >= 0)
+            return {
+                **state,
+                "reminders": state["reminders"] + seg_sum(
                     live.astype(jnp.int32), batch.rows, n_rows),
             }, None, ()
 

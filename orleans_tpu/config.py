@@ -287,6 +287,15 @@ class RemindersConfig:
 
     enabled: bool = True
     refresh_period: float = 30.0          # table re-read cadence
+    # delegate reminders on tensor-arena grain types (with a
+    # receive_reminder vector handler and narrow keys) to the device
+    # timers plane instead of one asyncio timer each
+    device_delegation: bool = True
+    # wall-clock → engine-tick mapping for delegated reminders: one
+    # engine tick is NOMINALLY this many seconds.  Delegated reminders
+    # fire on the tick grid; the service's pump keeps ticks flowing at
+    # this cadence while device timers are armed and the engine idles
+    tick_seconds_hint: float = 0.01
 
 
 @dataclass
@@ -513,6 +522,24 @@ class TensorEngineConfig:
     # key activation after a ring change while awaiting peers' write-back
     # releases; a dead/stalled peer must not wedge the cluster
     handoff_fence_timeout: float = 2.0
+    # device timers plane (tensor/timers_plane.py): per-tick harvest of
+    # the hierarchical timing wheel.  Off = the A/B baseline the timers
+    # bench measures against (armed timers stop firing while off; the
+    # wheel catches up on re-enable).  Live-reloadable.
+    timers_plane: bool = True
+    # wheel level widths in bits, lowest first: (8, 6, 6) = 256 one-tick
+    # buckets, 64×256-tick, 64×16384-tick (~1M-tick horizon before the
+    # overflow list).  More L0 bits = cheaper cascades, more idle bucket
+    # memory.  Takes effect for wheels built after the change.
+    timers_wheel_bits: tuple = (8, 6, 6)
+    # tick-jump size beyond which advance_to rebuilds the wheel from the
+    # live slot mirrors (O(armed)) instead of stepping tick-by-tick —
+    # idle gaps and fused windows land here
+    timers_catchup_jump: int = 4096
+    # arm/cancel rows the delta op log may hold between checkpoint cuts;
+    # overflow promotes the next timers export to a full (bounded
+    # memory, same discipline as the journal ring)
+    timers_ops_cap: int = 1 << 18
 
 
 @dataclass

@@ -262,6 +262,33 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                     for lk, by_src in gauges.get(
                         "route.exchange_cap", {}).items()},
             },
+            "timers": {
+                # device timers plane (tensor/timers_plane.py): wheel
+                # population is cluster-summed; lateness is the WORST
+                # silo's observation (a single late harvest anywhere
+                # breaks the on-time contract)
+                "armed": int(sum(
+                    v for by_src in gauges.get("timer.armed",
+                                               {}).values()
+                    for v in by_src.values())),
+                "fired": int(_counter_total(merged, "timer.fired")),
+                "re_armed": int(
+                    _counter_total(merged, "timer.re_armed")),
+                "cancelled": int(
+                    _counter_total(merged, "timer.cancelled")),
+                "migrated": int(
+                    _counter_total(merged, "timer.exported")),
+                "mean_harvest_width": round(max(
+                    (v for by_src in gauges.get(
+                        "timer.mean_harvest_width", {}).values()
+                     for v in by_src.values()), default=0.0), 3),
+                "worst_lateness_ticks": int(max(
+                    (v for by_src in gauges.get(
+                        "timer.worst_lateness_ticks", {}).values()
+                     for v in by_src.values()), default=0.0)),
+                "harvest_seconds": round(_counter_total(
+                    merged, "timer.harvest_seconds"), 6),
+            },
             "durability": {
                 # durable state plane (tensor/checkpoint.py): commit
                 # volume is cluster-summed; the age/pending gauges are
@@ -422,6 +449,15 @@ def render_text(view: Dict[str, Any]) -> str:
                 row += " budget " + ("HONORED" if ps["honored"]
                                      else "MISSED")
             lines.append(row)
+    tm = c.get("timers", {})
+    if tm.get("armed") or tm.get("fired"):
+        lines.append(
+            f"timers: {tm['armed']} armed, {tm['fired']} fired "
+            f"(+{tm.get('re_armed', 0)} re-armed, "
+            f"{tm.get('cancelled', 0)} cancelled, "
+            f"{tm.get('migrated', 0)} migrated), "
+            f"harvest width {tm.get('mean_harvest_width', 0.0)}, "
+            f"worst lateness {tm.get('worst_lateness_ticks', 0)} ticks")
     du = c.get("durability", {})
     if du.get("full_snapshots") or du.get("journal_segments") \
             or du.get("restored_rows"):

@@ -226,6 +226,35 @@ declare("stream.redeliveries", KIND_COUNTER, "rounds",
         "overflow redelivery rounds run for parked publish lanes "
         "(label 'route')")
 
+# -- device timers plane (tensor/timers_plane.py) ----------------------------
+declare("timer.armed", KIND_GAUGE, "timers",
+        "timers currently armed in the device timing wheel across all "
+        "vector types (one-shots + periodics awaiting their next due "
+        "tick)")
+declare("timer.fired", KIND_COUNTER, "timers",
+        "due timers harvested and injected as batched receive_reminder "
+        "calls (a periodic counts once per firing)")
+declare("timer.re_armed", KIND_COUNTER, "timers",
+        "periodic timers re-armed in the same harvest kernel that "
+        "fired them (phase-preserving: due += k*period)")
+declare("timer.cancelled", KIND_COUNTER, "timers",
+        "timers disarmed before firing (grain cancel or reminder "
+        "unregister)")
+declare("timer.exported", KIND_COUNTER, "timers",
+        "armed timers shipped out with live grain migration (they "
+        "re-arm on the target's wheel, relative dues preserved)")
+declare("timer.adopted", KIND_COUNTER, "timers",
+        "armed timers adopted from a migrating source silo")
+declare("timer.mean_harvest_width", KIND_GAUGE, "timers",
+        "mean fired timers per harvest since start — the batching win "
+        "over one-task-per-reminder host scheduling")
+declare("timer.worst_lateness_ticks", KIND_GAUGE, "ticks",
+        "worst observed fire lateness in engine ticks (0 = every "
+        "harvest caught its due bucket on the exact tick)")
+declare("timer.harvest_seconds", KIND_COUNTER, "seconds",
+        "host+device time spent in per-tick wheel advance/harvest — "
+        "the overhead the timers bench A/Bs against a plane-off run")
+
 # -- durable state plane (tensor/checkpoint.py) ------------------------------
 declare("ckpt.full_snapshots", KIND_COUNTER, "snapshots",
         "full-arena columnar snapshots committed durable (consistent "

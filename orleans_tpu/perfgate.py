@@ -102,6 +102,7 @@ FAMILIES: Dict[str, Tuple[str, str, Optional[str]]] = {
     "rpc": ("RPC", "rpc_metrics", "RPC_BENCH.json"),
     "rebalance": ("REBALANCE", "rebalance_metrics",
                   "REBALANCE_BENCH.json"),
+    "timers": ("TIMERS", "timers_metrics", "TIMERS_BENCH.json"),
 }
 
 
@@ -371,7 +372,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "'streams_metrics' (exactness flags use "
                              "direction 'flag'); 'rpc' compares "
                              "RPC_r*.json / RPC_BENCH.json against "
-                             "'rpc_metrics'")
+                             "'rpc_metrics'; 'timers' compares "
+                             "TIMERS_r*.json / TIMERS_BENCH.json "
+                             "against 'timers_metrics' (sample "
+                             "exactness oracles use direction 'flag', "
+                             "the <5% armed-wheel overhead bar uses "
+                             "direction 'lower')")
     parser.add_argument("--all-families", action="store_true",
                         help="evaluate EVERY family in one invocation "
                              "(the one CI gate entrypoint): combined "

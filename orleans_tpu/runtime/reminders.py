@@ -108,6 +108,15 @@ class ReminderTable:
     async def read_all(self) -> List[ReminderEntry]:
         raise NotImplementedError
 
+    async def read_range(self, lo: int, hi: int) -> List[ReminderEntry]:
+        """Rows whose ``grain_id.ring_hash()`` lands in [lo, hi] — the
+        ring-change reacquisition read (reference:
+        IReminderTable.ReadRows(begin, end)).  Backends with indexed
+        hash columns override this; the base scan keeps the contract
+        for simple stores."""
+        return [r for r in await self.read_all()
+                if lo <= r.grain_id.ring_hash() <= hi]
+
     async def upsert_row(self, entry: ReminderEntry) -> str:
         raise NotImplementedError
 
@@ -137,6 +146,10 @@ class InMemoryReminderTable(ReminderTable):
 
     async def read_all(self):
         return [replace(r) for r in self._rows.values()]
+
+    async def read_range(self, lo, hi):
+        return [replace(r) for r in self._rows.values()
+                if lo <= r.grain_id.ring_hash() <= hi]
 
     async def upsert_row(self, entry):
         etag = self._next_etag()
@@ -176,6 +189,10 @@ class MockReminderTable(ReminderTable):
         await self._lag()
         return await self.inner.read_all()
 
+    async def read_range(self, lo, hi):
+        await self._lag()
+        return await self.inner.read_range(lo, hi)
+
     async def upsert_row(self, entry):
         await self._lag()
         return await self.inner.upsert_row(entry)
@@ -192,6 +209,7 @@ class IReminderTableGrain:
     async def table_read_row(self, grain_id, name): ...
     async def table_read_rows(self, grain_id): ...
     async def table_read_all(self): ...
+    async def table_read_range(self, lo, hi): ...
     async def table_upsert_row(self, entry): ...
     async def table_remove_row(self, grain_id, name, etag): ...
 
@@ -213,6 +231,9 @@ class ReminderTableGrain(Grain, IReminderTableGrain):
 
     async def table_read_all(self):
         return await self.table.read_all()
+
+    async def table_read_range(self, lo, hi):
+        return await self.table.read_range(lo, hi)
 
     async def table_upsert_row(self, entry):
         return await self.table.upsert_row(entry)
@@ -252,11 +273,68 @@ class GrainBasedReminderTable(ReminderTable):
     async def read_all(self):
         return await self._call("table_read_all")
 
+    async def read_range(self, lo, hi):
+        return await self._call("table_read_range", lo, hi)
+
     async def upsert_row(self, entry):
         return await self._call("table_upsert_row", entry)
 
     async def remove_row(self, grain_id, name, etag):
         return await self._call("table_remove_row", grain_id, name, etag)
+
+
+# ---------------------------------------------------------------------------
+# ring-range segment arithmetic (scoped ring-change reads)
+# ---------------------------------------------------------------------------
+
+def _range_segments(ranges) -> List[Tuple[int, int]]:
+    """Flatten half-open ``RingRange``s into sorted, merged INCLUSIVE
+    ``[lo, hi]`` integer segments on ``[0, RANGE_SIZE)`` — the unit the
+    scoped ring-change read diffs and queries by."""
+    from orleans_tpu.runtime.ring import RANGE_SIZE
+    segs: List[Tuple[int, int]] = []
+    for r in ranges:
+        if r.begin == r.end:                    # full ring
+            return [(0, RANGE_SIZE - 1)]
+        if r.begin < r.end:                     # (begin, end] → [begin+1, end]
+            segs.append((r.begin + 1, r.end))
+        else:                                   # wraps past zero
+            if r.begin + 1 <= RANGE_SIZE - 1:
+                segs.append((r.begin + 1, RANGE_SIZE - 1))
+            segs.append((0, r.end))
+    segs.sort()
+    merged: List[Tuple[int, int]] = []
+    for lo, hi in segs:
+        if merged and lo <= merged[-1][1] + 1:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
+    return merged
+
+
+def _subtract_segments(cur: List[Tuple[int, int]],
+                       prev: List[Tuple[int, int]]
+                       ) -> List[Tuple[int, int]]:
+    """Parts of ``cur`` not covered by ``prev`` — the hash ranges a silo
+    GAINED in a ring change, i.e. the only rows it must read back."""
+    out: List[Tuple[int, int]] = []
+    for lo, hi in cur:
+        pieces = [(lo, hi)]
+        for plo, phi in prev:
+            nxt: List[Tuple[int, int]] = []
+            for slo, shi in pieces:
+                if phi < slo or plo > shi:      # disjoint
+                    nxt.append((slo, shi))
+                    continue
+                if slo < plo:
+                    nxt.append((slo, plo - 1))
+                if shi > phi:
+                    nxt.append((phi + 1, shi))
+            pieces = nxt
+            if not pieces:
+                break
+        out.extend(pieces)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -287,8 +365,21 @@ class LocalReminderService:
         self.retry_delay = retry_delay  # failed one-shot delivery backoff
         self.logger = TraceLogger(f"reminders.{silo.name}")
         self.local: Dict[Tuple[GrainId, str], _LocalReminder] = {}
+        # reminders handed to the device timing wheel instead of an
+        # asyncio task: (grain_id, name) → (vector type, int key, etag,
+        # periodic?) (tensor/timers_plane.py — LocalReminderService stays
+        # the registration/ownership authority, the wheel does the firing)
+        self.delegated: Dict[Tuple[GrainId, str],
+                             Tuple[str, int, str, bool]] = {}
         self.ticks_delivered = 0
+        # table-read accounting: ring changes must NOT trigger full-table
+        # reads (the regression-tested contract) — only the periodic
+        # reconcile does read_all; ring changes do scoped read_range
+        self.full_table_reads = 0
+        self.range_reads = 0
+        self._owned_segments: Optional[List[Tuple[int, int]]] = None
         self._refresh_task: Optional[asyncio.Task] = None
+        self._pump_task: Optional[asyncio.Task] = None
         self._running = False
 
     # -- lifecycle ----------------------------------------------------------
@@ -312,9 +403,16 @@ class LocalReminderService:
         if self._refresh_task is not None:
             self._refresh_task.cancel()
             self._refresh_task = None
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            self._pump_task = None
         for rem in list(self.local.values()):
             rem.task.cancel()
         self.local.clear()
+        # device-delegated timers stay armed in the wheel: on a hard
+        # kill the checkpointed wheel state IS the durable copy the
+        # recovering engine restores (exactly-once across the crash)
+        self.delegated.clear()
 
     # -- ownership ----------------------------------------------------------
 
@@ -403,13 +501,15 @@ class LocalReminderService:
         self._stop_local(grain_id, name)
 
     async def local_reminder_count(self) -> int:
-        return len(self.local)
+        return len(self.local) + len(self.delegated)
 
     # -- timers -------------------------------------------------------------
 
     def _start_local(self, entry: ReminderEntry) -> None:
         from orleans_tpu.utils.async_utils import spawn_in_fresh_context
         self._stop_local(entry.grain_id, entry.name)
+        if self._delegate_to_device(entry):
+            return
         # fresh context: a reminder registered from inside a grain turn must
         # NOT inherit that turn's call chain / activation (its ticks are new
         # top-level requests, not continuations — else deadlock detection
@@ -421,6 +521,100 @@ class LocalReminderService:
         rem = self.local.pop((grain_id, name), None)
         if rem is not None:
             rem.task.cancel()
+        dele = self.delegated.pop((grain_id, name), None)
+        if dele is not None:
+            eng = getattr(self.silo, "tensor_engine", None)
+            if eng is not None:
+                eng.timers.cancel(dele[0], dele[1], name)
+
+    # -- device delegation (tensor/timers_plane.py) -------------------------
+
+    def _delegate_to_device(self, entry: ReminderEntry) -> bool:
+        """Hand a tensor-arena grain's reminder to the device timing
+        wheel: the wheel fires ``receive_reminder`` as a batched vector
+        call inside the engine tick, so millions of armed reminders cost
+        one compare+gather per tick instead of one asyncio task each.
+        Host reminders (non-vector grains, wide keys) keep the asyncio
+        path unchanged."""
+        rcfg = getattr(getattr(self.silo, "config", None), "reminders", None)
+        if rcfg is None or not getattr(rcfg, "device_delegation", False):
+            return False
+        eng = getattr(self.silo, "tensor_engine", None)
+        if eng is None or not eng.config.timers_plane:
+            return False
+        gid = entry.grain_id
+        from orleans_tpu.tensor.vector_grain import vector_type
+        info = vector_type(gid.type_code)
+        if info is None or "receive_reminder" not in info.handlers:
+            return False
+        # only narrow integer keys fit the wheel's int32 arena columns
+        if gid.n0 != 0 or gid.key_ext is not None:
+            return False
+        key = gid.primary_key_int
+        if not (0 <= key < 2**31 - 1):
+            return False
+        # wall-clock schedule → engine ticks: the pump below advances the
+        # engine at tick_seconds_hint cadence, so a tick ≈ hint seconds
+        hint = max(rcfg.tick_seconds_hint, 1e-6)
+        due_tick = eng.tick_number + max(
+            1, round(max(0.0, entry.start_at - time.time()) / hint))
+        period_ticks = (max(1, round(entry.period / hint))
+                        if entry.period > 0 else 0)
+        eng.timers.arm(info.name, key, entry.name, due_tick, period_ticks)
+        self.delegated[entry.key] = (info.name, key, entry.etag,
+                                     period_ticks > 0)
+        self._ensure_pump()
+        return True
+
+    def _ensure_pump(self) -> None:
+        if self._pump_task is not None and not self._pump_task.done():
+            return
+        from orleans_tpu.utils.async_utils import spawn_in_fresh_context
+        self._pump_task = spawn_in_fresh_context(self._pump_loop())
+
+    async def _pump_loop(self) -> None:
+        """Advance the engine while device-delegated reminders are armed.
+        The engine's own loop idles when no batches are queued, so a
+        quiet engine would never move tick time and the wheel would
+        never fire — this pump calls run_tick directly at the hint
+        cadence (precedent: drain_queues also drives run_tick).  Also
+        reconciles fired one-shots back to the table: once the wheel has
+        fired them their row must go away, like the asyncio path's
+        remove-after-deliver."""
+        rcfg = self.silo.config.reminders
+        hint = max(rcfg.tick_seconds_hint, 1e-6)
+        try:
+            while self._running and self.delegated:
+                eng = getattr(self.silo, "tensor_engine", None)
+                if eng is None:
+                    return
+                eng.run_tick()
+                if any(eng.queues.values()):
+                    eng._wake_up()
+                for dkey, (tname, ikey, etag, periodic) in \
+                        list(self.delegated.items()):
+                    names = {n for n, _, _ in eng.timers.armed_for(tname,
+                                                                   ikey)}
+                    if dkey[1] in names:
+                        continue
+                    # gone from the wheel: a fired one-shot consumes its
+                    # durable row (the asyncio path's remove-after-
+                    # deliver); a periodic that vanished was migrated or
+                    # cancelled elsewhere — drop tracking, keep the row
+                    self.delegated.pop(dkey, None)
+                    if not periodic:
+                        try:
+                            await self.table.remove_row(dkey[0], dkey[1],
+                                                        etag)
+                        except Exception:  # noqa: BLE001 — refresh
+                            pass           # reconciles stragglers
+                await asyncio.sleep(hint)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001
+            self.logger.warn(f"device timer pump died: {exc!r}")
+        finally:
+            self._pump_task = None
 
     async def _run(self, entry: ReminderEntry) -> None:
         """Fire loop for one reminder.  Schedule is absolute
@@ -502,7 +696,7 @@ class LocalReminderService:
 
         async def guarded() -> None:
             try:
-                await self._refresh()
+                await self._refresh_ring_change()
             except Exception as exc:  # noqa: BLE001 — periodic refresh
                 self.logger.warn(      # will reconcile later
                     f"ring-change reminder refresh failed: {exc!r}")
@@ -519,6 +713,47 @@ class LocalReminderService:
             except Exception as exc:  # noqa: BLE001
                 self.logger.warn(f"reminder refresh failed: {exc!r}")
 
+    async def _refresh_ring_change(self) -> None:
+        """Scoped reacquisition on a ring change: stop timers whose hash
+        left our range with NO table I/O (pure ring math), then read
+        back ONLY the hash segments this silo gained — not the whole
+        table.  A join/leave in an N-silo cluster thus costs each silo
+        one read proportional to its range delta instead of N full-table
+        scans (the regression-tested contract; reference:
+        IReminderTable.ReadRows(begin, end)).  The periodic _refresh
+        keeps the full reconcile for everything drift-shaped."""
+        if not self._running:
+            return
+        prev = self._owned_segments
+        cur = _range_segments(self.silo.ring.my_range())
+        self._owned_segments = cur
+        # stop what moved away — no table read needed
+        for key in list(self.local) + list(self.delegated):
+            if not self._i_own(key[0]):
+                self._stop_local(*key)
+        if prev is None:
+            # no baseline to diff against yet: fall back to full
+            await self._refresh()
+            return
+        for lo, hi in _subtract_segments(cur, prev):
+            rows = await self.table.read_range(lo, hi)
+            self.range_reads += 1
+            for row in rows:
+                if not self._i_own(row.grain_id):
+                    continue  # ring moved again mid-read
+                self._reconcile_row(row)
+
+    def _reconcile_row(self, row: ReminderEntry) -> None:
+        """Start/refresh one owned row unless it is already running at
+        the current etag (asyncio task or device wheel)."""
+        cur = self.local.get(row.key)
+        if cur is not None and cur.entry.etag == row.etag:
+            return
+        dele = self.delegated.get(row.key)
+        if dele is not None and dele[2] == row.etag:
+            return
+        self._start_local(row)
+
     async def _refresh(self) -> None:
         """Reconcile local timers with the table under the current ring
         ranges (reference: LocalReminderService.ReadAndUpdateReminders
@@ -526,19 +761,22 @@ class LocalReminderService:
         if not self._running:
             return
         rows = await self.table.read_all()
+        self.full_table_reads += 1
+        self._owned_segments = _range_segments(self.silo.ring.my_range())
         owned = {r.key: r for r in rows if self._i_own(r.grain_id)}
         # stop what we no longer own or what no longer exists
-        for key in list(self.local):
+        for key in list(self.local) + list(self.delegated):
             if key not in owned:
                 self._stop_local(*key)
         # start/update what we own
-        for key, row in owned.items():
-            cur = self.local.get(key)
-            if cur is None or cur.entry.etag != row.etag:
-                self._start_local(row)
+        for row in owned.values():
+            self._reconcile_row(row)
 
     # -- stats --------------------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:
         return {"local_reminders": len(self.local),
-                "ticks_delivered": self.ticks_delivered}
+                "delegated_reminders": len(self.delegated),
+                "ticks_delivered": self.ticks_delivered,
+                "full_table_reads": self.full_table_reads,
+                "range_reads": self.range_reads}

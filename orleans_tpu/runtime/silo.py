@@ -869,6 +869,21 @@ class Silo:
                       "dropped_lanes": ss["dropped_lanes"],
                       "redeliveries": ss["redeliveries"]},
                      {"route": f"{src_t}.{src_m}"}, "stream.")
+            # device timers plane: wheel population + harvest health
+            # (the dashboard's timers row reads these)
+            tm = eng.timers.snapshot()
+            emit({"fired": tm["fired"],
+                  "re_armed": tm["re_armed"],
+                  "cancelled": tm["cancelled"],
+                  "exported": tm["exported"],
+                  "adopted": tm["adopted"],
+                  "harvest_seconds": tm["harvest_seconds"]},
+                 None, "timer.")
+            reg.gauge("timer.armed").set(float(tm["armed"]))
+            reg.gauge("timer.mean_harvest_width").set(
+                float(tm["mean_harvest_width"]))
+            reg.gauge("timer.worst_lateness_ticks").set(
+                float(tm["worst_lateness_ticks"]))
             ck = eng.checkpointer
             if ck.enabled:
                 # durable state plane: checkpoint / journal health +

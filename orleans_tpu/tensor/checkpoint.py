@@ -560,7 +560,7 @@ class _ActiveSnapshot:
     """One in-progress (pinned, draining) snapshot."""
 
     __slots__ = ("kind", "tick", "seq", "arenas", "queue", "bytes",
-                 "rows", "parts", "started")
+                 "rows", "parts", "started", "timers")
 
     def __init__(self, kind: str, tick: int, seq: int) -> None:
         self.kind = kind              # "full" | "delta"
@@ -572,6 +572,9 @@ class _ActiveSnapshot:
         self.rows = 0
         self.parts: Dict[str, List[str]] = {}
         self.started = time.perf_counter()
+        # timers-plane export pinned with the cut: (arrays, meta) for
+        # one blob, or None when nothing is armed/logged
+        self.timers: Any = None
 
 
 class CheckpointPlane:
@@ -799,6 +802,11 @@ class CheckpointPlane:
         self.last_dirty_rows = sum(
             len(a["rows"]) for a in snap.arenas.values()
             if a["meta"]["kind"] == "delta")
+        # the timers plane rides the same cut (AFTER any full
+        # promotion above — its export kind must match the snapshot's):
+        # full = compact live slots at absolute dues, delta = the
+        # arm/cancel op log since the previous cut
+        snap.timers = eng.timers.export_cut(snap.kind)
         self._active = snap
 
     def _dirty_rows(self, arena, pin, live_rows: np.ndarray) -> np.ndarray:
@@ -896,6 +904,11 @@ class CheckpointPlane:
                    {"full": None, "deltas": []})
         entry = {"seq": snap.seq, "tick": snap.tick,
                  "arenas": arenas_ref}
+        if snap.timers is not None:
+            arrays, tmeta = snap.timers
+            timers_blob = f"ckpt-{snap.seq:08d}-__timers"
+            snap.bytes += self.store.put_blob(timers_blob, arrays, tmeta)
+            entry["timers"] = timers_blob
         old_blobs: List[str] = []
         if snap.kind == "full":
             for prev in ([rec.get("full")] if rec.get("full") else []) \
@@ -903,6 +916,8 @@ class CheckpointPlane:
                 for ref in prev["arenas"].values():
                     old_blobs.extend(ref["parts"])
                     old_blobs.append(ref["meta"])
+                if prev.get("timers"):
+                    old_blobs.append(prev["timers"])
             rec = {"full": entry, "deltas": [], "tick": snap.tick}
             self._last_full_tick = snap.tick
         else:
@@ -1024,6 +1039,20 @@ class CheckpointPlane:
             for name, ref in entry["arenas"].items():
                 restored_rows += self._restore_arena_part(
                     name, ref, base=(entry is entries[0]))
+            if entry.get("timers"):
+                got = self.store.get_blob(entry["timers"])
+                if got is None:
+                    raise RuntimeError(
+                        f"manifest references missing timers blob "
+                        f"{entry['timers']} (commit-order contract "
+                        f"broken)")
+                eng.timers.restore_entry(got[0], got[1])
+        if entries:
+            # silent catch-up BEFORE journal fold-replay: fires
+            # acknowledged at/before the cut are retired (their effects
+            # are in the recovered state), everything due after the cut
+            # re-fires during replay exactly once
+            eng.timers.finish_restore(recovery_tick)
         # a mesh-shape mismatch between the recording and recovering
         # engines: the snapshot restored at the RECORDED layout — re-lay
         # onto the live mesh now (identity necessarily changes with it)

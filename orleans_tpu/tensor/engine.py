@@ -776,6 +776,13 @@ class TensorEngine:
         # out to the streams' subscribers, pull-mode when the publish
         # pattern matches the bound key set, push-mode otherwise
         self._stream_routes: Dict[Tuple[str, str], Any] = {}
+        # device timers plane (tensor/timers_plane.py): hierarchical
+        # timing wheel over per-type slot columns, harvested each tick
+        # into batched receive_reminder calls.  Always constructed —
+        # config.timers_plane gates the run_tick harvest only, so armed
+        # state survives a live toggle
+        from orleans_tpu.tensor.timers_plane import TimersPlane
+        self.timers = TimersPlane(self)
         # parked fan-out/subscription overflow checks (drained with the
         # miss checks — one batched device read covers the family)
         self._fanout_checks: List[_FanoutCheck] = []
@@ -1551,6 +1558,12 @@ class TensorEngine:
                 self.collector.run_slice(cfg.collection_pause_budget_s,
                                          cfg.collection_chunk_rows)
                 stages["collect"] += time.perf_counter() - t0
+        if cfg.timers_plane and self.timers.armed_total:
+            # harvest due timers BEFORE the rounds loop so fired
+            # batches deliver within this same tick
+            dt_tm = self.timers.advance_to(self.tick_number)
+            if dt_tm:
+                stages["timers"] += dt_tm
         if len(self._pending_checks) + len(self._exchange_checks) \
                 + len(self._fanout_checks) >= self.config.miss_check_cap:
             # bound device memory pinned by parked optimistic checks
@@ -2725,6 +2738,9 @@ class TensorEngine:
             "phases": self.profiler.snapshot(),
             "compile_attribution": self.compile_tracker.snapshot(),
             "memory": self.memledger.snapshot(),
+            # device timers plane (tensor/timers_plane.py): armed/fired
+            # counters + harvest width/lateness, all host mirrors
+            "timers": self.timers.snapshot(),
             # durable state plane (tensor/checkpoint.py): checkpoint /
             # journal health + the committed-recovery-point age
             "durability": self.checkpointer.snapshot(),

@@ -623,13 +623,15 @@ class VectorRouter:
 
     def _ship_adopt(self, target: SiloAddress, type_name: str,
                     keys: np.ndarray,
-                    columns: Dict[str, np.ndarray]) -> None:
+                    columns: Dict[str, np.ndarray],
+                    timers=None) -> None:
         """One-way adopt_grains frame: a migrated partition's state slab
         (key column + every state column, the same columnar shape the
-        checkpoint drain writes).  Sent on the same link as (and
-        therefore FIFO-before) any later handoff release, so a peer's
-        first-touch miss after the release finds the keys already
-        adopted."""
+        checkpoint drain writes) plus any armed device timers detached
+        from the movers (transport-plain payload, relative remaining
+        ticks).  Sent on the same link as (and therefore FIFO-before)
+        any later handoff release, so a peer's first-touch miss after
+        the release finds the keys already adopted."""
         from orleans_tpu.ids import GrainId, SystemTargetCodes
         from orleans_tpu.runtime.messaging import (
             Category,
@@ -647,11 +649,11 @@ class VectorRouter:
             method_name="adopt_grains",
             args=(type_name, np.asarray(keys, dtype=np.int64),
                   {n: np.asarray(c) for n, c in columns.items()},
-                  self.silo.address),
+                  self.silo.address, timers),
         ))
 
     async def adopt_grains(self, type_name: str, keys, columns,
-                           sender: SiloAddress) -> int:
+                           sender: SiloAddress, timers=None) -> int:
         """Receive a live-migrated partition: register the placement
         override (this silo now OWNS these keys — the one-answer
         contract) and land the pushed state at freshly allocated rows.
@@ -690,6 +692,11 @@ class VectorRouter:
             arena.last_use_tick[rows] = eng.tick_number
             eng.migrations += 1
             eng.grains_migrated += n
+        if timers:
+            # armed timers move WITH their grain (Orleans: a reminder
+            # survives migration): re-armed at the local clock, recorded
+            # as arm ops for this silo's next checkpoint cut
+            eng.timers.adopt_keys(type_name, timers)
         self.grains_adopted += n
         self.adopt_conflicts += conflicts
         eng._wake_up()
@@ -736,6 +743,11 @@ class VectorRouter:
         # ---- the synchronous no-divergence block ----
         self.register_placement(type_name, keys, target)
         columns = arena.rows_to_host(rows)
+        # detach armed device timers inside the same block: from this
+        # instant the source cannot fire them, and in-flight fires to
+        # the movers miss and re-route through the override like any
+        # other message — no deadline is ever stranded or doubled
+        timers = eng.timers.export_keys(type_name, keys)
         arena.evict_keys(keys, write_back=False)
         # ---------------------------------------------
         # Adoption outcome trichotomy.  A RETURNED rpc is definitive:
@@ -753,7 +765,8 @@ class VectorRouter:
             try:
                 reply = await self.silo.system_rpc(
                     target, "vector_router", "adopt_grains",
-                    (type_name, keys, columns, self.silo.address))
+                    (type_name, keys, columns, self.silo.address,
+                     timers))
                 break
             except Exception:
                 reply = None
@@ -777,6 +790,9 @@ class VectorRouter:
             arena.scatter_restore(back.astype(np.int64), columns,
                                   np.zeros(len(keys), dtype=np.int32))
             arena.last_use_tick[back] = eng.tick_number
+            if timers:
+                # the movers' timers re-land here with their state
+                eng.timers.adopt_keys(type_name, timers)
             self.silo.logger.warn(
                 f"migration of {len(keys)} {type_name} grains to "
                 f"{target} refused at adoption ({covered}/{len(keys)} "
@@ -847,7 +863,9 @@ class VectorRouter:
                 assert found.all()
                 self._ship_adopt(members[int(o)], type_name, keys[sel],
                                  arena.rows_to_host(
-                                     rows.astype(np.int64)))
+                                     rows.astype(np.int64)),
+                                 timers=self.engine.timers.export_keys(
+                                     type_name, keys[sel]))
                 total += len(sel)
             # no write-back: the graceful-stop checkpoint (before this)
             # is the durable net; the pushed slabs are the live copy
@@ -894,7 +912,9 @@ class VectorRouter:
                     assert found.all()
                     self._ship_adopt(target, type_name, keys[ridx],
                                      arena.rows_to_host(
-                                         rows.astype(np.int64)))
+                                         rows.astype(np.int64)),
+                                     timers=self.engine.timers
+                                     .export_keys(type_name, keys[ridx]))
                 self.engine.migrations += 1
                 self.engine.grains_migrated += len(stray)
                 self.grains_migrated_out += len(stray)
@@ -974,7 +994,7 @@ class HandoffFenceStub:
             code=2913)
 
     async def adopt_grains(self, type_name: str, keys, columns,
-                           sender):
+                           sender, timers=None):
         self.silo.logger.error(
             f"dropping {len(keys)}-grain migration slab for "
             f"{type_name}: this silo has no tensor engine (ring "

@@ -1,0 +1,142 @@
+"""Auction sample — time-triggered closings driven by the device timers
+plane (tensor/timers_plane.py).
+
+The classic reminder workload: every auction registers a one-shot
+"close" reminder at listing time; bids stream in as batched vector
+calls; when the due tick arrives the wheel harvests ALL auctions
+closing that tick in one compare+gather and injects a single batched
+``receive_reminder`` — thousands of simultaneous closings cost one
+kernel, not thousands of host timer callbacks (reference shape:
+Orleans auction/marketplace samples built on IRemindable +
+RegisterOrUpdateReminder).
+
+Exactness oracle: closings are deterministic in tick time, so the
+host can replay the schedule — an auction's final ``highest_bid``
+must equal the max over exactly the bids injected BEFORE its close
+tick, every auction must close exactly once (``closes == 1``), and
+the accepted/rejected bid counts must match the replay (a closed
+auction rejects every later bid; none may leak into the price).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.core.grain import batched_method
+from orleans_tpu.tensor import Batch, VectorGrain, field, vector_grain
+from orleans_tpu.tensor.vector_grain import scatter_add_rows
+
+
+@vector_grain
+class AuctionGrain(VectorGrain):
+    """One listing: open bids race a reminder-scheduled closing."""
+
+    highest_bid = field(jnp.float32, 0.0)
+    bids = field(jnp.int32, 0)         # accepted (auction still open)
+    closed = field(jnp.int32, 0)
+    closes = field(jnp.int32, 0)       # must end at exactly 1
+    late_bids = field(jnp.int32, 0)    # rejected (arrived after close)
+
+    @batched_method
+    @staticmethod
+    def bid(state, batch: Batch, n_rows: int):
+        rows, amount = batch.rows, batch.args["amount"]
+        # negative-wrap guard (see scatter_rows): padding rows read a
+        # fill of "closed" so they can never count as live
+        safe = jnp.where(rows >= 0, rows, state["closed"].shape[0])
+        open_ = state["closed"].at[safe].get(
+            mode="fill", fill_value=1) == 0
+        live = batch.mask & open_
+        ones = jnp.where(live, 1, 0).astype(jnp.int32)
+        late = jnp.where(batch.mask & ~open_, 1, 0).astype(jnp.int32)
+        return {
+            **state,
+            "highest_bid": state["highest_bid"].at[safe].max(
+                jnp.where(live, amount, -jnp.inf), mode="drop"),
+            "bids": scatter_add_rows(state["bids"], rows, ones),
+            "late_bids": scatter_add_rows(state["late_bids"], rows, late),
+        }
+
+    @batched_method
+    @staticmethod
+    def receive_reminder(state, batch: Batch, n_rows: int):
+        """The wheel's batched closing: every auction due this tick."""
+        rows = batch.rows
+        ones = jnp.where(batch.mask, 1, 0).astype(jnp.int32)
+        safe = jnp.where(rows >= 0, rows, state["closed"].shape[0])
+        return {
+            **state,
+            # max-with-0 leaves masked lanes untouched
+            "closed": state["closed"].at[safe].max(ones, mode="drop"),
+            "closes": scatter_add_rows(state["closes"], rows, ones),
+        }
+
+
+# ---------------------------------------------------------------------------
+# load generator + oracle
+# ---------------------------------------------------------------------------
+
+async def run_auction_load(engine, n_auctions: int = 10_000,
+                           n_ticks: int = 40, seed: int = 0,
+                           verify: bool = True) -> Dict[str, float]:
+    """List ``n_auctions`` with staggered close ticks, stream bids every
+    EVEN tick, close via the wheel on ODD ticks (so bid-vs-close
+    ordering inside a tick never enters the oracle), then check the
+    host-replayed schedule exactly."""
+    rng = np.random.default_rng(seed)
+    keys = np.arange(n_auctions, dtype=np.int64)
+    engine.arena_for("AuctionGrain").reserve(n_auctions)
+
+    injector = engine.make_injector("AuctionGrain", "bid", keys)
+    injector.inject({"amount": np.zeros(n_auctions, np.float32)})
+    engine.run_tick()
+    t0 = engine.tick_number
+
+    # odd relative close ticks in [3, n_ticks)
+    closes_rel = 3 + 2 * rng.integers(0, max(1, (n_ticks - 3) // 2),
+                                      n_auctions)
+    engine.timers.arm_batch("AuctionGrain", keys,
+                            t0 + closes_rel.astype(np.int64), 0, "close")
+
+    best = np.full(n_auctions, 0.0, np.float32)   # host oracle replay
+    accepted = np.zeros(n_auctions, np.int64)
+    rejected = np.zeros(n_auctions, np.int64)
+    for t in range(1, n_ticks + 1):
+        if t % 2 == 0:
+            amounts = rng.random(n_auctions, dtype=np.float32) * 100
+            injector.inject({"amount": amounts})
+            # the initial zero-amount activation bid counted too
+            open_ = t < closes_rel
+            best = np.where(open_, np.maximum(best, amounts), best)
+            accepted += open_
+            rejected += ~open_
+        engine.run_tick()
+    await engine.flush()
+
+    arena = engine.arena_for("AuctionGrain")
+    rows, found = arena.lookup_rows(keys)
+    got = {n: np.asarray(c)[rows] for n, c in arena.state.items()}
+    stats = {
+        "auctions": n_auctions,
+        "closed": int(got["closed"].sum()),
+        "late_bids": int(got["late_bids"].sum()),
+        "exact": bool(
+            found.all()
+            and (got["closes"] == 1).all()
+            and (got["closed"] == 1).all()
+            and (got["bids"] == accepted + 1).all()   # +1: activation
+            and (got["late_bids"] == rejected).all()
+            and np.allclose(got["highest_bid"], best)),
+    }
+    if verify:
+        assert stats["exact"], {
+            "closes": np.unique(got["closes"]).tolist(),
+            "late_mismatch": int((got["late_bids"] != rejected).sum()),
+            "accept_mismatch": int(
+                (got["bids"] != accepted + 1).sum()),
+            "bid_mismatches": int(
+                (~np.isclose(got["highest_bid"], best)).sum())}
+    return stats

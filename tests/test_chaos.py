@@ -23,6 +23,7 @@ from orleans_tpu.chaos import (
     check_arena_conservation,
     check_at_least_once,
     check_single_activation,
+    check_timer_conservation,
     wait_for_at_least_once,
 )
 
@@ -262,6 +263,58 @@ def test_chaos_kill_during_handoff_conserves_arena(run):
                                {"v": np.zeros(96, np.float32)})
             await check_arena_conservation(cluster, "ChaosCounter", keys)
             check_single_activation(cluster)
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+@pytest.mark.chaos
+def test_chaos_join_handoff_conserves_armed_timers(run):
+    """Join-handoff smoke for the timers plane: arm a far-future timer on
+    every resident key, then grow the cluster so the ring reshuffles and
+    handoff migrates a slice of the arena — every timer must ride its
+    state slab to exactly one wheel (none lost, none doubled)."""
+
+    async def main():
+        from orleans_tpu.chaos.report import define_chaos_counter
+        define_chaos_counter()
+
+        cluster = await ChaosCluster(plan=FaultPlan(seed=5),
+                                     n_silos=2).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            keys = np.arange(64, dtype=np.int64)
+            engine0 = cluster.silos[0].tensor_engine
+            engine0.send_batch("ChaosCounter", "poke", keys,
+                               {"v": np.ones(64, np.float32)})
+            await cluster.quiesce_engines()
+
+            # arm each key's timer on the silo where it is RESIDENT —
+            # migration must then carry it wherever the key goes
+            for silo in cluster.silos:
+                eng = silo.tensor_engine
+                arena = eng.arenas.get("ChaosCounter")
+                resident = np.array(sorted(arena.keys()), np.int64) \
+                    if arena is not None else np.array([], np.int64)
+                if resident.size:
+                    eng.timers.arm_batch(
+                        "ChaosCounter", resident,
+                        np.full(resident.size,
+                                eng.tick_number + 10_000, np.int64),
+                        0, "watch")
+
+            await cluster.start_additional_silo()
+            await cluster.wait_for_liveness_convergence()
+            # traffic across the reshuffled ring drives the handoff
+            engine0.send_batch("ChaosCounter", "poke", keys,
+                               {"v": np.zeros(64, np.float32)})
+            await cluster.quiesce_engines()
+
+            await check_arena_conservation(cluster, "ChaosCounter", keys)
+            check_timer_conservation(
+                cluster, "ChaosCounter",
+                [(int(k), "watch") for k in keys])
         finally:
             await cluster.stop()
 

@@ -1,0 +1,545 @@
+"""Device timers plane tests (tensor/timers_plane.py).
+
+The contract under test, end to end:
+
+* an armed timer fires its ``receive_reminder`` batch ON the due tick —
+  not before, not after (the hashed hierarchical wheel's bucket-visit
+  invariant), and a one-shot fires EXACTLY once;
+* periodic timers re-arm in the same harvest kernel with phase
+  preserved (due += k*period), and cancel disarms without a device
+  sweep (lazy stamp death);
+* the armed set survives eviction (fires re-activate through the
+  store), cross-shard row migration, cross-silo live migration
+  (relative dues carried in the adoption slab), and hard-kill recovery
+  from full+delta checkpoints — firing after restore but never twice;
+* the host LocalReminderService delegates tensor-arena grains to the
+  wheel and reconciles consumed one-shots back to the table;
+* a ring change costs the reminder service reads proportional to the
+  range it GAINED, never a full-table scan (the scoped reacquisition
+  regression).
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orleans_tpu.config import SiloConfig, TensorEngineConfig
+from orleans_tpu.core.grain import batched_method
+from orleans_tpu.ids import GrainId, SiloAddress
+from orleans_tpu.runtime.reminders import InMemoryReminderTable
+from orleans_tpu.runtime.silo import Silo
+from orleans_tpu.tensor import (
+    MemorySnapshotStore,
+    MemoryVectorStore,
+    TensorEngine,
+    VectorGrain,
+    field,
+    vector_grain,
+)
+from orleans_tpu.tensor.vector_grain import (
+    scatter_add_rows,
+    scatter_rows,
+    vector_type,
+)
+from orleans_tpu.testing.cluster import TestingCluster
+
+pytestmark = pytest.mark.timers
+
+
+@vector_grain
+class TimerProbeGrain(VectorGrain):
+    """Counts reminder deliveries per grain — the exactness oracle's
+    device half (fires must match the host-computed due schedule)."""
+
+    fires = field(jnp.int32, 0)
+    last_id = field(jnp.int32, -1)
+
+    @batched_method
+    @staticmethod
+    def receive_reminder(state, batch, n_rows):
+        ones = jnp.where(batch.mask, 1, 0).astype(jnp.int32)
+        return {
+            "fires": scatter_add_rows(state["fires"], batch.rows, ones),
+            "last_id": scatter_rows(state["last_id"], batch.rows,
+                                    batch.args["reminder_id"]),
+        }
+
+    @batched_method
+    @staticmethod
+    def poke(state, batch, n_rows):
+        return state
+
+
+def _engine(n_shards=1, backing=None, store=None, **cfg_kw):
+    cfg = TensorEngineConfig(tick_interval=0.0, auto_fusion_ticks=0,
+                             **cfg_kw)
+    snap = MemorySnapshotStore(backing) if backing is not None else None
+    e = TensorEngine(config=cfg, store=store, snapshot_store=snap)
+    if n_shards > 1:
+        e.n_shards = n_shards  # logical shard blocks (no mesh needed)
+    return e
+
+
+def _activate(eng, keys):
+    inj = eng.make_injector("TimerProbeGrain", "poke",
+                            np.asarray(keys, np.int64))
+    inj.inject({})
+    eng.run_tick()
+
+
+def _fires(eng, keys):
+    arena = eng.arena_for("TimerProbeGrain")
+    rows, found = arena.lookup_rows(np.asarray(keys, np.int64))
+    f = np.asarray(arena.state["fires"])[rows]
+    return np.where(found, f, 0), found
+
+
+# ---------------------------------------------------------------------------
+# exactness: on the due tick, exactly once
+# ---------------------------------------------------------------------------
+
+def test_one_shot_fires_exactly_once_on_exact_tick(run):
+    async def main():
+        eng = _engine()
+        keys = np.arange(64, dtype=np.int64)
+        _activate(eng, keys)
+        t0 = eng.tick_number
+        due = t0 + 10
+        eng.timers.arm_batch("TimerProbeGrain", keys,
+                             np.full(64, due, np.int64), 0, "close")
+        assert eng.timers.armed_total == 64
+        while eng.tick_number < due - 1:
+            eng.run_tick()
+        await eng.flush()
+        f, _ = _fires(eng, keys)
+        assert f.sum() == 0, "fired before due"
+        eng.run_tick()           # the due tick
+        await eng.flush()
+        f, _ = _fires(eng, keys)
+        assert (f == 1).all(), f  # ON the due tick, all of them
+        for _ in range(10):
+            eng.run_tick()
+        await eng.flush()
+        f, _ = _fires(eng, keys)
+        assert (f == 1).all(), "one-shot fired twice"
+        assert eng.timers.armed_total == 0
+        snap = eng.timers.snapshot()
+        assert snap["fired"] == 64
+        assert snap["worst_lateness_ticks"] == 0
+
+    run(main())
+
+
+def test_periodic_phase_preserved_and_cancel(run):
+    async def main():
+        eng = _engine()
+        _activate(eng, [7])
+        t0 = eng.tick_number
+        eng.timers.arm("TimerProbeGrain", 7, "beat", t0 + 3, 4)
+        horizon = t0 + 20
+        while eng.tick_number < horizon:
+            eng.run_tick()
+        await eng.flush()
+        f, _ = _fires(eng, [7])
+        # fires at t0+3, +7, +11, +15, +19: the host-clock oracle
+        want = len([t for t in range(t0 + 3, horizon + 1, 4)])
+        assert f[0] == want, (f[0], want)
+        assert eng.timers.snapshot()["re_armed"] >= want - 1
+        assert eng.timers.cancel("TimerProbeGrain", 7, "beat")
+        assert eng.timers.armed_total == 0
+        for _ in range(8):
+            eng.run_tick()
+        await eng.flush()
+        f2, _ = _fires(eng, [7])
+        assert f2[0] == want, "cancelled timer still fired"
+        assert not eng.timers.cancel("TimerProbeGrain", 7, "beat")
+
+    run(main())
+
+
+def test_wheel_upper_level_horizon_exact(run):
+    """A due beyond the L0 span (256 ticks) parks in an upper wheel
+    level and must still fire on the exact tick after cascading."""
+
+    async def main():
+        eng = _engine()
+        _activate(eng, [1, 2])
+        t0 = eng.tick_number
+        eng.timers.arm("TimerProbeGrain", 1, "far", t0 + 300)
+        eng.timers.arm("TimerProbeGrain", 2, "near", t0 + 5)
+        fired_at = {}
+        while eng.tick_number < t0 + 310:
+            eng.run_tick()
+            if (eng.tick_number - t0) in (5, 299, 300):
+                await eng.flush()
+                f, _ = _fires(eng, [1, 2])
+                fired_at[eng.tick_number - t0] = f.copy()
+        assert fired_at[5].tolist() == [0, 1]
+        assert fired_at[299].tolist() == [0, 1], "upper level fired early"
+        assert fired_at[300].tolist() == [1, 1]
+
+    run(main())
+
+
+def test_catchup_jump_rebuild_fires_all(run):
+    """A tick jump past timers_catchup_jump (fused windows, recovery)
+    takes the O(armed) rebuild path — every overjumped due still fires
+    exactly once."""
+
+    async def main():
+        eng = _engine(timers_catchup_jump=64)
+        keys = np.arange(32, dtype=np.int64)
+        _activate(eng, keys)
+        t0 = eng.tick_number
+        dues = t0 + 5 + np.arange(32, dtype=np.int64) * 7
+        eng.timers.arm_batch("TimerProbeGrain", keys, dues, 0, "jump")
+        eng.tick_number += 500  # beyond every due AND the jump limit
+        eng.run_tick()
+        await eng.flush()
+        f, _ = _fires(eng, keys)
+        assert (f == 1).all(), f
+        assert eng.timers.armed_total == 0
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# exactly-once across lifecycle events (the ISSUE's oracle matrix)
+# ---------------------------------------------------------------------------
+
+def test_evict_reactivate_fires_once_on_time(run):
+    """Deactivation does NOT disarm: the fire's miss re-activates the
+    grain through the store and delivers on the due tick."""
+
+    async def main():
+        store = MemoryVectorStore()
+        eng = _engine(store=store)
+        keys = np.arange(16, dtype=np.int64)
+        _activate(eng, keys)
+        t0 = eng.tick_number
+        due = t0 + 12
+        eng.timers.arm_batch("TimerProbeGrain", keys,
+                             np.full(16, due, np.int64), 0, "wake")
+        for _ in range(3):
+            eng.run_tick()
+        arena = eng.arena_for("TimerProbeGrain")
+        assert arena.evict_keys(keys, write_back=True) == 16
+        assert eng.timers.armed_total == 16  # armed set outlives the rows
+        while eng.tick_number < due:
+            eng.run_tick()
+        await eng.flush()
+        # the fire's miss path re-activated every key with state
+        f, found = _fires(eng, keys)
+        assert found.all(), "fire did not re-activate evicted grains"
+        assert (f == 1).all(), f
+        for _ in range(5):
+            eng.run_tick()
+        await eng.flush()
+        f, _ = _fires(eng, keys)
+        assert (f == 1).all(), "re-activated one-shot fired twice"
+
+    run(main())
+
+
+def test_cross_shard_migration_mid_countdown(run):
+    async def main():
+        eng = _engine(n_shards=4)
+        keys = np.arange(40, dtype=np.int64)
+        _activate(eng, keys)
+        t0 = eng.tick_number
+        due = t0 + 20
+        eng.timers.arm_batch("TimerProbeGrain", keys,
+                             np.full(40, due, np.int64), 0, "move")
+        while eng.tick_number < t0 + 8:
+            eng.run_tick()
+        rng = np.random.default_rng(3)
+        eng.migrate_keys("TimerProbeGrain", keys,
+                         rng.integers(0, 4, len(keys)))
+        while eng.tick_number < due - 1:
+            eng.run_tick()
+        await eng.flush()
+        f, _ = _fires(eng, keys)
+        assert f.sum() == 0
+        eng.run_tick()
+        await eng.flush()
+        f, _ = _fires(eng, keys)
+        assert (f == 1).all(), f
+
+    run(main())
+
+
+@pytest.mark.cluster
+def test_cross_silo_migration_carries_armed_timers(run):
+    """migrate_keys_out ships armed timers in the adoption slab: the
+    source can no longer fire them, the target fires them once at the
+    carried relative due."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            s0, s1 = cluster.silos
+            e0, e1 = s0.tensor_engine, s1.tensor_engine
+            keys = np.arange(500, 532, dtype=np.int64)
+            e0.send_batch("TimerProbeGrain", "poke", keys, {})
+            await cluster.quiesce_engines()
+            a0 = e0.arenas.get("TimerProbeGrain")
+            movers = np.array(sorted(
+                set(a0.keys().tolist()) & set(keys.tolist()))[:8],
+                np.int64)
+            assert len(movers) == 8, "need residents on silo 0"
+            remaining = 30
+            e0.timers.arm_batch("TimerProbeGrain", movers,
+                                np.full(8, e0.tick_number + remaining,
+                                        np.int64), 0, "deadline")
+            moved = await s0.vector_router.migrate_keys_out(
+                "TimerProbeGrain", movers, s1.address)
+            assert moved == 8
+            # armed set moved with the grains
+            assert all(not e0.timers.armed_for("TimerProbeGrain", int(k))
+                       for k in movers)
+            armed = {int(k): e1.timers.armed_for("TimerProbeGrain",
+                                                 int(k))
+                     for k in movers}
+            assert all(len(v) == 1 for v in armed.values()), armed
+            assert e0.timers.snapshot()["exported"] == 8
+            assert e1.timers.snapshot()["adopted"] == 8
+            # relative due preserved against the TARGET's clock
+            due1 = armed[int(movers[0])][0][1]
+            assert 0 < due1 - e1.tick_number <= remaining
+            while e1.tick_number < due1:
+                e1.run_tick()
+            await e1.flush()
+            a1 = e1.arenas["TimerProbeGrain"]
+            rows, found = a1.lookup_rows(movers)
+            assert found.all()
+            f = np.asarray(a1.state["fires"])[rows]
+            assert (f == 1).all(), f
+            for _ in range(5):
+                e1.run_tick()
+            await e1.flush()
+            f = np.asarray(a1.state["fires"])[a1.lookup_rows(movers)[0]]
+            assert (f == 1).all(), "migrated timer fired twice"
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_hard_kill_full_delta_recovery_fires_once_on_time(run):
+    """Timers armed before the full cut and between full and delta both
+    survive a hard kill; dues still in the future fire exactly once
+    after restore, at their original tick."""
+
+    async def main():
+        backing = MemorySnapshotStore.shared_backing()
+        eng = _engine(backing=backing)
+        keys = np.arange(100, dtype=np.int64)
+        _activate(eng, keys)
+        t1 = eng.tick_number
+        eng.timers.arm_batch("TimerProbeGrain", keys[:10],
+                             np.full(10, t1 + 50, np.int64), 0,
+                             "deadline")
+        eng.timers.arm("TimerProbeGrain", 99, "watch", t1 + 30, 25)
+        eng.checkpointer.checkpoint_full()
+        eng.timers.arm("TimerProbeGrain", 98, "late", t1 + 40)
+        eng.checkpointer.checkpoint_delta()
+        # hard kill here: eng is abandoned mid-countdown
+        eng2 = _engine(backing=backing)
+        stats = await eng2.checkpointer.recover()
+        assert stats["recovered"], stats
+        assert eng2.timers.armed_total == 12, eng2.timers.snapshot()
+        for _ in range(60):
+            eng2.run_tick()
+        await eng2.flush()
+        f, _ = _fires(eng2, keys)
+        assert (f[:10] == 1).all(), f[:10]
+        assert f[98] == 1, f[98]
+        assert f[99] >= 2, f[99]  # periodic resumed and kept beating
+
+    run(main())
+
+
+def test_fired_before_cut_never_refires_after_recovery(run):
+    """The never-twice half of the contract: a one-shot that fired
+    before the last committed cut is silently retired at restore —
+    recovery must not replay it."""
+
+    async def main():
+        backing = MemorySnapshotStore.shared_backing()
+        eng = _engine(backing=backing)
+        keys = np.arange(8, dtype=np.int64)
+        _activate(eng, keys)
+        eng.checkpointer.checkpoint_full()
+        t0 = eng.tick_number
+        eng.timers.arm_batch("TimerProbeGrain", keys,
+                             np.full(8, t0 + 3, np.int64), 0, "once")
+        while eng.tick_number < t0 + 5:
+            eng.run_tick()
+        await eng.flush()
+        f, _ = _fires(eng, keys)
+        assert (f == 1).all()
+        eng.checkpointer.checkpoint_delta()  # cut AFTER the fire
+        eng2 = _engine(backing=backing)
+        stats = await eng2.checkpointer.recover()
+        assert stats["recovered"], stats
+        assert eng2.timers.armed_total == 0, eng2.timers.snapshot()
+        for _ in range(10):
+            eng2.run_tick()
+        await eng2.flush()
+        f2, _ = _fires(eng2, keys)
+        assert (f2 == 1).all(), "recovery double-fired a one-shot"
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# LocalReminderService: device delegation + scoped ring-change refresh
+# ---------------------------------------------------------------------------
+
+def test_reminder_service_delegates_vector_grain_to_wheel(run):
+    async def main():
+        silo = Silo(name="tdel")
+        await silo.start()
+        try:
+            eng = silo.tensor_engine
+            assert eng is not None
+            _activate(eng, [5])
+            info = vector_type("TimerProbeGrain")
+            gid = GrainId.from_int(info.type_code, 5)
+            svc = silo.reminder_service
+            await svc.register_or_update(gid, "ding", due=0.05,
+                                         period=0.0)
+            assert (gid, "ding") in svc.delegated
+            assert (gid, "ding") not in svc.local
+            assert eng.timers.armed_total == 1
+            # the pump advances the idle engine; the wheel fires and the
+            # consumed one-shot's row is reconciled away
+            for _ in range(80):
+                await asyncio.sleep(0.025)
+                f, _ = _fires(eng, [5])
+                if f[0] and not svc.delegated \
+                        and await svc.table.read_row(gid, "ding") is None:
+                    break
+            f, _ = _fires(eng, [5])
+            assert f[0] == 1, f
+            assert (gid, "ding") not in svc.delegated
+            assert await svc.table.read_row(gid, "ding") is None
+            # unregister of a delegated periodic disarms the wheel
+            await svc.register_or_update(gid, "beat", due=0.05,
+                                         period=0.05)
+            assert eng.timers.armed_total == 1
+            await svc.unregister(gid, "beat")
+            assert eng.timers.armed_total == 0
+            assert (gid, "beat") not in svc.delegated
+        finally:
+            await silo.stop(graceful=False)
+
+    run(main())
+
+
+def test_host_grain_reminders_keep_asyncio_path(run):
+    """Non-vector grains (no arena rows) must not delegate."""
+
+    async def main():
+        silo = Silo(name="thost")
+        await silo.start()
+        try:
+            svc = silo.reminder_service
+            gid = GrainId.from_int(987654, 1)  # no such vector type
+            await svc.register_or_update(gid, "r", due=30.0, period=0.0)
+            assert (gid, "r") in svc.local
+            assert (gid, "r") not in svc.delegated
+        finally:
+            await silo.stop(graceful=False)
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# time-triggered samples (auction closings, heartbeat watchdogs)
+# ---------------------------------------------------------------------------
+
+def test_auction_sample_closes_exactly(run):
+    from samples.auction import run_auction_load
+
+    async def main():
+        stats = await run_auction_load(_engine(), n_auctions=512,
+                                       n_ticks=24, verify=True)
+        assert stats["exact"] and stats["closed"] == 512
+
+    run(main())
+
+
+def test_watchdog_sample_flags_exactly(run):
+    from samples.watchdog import run_watchdog_load
+
+    async def main():
+        stats = await run_watchdog_load(_engine(), n_devices=512,
+                                        window=6, n_windows=3,
+                                        verify=True)
+        assert stats["exact"]
+        assert stats["flagged_dead"] == stats["silent"] > 0
+
+    run(main())
+
+
+class CountingReminderTable(InMemoryReminderTable):
+    def __init__(self):
+        super().__init__()
+        self.read_alls = 0
+        self.range_reads = 0
+
+    async def read_all(self):
+        self.read_alls += 1
+        return await super().read_all()
+
+    async def read_range(self, lo, hi):
+        self.range_reads += 1
+        return await super().read_range(lo, hi)
+
+
+def test_ring_change_reads_only_gained_range(run):
+    """The scoped reacquisition regression: a silo join/leave must not
+    re-read the entire reminder table — losing range costs ZERO table
+    reads, gaining range costs read_range over the delta only."""
+
+    async def main():
+        table = CountingReminderTable()
+        silo = Silo(name="tring", reminder_table=table)
+        await silo.start()
+        try:
+            svc = silo.reminder_service
+            # park a spread of far-future reminders across the hash space
+            for k in range(24):
+                await svc.register_or_update(
+                    GrainId.from_int(987654, k), "r", due=3600.0,
+                    period=0.0)
+            assert len(svc.local) == 24
+            base_alls = table.read_alls
+            # a peer JOINS: we only LOSE range — no table read at all
+            peer = SiloAddress.new_local("peer", 1)
+            silo.ring.add_silo(peer)
+            await asyncio.sleep(0.05)
+            assert table.read_alls == base_alls, \
+                "ring change triggered a full-table read"
+            lost = {k for k in list(svc.local)
+                    if not svc._i_own(k[0])}
+            assert not lost
+            assert len(svc.local) < 24, "join should shed some reminders"
+            shed = 24 - len(svc.local)
+            base_ranges = table.range_reads
+            # the peer LEAVES: we gain its range back — scoped reads only
+            silo.ring.remove_silo(peer)
+            await asyncio.sleep(0.05)
+            assert table.read_alls == base_alls, \
+                "ring change triggered a full-table read"
+            assert table.range_reads > base_ranges
+            assert len(svc.local) == 24, \
+                f"regained only {len(svc.local)}/24 ({shed} were shed)"
+            assert svc.snapshot()["range_reads"] == table.range_reads
+        finally:
+            await silo.stop(graceful=False)
+
+    run(main())
