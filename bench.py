@@ -510,6 +510,13 @@ async def _multichip_tier(smoke: bool, sizes: "tuple | None" = None
         e.config.cross_shard_exchange = exchange
         if structured is not None:
             e.config.exchange_structured = structured
+        # pin the LEGACY max-over-dest cap: this tier's A/B and seeded
+        # baselines are defined against it, and legacy<->perdest plan
+        # flips as the occupancy estimates settle would bill their
+        # re-trace pauses to the exchange-on arms only.  The
+        # per-destination grant A/B lives in the rebalance workload's
+        # single_hot_grain sub-tier.
+        e.config.exchange_per_dest = "never"
         return e
 
     def sink_per_tick(engine, total_ticks: int):
@@ -3498,6 +3505,191 @@ async def _rpc_tier(smoke: bool) -> dict:
     return out
 
 
+async def _single_hot_grain_tier(smoke: bool, mesh, n_dev: int) -> dict:
+    """The hottest-grain ceiling (``single_hot_grain`` sub-tier of
+    ``--workload rebalance``): Zipf s→∞ — EVERY lane addresses ONE sink
+    grain, so migration is useless (moving the grain just moves the
+    burn) and the only levers are the exchange's per-destination grant
+    vector and device-side hot-grain replication.  Three arms, one
+    artifact: (OFF) legacy max-over-dest cap, no controller — the deep
+    ceiling every shard's padded plan pays for one burning destination;
+    (caps) the per-destination grant vector engaged, still no
+    controller — the structural padding is gone but one shard still
+    absorbs every lane; (caps+replication) the controller reads its own
+    telemetry, sees a grain too hot for any single-destination move,
+    and promotes it to replica rows across shards — the lane-hash
+    spread divides the per-pair demand by k and throughput recovers to
+    ≥0.9x uniform.  Delivery conservation is asserted EXACTLY per arm
+    through the commutative fold (read_row folds live replica groups).
+    The idle-cost A/B: uniform load driven THROUGH the live replica
+    spread must cost <5% vs the caps-only arm."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from orleans_tpu.config import MetricsConfig, RebalanceConfig
+    from orleans_tpu.runtime.rebalancer import RebalanceController
+    from orleans_tpu.tensor.arena import shard_of_keys
+    from orleans_tpu.tensor.engine import TensorEngine
+    from samples.routing import build_ratio_destinations, sink_keys
+
+    n_src, n_sink = 131_072, 256
+    warm, ticks, rounds = (6, 3, 2) if smoke else (10, 4, 3)
+    sources = np.arange(n_src, dtype=np.int64)
+    sinks = sink_keys(n_sink)
+    uniform_dst = build_ratio_destinations(sources, sinks, n_dev,
+                                           1.0 - 1.0 / n_dev, seed=3)
+    hot_sink = int(sinks[shard_of_keys(sinks, n_dev) == 0][0])
+    hot_dst = np.full(n_src, hot_sink, dtype=np.int64)
+    rng = np.random.default_rng(20260806)
+    vv = jnp.asarray(rng.integers(1, 8, n_src).astype(np.float32))
+
+    def mk(per_dest: str) -> dict:
+        eng = TensorEngine(mesh=mesh, initial_capacity=1024,
+                           metrics=MetricsConfig(attribution_top_k=32))
+        eng.config.auto_fusion_ticks = 0
+        eng.config.tick_interval = 0.0
+        eng.config.exchange_structured = "always"
+        eng.config.exchange_per_dest = per_dest
+        eng.arena_for("RouteSource").reserve(n_src)
+        eng.arena_for("RouteSource").resolve_rows(sources)
+        eng.arena_for("RouteSink").reserve(n_sink)
+        eng.arena_for("RouteSink").resolve_rows(sinks)
+        return {"engine": eng,
+                "injector": eng.make_injector("RouteSource", "send",
+                                              sources),
+                "lanes": 0}
+
+    async def drive(st: dict, dst_dev, n: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            st["injector"].inject({"dst": dst_dev, "v": vv})
+            st["lanes"] += n_src
+            await st["engine"].drain_queues()
+        await st["engine"].flush()
+        return time.perf_counter() - t0
+
+    async def measure(st: dict, dst, warm_ticks: int) -> float:
+        dd = jnp.asarray(dst.astype(np.int32))
+        await drive(st, dd, warm_ticks)
+        best = 0.0
+        for _ in range(rounds):
+            elapsed = await drive(st, dd, ticks)
+            best = max(best, 2 * n_src * ticks / elapsed)
+        return best
+
+    def received_total(st: dict) -> int:
+        # read_row folds live replica groups — conservation holds
+        # THROUGH promotion, not only after a demote
+        arena = st["engine"].arenas["RouteSink"]
+        return sum(int(arena.read_row(int(k))["received"])
+                   for k in sinks)
+
+    # ---- arm 1 (OFF): legacy max-over-dest cap, no controller --------
+    off = mk("never")
+    uniform_off = await measure(off, uniform_dst, warm)
+    hot_off = await measure(off, hot_dst, warm)
+
+    # ---- arm 2 (caps): per-destination grant vector, no controller ---
+    caps = mk("always")
+    uniform_caps = await measure(caps, uniform_dst, warm)
+    hot_caps = await measure(caps, hot_dst, warm)
+
+    # ---- arm 3 (caps + replication): the controller promotes --------
+    rep = mk("always")
+    ctrl = RebalanceController(
+        engine=rep["engine"],
+        config=RebalanceConfig(
+            enabled=True, trigger_share=0.3, hysteresis_intervals=2,
+            cooldown_intervals=0, move_budget=8,
+            min_interval_msgs=1024, replicate_share=0.15,
+            max_replicas=n_dev, demote_share=0.0))
+    dd_hot = jnp.asarray(hot_dst.astype(np.int32))
+    await drive(rep, dd_hot, warm)
+    detect_interval = None
+    for interval in range(12):
+        await drive(rep, dd_hot, 2)
+        await ctrl.run_once()
+        if ctrl.replications_applied and detect_interval is None:
+            detect_interval = interval
+        if detect_interval is not None \
+                and interval >= detect_interval + 1:
+            break
+    replica_groups = {int(k): [int(x) for x in v] for k, v in
+                      rep["engine"].arenas["RouteSink"]
+                      ._replicas.items()}
+    hot_rep = await measure(
+        rep, hot_dst,
+        warm + rep["engine"].config.exchange_shrink_patience)
+    # idle-cost A/B: uniform traffic THROUGH the live spread path
+    uniform_rep = await measure(rep, uniform_dst, warm)
+    spread_overhead_pct = round(
+        max(0.0, (uniform_caps - uniform_rep) / uniform_caps * 100.0),
+        2) if uniform_caps else 0.0
+
+    conservation = {name: bool(received_total(st) == st["lanes"])
+                    for name, st in (("off", off), ("caps", caps),
+                                     ("replication", rep))}
+    out = {
+        "sizes": {"sources": n_src, "sinks": n_sink,
+                  "zipf_exponent": "inf", "hot_sink": hot_sink,
+                  "ticks_per_round": ticks, "rounds": rounds},
+        "uniform_msgs_per_sec": {"off": round(uniform_off, 1),
+                                 "caps": round(uniform_caps, 1),
+                                 "replication": round(uniform_rep, 1)},
+        "hot_msgs_per_sec": {"off": round(hot_off, 1),
+                             "caps": round(hot_caps, 1),
+                             "replication": round(hot_rep, 1)},
+        "off_ratio": round(hot_off / uniform_off, 4),
+        "caps_only_ratio": round(hot_caps / uniform_caps, 4),
+        "recovery_ratio": round(hot_rep / uniform_caps, 4),
+        "recovery_met": bool(hot_rep / uniform_caps >= 0.9),
+        "replication_engaged": bool(replica_groups),
+        "replica_groups": replica_groups,
+        "spread_overhead_pct": spread_overhead_pct,
+        "spread_overhead_met": bool(spread_overhead_pct < 5.0),
+        "controller": {
+            "detect_interval": detect_interval,
+            "replications_applied": ctrl.replications_applied,
+            "replica_fallback_moves": ctrl.replica_fallback_moves,
+            "decisions": list(ctrl.decisions),
+            **ctrl.planner.snapshot(),
+        },
+        "delivery_conservation_exact": bool(all(conservation.values())),
+        "delivery_conservation": conservation,
+        "ab_contract": "three arms, identical Zipf(s→∞) pattern: "
+                       "legacy max-over-dest cap / per-destination "
+                       "grant vector / grant vector + hot-grain "
+                       "replication; recovery judged against the "
+                       "caps arm's uniform baseline on this rig, "
+                       "compile-settled, best-of-round",
+    }
+    if smoke:
+        if not out["delivery_conservation_exact"]:
+            raise RuntimeError(
+                f"single_hot_grain smoke: conservation broke "
+                f"({conservation})")
+        if not out["replication_engaged"]:
+            raise RuntimeError(
+                "single_hot_grain smoke: controller never promoted "
+                f"the hot grain ({ctrl.planner.snapshot()})")
+        if not out["recovery_met"]:
+            raise RuntimeError(
+                f"single_hot_grain smoke: recovery "
+                f"{out['recovery_ratio']} < 0.9x uniform "
+                f"(caps-only {out['caps_only_ratio']})")
+        if out["recovery_ratio"] <= out["caps_only_ratio"]:
+            raise RuntimeError(
+                f"single_hot_grain smoke: replication did not beat "
+                f"caps-only ({out['recovery_ratio']} <= "
+                f"{out['caps_only_ratio']})")
+        if not out["spread_overhead_met"]:
+            raise RuntimeError(
+                f"single_hot_grain smoke: spread overhead "
+                f"{spread_overhead_pct}% >= 5%")
+    return out
+
+
 async def _rebalance_tier(smoke: bool) -> dict:
     """The closed-loop rebalance tier (``--workload rebalance``): a
     Zipf hot spot pinned to ONE mesh shard collapses aggregate msg/s
@@ -3558,6 +3750,12 @@ async def _rebalance_tier(smoke: bool) -> dict:
     # "auto" disengages it on host-virtual meshes, so pin it like the
     # exactness/overflow suites do
     engine.config.exchange_structured = "always"
+    # pin the LEGACY max-over-dest cap: this tier's seeded baselines
+    # (collapse depth, recovery, slo burn) are defined against it, and
+    # mid-loop legacy↔perdest plan flips would bill their re-trace
+    # pauses to the recovered segment's burn.  The per-destination
+    # grant A/B lives in the single_hot_grain sub-tier's arms.
+    engine.config.exchange_per_dest = "never"
 
     sources = np.arange(n_src, dtype=np.int64)
     sinks = sink_keys(n_sink)
@@ -3708,6 +3906,8 @@ async def _rebalance_tier(smoke: bool) -> dict:
                        "against the uniform baseline on this rig, "
                        "compile-settled, best-of-round",
     }
+    out["single_hot_grain"] = await _single_hot_grain_tier(
+        smoke, mesh, n_dev)
     try:
         from orleans_tpu.perfgate import run_gate
         out["perfgate"] = run_gate("PERF_BASELINE.json", artifact=out,

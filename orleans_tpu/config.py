@@ -260,6 +260,23 @@ class RebalanceConfig:
     # halves (floor 1.25/n_shards): a burning SLO justifies acting on
     # milder skew
     slo_burn_trigger: float = 1.0
+    # ---- hot-grain replication (the lever past migration) ----
+    # a single grain whose interval traffic share reaches this can no
+    # longer be fixed by moving it (the burn relocates with it): if its
+    # dominant methods are declared commutative the controller PROMOTES
+    # it to replica rows across shards instead (0 disables replication
+    # and restores the pure-migration planner)
+    replicate_share: float = 0.15
+    # replica rows a promotion spreads a hot grain across (clamped to
+    # the mesh's shard count by the arena)
+    max_replicas: int = 4
+    # a replicated grain whose interval share falls below this is a
+    # demotion candidate — its state folds back to one row
+    demote_share: float = 0.02
+    # consecutive below-demote_share intervals before the fold (the
+    # replication analog of shrink patience: a hot grain's lull must
+    # not flap promote/demote)
+    demote_patience: int = 4
     # ---- cross-silo leg (clustered silos only) ----
     # move hot grains to a less-loaded PEER silo when this silo's SLO
     # burns and a peer has capacity headroom (placement overrides +
@@ -416,6 +433,19 @@ class TensorEngineConfig:
     # consecutive drains below the current grant before a cap shrinks
     # (growth is immediate; shrink hysteresis stops compile flapping)
     exchange_shrink_patience: int = 4
+    # per-DESTINATION exchange caps: instead of one scalar cap sized by
+    # the max-over-destinations demand (one hot destination sizes every
+    # lane's buckets), grant each destination its own ladder rung from
+    # its measured demand — send width becomes sum-of-per-dest-caps and
+    # the receive width a single rung over the worst shard's total
+    # inbound.  "auto" engages the per-dest formulation only when it is
+    # strictly narrower than the n·cap layout for the measured site
+    # (symmetric demand keeps the legacy plan — zero regression);
+    # "always"/"never" force either side.  Same grow-on-overflow /
+    # shrink-after-patience / park-and-redeliver discipline, same
+    # O(log) re-trace bound (re-quantization on any dest's rung change,
+    # cause bucket_growth).
+    exchange_per_dest: str = "auto"
     # fused source batches with static key sets are PACKED home-shard-
     # local on the host at window build (one gather outside the scan):
     # their cross-shard demand is zero by construction, so the source

@@ -53,6 +53,11 @@ class MethodInfo:
     one_way: bool = False
     always_interleave: bool = False
     batched: bool = False  # tensor-path handler (TPU data plane)
+    # commutative/mergeable: the handler's state updates fold — replica
+    # rows combined by the declared per-field reduction produce the
+    # same state as one row receiving every message (the contract
+    # hot-grain replication requires; see runtime/rebalancer.py)
+    commutative: bool = False
 
 
 @dataclass
@@ -106,6 +111,19 @@ def batched_method(fn: Callable) -> Callable:
     (state_rows, result_rows)`` over stacked activations (see
     orleans_tpu.tensor.engine)."""
     fn.__grain_batched__ = True
+    return fn
+
+
+def commutative(fn: Callable) -> Callable:
+    """Declare a handler commutative/mergeable: its state updates are
+    order-independent AND distribute over the grain's per-field fold
+    reductions (StateField ``fold`` — sum by default), so k replica
+    rows each receiving a partition of the messages fold to the exact
+    state one row would reach receiving all of them.  The analog of the
+    reference's [StatelessWorker] scale-out contract, applied to state:
+    only grains whose DOMINANT methods carry this marker are eligible
+    for hot-grain replication (runtime/rebalancer.py)."""
+    fn.__grain_commutative__ = True
     return fn
 
 
@@ -192,6 +210,7 @@ def grain_interface(cls: type) -> type:
             one_way=getattr(attr, "__grain_one_way__", False),
             always_interleave=getattr(attr, "__grain_always_interleave__", False),
             batched=is_batched,
+            commutative=getattr(attr, "__grain_commutative__", False),
         ))
     cls.__grain_interface_info__ = info
     _INTERFACES[info.interface_id] = info

@@ -261,6 +261,13 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                         max(by_src.values(), default=0.0)
                     for lk, by_src in gauges.get(
                         "route.exchange_cap", {}).items()},
+                # steady-state fill of each per-destination grant: near
+                # 1.0 means the ladder sized the lane to its traffic
+                "cap_utilization": {
+                    (lk.split("=", 1)[1] if "=" in lk else lk):
+                        max(by_src.values(), default=0.0)
+                    for lk, by_src in gauges.get(
+                        "route.exchange_cap_util", {}).items()},
             },
             "timers": {
                 # device timers plane (tensor/timers_plane.py): wheel
@@ -341,6 +348,15 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                     _counter_total(merged, "rebalance.migrations")),
                 "migrated_grains": int(
                     _counter_total(merged, "rebalance.migrated_grains")),
+                # hot-grain replication: the second actuator
+                "replicated": int(
+                    _counter_total(merged, "rebalance.replicated")),
+                "demoted": int(
+                    _counter_total(merged, "rebalance.demoted")),
+                "replica_folds": int(
+                    _counter_total(merged, "rebalance.replica_folds")),
+                "hot_grain_blocked": int(_counter_total(
+                    merged, "rebalance.hot_grain_blocked")),
                 "max_move_pause_s": max(
                     (v for by_src in gauges.get("rebalance.move_pause_s",
                                                 {}).values()

@@ -191,6 +191,11 @@ declare("route.exchange_cap", KIND_GAUGE, "lanes",
         "(label 'shard'): the ladder rung the measured peak demand "
         "quantizes to with headroom, maxed over sites — 0 means no "
         "cross-shard demand observed")
+declare("route.exchange_cap_util", KIND_GAUGE, "ratio",
+        "steady-state fill of the per-destination grant toward one "
+        "shard (label 'shard'): last observed demand over the granted "
+        "cap, maxed over sites — the proof the per-destination ladder "
+        "sizes each lane to ITS traffic, not to the hottest pair's")
 declare("arena.shard_occupancy", KIND_GAUGE, "rows",
         "live rows in one mesh shard block (labels 'arena', 'shard') — "
         "the per-shard balance behind the multichip bench")
@@ -455,6 +460,20 @@ declare("rebalance.migrations", KIND_COUNTER, "waves",
         "source (controller, ring-change handoff, drain)")
 declare("rebalance.migrated_grains", KIND_COUNTER, "grains",
         "grains live-migrated on this engine from any source")
+declare("rebalance.replicated", KIND_COUNTER, "grains",
+        "hot grains promoted to replica rows across shards (the "
+        "controller's second actuator — for grains too hot for ANY "
+        "single shard, where migration just relocates the burn)")
+declare("rebalance.demoted", KIND_COUNTER, "grains",
+        "replicated grains folded back to one row after their traffic "
+        "cooled (demote_share for demote_patience intervals)")
+declare("rebalance.replica_folds", KIND_COUNTER, "folds",
+        "commutative replica-state folds performed (demotion, "
+        "checkpoint and read paths — each is one segment reduction)")
+declare("rebalance.hot_grain_blocked", KIND_COUNTER, "intervals",
+        "burning-shard intervals whose heat rode one grain below the "
+        "mover floor — previously a silent forever-armed idle, now "
+        "routed to the replication decision")
 
 # -- host control path (stats.SiloMetrics mirror) ----------------------------
 declare("host.requests_sent", KIND_COUNTER, "requests",

@@ -40,6 +40,7 @@ import numpy as np
 __all__ = [
     "ArenaSignals",
     "Move",
+    "Replicate",
     "RebalancePlanner",
     "RebalanceController",
     "interval_latency_burn",
@@ -72,6 +73,25 @@ class Move:
     reason: str
 
 
+@dataclass
+class Replicate:
+    """One planned hot-grain promotion: a grain too hot for ANY single
+    shard (its share alone clears ``replicate_share`` — migrating it
+    would just relocate the burn) spreads to ``k`` replica rows.  The
+    controller applies it through ``engine.replicate_key`` when the
+    grain's traffic-bearing methods are declared commutative, else
+    falls back to migrating the grain to ``fallback_dst``."""
+
+    arena: str
+    key: int
+    k: int
+    src_shard: int
+    fallback_dst: int     # coolest shard, for the non-commutative case
+    share: float          # the burning shard's interval share
+    grain_share: float    # the grain's own share of arena traffic
+    reason: str
+
+
 class RebalancePlanner:
     """The pure decision core (see module docstring).  State held
     between ``plan`` calls: consecutive-over-trigger counts (hysteresis)
@@ -88,6 +108,12 @@ class RebalancePlanner:
         self.skipped_hysteresis = 0
         self.skipped_cooldown = 0
         self.skipped_no_candidates = 0
+        self.replications_planned = 0
+        self.hot_grain_blocked = 0
+        # the replication decisions of the LAST plan() call (the moves
+        # are the return value; these ride alongside so the signature
+        # the tests pin stays put)
+        self.pending_replications: List[Replicate] = []
 
     def effective_trigger(self, n_shards: int, slo_burn: float) -> float:
         """The share that arms a move: the configured trigger, halved
@@ -103,6 +129,7 @@ class RebalancePlanner:
              slo_burn: float = 0.0) -> List[Move]:
         self.intervals += 1
         moves: List[Move] = []
+        self.pending_replications = []
         for sig in signals:
             if sig.n_shards <= 1:
                 continue
@@ -135,12 +162,54 @@ class RebalancePlanner:
             if over < self.cfg.hysteresis_intervals:
                 self.skipped_hysteresis += 1
                 continue
-            movers = [h for h in sig.hot
-                      if h.get("shard") == burning
-                      and h.get("share", 0.0) >= self.cfg.min_grain_share]
-            movers = movers[:max(0, int(self.cfg.move_budget))]
+            # two escalating levers: a grain whose OWN share clears
+            # replicate_share is too hot for any single shard —
+            # migrating it just relocates the burn, so it goes to the
+            # replication lever; the rest migrate as before
+            budget = max(0, int(self.cfg.move_budget))
+            rep_share = float(getattr(self.cfg, "replicate_share",
+                                      0.0) or 0.0)
+            hot_here = [h for h in sig.hot
+                        if h.get("shard") == burning]
+            rep_cands = [h for h in hot_here
+                         if h.get("share", 0.0) >= rep_share] \
+                if rep_share > 0 else []
+            rep_keys = {int(h["key"]) for h in rep_cands}
+            movers = [h for h in hot_here
+                      if h.get("share", 0.0) >= self.cfg.min_grain_share
+                      and int(h["key"]) not in rep_keys]
+            movers = movers[:budget]
+            if not movers and not rep_cands:
+                if hot_here and rep_share > 0:
+                    # BUGFIX: a burning shard whose heat rides one grain
+                    # below the mover floor used to idle here FOREVER —
+                    # hysteresis armed, no candidates, no action, every
+                    # interval.  Count it and route the hottest grain to
+                    # the replication lever instead of spinning.
+                    self.hot_grain_blocked += 1
+                    rep_cands = hot_here[:1]
+                else:
+                    self.skipped_no_candidates += 1
+                    continue
+            coolest = int(np.argmin(shares))
+            for h in rep_cands[:budget]:
+                self.pending_replications.append(Replicate(
+                    arena=sig.arena,
+                    key=int(h["key"]),
+                    k=max(2, min(int(self.cfg.max_replicas),
+                                 sig.n_shards)),
+                    src_shard=burning,
+                    fallback_dst=coolest,
+                    share=share,
+                    grain_share=float(h.get("share", 0.0)),
+                    reason=f"grain {int(h['key'])} share "
+                           f"{float(h.get('share', 0.0)):.3f} on burning "
+                           f"shard {burning} (shard share {share:.3f}) — "
+                           f"beyond the single-shard ceiling"))
+                self.replications_planned += 1
             if not movers:
-                self.skipped_no_candidates += 1
+                self._over[sig.arena] = 0
+                self._cooldown[sig.arena] = self.cfg.cooldown_intervals
                 continue
             # destinations: greedy share-aware packing — each mover
             # (hottest first) lands on the destination with the least
@@ -184,6 +253,8 @@ class RebalancePlanner:
             "skipped_hysteresis": self.skipped_hysteresis,
             "skipped_cooldown": self.skipped_cooldown,
             "skipped_no_candidates": self.skipped_no_candidates,
+            "replications_planned": self.replications_planned,
+            "hot_grain_blocked": self.hot_grain_blocked,
         }
 
 
@@ -243,6 +314,15 @@ class RebalanceController:
         # what actually happened to the arena)
         self.moves_applied = 0
         self.grains_moved = 0
+        self.replications_applied = 0
+        self.demotions_applied = 0
+        self.replica_fallback_moves = 0
+        # per replicated grain: consecutive below-demote_share intervals
+        # + the cumulative-msgs baseline diffed into interval shares
+        # (attribution hot shares are lifetime-cumulative — a grain that
+        # was once hot would otherwise never read as cooled)
+        self._replica_cool: Dict[tuple, int] = {}
+        self._replica_prev_msgs: Dict[tuple, int] = {}
         self.cross_silo_moves = 0
         self.cross_silo_grains = 0
         self.last_trigger_share = 0.0
@@ -306,6 +386,7 @@ class RebalanceController:
         signals = self._signals()
         burn = self._slo_burn()
         moves = self.planner.plan(signals, slo_burn=burn)
+        reps = list(self.planner.pending_replications)
         moved_total = 0
         for mv in moves:
             t0 = time.perf_counter()
@@ -325,9 +406,132 @@ class RebalanceController:
                 "share": round(mv.share, 4),
                 "trigger": round(mv.trigger, 4),
                 "pause_s": round(pause, 6), "reason": mv.reason})
+        moved_total += self._apply_replications(reps)
+        self._maybe_demote(signals)
         if self.cfg.cross_silo and self.silo is not None:
             moved_total += await self._cross_silo_leg(burn)
         return moved_total
+
+    # -- hot-grain replication lever ----------------------------------------
+
+    def _replicable(self, arena_name: str) -> bool:
+        """True when the grain TYPE's state is safe to replicate: every
+        method observed carrying traffic (the attribution plane's
+        per-method slots; fallback when no slot data — every declared
+        method) is declared ``@commutative``, so the replica fold is
+        order-independent and exact."""
+        arena = self.engine.arenas.get(arena_name)
+        if arena is None or not arena.info.methods:
+            return False
+        infos = arena.info.methods
+        att = self.engine.attribution
+        active: List[str] = []
+        if att.enabled:
+            prefix = f"{arena_name}."
+            active = [m[len(prefix):]
+                      for m in att.snapshot(cache=True).get("methods", {})
+                      if m.startswith(prefix)]
+        names = [m for m in active if m in infos] or list(infos)
+        return all(getattr(infos[m], "commutative", False)
+                   for m in names)
+
+    def _apply_replications(self, reps: List[Replicate]) -> int:
+        """Apply the planner's promotion decisions: commutative grains
+        promote through ``engine.replicate_key``; non-commutative ones
+        fall back to a single-grain migration to the coolest shard (the
+        old lever — the burn relocates, but at least off the burning
+        shard).  Returns grains moved by the fallback leg."""
+        moved_total = 0
+        for rp in reps:
+            t0 = time.perf_counter()
+            if self._replicable(rp.arena):
+                already = (rp.arena, rp.key) in self._replica_cool
+                got = self.engine.replicate_key(rp.arena, rp.key, rp.k)
+                pause = time.perf_counter() - t0
+                if got and not already:
+                    self.replications_applied += 1
+                    self._replica_cool[(rp.arena, rp.key)] = 0
+                    self.decisions.append({
+                        "t": time.time(), "leg": "replicate",
+                        "arena": rp.arena, "key": rp.key,
+                        "replicas": got,
+                        "grain_share": round(rp.grain_share, 4),
+                        "share": round(rp.share, 4),
+                        "pause_s": round(pause, 6),
+                        "reason": rp.reason})
+            else:
+                moved = self.engine.migrate_keys(
+                    rp.arena, np.array([rp.key], dtype=np.int64),
+                    np.array([rp.fallback_dst], dtype=np.int64))
+                pause = time.perf_counter() - t0
+                if moved:
+                    self.replica_fallback_moves += 1
+                    self.grains_moved += moved
+                    moved_total += moved
+                self.decisions.append({
+                    "t": time.time(), "leg": "replicate-fallback",
+                    "arena": rp.arena, "key": rp.key,
+                    "dst_shard": rp.fallback_dst, "grains": moved,
+                    "pause_s": round(pause, 6),
+                    "reason": "state not commutative — migrated instead"})
+            self.last_move_pause_s = pause
+            self.max_move_pause_s = max(self.max_move_pause_s, pause)
+        return moved_total
+
+    def _maybe_demote(self, signals: List[ArenaSignals]) -> int:
+        """Cool-down sweep: a replicated grain whose INTERVAL share
+        stays below ``demote_share`` for ``demote_patience`` consecutive
+        intervals folds back to one row (promote/demote must not flap —
+        the estimator's shrink-patience discipline).  The attribution
+        hot list carries lifetime-cumulative msgs, so the interval share
+        is the diff against last interval's baseline over the arena's
+        interval total — a grain absent from the top-K reads as cold."""
+        live = {(name, int(k))
+                for name, a in self.engine.arenas.items()
+                for k in a._replicas}
+        if not live:
+            self._replica_cool.clear()
+            self._replica_prev_msgs.clear()
+            return 0
+        totals = {sig.arena: int(np.sum(sig.interval_shard_msgs))
+                  for sig in signals}
+        cum_msgs: Dict[tuple, int] = {}
+        for sig in signals:
+            for h in sig.hot:
+                cum_msgs[(sig.arena, int(h["key"]))] = \
+                    int(h.get("msgs", 0))
+        demoted = 0
+        for ident in sorted(live):
+            prev = self._replica_prev_msgs.get(ident)
+            cum = cum_msgs.get(ident, prev if prev is not None else 0)
+            cum = max(cum, prev or 0)
+            delta = cum - prev if prev is not None else cum
+            self._replica_prev_msgs[ident] = cum
+            total = totals.get(ident[0], 0)
+            share = delta / total if total > 0 else 0.0
+            if share < self.cfg.demote_share:
+                streak = self._replica_cool.get(ident, 0) + 1
+            else:
+                streak = 0
+            self._replica_cool[ident] = streak
+            if streak >= max(1, int(self.cfg.demote_patience)):
+                name, key = ident
+                if self.engine.demote_key(name, key):
+                    demoted += 1
+                    self.demotions_applied += 1
+                    self.decisions.append({
+                        "t": time.time(), "leg": "demote",
+                        "arena": name, "key": key,
+                        "reason": f"share < {self.cfg.demote_share} for "
+                                  f"{streak} intervals"})
+                self._replica_cool.pop(ident, None)
+                self._replica_prev_msgs.pop(ident, None)
+        # grains demoted elsewhere (eviction, reshard): drop tracking
+        for ident in list(self._replica_cool):
+            if ident not in live:
+                self._replica_cool.pop(ident)
+                self._replica_prev_msgs.pop(ident, None)
+        return demoted
 
     async def _cross_silo_leg(self, burn: float) -> int:
         """Move hot grains to a less-loaded PEER when this silo's SLO
@@ -430,6 +634,9 @@ class RebalanceController:
             **self.planner.snapshot(),
             "moves_applied": self.moves_applied,
             "grains_moved": self.grains_moved,
+            "replications_applied": self.replications_applied,
+            "demotions_applied": self.demotions_applied,
+            "replica_fallback_moves": self.replica_fallback_moves,
             "cross_silo_moves": self.cross_silo_moves,
             "cross_silo_grains": self.cross_silo_grains,
             "last_trigger_share": self.last_trigger_share,

@@ -854,10 +854,15 @@ class Silo:
                                      xs["bucket_utilization"],
                                      {"silo": self.name})
                 # per-destination occupancy-sized caps (the sizing
-                # signal the exchange plans from)
+                # signal the exchange plans from) + their steady-state
+                # fill (proof each lane is sized to ITS traffic)
                 for shard, cap in eng.exchange.cap_gauges().items():
                     reg.gauge("route.exchange_cap",
                               {"shard": str(shard)}).set(cap)
+                for shard, util in \
+                        eng.exchange.cap_util_gauges().items():
+                    reg.gauge("route.exchange_cap_util",
+                              {"shard": str(shard)}).set(util)
             for (src_t, src_m), route in eng._stream_routes.items():
                 ss = route.snapshot()
                 emit({"published_events": ss["published_events"],
@@ -1010,6 +1015,15 @@ class Silo:
                     eng.migrations)
                 reg.counter("rebalance.migrated_grains").set_total(
                     eng.grains_migrated)
+                # hot-grain replication: the second actuator's counters
+                reg.counter("rebalance.replicated").set_total(
+                    eng.grains_replicated)
+                reg.counter("rebalance.demoted").set_total(
+                    eng.replica_demotions)
+                reg.counter("rebalance.replica_folds").set_total(
+                    sum(a.replica_folds for a in eng.arenas.values()))
+                reg.counter("rebalance.hot_grain_blocked").set_total(
+                    rb["hot_grain_blocked"])
             att = eng.attribution
             if due:
                 if att.enabled:

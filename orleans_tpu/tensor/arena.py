@@ -57,6 +57,26 @@ def _idle_mask_kernel(last_use_dev, last_use_host, live, cutoff):
     return live & (jnp.maximum(last_use_dev, last_use_host) < cutoff)
 
 
+@jax.jit
+def _spread_replicas_kernel(prim, counts, table, rows):
+    """Scatter resolved rows across a hot grain's replica set: lanes
+    whose row is a replicated PRIMARY re-point to one of the grain's
+    replica rows by lane hash (deterministic — the host twin
+    ``spread_rows_host`` computes the identical choice).  ``prim`` is the
+    sorted primary rows pow2-padded with an int32 sentinel, ``counts``
+    the per-group replica count (pad 1, so the modulus never divides by
+    zero) and ``table`` the [groups, KMAX] replica row table (-1 pad).
+    Non-replicated lanes (and misses, rows < 0) pass through unchanged —
+    the common no-replica case never calls this at all."""
+    lanes = jax.lax.iota(jnp.uint32, rows.shape[0])
+    idx = jnp.clip(jnp.searchsorted(prim, rows), 0, prim.shape[0] - 1)
+    hit = (prim[idx] == rows) & (rows >= 0)
+    h = (lanes * jnp.uint32(2654435761)) >> jnp.uint32(8)
+    choice = (h % counts[idx].astype(jnp.uint32)).astype(jnp.int32)
+    alt = table[idx, choice]
+    return jnp.where(hit & (alt >= 0), alt, rows)
+
+
 def _pow2_pad(rows: np.ndarray, fill: int) -> np.ndarray:
     """Pad an index vector to the next power of two with ``fill`` —
     data-dependent row counts would otherwise compile one eager device
@@ -221,6 +241,24 @@ class GrainArena:
         # True once any activated key falls outside the int32 range:
         # narrow emits to this arena then resolve through the wide mirror
         self.has_wide_keys = False
+        # hot-grain replication (the device-native StatelessWorker
+        # scale-out — see promote_replicas): key → int64 row vector,
+        # rows[0] = the PRIMARY (the row the directory index resolves
+        # to); rows[1:] = secondary replica rows on other shards.
+        # Secondary rows carry the key in ``_key_of_row`` (attribution
+        # and the state columns treat them as ordinary rows) but are
+        # EXCLUDED from the sorted index (``_replica_secondary``), so
+        # key→row resolution stays a bijection onto primaries and the
+        # delivery spread is an explicit post-resolve remap.
+        self._replicas: Dict[int, np.ndarray] = {}
+        self._replica_secondary = np.zeros(self.capacity, dtype=bool)
+        self.replica_promotions = 0
+        self.replica_demotions = 0
+        self.replica_folds = 0
+        # device mirror of the spread map (primary row → replica row
+        # table) — rebuilt lazily, tracer-safe (device_index pattern)
+        self._dev_replicas: Optional[Tuple] = None
+        self._dev_replicas_stale = True
         # weakref to the owning TensorEngine (set by engine.arena_for):
         # row moves settle its auto-fusion chain first — see
         # _settle_owner_chain
@@ -332,7 +370,12 @@ class GrainArena:
     # -- key → row resolution ----------------------------------------------
 
     def _rebuild_index(self) -> None:
+        # replica SECONDARIES are excluded: the index stays a bijection
+        # key → primary row; delivery fans across replicas through the
+        # explicit spread remap (spread_rows_host / replica_mirror)
         live = self._key_of_row >= 0
+        if self._replicas:
+            live = live & ~self._replica_secondary
         rows = np.nonzero(live)[0].astype(np.int32)
         keys = self._key_of_row[rows]
         order = np.argsort(keys, kind="stable")
@@ -625,6 +668,15 @@ class GrainArena:
             # traffic counts move with their rows (device scatter, the
             # last_use_dev discipline — keys keep their totals)
             att.remap_rows(self, old_rows, new_rows, new_capacity)
+        # replica groups ride the same block-preserving row map
+        if self._replicas:
+            self._replicas = {
+                k: (r // old_per) * new_per + (r % old_per)
+                for k, r in self._replicas.items()}
+        new_sec = np.zeros(new_capacity, dtype=bool)
+        new_sec[new_rows] = self._replica_secondary[old_rows]
+        self._replica_secondary = new_sec
+        self._dev_replicas_stale = True
 
         self.state = new_state
         self.shard_capacity = new_per
@@ -744,7 +796,15 @@ class GrainArena:
         GrainDirectoryHandoffManager.cs:141; deactivate→storage→
         reactivate cycle, Catalog.cs:836)."""
         self._settle_owner_chain()
-        rows, found = self.lookup_rows(np.asarray(keys, dtype=np.int64))
+        keys = np.asarray(keys, dtype=np.int64)
+        if self._replicas:
+            # a replicated key folds back to one row FIRST, so the
+            # write-back below stores the merged state and the
+            # secondaries' slots free through the demotion path
+            for k in keys.tolist():
+                if int(k) in self._replicas:
+                    self.demote_replicas(int(k))
+        rows, found = self.lookup_rows(keys)
         return self._deactivate_rows(rows[found], write_back)
 
     def _deactivate_rows(self, victims: np.ndarray, write_back: bool) -> int:
@@ -762,6 +822,10 @@ class GrainArena:
         # settle here would be too late: its replay could repack the
         # arena and stale the victim row ids already in hand
         victims = np.asarray(victims, dtype=np.int64)
+        if self._replicas:
+            # replica member rows never collect individually — demotion
+            # is the only exit (evict_keys demotes first, then re-enters)
+            victims = victims[~self._replica_member_mask(victims)]
         if len(victims) == 0:
             return 0
         keys = self._key_of_row[victims]
@@ -852,6 +916,15 @@ class GrainArena:
         att = self._attribution()
         if att is not None:
             att.remap_rows(self, old_rows, new_rows, self.capacity)
+        if self._replicas:
+            remap = np.full(self.capacity, -1, dtype=np.int64)
+            remap[old_rows] = new_rows
+            self._replicas = {k: remap[r]
+                              for k, r in self._replicas.items()}
+            new_sec = np.zeros(self.capacity, dtype=bool)
+            new_sec[new_rows] = self._replica_secondary[old_rows]
+            self._replica_secondary = new_sec
+            self._dev_replicas_stale = True
         self._dirty = True
         self.generation += 1
 
@@ -890,6 +963,12 @@ class GrainArena:
         rows, found = self.lookup_rows(keys)
         cur = rows.astype(np.int64) // self.shard_capacity
         sel = found & (dst != cur)
+        if self._replicas:
+            # a replicated grain already spans shards — moving its
+            # primary would not change its load picture, and the replica
+            # row table would go stale.  Demote first to migrate.
+            sel &= ~np.isin(keys, np.fromiter(
+                self._replicas, np.int64, len(self._replicas)))
         keys, dst = keys[sel], dst[sel]
         if len(keys) == 0:
             return 0
@@ -942,6 +1021,200 @@ class GrainArena:
         self._dirty = True
         return len(keys)
 
+    # -- hot-grain replication (break the single-hot-grain ceiling) ----------
+    # A grain whose traffic exceeds what one shard can absorb — and whose
+    # state folds commutatively (StateField.fold) — promotes to k replica
+    # rows spread over shards.  Delivery scatters lanes across the
+    # replicas (lane hash), so the per-pair exchange demand divides by k;
+    # reads/checkpoints fold the replicas back with one reduction.  The
+    # key→row bijection is preserved: lookups resolve to the PRIMARY
+    # (``_rebuild_index`` excludes secondaries) and only the spread step
+    # re-points delivery lanes.
+
+    REPLICA_TABLE_WIDTH = 8  # mirror row width; max_replicas knob ≤ this
+
+    def _replica_mirror_host(self) -> Tuple[np.ndarray, np.ndarray,
+                                            np.ndarray]:
+        """(prim, counts, table) host arrays — the one construction both
+        the device mirror and the host spread twin derive from, so the
+        two resolutions agree bit-exactly."""
+        items = sorted(self._replicas.items(), key=lambda kv: int(kv[1][0]))
+        alloc = 1 << max(0, len(items) - 1).bit_length()
+        kmax = self.REPLICA_TABLE_WIDTH
+        prim = np.full(alloc, 2**31 - 1, dtype=np.int32)
+        counts = np.ones(alloc, dtype=np.int32)
+        table = np.full((alloc, kmax), -1, dtype=np.int32)
+        for i, (_, rws) in enumerate(items):
+            k = min(len(rws), kmax)
+            prim[i] = int(rws[0])
+            counts[i] = k
+            table[i, :k] = rws[:k]
+        return prim, counts, table
+
+    def replica_mirror(self) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                      jnp.ndarray]:
+        """Device mirror of the replica table for
+        ``_spread_replicas_kernel`` — row-keyed (works regardless of key
+        width), replicated across the mesh, cached until a
+        promote/demote or row move stales it."""
+        if not self._dev_replicas_stale and self._dev_replicas is not None:
+            return self._dev_replicas
+        parts = tuple(jnp.asarray(a) for a in self._replica_mirror_host())
+        if self.sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            repl = NamedSharding(self.sharding.mesh, PartitionSpec())
+            parts = tuple(jax.device_put(a, repl) for a in parts)
+        if isinstance(parts[0], jax.core.Tracer):
+            return parts  # trace-local (see device_index)
+        self._dev_replicas = parts
+        self._dev_replicas_stale = False
+        return parts
+
+    def spread_rows_host(self, rows: np.ndarray) -> np.ndarray:
+        """Host twin of the spread kernel: identical lane-hash replica
+        choice, applied to host-resolved rows (injector refresh, host
+        resolve path, fused prepare)."""
+        rows = np.asarray(rows)
+        if not self._replicas or len(rows) == 0:
+            return rows
+        prim, counts, table = self._replica_mirror_host()
+        r = rows.astype(np.int64)
+        idx = np.clip(np.searchsorted(prim, r), 0, len(prim) - 1)
+        hit = (prim[idx].astype(np.int64) == r) & (r >= 0)
+        lanes = np.arange(len(r), dtype=np.uint32)
+        h = (lanes * np.uint32(2654435761)) >> np.uint32(8)
+        choice = (h % counts[idx].astype(np.uint32)).astype(np.int64)
+        alt = table[idx, choice].astype(np.int64)
+        out = np.where(hit & (alt >= 0), alt, r)
+        return out.astype(rows.dtype)
+
+    def _replica_member_mask(self, rows: np.ndarray) -> np.ndarray:
+        """True for rows inside any replica group (primary or secondary)
+        — those rows never collect/evict individually; demotion is the
+        only exit."""
+        rows = np.asarray(rows, dtype=np.int64)
+        mask = self._replica_secondary[rows].copy()
+        if self._replicas:
+            prim = np.fromiter((int(r[0]) for r in self._replicas.values()),
+                               np.int64, len(self._replicas))
+            mask |= np.isin(rows, prim)
+        return mask
+
+    def promote_replicas(self, key: int, k: int) -> int:
+        """Promote ``key`` to ``k`` replica rows (its existing row stays
+        the primary; k-1 fresh secondaries land on OTHER shards,
+        round-robin).  Secondary slots come off the free lists holding
+        field inits — the fold identity — so a fresh replica contributes
+        nothing to the merge.  Generation bumps (the next durable
+        checkpoint is a full; deltas never span a replication change)
+        and the eviction epoch bumps (in-flight resolved rows
+        re-validate).  Returns the group size actually installed."""
+        k = int(max(2, min(k, self.REPLICA_TABLE_WIDTH)))
+        self._settle_owner_chain()
+        key = int(key)
+        if key in self._replicas:
+            return len(self._replicas[key])
+        rows, found = self.lookup_rows(np.array([key], dtype=np.int64))
+        if not found[0]:
+            raise KeyError(
+                f"arena {self.info.name}: cannot replicate key {key} — "
+                f"not live")
+        prim_shard = int(rows[0]) // self.shard_capacity
+        if self.n_shards > 1:
+            others = [s for s in range(self.n_shards) if s != prim_shard]
+            shards = np.array([others[i % len(others)]
+                               for i in range(k - 1)], dtype=np.int64)
+        else:
+            shards = np.zeros(k - 1, dtype=np.int64)
+        self._ensure_capacity(np.bincount(shards,
+                                          minlength=self.n_shards))
+        # re-lookup AFTER the capacity check: _grow moves rows
+        prow, found = self.lookup_rows(np.array([key], dtype=np.int64))
+        assert found[0]
+        prow = int(prow[0])
+        sec = self._take_rows(shards)
+        self._key_of_row[sec] = key
+        self._replica_secondary[sec] = True
+        self._replicas[key] = np.concatenate(
+            [np.array([prow], dtype=np.int64), sec])
+        self.last_use_tick[sec] = self.last_use_tick[prow]
+        self.replica_promotions += 1
+        self._dirty = True
+        self._dev_replicas_stale = True
+        self.generation += 1
+        self.eviction_epoch += 1
+        return k
+
+    def _fold_replica_host(self, rws: np.ndarray) -> Dict[str, np.ndarray]:
+        """Commutative merge of one replica group's rows on host.
+        fold="sum" merges as Σ replicas − (k−1)·init (bit-exact for
+        integer dtypes — the exactness-oracle contract); "max"/"min"
+        reduce directly (their identity IS the init by declaration)."""
+        rws = np.asarray(rws, dtype=np.int64)
+        host = self.rows_to_host(rws)
+        k = len(rws)
+        out: Dict[str, np.ndarray] = {}
+        for name, f in self.info.state_fields.items():
+            vals = host[name]
+            if f.fold == "max":
+                out[name] = vals.max(axis=0)
+            elif f.fold == "min":
+                out[name] = vals.min(axis=0)
+            else:
+                init = np.asarray(f.init, dtype=f.dtype)
+                out[name] = (vals.sum(axis=0, dtype=vals.dtype)
+                             - np.asarray(k - 1, dtype=f.dtype) * init
+                             ).astype(f.dtype)
+        return out
+
+    def demote_replicas(self, key: int) -> int:
+        """Fold ``key``'s replica group back to its primary row and free
+        the secondaries — the inverse of ``promote_replicas``, under the
+        eviction-epoch discipline (attribution retires the secondaries'
+        counts per KEY before slot reuse, exactly like eviction).
+        Returns the number of secondary rows freed (0 if not
+        replicated)."""
+        # settle FIRST: a settle-triggered replay may grow/compact this
+        # arena and remap the replica dict — pop only once final
+        self._settle_owner_chain()
+        key = int(key)
+        rws = self._replicas.pop(key, None)
+        if rws is None:
+            return 0
+        rws = np.asarray(rws, dtype=np.int64)
+        prow = int(rws[0])
+        sec = rws[1:]
+        merged = self._fold_replica_host(rws)
+        dst = jnp.asarray(np.array([prow], dtype=np.int32))
+        for name, f in self.info.state_fields.items():
+            val = np.asarray(merged[name],
+                             dtype=f.dtype).reshape((1, *f.shape))
+            self.state[name] = self.state[name].at[dst].set(
+                jnp.asarray(val))
+        # merge the use clocks: the primary inherits the hottest replica
+        dev = np.asarray(self.last_use_dev[
+            jnp.asarray(_pow2_pad(rws, 0))])[:len(rws)]
+        self.last_use_dev = self.last_use_dev.at[dst].max(
+            jnp.int32(int(dev.max())))
+        self.last_use_tick[prow] = int(self.last_use_tick[rws].max())
+        att = self._attribution()
+        if att is not None:
+            # retire the secondaries' traffic per KEY before the slots
+            # can be reused — totals survive demotion exactly as they
+            # survive eviction
+            att.on_evict(self, sec, np.full(len(sec), key,
+                                            dtype=np.int64))
+        self._key_of_row[sec] = -1
+        self._replica_secondary[sec] = False
+        self._free_rows(sec)
+        self.replica_demotions += 1
+        self.replica_folds += 1
+        self._dirty = True
+        self._dev_replicas_stale = True
+        self.generation += 1
+        self.eviction_epoch += 1
+        return len(sec)
+
     # -- elasticity (reference: GrainDirectoryHandoffManager.cs:141) ---------
 
     def reshard(self, n_shards: int, sharding: Optional[Any] = None) -> None:
@@ -953,6 +1226,11 @@ class GrainArena:
         the same stable key hash and the state gathers to its new block in
         one scatter per column."""
         self._settle_owner_chain()
+        # replication is shard-relative: a new mesh invalidates the
+        # spread — fold every group back and let the rebalance
+        # controller re-promote from post-reshard telemetry
+        for k in list(self._replicas):
+            self.demote_replicas(k)
         att = self._attribution()
         if att is not None:
             # fold traffic counts to the host retired mirror while the
@@ -981,6 +1259,9 @@ class GrainArena:
         self._free = [np.empty(0, dtype=np.int64)
                       for _ in range(self.n_shards)]
         self.last_use_tick = np.zeros(self.capacity, dtype=np.int64)
+        self._replica_secondary = np.zeros(self.capacity, dtype=bool)
+        self._dev_replicas = None
+        self._dev_replicas_stale = True
         self.live_count = 0
         self._dirty = True
         self._dev_index_stale = True
@@ -1018,11 +1299,23 @@ class GrainArena:
         if self.store is None:
             raise RuntimeError(f"arena {self.info.name} has no store")
         live_rows = np.nonzero(self._key_of_row >= 0)[0]
+        if self._replicas:
+            live_rows = live_rows[~self._replica_secondary[live_rows]]
         if len(live_rows) == 0:
             return 0
         keys = self._key_of_row[live_rows]
-        self.store.write_many_columnar(self.info.name, keys.tolist(),
-                                       self.rows_to_host(live_rows))
+        cols = self.rows_to_host(live_rows)
+        if self._replicas:
+            # a replicated key's stored record is the commutative FOLD —
+            # the store never sees replica internals, so a restore into
+            # an unreplicated arena is exact
+            pos = {int(kk): i for i, kk in enumerate(keys.tolist())}
+            for kk, rws in self._replicas.items():
+                folded = self._fold_replica_host(rws)
+                i = pos[int(kk)]
+                for name in cols:
+                    cols[name][i] = folded[name]
+        self.store.write_many_columnar(self.info.name, keys.tolist(), cols)
         return len(live_rows)
 
     def restore_from_store(self) -> int:
@@ -1062,6 +1355,12 @@ class GrainArena:
             # shard).  int-keyed dict of small cardinality — JSON-safe.
             "shard_override": {int(k): int(v) for k, v
                                in self._shard_override.items()},
+            # replica groups (primary first): the raw secondary rows ride
+            # the pinned state columns, so a kill/recover spanning a
+            # promoted interval restores the group bit-exactly.  JSON-safe
+            # small dict, like the pins above.
+            "replicas": {int(k): [int(x) for x in r]
+                         for k, r in self._replicas.items()},
         }
 
     def _rebuild_free_lists(self) -> None:
@@ -1104,7 +1403,16 @@ class GrainArena:
         self.last_use_tick = np.asarray(last_use_tick,
                                         dtype=np.int64).copy()
         self._rebuild_free_lists()
-        self.live_count = int((self._key_of_row >= 0).sum())
+        self._replicas = {int(k): np.asarray(v, dtype=np.int64)
+                          for k, v in meta.get("replicas", {}).items()}
+        self._replica_secondary = np.zeros(self.capacity, dtype=bool)
+        for r in self._replicas.values():
+            self._replica_secondary[r[1:]] = True
+        self._dev_replicas = None
+        self._dev_replicas_stale = True
+        # secondaries occupy slots but are not activations
+        self.live_count = int((self._key_of_row >= 0).sum()
+                              - self._replica_secondary.sum())
         self.generation = int(meta["generation"])
         self.eviction_epoch = int(meta["eviction_epoch"])
         self.has_wide_keys = bool(meta.get("has_wide_keys", False))
@@ -1149,6 +1457,10 @@ class GrainArena:
         # 2. stale slots of keys that MOVED since the base snapshot
         lookup, found = self.lookup_rows(keys)
         moved = found & (lookup.astype(np.int64) != rows)
+        if self._replicas:
+            # a secondary row's key looks up to its PRIMARY row — without
+            # this guard the primary slot would be freed as "stale"
+            moved &= ~self._replica_secondary[rows]
         stale = lookup[moved].astype(np.int64)
         freed = np.unique(np.concatenate([dead, stale]))
         if len(freed):
@@ -1172,7 +1484,8 @@ class GrainArena:
                                             dtype=np.int64).copy()
         self._shard_next = np.asarray(shard_next, dtype=np.int64).copy()
         self._rebuild_free_lists()
-        self.live_count = int((self._key_of_row >= 0).sum())
+        self.live_count = int((self._key_of_row >= 0).sum()
+                              - self._replica_secondary.sum())
         self.eviction_epoch = int(meta["eviction_epoch"])
         if "shard_override" in meta:
             # migrations between pins changed placement identity: the
@@ -1211,6 +1524,9 @@ class GrainArena:
     # -- host access (debug / persistence / host-path interop) --------------
 
     def read_row(self, key: int) -> Optional[Dict[str, np.ndarray]]:
+        if int(key) in self._replicas:
+            # replicated grain: the observable state is the fold
+            return self._fold_replica_host(self._replicas[int(key)])
         rows, found = self.lookup_rows(np.array([key], dtype=np.int64))
         if not found[0]:
             return None
@@ -1218,4 +1534,7 @@ class GrainArena:
         return {name: np.asarray(col[r]) for name, col in self.state.items()}
 
     def keys(self) -> np.ndarray:
-        return self._key_of_row[self._key_of_row >= 0]
+        live = self._key_of_row >= 0
+        if self._replicas:
+            live &= ~self._replica_secondary
+        return self._key_of_row[live]

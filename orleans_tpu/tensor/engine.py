@@ -197,8 +197,11 @@ class _ExchangeCheck:
     keys: Optional[jnp.ndarray]  # int32[m] device — redelivery addresses
     args: Any                    # the PRE-exchange args pytree
     dropped: Optional[jnp.ndarray]  # bool[m] device
-    # int32[3 + n_shards] device: (cross, dropped, delivered) sums plus
-    # the per-destination bucket demand the occupancy estimator feeds on
+    # int32[3 + 2·n_shards] device: (cross, dropped, delivered) sums
+    # plus the per-destination bucket demand the occupancy estimator
+    # feeds on, max-over-sources then sum-over-sources (legacy [3 + n]
+    # checks from older paths still drain — fold_stats is
+    # width-agnostic)
     stats: jnp.ndarray
     inject_tick: int = -1
     # a disengaged-exchange probe: stats fold at drain, but the batch
@@ -286,11 +289,15 @@ def resolve_rows_on_device(arena, keys, valid):
     """Pick the cheapest device resolve for this arena: dense direct-map
     when the key space affords it, else sorted searchsorted; wide keys
     (an ``(hi, lo)`` int32 word pair) and arenas holding wide keys use
-    the two-level hash/bucket mirror."""
+    the two-level hash/bucket mirror.  Arenas holding hot-grain replicas
+    pay one extra spread step: lanes resolving to a replicated primary
+    re-point to a replica row by lane hash (the mirror is row-keyed, so
+    the spread composes with every key-width path)."""
     if isinstance(keys, tuple):
         hi, lo = keys
-        return _resolve_rows_wide_kernel(*arena.device_index_wide(),
-                                         hi, lo, valid)
+        rows, misses = _resolve_rows_wide_kernel(
+            *arena.device_index_wide(), hi, lo, valid)
+        return _spread_resolved(arena, rows), misses
     if arena.has_wide_keys:
         # narrow emit keys into a wide-keyed arena: the narrow mirror
         # cannot exist (it would overflow); route through the wide one
@@ -299,13 +306,27 @@ def resolve_rows_on_device(arena, keys, valid):
         # never lookups — without this a padding lane (0, 2**31-1) could
         # alias a live grain whose key IS 2**31-1
         valid = valid & (keys < KEY_SENTINEL)
-        return _resolve_rows_wide_kernel(
+        rows, misses = _resolve_rows_wide_kernel(
             *arena.device_index_wide(), jnp.zeros_like(keys), keys, valid)
+        return _spread_resolved(arena, rows), misses
     dense = arena.dense_index()
     if dense is not None:
-        return _resolve_rows_dense_kernel(dense, keys, valid)
-    sk, sr = arena.device_index()
-    return _resolve_rows_kernel(sk, sr, keys, valid)
+        rows, misses = _resolve_rows_dense_kernel(dense, keys, valid)
+    else:
+        sk, sr = arena.device_index()
+        rows, misses = _resolve_rows_kernel(sk, sr, keys, valid)
+    return _spread_resolved(arena, rows), misses
+
+
+def _spread_resolved(arena, rows):
+    """Apply the hot-grain replica spread when the arena has promoted
+    grains (tensor/arena.py: the mirror arrays are runtime jit INPUTS,
+    not baked constants — a promote/demote re-runs nothing, the next
+    dispatch just reads the new table)."""
+    if not arena._replicas:
+        return rows
+    from orleans_tpu.tensor.arena import _spread_replicas_kernel
+    return _spread_replicas_kernel(*arena.replica_mirror(), rows)
 
 
 @partial(jax.jit, static_argnames=("miss_buf",))
@@ -745,6 +766,12 @@ class TensorEngine:
         # actuator counters, published as rebalance.* by the silo
         self.migrations = 0
         self.grains_migrated = 0
+        # hot-grain replication accounting (replicate_key/demote_key):
+        # the rebalance controller's second actuator, published as
+        # rebalance.replicated/demoted/replica_folds by the silo
+        self.replications = 0
+        self.grains_replicated = 0
+        self.replica_demotions = 0
         self._pending_checks: List[_MissCheck] = []
         # parked cross-shard exchange overflow checks (drained with the
         # miss checks — one batched device read covers both families)
@@ -923,6 +950,45 @@ class TensorEngine:
             self.migrations += 1
             self.grains_migrated += moved
         return moved
+
+    def replicate_key(self, type_name: str, key: int, k: int) -> int:
+        """Promote one hot grain to ``k`` replica rows spread over
+        shards (the rebalance controller's second actuator — for grains
+        too hot for ANY single shard, where migration just moves the
+        burn).  Delivery scatters across the replicas by lane hash, so
+        the per-pair exchange demand divides by k; reads and checkpoints
+        observe the commutative fold (arena.promote_replicas).  Parked
+        optimistic checks drain FIRST, the migrate_keys discipline:
+        their redeliveries re-resolve (and re-spread) against the
+        post-promotion table.  Returns the replica group size (0 if the
+        type is unknown)."""
+        arena = self.arenas.get(type_name)
+        if arena is None:
+            return 0
+        if self._pending_checks or self._exchange_checks \
+                or self._fanout_checks:
+            self._drain_checks()
+        if int(key) in arena._replicas:
+            return len(arena._replicas[int(key)])
+        got = arena.promote_replicas(key, k)
+        self.replications += 1
+        self.grains_replicated += 1
+        return got
+
+    def demote_key(self, type_name: str, key: int) -> int:
+        """Fold a replicated grain back to one row (the controller's
+        cool-down path).  Same drain-first discipline as promotion.
+        Returns secondary rows freed."""
+        arena = self.arenas.get(type_name)
+        if arena is None:
+            return 0
+        if self._pending_checks or self._exchange_checks \
+                or self._fanout_checks:
+            self._drain_checks()
+        freed = arena.demote_replicas(key)
+        if freed:
+            self.replica_demotions += 1
+        return freed
 
     async def reshard(self, mesh: Optional[jax.sharding.Mesh]) -> None:
         """Re-lay every arena over a new mesh — the data-plane elasticity
@@ -1720,6 +1786,8 @@ class TensorEngine:
             # were freed since resolution — re-resolution re-activates
             # any evicted key (through the store) before applying
             rows = arena.resolve_rows(b.keys_host, tick=self.tick_number)
+            if arena._replicas:
+                rows = arena.spread_rows_host(rows)
             return rows.astype(np.int32), args  # numpy → host-pad path
         keys = b.keys_wide if b.keys_wide is not None else b.keys_dev
         m = keys[0].shape[0] if isinstance(keys, tuple) else keys.shape[0]
@@ -2715,6 +2783,14 @@ class TensorEngine:
             "migration_pins": {name: len(a._shard_override)
                                for name, a in self.arenas.items()
                                if a._shard_override},
+            # hot-grain replication (replicate_key/demote_key)
+            "replications": self.replications,
+            "grains_replicated": self.grains_replicated,
+            "replica_demotions": self.replica_demotions,
+            "replica_folds": sum(a.replica_folds
+                                 for a in self.arenas.values()),
+            "replicated_now": sum(len(a._replicas)
+                                  for a in self.arenas.values()),
             "collection": self.collector.snapshot(),
             "fragmentation": {name: round(a.fragmentation(), 4)
                               for name, a in self.arenas.items()},
@@ -2808,7 +2884,14 @@ class BatchInjector:
                 self.epoch = arena.eviction_epoch
                 return
         rows = arena.resolve_rows(self.keys, tick=self.engine.tick_number)
+        # the host mirror stays UNSPREAD (lookup_rows resolves to
+        # primaries, so the epoch revalidation above compares apples to
+        # apples); only the device rows take the replica spread.  Any
+        # promote/demote bumps the generation, so spread rows never
+        # survive a replication change through the epoch-only fast path.
         self._rows_host = rows.astype(np.int32)
+        if arena._replicas:
+            rows = arena.spread_rows_host(rows)
         self.rows = jnp.asarray(rows)
         self.generation = arena.generation
         self.epoch = arena.eviction_epoch

@@ -137,36 +137,100 @@ class _SiteEstimator:
         self._obs = 0
         self.grant: Optional[int] = None
         self.observations = 0
+        # per-DESTINATION formulation: one rung per destination (send
+        # segments) + one receive rung over the worst shard's total
+        # inbound.  Decided on its own window so the scalar grant's
+        # schedule (and the tests pinning it) is untouched.
+        self.grants: Optional[np.ndarray] = None    # int64[n] send caps
+        self.recv_grant: Optional[int] = None       # inbound rung
+        self.peak_inbound = np.zeros(n_shards, np.int64)
+        self.last_need = np.zeros(n_shards, np.int64)
+        self._window_pd = np.zeros(n_shards, np.int64)
+        self._window_in = np.zeros(n_shards, np.int64)
+        self._obs_pd = 0
+
+    @staticmethod
+    def _rungs(vec: np.ndarray, headroom: float) -> np.ndarray:
+        return np.array([ladder_ceil(int(np.ceil(float(v) * headroom)))
+                         if v > 0 else 0 for v in vec], np.int64)
 
     def observe(self, need: np.ndarray, headroom: float,
-                patience: int) -> bool:
-        """Fold one drained need vector; returns True when the grant
-        changed (the caller bumps the exchange's plan version)."""
+                patience: int, inbound: Optional[np.ndarray] = None
+                ) -> Tuple[bool, bool]:
+        """Fold one drained need vector; returns (legacy grant changed,
+        per-dest grants changed) — the caller bumps the exchange's plan
+        version for the planes the configured mode can actually bake
+        (a per-dest-only rung move must NOT re-trace a "never" run).
+        ``need`` is the per-destination demand maxed over source shards
+        (sizes the per-dest send caps); ``inbound`` is the same demand
+        SUMMED over sources — each destination's total inbound, which
+        sizes the receive rung.  Legacy [n]-tail drains pass only
+        ``need``: it then stands in for the inbound too (exact for
+        globally counted tails, an upper bound otherwise)."""
         need = np.asarray(need, np.int64)
+        inb = need if inbound is None else np.asarray(inbound, np.int64)
         self.peak = np.maximum(self.peak, need)
+        self.peak_inbound = np.maximum(self.peak_inbound, inb)
+        self.last_need = need
         self._window = np.maximum(self._window, need)
         self._obs += 1
         self.observations += 1
+        changed = False
         want = ladder_ceil(int(np.ceil(float(need.max()) * headroom))) \
             if need.max() > 0 else 0
         if self.grant is None or want > self.grant:
             self.grant = want
             self._window = np.zeros(self.n_shards, np.int64)
             self._obs = 0
-            return True
-        if self._obs >= max(1, int(patience)):
+            changed = True
+        elif self._obs >= max(1, int(patience)):
             calm = ladder_ceil(int(np.ceil(float(self._window.max())
                                            * headroom)))
             self._window = np.zeros(self.n_shards, np.int64)
             self._obs = 0
             if calm < self.grant:
                 self.grant = calm
-                return True
-        return False
+                changed = True
+        # per-destination grants: any rung grows immediately; shrink
+        # waits for a full calm window (same discipline, vectorized)
+        self._window_pd = np.maximum(self._window_pd, need)
+        self._window_in = np.maximum(self._window_in, inb)
+        self._obs_pd += 1
+        changed_pd = False
+        want_pd = self._rungs(need, headroom)
+        want_r = ladder_ceil(int(np.ceil(float(inb.max()) * headroom))) \
+            if inb.max() > 0 else 0
+        if self.grants is None or (want_pd > self.grants).any() \
+                or want_r > self.recv_grant:
+            self.grants = want_pd if self.grants is None \
+                else np.maximum(self.grants, want_pd)
+            self.recv_grant = want_r if self.recv_grant is None \
+                else max(self.recv_grant, want_r)
+            self._window_pd = np.zeros(self.n_shards, np.int64)
+            self._window_in = np.zeros(self.n_shards, np.int64)
+            self._obs_pd = 0
+            changed_pd = True
+        elif self._obs_pd >= max(1, int(patience)):
+            calm_pd = self._rungs(self._window_pd, headroom)
+            calm_r = ladder_ceil(int(np.ceil(
+                float(self._window_in.max()) * headroom))) \
+                if self._window_in.max() > 0 else 0
+            self._window_pd = np.zeros(self.n_shards, np.int64)
+            self._window_in = np.zeros(self.n_shards, np.int64)
+            self._obs_pd = 0
+            if (calm_pd < self.grants).any() or calm_r < self.recv_grant:
+                self.grants = np.minimum(self.grants, calm_pd)
+                self.recv_grant = min(self.recv_grant, calm_r)
+                changed_pd = True
+        return changed, changed_pd
 
     def snapshot(self) -> Dict[str, Any]:
         return {"grant": self.grant,
+                "grants": None if self.grants is None
+                else self.grants.tolist(),
+                "recv_grant": self.recv_grant,
                 "peak_need": self.peak.tolist(),
+                "peak_inbound": self.peak_inbound.tolist(),
                 "observations": self.observations}
 
 
@@ -303,10 +367,12 @@ class ShardExchange:
 
     def _probe(self, arena, rows, mask, site: Site) -> Any:
         """Measure-only classification for a disengaged exchange: one
-        async jit returning the int32[3+n] stats vector (cross, 0,
-        valid, per-dest demand) — the batch itself is untouched and
-        delivers through the normal path, so the parked check must
-        never redeliver (measure_only)."""
+        async jit returning the int32[3+2n] stats vector (cross, 0,
+        valid, per-dest demand twice — the global count is both an
+        upper bound on the per-src need and the exact total inbound) —
+        the batch itself is untouched and delivers through the normal
+        path, so the parked check must never redeliver
+        (measure_only)."""
         n = self.n_shards
         m = int(rows.shape[0])
         shard_capacity = int(arena.shard_capacity)
@@ -330,25 +396,41 @@ class ShardExchange:
                 # probe semantics: cross lanes DELIVER (through the
                 # implicit-collective path) — counted as cross traffic,
                 # never as drops
+                g = demand_per_dest(cross, dest, n)
                 return jnp.concatenate([jnp.stack([
                     jnp.sum(cross.astype(jnp.int32)),
                     jnp.int32(0),
                     jnp.sum(valid.astype(jnp.int32)),
-                ]), demand_per_dest(cross, dest, n)])
+                ]), g, g])
             fn = jax.jit(call)
             self._jit_cache[key] = fn
         return fn(jnp.asarray(rows), mask)
 
     # -- occupancy-sized planning -------------------------------------------
 
-    def observe_need(self, site: Site, need: np.ndarray) -> None:
-        """Fold one drained per-destination demand vector for a site."""
+    def observe_need(self, site: Site, need: np.ndarray,
+                     inbound: Optional[np.ndarray] = None) -> None:
+        """Fold one drained per-destination demand vector for a site.
+        A [2n] vector (max-half ‖ sum-half) may arrive as one array in
+        ``need``; it is split here so every drain path can stay
+        width-agnostic."""
         cfg = self.engine.config
+        need = np.asarray(need)
+        n = self.n_shards
+        if inbound is None and need.shape[0] == 2 * n:
+            need, inbound = need[:n], need[n:]
         est = self.estimators.get(site)
         if est is None:
             est = self.estimators[site] = _SiteEstimator(self.n_shards)
-        if est.observe(np.asarray(need), cfg.exchange_headroom,
-                       cfg.exchange_shrink_patience):
+        changed, changed_pd = est.observe(
+            need, cfg.exchange_headroom,
+            cfg.exchange_shrink_patience, inbound=inbound)
+        # a per-dest-only rung move is invisible to a "never" run's
+        # baked plans — bumping the version there would re-trace every
+        # fused window for a vector no plan consumes (the estimator
+        # keeps tracking either way: gauges + a later mode flip)
+        if changed or (changed_pd and getattr(
+                cfg, "exchange_per_dest", "auto") != "never"):
             self.cap_version += 1
 
     def grant_for(self, site: Optional[Site]) -> Optional[int]:
@@ -356,6 +438,17 @@ class ShardExchange:
             return None
         est = self.estimators.get(site)
         return None if est is None else est.grant
+
+    def grants_for(self, site: Optional[Site]
+                   ) -> Optional[Tuple[np.ndarray, int]]:
+        """The per-destination grant vector + receive rung for a
+        measured site, or None (unmeasured / sizing off)."""
+        if site is None or not self.engine.config.exchange_occupancy_sizing:
+            return None
+        est = self.estimators.get(site)
+        if est is None or est.grants is None:
+            return None
+        return est.grants, int(est.recv_grant or 0)
 
     def plan(self, m: int, site: Optional[Site] = None
              ) -> Tuple[int, int]:
@@ -390,6 +483,36 @@ class ShardExchange:
             int(L / n * cfg.exchange_capacity_factor))))
         return L, cap
 
+    def plan_ex(self, m: int, site: Optional[Site] = None):
+        """The mode-selecting plan: ``("legacy", L, cap, None)`` or
+        ``("perdest", L, cap, (caps_tuple, R))``.  The per-destination
+        formulation replaces the ``n·cap`` send/receive layout with
+        per-dest send segments (width ``sum(caps)``) and one receive
+        rung ``R`` sized by the worst shard's total inbound —
+        ``exchange_per_dest="auto"`` engages it only when that is
+        strictly narrower than the legacy layout for the measured site,
+        so symmetric demand keeps the exact legacy plan."""
+        L, cap = self.plan(m, site=site)
+        mode = getattr(self.engine.config, "exchange_per_dest", "auto")
+        if mode == "never":
+            return ("legacy", L, cap, None)
+        pd = self.grants_for(site)
+        if pd is None:
+            return ("legacy", L, cap, None)
+        grants, recv = pd
+        caps = np.minimum(grants, L).astype(np.int64)
+        if caps.max() == 0 or cap == 0:
+            # no measured cross demand: the legacy cap-0 fast path is
+            # already the narrowest possible program
+            return ("legacy", L, cap, None)
+        n = self.n_shards
+        R = max(1, ladder_ceil(min(int(recv), n * L)))
+        S = int(caps.sum())
+        if mode != "always" and S + R >= 2 * n * cap:
+            return ("legacy", L, cap, None)
+        return ("perdest", L, cap,
+                (tuple(int(c) for c in caps), R))
+
     def plan_signature(self, sites) -> Tuple:
         """What a fused window's baked exchange plans depend on: the
         occupancy toggle, the fallback knobs, and the current grant per
@@ -397,11 +520,22 @@ class ShardExchange:
         (cause ``bucket_growth`` — re-quantization is attributed, never
         a silent recompile)."""
         cfg = self.engine.config
+        mode = getattr(cfg, "exchange_per_dest", "auto")
+
+        def pd_sig(s):
+            # a "never" run bakes only legacy plans: the per-dest
+            # vector must not churn its signature
+            if mode == "never":
+                return None
+            pd = self.grants_for(s)
+            return None if pd is None else (tuple(pd[0].tolist()), pd[1])
         return (self.engaged(),
                 bool(cfg.exchange_occupancy_sizing),
+                mode,
                 int(cfg.exchange_pad_quantum),
                 float(cfg.exchange_capacity_factor),
-                tuple((s, self.grant_for(s)) for s in sorted(sites)))
+                tuple((s, self.grant_for(s), pd_sig(s))
+                      for s in sorted(sites)))
 
     # -- host-side shard alignment ------------------------------------------
 
@@ -495,12 +629,16 @@ class ShardExchange:
             _valid, dest, local, cross = classify_lanes(
                 rows, mask, shard_capacity, L, n)
             # cap-0 semantics: cross lanes DROP into redelivery
-            # (stats[1]) — the estimate said there were none
+            # (stats[1]) — the estimate said there were none.  The
+            # demand tail is GLOBAL, so it serves as both halves of the
+            # [2n] tail: an upper bound on the per-src need and the
+            # exact total inbound.
+            g = demand_per_dest(cross, dest, n)
             stats = jnp.concatenate([jnp.stack([
                 jnp.int32(0),
                 jnp.sum(cross.astype(jnp.int32)),
                 jnp.sum(local.astype(jnp.int32)),
-            ]), demand_per_dest(cross, dest, n)])
+            ]), g, g])
             recv_rows = jnp.where(local, rows, -1)
             return recv_rows, leaves, local, cross, stats
 
@@ -573,10 +711,140 @@ class ShardExchange:
                        out_specs=out_specs, check_rep=False)
         recv_rows, recv_mask, dropped, stats, *recv_leaves = fn(
             rows, mask, *leaves)
-        # counts SUM across shards; per-dest demand is a MAX (the bucket
-        # is per (src, dst) pair, so the cap must cover the worst src)
+        # counts SUM across shards; the per-dest demand reduces BOTH
+        # ways into the [2n] tail — MAX over sources (the per-(src,dst)
+        # bucket cap must cover the worst src) and SUM over sources
+        # (each destination's total inbound, sizing the per-dest
+        # formulation's receive rung)
         stats = jnp.concatenate([jnp.sum(stats[:, :3], axis=0),
-                                 jnp.max(stats[:, 3:], axis=0)])
+                                 jnp.max(stats[:, 3:], axis=0),
+                                 jnp.sum(stats[:, 3:], axis=0)])
+        return recv_rows, recv_leaves, recv_mask, dropped, stats
+
+    def _traced_perdest(self, rows, leaves: List[Any], mask,
+                        shard_capacity: int, L: int,
+                        caps: Tuple[int, ...], R: int):
+        """The per-DESTINATION exchange body: same contract as
+        ``_traced`` (``(recv_rows, recv_leaves, recv_mask, dropped,
+        stats[3+2n])``), different layout.  Each shard packs its cross
+        lanes into per-destination send segments at static offsets
+        (width ``S = sum(caps)`` instead of ``n * cap`` — one hot
+        destination no longer sizes every lane's buckets), the segments
+        move with one ``all_gather`` alongside an ``[n, n]`` fill
+        matrix, and each shard compacts its inbound lanes to the single
+        receive rung ``R`` with a searchsorted over the fill prefix
+        sums + one gather per leaf (no sort).  Receive overflow (total
+        inbound past ``R``) is computed on the SENDER from the same
+        fill prefix ranks the receiver takes lanes in, so an overflow
+        lane parks into the standard redelivery net instead of being
+        silently truncated."""
+        from jax.experimental.shard_map import shard_map
+
+        n = self.n_shards
+        axis = self.axis
+        m_pad = n * L
+        caps_arr = np.asarray(caps, np.int32)
+        offs_arr = np.concatenate([[0], np.cumsum(caps_arr)[:-1]]) \
+            .astype(np.int32)
+        S = int(caps_arr.sum())
+
+        def pad_to(x, fill):
+            if x.shape[0] == m_pad:
+                return x
+            widths = [(0, m_pad - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, widths, constant_values=fill)
+
+        rows = pad_to(jnp.asarray(rows, jnp.int32), -1)
+        mask = pad_to(jnp.asarray(mask, bool), False)
+        leaves = [pad_to(jnp.asarray(x), 0) for x in leaves]
+
+        def per_shard(rows_l, mask_l, *leaves_l):
+            my = jax.lax.axis_index(axis)
+            valid = mask_l & (rows_l >= 0)
+            dest = jnp.where(valid, rows_l // shard_capacity, n)
+            local = valid & (dest == my)
+            cross = valid & ~local
+            need = demand_per_dest(cross, dest, n)
+            sdest_in = jnp.where(cross, dest, n)
+            order = jnp.argsort(sdest_in)  # ties keep relative order
+            sdest = sdest_in[order]
+            start = jnp.searchsorted(sdest,
+                                     jnp.arange(n, dtype=sdest.dtype))
+            pos = jnp.arange(L) - start[jnp.clip(sdest, 0, n - 1)]
+            caps_v = jnp.asarray(caps_arr)
+            offs_v = jnp.asarray(offs_arr)
+            sdest_c = jnp.clip(sdest, 0, n - 1)
+            fits = (sdest < n) & (pos < caps_v[sdest_c])
+            slot = jnp.where(fits, offs_v[sdest_c] + pos, S)
+            send_rows = jnp.full(S, -1, jnp.int32) \
+                .at[slot].set(rows_l[order], mode="drop")
+
+            def segment(leaf):
+                s = leaf[order]
+                out = jnp.zeros((S,) + s.shape[1:], s.dtype)
+                return out.at[slot].set(s, mode="drop")
+
+            send_leaves = [segment(x) for x in leaves_l]
+            # fill matrix: lanes each source actually packed per dest
+            fills_row = jnp.minimum(need, caps_v)
+            fills = jax.lax.all_gather(fills_row, axis)      # [n, n]
+            g_rows = jax.lax.all_gather(send_rows, axis)     # [n, S]
+            g_leaves = [jax.lax.all_gather(s, axis)
+                        for s in send_leaves]
+            # receive compaction to R lanes, src-major order: output
+            # position j maps through the inbound prefix sums to
+            # (source shard, offset within its segment for me)
+            mine = fills[:, my]
+            cum = jnp.cumsum(mine)
+            total_in = cum[n - 1]
+            j = jnp.arange(R)
+            src = jnp.searchsorted(cum, j, side="right")
+            src_c = jnp.clip(src, 0, n - 1)
+            within = j - (cum[src_c] - mine[src_c])
+            live = j < total_in
+            lane = jnp.clip(offs_v[my] + within, 0, max(S - 1, 0))
+            recv_rows_x = jnp.where(live, g_rows[src_c, lane], -1)
+
+            def compact(g):
+                out = g[src_c, lane]
+                shape = (R,) + (1,) * (out.ndim - 1)
+                return jnp.where(live.reshape(shape), out,
+                                 jnp.zeros((), out.dtype))
+
+            recv_leaves_x = [compact(g) for g in g_leaves]
+            # sender-side receive-overflow: the global rank of a sent
+            # lane in the receiver's src-major take order
+            before = jnp.cumsum(fills, axis=0) - fills       # excl. src
+            rank = before[my][sdest_c] + pos
+            recv_drop = fits & (rank >= R)
+            dropped_sorted = ((sdest < n) & ~fits) | recv_drop
+            dropped_l = jnp.zeros(L, bool).at[order].set(dropped_sorted)
+            n_dropped = jnp.sum(dropped_sorted.astype(jnp.int32))
+            recv_rows = jnp.concatenate(
+                [jnp.where(local, rows_l, -1), recv_rows_x])
+            recv_leaves = [
+                jnp.concatenate([x, rx])
+                for x, rx in zip(leaves_l, recv_leaves_x)]
+            recv_mask = recv_rows >= 0
+            stats = jnp.concatenate([jnp.stack([
+                jnp.sum(cross.astype(jnp.int32)),
+                n_dropped,
+                jnp.sum(valid.astype(jnp.int32)) - n_dropped,
+            ]), need])[None, :]  # [1, 3 + n]: reduced outside
+            return (recv_rows, recv_mask, dropped_l, stats, *recv_leaves)
+
+        P = PartitionSpec
+        sharded = P(axis)
+        out_specs = (sharded, sharded, sharded, sharded) \
+            + (sharded,) * len(leaves)
+        fn = shard_map(per_shard, mesh=self.mesh,
+                       in_specs=(sharded, sharded) + (sharded,) * len(leaves),
+                       out_specs=out_specs, check_rep=False)
+        recv_rows, recv_mask, dropped, stats, *recv_leaves = fn(
+            rows, mask, *leaves)
+        stats = jnp.concatenate([jnp.sum(stats[:, :3], axis=0),
+                                 jnp.max(stats[:, 3:], axis=0),
+                                 jnp.sum(stats[:, 3:], axis=0)])
         return recv_rows, recv_leaves, recv_mask, dropped, stats
 
     # -- fused-path entry (called under an active trace) ---------------------
@@ -587,7 +855,8 @@ class ShardExchange:
         ``(rows2, args2, mask2, dropped_count, need)`` — the dropped
         count folds into the window's device-side miss counter so a
         capacity overflow fails ``verify()`` (rollback + unfused replay)
-        instead of losing lanes, and ``need`` (int32[n]) rides the
+        instead of losing lanes, and ``need`` (int32[2n]: per-dest
+        demand maxed over sources ‖ summed over sources) rides the
         window's xneed accumulator so steady fused traffic keeps the
         site's occupancy estimate honest in BOTH directions.  A group
         whose args are not lane-aligned (slab-style handlers consuming a
@@ -598,8 +867,8 @@ class ShardExchange:
         n = self.n_shards
         if not exchangeable_args(args, m):
             return rows, args, mask, jnp.int32(0), \
-                jnp.zeros(n, jnp.int32)
-        L, cap = self.plan(m, site=site)
+                jnp.zeros(2 * n, jnp.int32)
+        mode, L, cap, pd = self.plan_ex(m, site=site)
         if cap == 0:
             # LEAN in-scan fast path: classification + the miss count,
             # nothing else — the per-tick demand reductions of the full
@@ -630,10 +899,15 @@ class ShardExchange:
             self.trace_log.append((site, int(m), m_pad))
             self.note_transport_width(m_pad)
             return (jnp.where(local, rows_p, -1), args_p, local,
-                    dropped, jnp.zeros(n, jnp.int32))
+                    dropped, jnp.zeros(2 * n, jnp.int32))
         leaves, treedef, scalar_ix = _split_leaves(args, m)
-        rows2, leaves2, mask2, _dropped, stats = self._traced(
-            rows, leaves, mask, shard_capacity, L, cap)
+        if mode == "perdest":
+            caps, R = pd
+            rows2, leaves2, mask2, _dropped, stats = self._traced_perdest(
+                rows, leaves, mask, shard_capacity, L, caps, R)
+        else:
+            rows2, leaves2, mask2, _dropped, stats = self._traced(
+                rows, leaves, mask, shard_capacity, L, cap)
         args2 = _join_leaves(treedef, scalar_ix, leaves2)
         self.trace_log.append((site, int(m), int(rows2.shape[0])))
         self.note_transport_width(int(rows2.shape[0]))
@@ -646,7 +920,7 @@ class ShardExchange:
                  defer_stats: bool = False):
         """One async exchange dispatch for an unfused batch.  Returns
         ``(rows2, args2, mask2, dropped_mask, stats)`` with the dropped
-        mask and the int32[3+n] stats still ON DEVICE — the engine parks
+        mask and the int32[3+2n] stats still ON DEVICE — the engine parks
         them (like a miss-check) and reads everything in one batched
         transfer at the next quiescence point.  ``defer_stats`` (the
         round-start pre-dispatch) appends a run-cost tuple to the
@@ -656,14 +930,26 @@ class ShardExchange:
         t0 = time.perf_counter()
         m = int(rows.shape[0])
         shard_capacity = int(arena.shard_capacity)
-        L, cap = self.plan(m, site=site)
+        mode, L, cap, pd = self.plan_ex(m, site=site)
         leaves, treedef, scalar_ix = _split_leaves(args, m)
-        key = (L, cap, shard_capacity, len(leaves))
+        if mode == "perdest":
+            caps, R = pd
+            key = (L, ("pd", caps, R), shard_capacity, len(leaves))
+            cap_label = f"pd{sum(caps)}r{R}"
+        else:
+            key = (L, cap, shard_capacity, len(leaves))
+            cap_label = str(cap)
         fn = self._jit_cache.get(key)
         if fn is None:
-            def call(rows, mask, *leaves):
-                return self._traced(rows, list(leaves), mask,
-                                    shard_capacity, L, cap)
+            if mode == "perdest":
+                def call(rows, mask, *leaves):
+                    return self._traced_perdest(
+                        rows, list(leaves), mask, shard_capacity,
+                        L, caps, R)
+            else:
+                def call(rows, mask, *leaves):
+                    return self._traced(rows, list(leaves), mask,
+                                        shard_capacity, L, cap)
             fn = jax.jit(call)
             self._jit_cache[key] = fn
             shape = (L, shard_capacity, len(leaves))
@@ -676,9 +962,10 @@ class ShardExchange:
                 from orleans_tpu.tensor.profiler import CAUSE_BUCKET_GROWTH
                 self.engine.compile_tracker.record(
                     CAUSE_BUCKET_GROWTH,
-                    key=f"exchange[{L}]cap{sorted(seen)[-1]}->{cap}",
+                    key=f"exchange[{L}]cap{sorted(seen)[-1]}"
+                        f"->{cap_label}",
                     tick=self.engine.tick_number)
-            seen.add(cap)
+            seen.add(cap_label)
         rows2, leaves2, mask2, dropped, stats = fn(
             jnp.asarray(rows), mask, *leaves)
         args2 = _join_leaves(treedef, scalar_ix, leaves2)
@@ -707,8 +994,10 @@ class ShardExchange:
     def fold_stats(self, stats_host: np.ndarray,
                    site: Optional[Site] = None,
                    scale: int = 1) -> None:
-        """Accumulate one drained [3 + n] stats vector; the demand tail
-        feeds the site's occupancy estimator.  ``scale > 1`` marks a
+        """Accumulate one drained [3 + n] or [3 + 2n] stats vector; the
+        demand tail feeds the site's occupancy estimator (a [2n] tail
+        splits into max-half ‖ sum-half inside ``observe_need``).
+        ``scale > 1`` marks a
         SAMPLED disengaged-mode probe (1-in-scale groups measured):
         count stats multiply up to stay an unbiased estimate comparable
         with engaged-mode exact totals; the demand tail is a peak, not
@@ -749,6 +1038,22 @@ class ShardExchange:
                 rung = ladder_ceil(int(np.ceil(
                     float(est.peak[s]) * cfg.exchange_headroom)))
                 out[s] = max(out[s], rung)
+        return out
+
+    def cap_util_gauges(self) -> Dict[int, float]:
+        """Steady-state utilization of the per-destination grants: the
+        LAST drained demand over the current grant per destination,
+        maxed over sites — the ``route.exchange_cap_util{shard}``
+        gauge.  1.0 means the grant is exactly full; a persistently
+        low column is padding every lane pays for."""
+        out = {s: 0.0 for s in range(self.n_shards)}
+        for est in self.estimators.values():
+            if est.grants is None:
+                continue
+            for s in range(self.n_shards):
+                if est.grants[s] > 0:
+                    util = float(est.last_need[s]) / float(est.grants[s])
+                    out[s] = max(out[s], round(util, 4))
         return out
 
     def snapshot(self) -> Dict[str, Any]:

@@ -86,7 +86,8 @@ class _Source:
         self.method = method
         self.arena = engine.arena_for(type_name)
         self.keys = np.asarray(keys, dtype=np.int64)
-        self.rows = jnp.asarray(self.arena.resolve_rows(self.keys))
+        self.rows = jnp.asarray(self.arena.spread_rows_host(
+            self.arena.resolve_rows(self.keys)))
 
 
 class FusedTickProgram:
@@ -726,8 +727,13 @@ class FusedTickProgram:
             return {}
         if self._xneed is not None:
             return self._xneed
+        # [2n]: per-dest demand maxed over sources ‖ summed over
+        # sources (the per-dest formulation's receive-rung signal) —
+        # matches apply_traced's need vector; max-merge is correct for
+        # both halves (each is a per-tick peak)
         n = self.engine.n_shards
-        return {k: jnp.zeros(n, jnp.int32) for k in self._exchange_sites}
+        return {k: jnp.zeros(2 * n, jnp.int32)
+                for k in self._exchange_sites}
 
     def _fold_xneed(self) -> None:
         """Read the accumulated per-site bucket demand (one small
@@ -814,7 +820,8 @@ class FusedTickProgram:
             self._fold_xneed()
             self._donate = donate_target
             for s in self.sources:
-                s.rows = jnp.asarray(s.arena.resolve_rows(s.keys))
+                s.rows = jnp.asarray(s.arena.spread_rows_host(
+                    s.arena.resolve_rows(s.keys)))
             examples = [
                 {**statics[i], **jax.tree_util.tree_map(lambda a: a[0],
                                                         stackeds[i])}

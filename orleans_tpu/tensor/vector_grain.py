@@ -79,15 +79,25 @@ def ones_mask(n: int):
 
 @dataclass(frozen=True)
 class StateField:
-    """One per-activation state column."""
+    """One per-activation state column.
+
+    ``fold`` names the replica-merge reduction for hot-grain
+    replication (tensor/arena.py promote/demote): "sum" (the default —
+    replicas start at ``init`` and accumulate deltas, so the merged
+    value is ``Σ replicas − (k−1)·init``), "max", or "min".  Only
+    consulted when the grain is promoted; unreplicated grains never
+    touch it."""
 
     shape: Tuple[int, ...]
     dtype: Any
     init: Any  # scalar or array broadcast to shape
+    fold: str = "sum"
 
 
-def field(dtype, init=0, shape: Tuple[int, ...] = ()) -> StateField:
-    return StateField(shape=tuple(shape), dtype=dtype, init=init)
+def field(dtype, init=0, shape: Tuple[int, ...] = (),
+          fold: str = "sum") -> StateField:
+    return StateField(shape=tuple(shape), dtype=dtype, init=init,
+                      fold=fold)
 
 
 class Batch(NamedTuple):
@@ -294,7 +304,8 @@ def vector_grain(cls: type) -> type:
             methods[name] = MethodInfo(
                 name=name, method_id=method_id_of(name),
                 one_way=getattr(fn, "__grain_one_way__", False),
-                batched=True)
+                batched=True,
+                commutative=getattr(fn, "__grain_commutative__", False))
     iface = InterfaceInfo(name=cls.__name__,
                           interface_id=type_code_of(cls.__name__), cls=cls)
     for m in methods.values():

@@ -21,7 +21,7 @@ from typing import Dict, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from orleans_tpu.core.grain import batched_method
+from orleans_tpu.core.grain import batched_method, commutative
 from orleans_tpu.tensor import (
     Batch,
     Emit,
@@ -64,13 +64,19 @@ class RouteSource(VectorGrain):
 
 @vector_grain
 class RouteSink(VectorGrain):
-    """Per-consumer aggregate (order-free fan-in)."""
+    """Per-consumer aggregate (order-free fan-in).
+
+    ``recv`` is declared ``@commutative``: both columns are pure sums,
+    so a hot sink may be promoted to replica rows (hot-grain
+    replication) and the fold is exact — this is what lets the
+    rebalance bench's single-hot-grain tier recover."""
 
     total = field(jnp.float32, 0.0)
     received = field(jnp.int32, 0)
 
     @batched_method
     @staticmethod
+    @commutative
     def recv(state, batch: Batch, n_rows: int):
         rows, args = batch.rows, batch.args
         return {**state,

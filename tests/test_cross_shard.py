@@ -693,12 +693,17 @@ def test_estimator_grows_immediately_shrinks_with_patience():
     assert caps[1] == 0
 
 
-def test_undersized_estimate_parks_and_redelivers_under_churn(run):
+@pytest.mark.parametrize("per_dest", ["never", "always"])
+def test_undersized_estimate_parks_and_redelivers_under_churn(run,
+                                                              per_dest):
     """THE safety property of occupancy sizing: a stale/undersized cap
     estimate may only ever park-and-redeliver — never drop, never
     double-deliver — across traffic shifts, arena growth, mesh
     reshards, and eviction-epoch bumps.  Verified by an exact host
-    mirror of every delivery across randomized churn rounds."""
+    mirror of every delivery across randomized churn rounds.
+    Parametrized over BOTH exchange bodies: the legacy max-over-dest
+    cap and the per-destination grant vector — an undersized/stale
+    per-dest grant must obey the identical conservation contract."""
 
     async def main():
         from orleans_tpu.tensor import MemoryVectorStore
@@ -707,6 +712,7 @@ def test_undersized_estimate_parks_and_redelivers_under_churn(run):
                          store=MemoryVectorStore())
         e.config.auto_fusion_ticks = 0
         e.config.exchange_structured = "always"
+        e.config.exchange_per_dest = per_dest
         e.config.exchange_shrink_patience = 1  # shrink eagerly: the
         # estimate goes stale the moment traffic shifts back up
         n_src = 256

@@ -131,10 +131,56 @@ def test_planner_move_budget_and_burning_shard_selection():
 def test_planner_min_grain_share_filters_cold_movers():
     p = RebalancePlanner(_cfg(min_grain_share=0.05))
     hot = [{"key": 1, "msgs": 10, "share": 0.01, "shard": 0},
-           {"key": 2, "msgs": 900, "share": 0.6, "shard": 0}]
+           {"key": 2, "msgs": 900, "share": 0.12, "shard": 0}]
     moves = p.plan([_sig([900, 50, 25, 25], hot=hot)])
     assert len(moves) == 1
     assert moves[0].keys.tolist() == [2]
+    assert p.pending_replications == []
+
+
+def test_planner_replicate_share_routes_to_replication():
+    """A grain whose OWN share clears replicate_share is beyond the
+    single-shard ceiling: it leaves the mover list and becomes a
+    Replicate decision (migrating it would just relocate the burn)."""
+    p = RebalancePlanner(_cfg(min_grain_share=0.05))
+    hot = [{"key": 1, "msgs": 60, "share": 0.06, "shard": 0},
+           {"key": 2, "msgs": 900, "share": 0.6, "shard": 0}]
+    moves = p.plan([_sig([900, 50, 25, 25], hot=hot)])
+    assert len(moves) == 1
+    assert moves[0].keys.tolist() == [1]          # only the mild mover
+    assert len(p.pending_replications) == 1
+    rp = p.pending_replications[0]
+    assert rp.key == 2 and rp.src_shard == 0
+    assert rp.k >= 2 and rp.fallback_dst != 0
+    assert p.replications_planned == 1
+    # replicate_share=0 disables the lever entirely (pure migration)
+    p2 = RebalancePlanner(_cfg(min_grain_share=0.05,
+                               replicate_share=0.0))
+    moves2 = p2.plan([_sig([900, 50, 25, 25], hot=hot)])
+    assert moves2[0].keys.tolist() == [1, 2]
+    assert p2.pending_replications == []
+
+
+def test_planner_hot_grain_blocked_routes_to_replication():
+    """THE BUGFIX: a burning shard whose heat rides one grain below the
+    mover floor used to spin forever — hysteresis armed, zero
+    candidates, zero action every interval.  It now counts
+    hot_grain_blocked and routes the hottest grain to replication."""
+    p = RebalancePlanner(_cfg(min_grain_share=0.2))
+    hot = [{"key": 9, "msgs": 850, "share": 0.14, "shard": 0}]
+    moves = p.plan([_sig([900, 50, 25, 25], hot=hot)])
+    assert moves == []
+    assert p.hot_grain_blocked == 1
+    assert p.skipped_no_candidates == 0
+    assert len(p.pending_replications) == 1
+    assert p.pending_replications[0].key == 9
+    # with replication disabled the old silent-idle remains, but it is
+    # at least counted as no-candidates (not an infinite armed spin)
+    p2 = RebalancePlanner(_cfg(min_grain_share=0.2,
+                               replicate_share=0.0))
+    assert p2.plan([_sig([900, 50, 25, 25], hot=hot)]) == []
+    assert p2.skipped_no_candidates == 1
+    assert p2.hot_grain_blocked == 0
 
 
 def test_planner_cooldown_then_rearm():

@@ -1,0 +1,393 @@
+"""Hot-grain replication (tensor/arena.py promote/demote, the engine's
+replica-spread delivery path, runtime/rebalancer.py replicate/demote
+legs).
+
+Covers the PR's contracts: the spread kernel's host twin and device
+body agree bit-for-bit on the same mirror; replication exactness — the
+commutative-fold results of a replicated engine are bit-identical to an
+unreplicated oracle engine over the same injection sequence, INCLUDING
+a demotion mid-traffic; promote/demote identity discipline (idempotent
+promote, fold-on-read, secondaries invisible to keys()/live_count,
+eviction demotes first); kill/recover where the durable cadence SPANS a
+promoted interval (journal + checkpoints cut while replicas are live,
+hard kill, fresh-engine recovery restores the replica group and the
+fold stays exact); and the controller closed loop — a commutative hot
+grain promotes and later folds back after the demote-patience cool-off,
+while a NON-commutative hot grain falls back to single-grain migration.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orleans_tpu.config import (
+    MetricsConfig,
+    RebalanceConfig,
+    TensorEngineConfig,
+)
+from orleans_tpu.core.grain import commutative
+from orleans_tpu.runtime.rebalancer import RebalanceController
+from orleans_tpu.tensor import (
+    Batch,
+    TensorEngine,
+    VectorGrain,
+    field,
+    seg_max,
+    seg_sum,
+)
+from orleans_tpu.tensor.arena import _spread_replicas_kernel, shard_of_keys
+from orleans_tpu.tensor.vector_grain import (
+    batched_method,
+    vector_grain,
+    vector_type,
+)
+
+pytestmark = pytest.mark.rebalance
+
+
+def _define_grains():
+    if vector_type("ReplCounter") is not None:
+        return
+
+    @vector_grain
+    class ReplCounter(VectorGrain):
+        # sum fold (the default) plus a max-fold column: both reductions
+        # must survive promote/demote bit-exact
+        total = field(jnp.int32, 0)
+        hwm = field(jnp.int32, 0, fold="max")
+
+        @batched_method
+        @staticmethod
+        @commutative
+        def bump(state, batch: Batch, n_rows: int):
+            amt = batch.args["amount"]
+            return {**state,
+                    "total": state["total"]
+                    + seg_sum(amt, batch.rows, n_rows),
+                    "hwm": jnp.maximum(
+                        state["hwm"],
+                        seg_max(amt, batch.rows, n_rows))}, None, ()
+
+    @vector_grain
+    class ReplLedger(VectorGrain):
+        # deliberately NOT @commutative: the controller must refuse to
+        # replicate it and fall back to migration
+        balance = field(jnp.int32, 0)
+
+        @batched_method
+        @staticmethod
+        def deposit(state, batch: Batch, n_rows: int):
+            return {**state, "balance": state["balance"]
+                    + seg_sum(batch.args["amount"], batch.rows,
+                              n_rows)}, None, ()
+
+
+_define_grains()
+
+
+def _engine(n_shards=4, **kw) -> TensorEngine:
+    cfg = kw.pop("config", None) or TensorEngineConfig(
+        tick_interval=0.0, auto_fusion_ticks=0)
+    e = TensorEngine(config=cfg, **kw)
+    e.n_shards = n_shards
+    return e
+
+
+def _totals(engine, keys, type_name="ReplCounter",
+            col="total") -> np.ndarray:
+    """Observable state per key — folds replicated grains, reads the
+    column directly otherwise (read_row is the fold-aware accessor)."""
+    arena = engine.arenas[type_name]
+    return np.array([int(arena.read_row(int(k))[col]) for k in keys],
+                    dtype=np.int64)
+
+
+# ---------------------------------------------------------------------------
+# the spread kernel: host twin ≡ device body
+# ---------------------------------------------------------------------------
+
+def test_spread_host_and_device_kernels_agree(run):
+    async def main():
+        engine = _engine(4)
+        keys = np.arange(64, dtype=np.int64)
+        engine.send_batch("ReplCounter", "bump", keys,
+                          {"amount": np.ones(64, np.int32)})
+        engine.run_tick()
+        await engine.flush()
+        assert engine.replicate_key("ReplCounter", 5, 3) == 3
+        assert engine.replicate_key("ReplCounter", 17, 4) == 4
+        arena = engine.arenas["ReplCounter"]
+        rows, found = arena.lookup_rows(np.tile(keys, 4))
+        assert found.all()
+        rows = np.concatenate(
+            [rows, np.full(7, -1, rows.dtype)]).astype(np.int32)
+        host = arena.spread_rows_host(rows)
+        dev = np.asarray(_spread_replicas_kernel(
+            *arena.replica_mirror(), jnp.asarray(rows)))
+        assert np.array_equal(host, dev)
+        # the spread actually fans out: a promoted key's lanes land on
+        # more than one physical row; unpromoted lanes are untouched
+        p5 = arena._replicas[5]
+        hit5 = host[rows == p5[0]]
+        assert len(set(hit5.tolist())) > 1
+        assert set(hit5.tolist()) <= set(int(r) for r in p5)
+        unpromoted = ~np.isin(rows, [int(arena._replicas[5][0]),
+                                     int(arena._replicas[17][0])])
+        assert np.array_equal(host[unpromoted], rows[unpromoted])
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# exactness: replicated engine ≡ unreplicated oracle (demote mid-traffic)
+# ---------------------------------------------------------------------------
+
+def test_replication_exactness_vs_unreplicated_oracle(run):
+    """The acceptance oracle: the same injection sequence through an
+    engine that promotes a hot grain to 3 replicas at tick 3 and folds
+    it back at tick 8 ends bit-identical — BOTH fold kinds — to an
+    engine that never replicates.  Mid-promotion reads fold too."""
+
+    async def main():
+        rng = np.random.default_rng(23)
+        engine, oracle = _engine(4), _engine(1)
+        keys = np.arange(128, dtype=np.int64)
+        hot = 5
+        for t in range(12):
+            amounts = rng.integers(1, 100, 128).astype(np.int32)
+            extra = rng.integers(1, 100, 64).astype(np.int32)
+            for e in (engine, oracle):
+                e.send_batch("ReplCounter", "bump", keys,
+                             {"amount": amounts})
+                # a hot wave aimed at one key — the lanes the spread
+                # kernel partitions across the replica group
+                e.send_batch("ReplCounter", "bump",
+                             np.full(64, hot, np.int64),
+                             {"amount": extra})
+                e.run_tick()
+            if t == 3:
+                assert engine.replicate_key("ReplCounter", hot, 3) == 3
+            if t == 5:
+                for e in (engine, oracle):
+                    await e.flush()
+                # mid-promotion observable state is the fold
+                assert np.array_equal(_totals(engine, keys),
+                                      _totals(oracle, keys))
+                assert np.array_equal(
+                    _totals(engine, keys, col="hwm"),
+                    _totals(oracle, keys, col="hwm"))
+                assert len(engine.arenas["ReplCounter"]._replicas) == 1
+            if t == 8:
+                # returns SECONDARY rows freed: k - 1
+                assert engine.demote_key("ReplCounter", hot) == 2
+        await engine.flush()
+        await oracle.flush()
+        assert np.array_equal(_totals(engine, keys),
+                              _totals(oracle, keys))
+        assert np.array_equal(_totals(engine, keys, col="hwm"),
+                              _totals(oracle, keys, col="hwm"))
+        arena = engine.arenas["ReplCounter"]
+        assert not arena._replicas
+        assert engine.replications == 1
+        assert engine.grains_replicated == 1
+        assert engine.replica_demotions == 1
+        assert arena.replica_folds >= 1
+        snap = engine.snapshot()
+        assert snap["replicated_now"] == 0
+        assert snap["replica_folds"] >= 1
+
+    run(main())
+
+
+def test_promote_demote_identity_discipline(run):
+    """Identity invariants around the replica group: promote is
+    idempotent, secondaries are invisible to keys()/live_count, and
+    eviction of a promoted key demotes (folds) first — state survives."""
+
+    async def main():
+        engine = _engine(4)
+        keys = np.arange(32, dtype=np.int64)
+        engine.send_batch("ReplCounter", "bump", keys,
+                          {"amount": np.full(32, 7, np.int32)})
+        engine.run_tick()
+        await engine.flush()
+        arena = engine.arenas["ReplCounter"]
+        live0 = arena.live_count
+        assert engine.replicate_key("ReplCounter", 9, 3) == 3
+        # idempotent: a re-promote reports the live group, no new work
+        assert engine.replicate_key("ReplCounter", 9, 3) == 3
+        assert engine.replications == 1
+        assert arena.live_count == live0
+        assert set(arena.keys().tolist()) == set(keys.tolist())
+        # demote of an unreplicated key is a no-op
+        assert engine.demote_key("ReplCounter", 10) == 0
+        assert engine.replica_demotions == 0
+        # eviction demotes first: the fold lands before the key leaves
+        engine.send_batch("ReplCounter", "bump", keys,
+                          {"amount": np.full(32, 3, np.int32)})
+        engine.run_tick()
+        await engine.flush()
+        arena.evict_keys(np.array([9], dtype=np.int64), write_back=False)
+        assert not arena._replicas
+        rows, found = arena.lookup_rows(np.array([9], dtype=np.int64))
+        assert not found[0]
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# durability: the kill spans a promoted interval
+# ---------------------------------------------------------------------------
+
+def test_kill_recover_spanning_promoted_interval(run):
+    """Journal + checkpoint cadence runs WHILE a grain is replicated:
+    the snapshot cut carries the replica group (layout meta + partial
+    rows), the engine hard-kills mid-cadence, and a fresh engine
+    recovers — the replica group is restored, journal replay re-spreads
+    across it, and the fold equals the acked-prefix oracle exactly.
+    A post-recovery demote folds back to the same truth."""
+
+    async def main():
+        from orleans_tpu.tensor import MemorySnapshotStore
+
+        backing = {}
+        cfg = TensorEngineConfig(
+            tick_interval=0.0, auto_fusion_ticks=0,
+            ckpt_full_every_ticks=10, ckpt_delta_every_ticks=5,
+            ckpt_pause_budget_s=0.002, journal_flush_every_ticks=3)
+        engine = _engine(4, config=cfg,
+                         snapshot_store=MemorySnapshotStore(backing))
+        engine.register_journal("ReplCounter", "bump")
+        rng = np.random.default_rng(31)
+        keys = np.arange(96, dtype=np.int64)
+        amounts_by_tick = []
+        for t in range(29):
+            amounts = rng.integers(1, 100, 96).astype(np.int32)
+            amounts_by_tick.append(amounts)
+            engine.send_batch("ReplCounter", "bump", keys,
+                              {"amount": amounts})
+            engine.run_tick()
+            if t == 8:
+                assert engine.replicate_key("ReplCounter", 11, 3) == 3
+        await engine.flush()
+        assert len(engine.arenas["ReplCounter"]._replicas) == 1
+        site = engine.checkpointer.journal.sites[("ReplCounter", "bump")]
+        acked = site.committed_lanes // 96
+        assert 8 < acked < 29, "kill must land inside the promoted span"
+        oracle = np.zeros(96, dtype=np.int64)
+        for amounts in amounts_by_tick[:acked]:
+            oracle += amounts
+        # HARD KILL → recovery on a fresh engine over the same backing
+        engine2 = _engine(4, config=cfg,
+                          snapshot_store=MemorySnapshotStore(backing))
+        stats = await engine2.checkpointer.recover()
+        assert stats["recovered"]
+        await engine2.flush()
+        arena2 = engine2.arenas["ReplCounter"]
+        assert set(arena2._replicas) == {11}, \
+            "replica group must survive recovery"
+        assert len(arena2._replicas[11]) == 3
+        assert np.array_equal(_totals(engine2, keys), oracle)
+        # and the group still folds back cleanly on the recovered engine
+        assert engine2.demote_key("ReplCounter", 11) == 2
+        assert np.array_equal(_totals(engine2, keys), oracle)
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# the closed loop: controller promotes the hot grain, later demotes it
+# ---------------------------------------------------------------------------
+
+def _ctrl_cfg(**kw) -> RebalanceConfig:
+    base = dict(enabled=True, trigger_share=0.4,
+                hysteresis_intervals=1, cooldown_intervals=0,
+                move_budget=8, min_grain_share=0.0,
+                min_interval_msgs=64, replicate_share=0.15,
+                max_replicas=4, demote_share=0.02, demote_patience=2)
+    base.update(kw)
+    return RebalanceConfig(**base)
+
+
+def test_controller_replicates_commutative_hot_grain_then_demotes(run):
+    """End to end on the plane's own telemetry: one grain eats the
+    shard — too hot for any single-destination move to fix — so the
+    controller promotes it to replicas; when the wave passes, the
+    demote-patience cool-off folds it back.  No thrash in between."""
+
+    async def main():
+        engine = _engine(4, metrics=MetricsConfig(
+            attribution_enabled=True, attribution_top_k=16))
+        keys = np.arange(256, dtype=np.int64)
+        home = shard_of_keys(keys, 4)
+        hot = int(keys[home == 0][0])
+        ctrl = RebalanceController(engine=engine, config=_ctrl_cfg())
+        # hot phase: ~all traffic to ONE key until the controller acts
+        for _ in range(4):
+            for _ in range(4):
+                engine.send_batch("ReplCounter", "bump",
+                                  np.full(256, hot, np.int64),
+                                  {"amount": np.ones(256, np.int32)})
+                engine.run_tick()
+            await engine.flush()
+            await ctrl.run_once()
+            if ctrl.replications_applied:
+                break
+        assert ctrl.replications_applied == 1, ctrl.planner.snapshot()
+        arena = engine.arenas["ReplCounter"]
+        assert hot in arena._replicas
+        assert engine.snapshot()["replicated_now"] == 1
+        assert ctrl.replica_fallback_moves == 0
+        # cool phase: balanced traffic, the hot key goes cold — after
+        # demote_patience intervals the group folds back
+        for _ in range(4):
+            engine.send_batch("ReplCounter", "bump", keys,
+                              {"amount": np.ones(256, np.int32)})
+            engine.run_tick()
+            await engine.flush()
+            await ctrl.run_once()
+            if ctrl.demotions_applied:
+                break
+        assert ctrl.demotions_applied == 1, ctrl.snapshot()
+        assert not arena._replicas
+        assert engine.snapshot()["replicated_now"] == 0
+        legs = [d["leg"] for d in ctrl.decisions]
+        assert "replicate" in legs and "demote" in legs
+
+    run(main())
+
+
+def test_controller_non_commutative_falls_back_to_migration(run):
+    """The same single-grain burn on a grain WITHOUT @commutative: the
+    controller must not replicate (the fold would be a lie) — it falls
+    back to migrating that one grain to the coolest shard."""
+
+    async def main():
+        engine = _engine(4, metrics=MetricsConfig(
+            attribution_enabled=True, attribution_top_k=16))
+        keys = np.arange(256, dtype=np.int64)
+        home = shard_of_keys(keys, 4)
+        hot = keys[home == 0][:1]
+        ctrl = RebalanceController(engine=engine, config=_ctrl_cfg())
+        for _ in range(4):
+            for _ in range(4):
+                engine.send_batch("ReplLedger", "deposit",
+                                  np.tile(hot, 256),
+                                  {"amount": np.ones(256, np.int32)})
+                engine.run_tick()
+            await engine.flush()
+            await ctrl.run_once()
+            if ctrl.replica_fallback_moves:
+                break
+        assert ctrl.replica_fallback_moves >= 1, ctrl.planner.snapshot()
+        assert ctrl.replications_applied == 0
+        arena = engine.arenas["ReplLedger"]
+        assert not arena._replicas
+        rows, found = arena.lookup_rows(hot)
+        assert found.all()
+        assert int(rows[0]) // arena.shard_capacity != 0, \
+            "fallback must move the grain off the burning shard"
+        legs = [d["leg"] for d in ctrl.decisions]
+        assert "replicate-fallback" in legs
+
+    run(main())
