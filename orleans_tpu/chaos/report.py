@@ -4,7 +4,7 @@
 ``bench.py --chaos-smoke``) runs the canonical short scenario on a
 3-silo ChaosCluster — storage flakes + injected CAS conflicts + one
 NaN-poisoned slab under live traffic, then partition → heal → hard-kill
-— checks all seven invariants (including the durable-state-plane
+— checks all eight invariants (including the durable-state-plane
 kill-mid-traffic recovery scenario), and emits a JSON report alongside the
 BENCH_*.json artifacts.  The report carries the (seed, plan) pair and
 the deterministic trace signature, so a failing run is replayable
@@ -227,6 +227,106 @@ async def durability_kill_scenario(seed: int,
         "recovery": {k: v for k, v in stats.items() if k != "re_anchor"},
     })
     return report
+
+
+async def standby_failover_scenario(seed: int,
+                                    rto_bound_s: float = 15.0
+                                    ) -> Dict[str, Any]:
+    """Warm-standby failover smoke: a standby engine tails the
+    primary's committed fulls/deltas and stages its sealed journal
+    segments WHILE seeded deposit traffic runs; the primary is
+    hard-killed mid-cadence and the standby promotes — fence the
+    store, fold-replay only the un-adopted tail, land bit-exact at
+    the acknowledged prefix.  Asserts zero acknowledged-write loss,
+    promotion inside the RTO bound, and that the old (merely
+    partitioned, still-running) primary can never commit again once
+    its range is claimed."""
+    import numpy as np
+
+    from orleans_tpu.config import TensorEngineConfig
+    from orleans_tpu.tensor import MemorySnapshotStore, TensorEngine
+    from orleans_tpu.tensor.checkpoint import FencedError, StandbyTailer
+
+    define_chaos_ledger()
+    backing = MemorySnapshotStore.shared_backing()
+    cfg = TensorEngineConfig(
+        tick_interval=0.0, auto_fusion_ticks=0,
+        ckpt_full_every_ticks=10, ckpt_delta_every_ticks=5,
+        ckpt_pause_budget_s=0.002, journal_flush_every_ticks=3)
+    primary = TensorEngine(config=cfg,
+                           snapshot_store=MemorySnapshotStore(backing))
+    primary.register_journal("ChaosLedger", "deposit")
+    standby = TensorEngine(config=TensorEngineConfig(
+        tick_interval=0.0, auto_fusion_ticks=0))
+    standby.register_journal("ChaosLedger", "deposit")
+    tailer = StandbyTailer(standby, MemorySnapshotStore(backing))
+    rng = np.random.default_rng(seed)
+    n_keys = 64
+    keys = np.arange(n_keys, dtype=np.int64)
+    ticks_driven = 29
+    amounts_by_entry: List[np.ndarray] = []
+    for t in range(ticks_driven):
+        amounts = rng.integers(1, 100, n_keys).astype(np.int32)
+        amounts_by_entry.append(amounts)
+        primary.send_batch("ChaosLedger", "deposit", keys,
+                           {"amount": amounts})
+        primary.run_tick()
+        if t % 3 == 2:
+            tailer.poll()  # log shipping rides the committed cuts
+    await primary.flush()
+    assert tailer.adopted_rows > 0, \
+        "scenario degenerate: standby never adopted a committed cut"
+    site = primary.checkpointer.journal.sites[("ChaosLedger",
+                                               "deposit")]
+    # HARD KILL the primary process; the OBJECT stays alive to model
+    # the partitioned zombie the fence must reject
+    acked_entries = site.committed_lanes // n_keys
+    oracle = np.zeros(n_keys, dtype=np.int64)
+    for amounts in amounts_by_entry[:acked_entries]:
+        oracle += amounts
+    res = await tailer.promote(owner="chaos-standby")
+    assert res["promoted"]
+    assert res["replayed_lanes"] > 0, \
+        "scenario degenerate: promotion replayed no journal tail"
+    assert ticks_driven > acked_entries, \
+        "scenario degenerate: every entry was already acknowledged"
+    rto_s = res["seconds"]
+    if rto_s > rto_bound_s:
+        from orleans_tpu.chaos.invariants import InvariantViolation
+        raise InvariantViolation(
+            f"standby promotion took {rto_s:.3f}s > bound "
+            f"{rto_bound_s}s")
+    # zero acknowledged-write loss, bit-exact at the acked horizon
+    arena = standby.arena_for("ChaosLedger")
+    rows, found = arena.lookup_rows(keys)
+    assert found.all(), "promoted standby lost acknowledged accounts"
+    balances = np.asarray(arena.state["balance"])[rows].astype(np.int64)
+    deposits = np.asarray(arena.state["deposits"])[rows]
+    assert np.array_equal(balances, oracle), \
+        "promoted standby balances diverge from the acked oracle"
+    assert (deposits == acked_entries).all(), \
+        "promoted standby deposit counts diverge"
+    # promotion fence: the old primary's next commit must refuse, and
+    # its plane must report itself fenced (a silo wires this to kill)
+    fenced = False
+    try:
+        primary.checkpointer.checkpoint_full()
+    except FencedError:
+        fenced = True
+    assert fenced, "old primary committed after its range was claimed"
+    assert primary.checkpointer.fenced
+    return {
+        "ok": True,
+        "driven_entries": ticks_driven,
+        "acknowledged_entries": acked_entries,
+        "lost_unacknowledged_entries": ticks_driven - acked_entries,
+        "rto_s": round(rto_s, 6),
+        "rto_bound_s": rto_bound_s,
+        "fence_epoch": res["fence_epoch"],
+        "adopted_rows": res["adopted_rows"],
+        "replayed_lanes": res["replayed_lanes"],
+        "old_primary_fenced": True,
+    }
 
 
 async def migration_storm_scenario(seed: int,
@@ -457,7 +557,7 @@ def smoke_plan(seed: int):
 
 
 async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
-    """One full smoke run; returns the report dict (``ok`` = all seven
+    """One full smoke run; returns the report dict (``ok`` = all eight
     invariants held).  Invariant violations are reported, not raised —
     the caller (CLI / bench step) decides the exit code."""
     import numpy as np
@@ -539,7 +639,7 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
         live_engine.send_batch("ChaosCounter", "poke", keys,
                                {"v": np.zeros(64, np.float32)})
 
-        # -- the seven invariants ---------------------------------------
+        # -- the eight invariants ---------------------------------------
         def _run(name, result):
             invariants[name] = result
 
@@ -588,6 +688,14 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
                  await migration_storm_scenario(seed))
         except (InvariantViolation, AssertionError) as exc:
             _run("migration_storm", {"ok": False, "error": str(exc)})
+        # warm-standby failover (seeded, engine-level like the kill
+        # scenario): log shipping while traffic runs, hard kill,
+        # promotion fence + tail fold-replay, zero acknowledged loss
+        try:
+            _run("standby_failover",
+                 await standby_failover_scenario(seed))
+        except (InvariantViolation, AssertionError) as exc:
+            _run("standby_failover", {"ok": False, "error": str(exc)})
 
         # flight-recorder evidence: every silo's ring (dead silos too —
         # their in-memory spans ARE the crash evidence), correlated by
@@ -600,7 +708,7 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
         await cluster.stop()
 
     ok = all(v.get("ok") for v in invariants.values()) \
-        and len(invariants) == 7
+        and len(invariants) == 8
     return {
         "metric": "chaos_smoke",
         "ok": ok,

@@ -30,6 +30,12 @@ class LivenessConfig:
     # per-peer gossip RPC timeout (also bounds the shutdown goodbye wait);
     # hoisted from the hard-coded 1.0 so chaos plans/tests can tighten it
     gossip_timeout: float = 1.0
+    # fast-suspect: a non-quorum suspect vote gossips the suspicion
+    # immediately so other members probe the victim out-of-band and add
+    # their votes now, instead of waiting for their own probe rounds to
+    # notice — detection converges within ~probe_timeout of the first
+    # vote rather than another probe_period * num_missed_probes_limit
+    fast_suspect: bool = True
 
 
 @dataclass
@@ -519,6 +525,21 @@ class TensorEngineConfig:
     # (runtime/silo.py start: restore arenas + fold-replay the journal
     # tail BEFORE serving traffic); off = manual recover() only
     durable_recovery: bool = True
+    # journal tail fold-replay window (ticks): recover() groups runs of
+    # consecutive journaled ticks with a consistent per-site signature
+    # into ONE fused device window (tensor/fused.py stacked-rows mode)
+    # instead of a per-tick engine call each, rolling back (exactly) to
+    # the per-tick path on any miss.  <= 1 replays per-tick always.
+    # Fused replay is also skipped while timers are armed at the cut
+    # (fused windows don't harvest timers) or a router is attached.
+    recover_fused_window: int = 64
+    # terminal re-anchor policy after recover(): "sync" writes a fresh
+    # full checkpoint inside recover (the pre-PR-18 behavior — restore
+    # time includes a full snapshot drain), "defer" leaves the old
+    # recovery point in place and lets the periodic cadence re-anchor;
+    # correctness is unchanged (a second crash replays the same
+    # journal tail idempotently from the old cut).
+    recover_reanchor: str = "defer"
     # periodic arena write-back cadence (ticks; 0 = only explicit
     # checkpoints): bounds the state-loss window when a silo is KILLED
     # (no goodbye, no graceful handoff write-back) to at most this many
@@ -594,6 +615,17 @@ class SiloConfig:
     # ProxyGatewayEndpoint — silos without one don't accept clients and
     # are not advertised by gateway list providers)
     gateway_enabled: bool = True
+    # warm-standby: name of the primary silo this silo tails (log
+    # shipping over the primary's SnapshotStore — committed fulls,
+    # deltas, and sealed journal segments; see runtime/silo.py
+    # arm_standby).  Empty = not a standby.  A standby adopts the
+    # primary's checkpoints as they commit and promotes (fence + replay
+    # the staged journal tail) when membership declares the primary
+    # DEAD.  The store itself is attached via silo.arm_standby(...) at
+    # setup — it is a live object, not config.
+    standby_for: str = ""
+    # standby manifest poll cadence (seconds)
+    standby_poll_period: float = 0.05
     liveness: LivenessConfig = field(default_factory=LivenessConfig)
     directory: DirectoryConfig = field(default_factory=DirectoryConfig)
     collection: CollectionConfig = field(default_factory=CollectionConfig)

@@ -332,6 +332,21 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                     (v for by_src in gauges.get("ckpt.max_pause_s",
                                                 {}).values()
                      for v in by_src.values()), default=0.0),
+                # warm-standby cover: -1 = NO silo is tailing as a
+                # standby (no failover cover — the sentinel dominates,
+                # same discipline as age_ticks); else the worst lag any
+                # standby holds behind the durable horizon
+                "standby_lag_ticks": (lambda vs: -1.0 if not vs
+                                      else max(vs))(
+                    [v for by_src in gauges.get("ckpt.standby_lag_ticks",
+                                                {}).values()
+                     for v in by_src.values() if v >= 0]),
+                "promotions": int(
+                    _counter_total(merged, "recovery.promotions")),
+                "last_rto_s": max(
+                    (v for by_src in gauges.get("recovery.last_rto_s",
+                                                {}).values()
+                     for v in by_src.values()), default=0.0),
             },
             # closed-loop rebalance (runtime/rebalancer.py): is the
             # actuator acting, how much placement moved, and the worst
@@ -485,10 +500,14 @@ def render_text(view: Dict[str, Any]) -> str:
             f"journal {du['journal_segments']} segments / "
             f"{du['journal_appended_lanes']} lanes "
             f"(pending {int(du.get('pending_lanes', 0))}), "
-            f"recovery-point age {int(du.get('age_ticks', -1))} ticks"
+            f"recovery-point age {int(du.get('age_ticks', -1))} ticks, "
+            f"standby lag {int(du.get('standby_lag_ticks', -1))} ticks"
             + (f", restored {du['restored_rows']} rows"
                f" + replayed {du['replayed_lanes']} lanes"
-               if du.get("restored_rows") else ""))
+               if du.get("restored_rows") else "")
+            + (f", {du['promotions']} promotions "
+               f"(last RTO {du.get('last_rto_s', 0.0):.3f}s)"
+               if du.get("promotions") else ""))
     rb = c.get("rebalance", {})
     if rb.get("migrations") or rb.get("intervals"):
         lines.append(

@@ -308,6 +308,34 @@ declare("journal.flush_s", KIND_COUNTER, "seconds",
 declare("journal.pending_lanes", KIND_GAUGE, "lanes",
         "lanes in open journal rings NOT yet sealed durable — the "
         "journal half of the loss window a hard kill would pay")
+declare("ckpt.standby_lag_ticks", KIND_GAUGE, "ticks",
+        "ticks this warm standby trails the primary's durable horizon "
+        "(committed recovery point + sealed journal segments); -1 = "
+        "this silo is not a standby — the sentinel dominates the "
+        "cluster row so a cluster with no failover cover shows -1")
+declare("ckpt.standby_polls", KIND_COUNTER, "polls",
+        "standby tailing steps against the primary's snapshot store "
+        "(log shipping over the durable plane, no new wire protocol)")
+declare("ckpt.standby_adopted_rows", KIND_COUNTER, "rows",
+        "arena rows a warm standby adopted from the primary's "
+        "committed fulls/deltas ahead of any promotion")
+declare("ckpt.standby_staged_segments", KIND_GAUGE, "segments",
+        "sealed journal segments staged host-side on the standby, "
+        "ready to fold-replay at promotion (never applied early — "
+        "deltas record absolute values)")
+declare("recovery.promotions", KIND_COUNTER, "promotions",
+        "standby promotions this engine performed (fence acquired + "
+        "staged tail replayed + range taken over)")
+declare("recovery.last_rto_s", KIND_GAUGE, "seconds",
+        "wall seconds of the last standby promotion — the measured "
+        "failover RTO (fence + final catch-up + tail fold-replay)")
+declare("recovery.fused_windows", KIND_COUNTER, "windows",
+        "journal fold-replay windows executed as ONE fused program "
+        "over consecutive journaled ticks (autofuse machinery) "
+        "instead of per-tick engine calls")
+declare("recovery.fused_lanes", KIND_COUNTER, "lanes",
+        "journal lanes replayed through fused windows (subset of "
+        "journal.replayed_lanes)")
 
 # -- transport links (runtime/transport per-link stats) ----------------------
 for _n, _u, _d in (

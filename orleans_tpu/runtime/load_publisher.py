@@ -51,6 +51,10 @@ class SiloRuntimeStatistics:
     # backend exposes no memory stats): a peer below its low watermark
     # is no migration target no matter how idle it looks
     memory_headroom: Optional[float] = None
+    # an armed warm standby tails its primary's snapshot store and will
+    # adopt that whole arena on promotion — its apparent idleness is
+    # reserved capacity, not headroom (rebalancer skips such peers)
+    is_standby: bool = False
 
 
 def collect_silo_statistics(silo) -> SiloRuntimeStatistics:
@@ -88,6 +92,8 @@ def collect_silo_statistics(silo) -> SiloRuntimeStatistics:
         hot_set=silo.hot_set(),
         arena_occupancy=arena_occupancy,
         memory_headroom=memory_headroom,
+        is_standby=(getattr(silo, "standby", None) is not None
+                    and not silo.standby.promoted),
     )
 
 

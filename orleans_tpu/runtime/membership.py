@@ -135,6 +135,10 @@ class MembershipOracle:
         self.hosting: Dict[SiloAddress, bool] = {}
         self._known_dead: set = set()
         self._missed_probes: Dict[SiloAddress, int] = {}
+        # fast-suspect: victims currently being probed out-of-band (a
+        # suspicion notification arrived) — dedup guard so a gossip
+        # storm cannot pile concurrent probes on one victim
+        self._fast_probing: set = set()
         self._tasks: List[asyncio.Task] = []
         self._running = False
         self.logger = silo.logger.child("membership")
@@ -373,7 +377,8 @@ class MembershipOracle:
                 await self.refresh_view()
                 return
             votes = entry.fresh_votes(now, self.config.death_vote_expiration)
-            if not any(s == self.silo.address for s, _ in votes):
+            new_vote = not any(s == self.silo.address for s, _ in votes)
+            if new_vote:
                 votes.append((self.silo.address, now))
             try:
                 if len(votes) >= self.config.num_votes_for_death \
@@ -384,16 +389,61 @@ class MembershipOracle:
                     await self.table.update_row(entry, etag, version)
                     self.logger.warn(
                         f"declared {victim} DEAD ({len(votes)} votes)")
+                    await self.refresh_view()
+                    await self.gossip()
                 else:
                     entry.suspect_times = votes
                     await self.table.update_row(entry, etag, version)
                     self.logger.warn(f"suspected {victim} "
                                      f"({len(votes)} votes)")
-                await self.refresh_view()
-                await self.gossip()
+                    await self.refresh_view()
+                    await self.gossip()
+                    if self.config.fast_suspect and new_vote:
+                        # fast-suspect: push the suspicion to peers so
+                        # they probe the victim NOW and vote — quorum
+                        # converges within ~one probe timeout instead
+                        # of waiting every voter's own probe round
+                        await self._gossip_suspicion(victim)
                 return
             except CasConflictError:
                 await asyncio.sleep(0)
+
+    async def _gossip_suspicion(self, victim: SiloAddress) -> None:
+        """Fan the suspicion out to every active peer (fast-suspect
+        path).  Gossip is still only a HINT: recipients probe the
+        victim themselves and vote through the same CAS table protocol
+        — no peer ever trusts the payload as a death verdict."""
+        for peer in list(self.view):
+            if peer == victim:
+                continue
+            if self.view.get(peer) == SiloStatus.ACTIVE:
+                try:
+                    await self.silo.system_rpc(
+                        peer, "membership", "notify_suspected", (victim,),
+                        timeout=self.config.gossip_timeout)
+                except Exception:
+                    pass
+
+    async def confirm_suspicion(self, victim: SiloAddress) -> None:
+        """Receiving half of fast-suspect: a peer suspects ``victim`` —
+        probe it immediately (out of band of the probe loop) and add
+        our vote if the probe fails."""
+        if (not self._running or not self.config.fast_suspect
+                or victim == self.silo.address
+                or victim in self._fast_probing):
+            return
+        self._fast_probing.add(victim)
+        try:
+            try:
+                alive = await self.silo.system_rpc(
+                    victim, "membership", "ping", (self.silo.address,),
+                    timeout=self.config.probe_timeout)
+            except Exception:
+                alive = False
+            if not alive:
+                await self.try_suspect_or_kill(victim)
+        finally:
+            self._fast_probing.discard(victim)
 
     # ================= heartbeats + refresh ===============================
 
@@ -458,3 +508,7 @@ class _MembershipTarget:
 
     async def notify_table_changed(self) -> None:
         await self.oracle.refresh_view()
+
+    async def notify_suspected(self, victim: SiloAddress) -> None:
+        """(fast-suspect hint: probe the victim now, vote if it fails)"""
+        await self.oracle.confirm_suspicion(victim)

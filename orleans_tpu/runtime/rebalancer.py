@@ -584,6 +584,11 @@ class RebalanceController:
         for addr, st in lp.periodic_stats.items():
             if addr == silo.address or not silo.is_silo_alive(addr):
                 continue
+            if getattr(st, "is_standby", False):
+                # an armed standby's emptiness is reserved for its
+                # primary's arena at promotion — never a migration
+                # target (standby placement awareness)
+                continue
             occ = getattr(st, "arena_occupancy", None)
             if occ is None:
                 continue

@@ -312,6 +312,21 @@ class Silo:
         # durable state plane: the last startup recovery's stats (None
         # until a recovery ran — tensor/checkpoint.py recover())
         self.last_recovery: Optional[Dict[str, Any]] = None
+        # warm standby (tensor/checkpoint.py StandbyTailer): armed via
+        # arm_standby(store, primary=...); polls the primary's snapshot
+        # store on config.standby_poll_period and promotes on the
+        # primary's DEAD declaration.  last_promotion holds promote()'s
+        # stats (the measured RTO) once it fired.
+        self.standby = None
+        self._standby_primary: str = self.config.standby_for
+        self._standby_task: Optional[asyncio.Task] = None
+        self.last_promotion: Optional[Dict[str, Any]] = None
+        if self.tensor_engine is not None:
+            # promotion fence trip: a standby claimed our store — this
+            # silo must never acknowledge another write (it would be
+            # lost to the promoted range owner).  Fast-kill, exactly
+            # like the crash the standby already covers.
+            self.tensor_engine.checkpointer.on_fenced = self.kill
         # closed-loop rebalance (runtime/rebalancer.py): consumes the
         # attribution plane's HotSet/skew/slo.* signals and ACTS via
         # batched live migration.  Always constructed with an engine so
@@ -378,6 +393,9 @@ class Silo:
                 # crash recovery is a startup stage, like storage init
                 self.last_recovery = await ck.recover()
             self.tensor_engine.start()
+        if self.standby is not None and self._standby_task is None:
+            self._standby_task = asyncio.get_running_loop().create_task(
+                self._standby_poll_loop())
         if self.load_publisher is not None:
             self.load_publisher.start()
         if self.cache_maintainer is not None:
@@ -412,6 +430,9 @@ class Silo:
             self.cache_maintainer.stop()
         if self.rebalancer is not None:
             self.rebalancer.stop()
+        if self._standby_task is not None:
+            self._standby_task.cancel()
+            self._standby_task = None
         if self.tensor_engine is not None:
             await self.tensor_engine.stop(drain=graceful)
         # reminder timers must die on ANY stop — a zombie service would
@@ -504,6 +525,9 @@ class Silo:
         if self._stats_report_task is not None:
             self._stats_report_task.cancel()
             self._stats_report_task = None
+        if self._standby_task is not None:
+            self._standby_task.cancel()
+            self._standby_task = None
         self.catalog.stop_collector()
         for provider in self.stream_providers.values():
             k = getattr(provider, "kill", None)
@@ -520,6 +544,47 @@ class Silo:
 
     def on_stop(self, cb: Callable[[], Any]) -> None:
         self._stop_callbacks.append(cb)
+
+    # ================= warm standby ========================================
+
+    def arm_standby(self, store, primary: str = "") -> None:
+        """Make this silo a warm standby: tail ``store`` (the primary's
+        snapshot store — log shipping over the existing durable plane,
+        no new wire protocol) and promote when membership declares the
+        primary DEAD.  ``primary`` names the silo whose death triggers
+        promotion (falls back to config.standby_for; empty = any DEAD
+        declaration promotes).  Callable before or after start()."""
+        if self.tensor_engine is None:
+            raise RuntimeError("standby needs a tensor engine")
+        from orleans_tpu.tensor.checkpoint import StandbyTailer
+        self.standby = StandbyTailer(self.tensor_engine, store)
+        if primary:
+            self._standby_primary = primary
+        if self.status == SiloStatus.ACTIVE \
+                and self._standby_task is None:
+            self._standby_task = asyncio.get_running_loop().create_task(
+                self._standby_poll_loop())
+
+    async def _standby_poll_loop(self) -> None:
+        period = max(self.config.standby_poll_period, 0.001)
+        while self.standby is not None and not self.standby.promoted:
+            try:
+                self.standby.poll()
+            except Exception:  # noqa: BLE001 — tailing must outlive
+                # transient store hiccups; the tailer re-bases itself
+                self.logger.warn("standby poll failed", code=2810)
+            await asyncio.sleep(period)
+
+    async def _promote_standby(self, dead: "SiloAddress") -> None:
+        standby, self._standby_task = self.standby, None
+        if standby is None or standby.promoted:
+            return
+        self.last_promotion = await standby.promote(owner=self.name)
+        self.last_promotion["for"] = str(dead)
+        self.logger.info(
+            f"standby promoted over {dead} in "
+            f"{self.last_promotion['seconds']}s "
+            f"(fence epoch {self.last_promotion['fence_epoch']})")
 
     # ================= live config reload ==================================
 
@@ -918,6 +983,27 @@ class Silo:
                      None, "journal.")
                 reg.gauge("journal.pending_lanes").set(
                     float(js["pending_lanes"]))
+            # warm standby & recovery plane: the standby-lag gauge uses
+            # the same -1 sentinel discipline as ckpt.age_ticks — a
+            # silo that is not a standby reports -1, and the dashboard
+            # cluster row lets the sentinel dominate (no standby
+            # anywhere = no failover cover, surfaced, not averaged
+            # away)
+            reg.gauge("ckpt.standby_lag_ticks").set(
+                float(self.standby.lag_ticks())
+                if self.standby is not None else -1.0)
+            if self.standby is not None:
+                sb = self.standby.snapshot()
+                reg.counter("ckpt.standby_polls").set_total(sb["polls"])
+                reg.counter("ckpt.standby_adopted_rows").set_total(
+                    sb["adopted_rows"])
+                reg.gauge("ckpt.standby_staged_segments").set(
+                    float(sb["staged_segments"]))
+            emit({"promotions": ck.promotions,
+                  "fused_windows": ck.replay_fused_windows,
+                  "fused_lanes": ck.replay_fused_lanes},
+                 None, "recovery.")
+            reg.gauge("recovery.last_rto_s").set(ck.last_rto_s)
             emit({"messages_processed": eng.messages_processed,
                   "ticks": eng.ticks_run,
                   "compiles": eng.compile_count(),
@@ -1195,6 +1281,13 @@ class Silo:
         """Fan-out of a death notification (reference: Silo.cs:364-376
         status-change listeners)."""
         self.ring.remove_silo(addr)
+        if self.standby is not None and not self.standby.promoted \
+                and (not self._standby_primary
+                     or self._standby_primary in (addr.host, str(addr))):
+            # the primary we tail was declared DEAD: promote — fence
+            # its store, replay the staged tail, serve its ring range
+            # (the ring removal above already re-homed it onto us)
+            asyncio.ensure_future(self._promote_standby(addr))
         self.grain_directory.on_silo_dead(addr)
         self.runtime_client.break_outstanding_messages_to_dead_silo(addr)
         # a dead silo's breaker is moot (its traffic re-addresses; a

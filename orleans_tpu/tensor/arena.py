@@ -1377,16 +1377,25 @@ class GrainArena:
 
     def adopt_layout(self, meta: Dict[str, Any], key_of_row: np.ndarray,
                      last_use_tick: np.ndarray,
-                     shard_next: np.ndarray) -> None:
+                     shard_next: np.ndarray, *,
+                     init_columns: bool = True,
+                     replace: bool = False) -> None:
         """Restore a FULL snapshot's layout onto this (empty, freshly
         restarted) arena: exact key→row map, high-water marks, free
         lists, generation and eviction epoch.  Columns re-initialize to
         field inits; ``scatter_restore`` then lands the snapshot rows.
-        A mesh-shape mismatch is the caller's to resolve (restore at
-        the recorded layout, then ``reshard`` — identity necessarily
-        changes with the mesh)."""
+        ``init_columns=False`` skips the device column (re)allocation —
+        the fast-restore path follows with ``adopt_columns`` (one
+        host-assembled transfer per column) instead of per-chunk
+        scatters, so initializing columns here would be a wasted
+        device allocation + fill.  ``replace=True`` permits adoption
+        over a NON-empty arena (warm-standby re-base onto a newer full:
+        the old columns are dropped wholesale).  A mesh-shape mismatch
+        is the caller's to resolve (restore at the recorded layout,
+        then ``reshard`` — identity necessarily changes with the
+        mesh)."""
         self._settle_owner_chain()
-        if self.live_count:
+        if self.live_count and not replace:
             raise RuntimeError(
                 f"arena {self.info.name}: adopt_layout needs an empty "
                 f"arena (restore happens before traffic)")
@@ -1419,8 +1428,9 @@ class GrainArena:
         self._shard_override = {int(k): int(v) for k, v in
                                 meta.get("shard_override", {}).items()}
         self._override_sorted = None
-        self._init_state_columns(self.capacity)
-        self.last_use_dev = self._dev_zeros_i32(self.capacity)
+        if init_columns:
+            self._init_state_columns(self.capacity)
+            self.last_use_dev = self._dev_zeros_i32(self.capacity)
         self._dirty = True
         self._dev_index_stale = True
         self._dev_dense_stale = True
@@ -1429,6 +1439,37 @@ class GrainArena:
         self._dev_sorted_rows = None
         self._dev_dense = None
         self._dev_wide = None
+
+    def adopt_columns(self, columns: Dict[str, np.ndarray],
+                      last_use_dev: np.ndarray) -> None:
+        """Fast-restore companion of ``adopt_layout(init_columns=False)``:
+        adopt HOST-assembled full-capacity columns wholesale — one
+        ``device_put`` per state column instead of per-chunk device
+        scatters.  ``device_put`` dispatches asynchronously, so the
+        caller's loop naturally overlaps decoding/assembling column
+        N+1 on the host with column N's h2d transfer (the PR 9 staged
+        overlap discipline, applied to restore)."""
+        new_state: Dict[str, Any] = {}
+        for name, f in self.info.state_fields.items():
+            col = np.asarray(columns[name])
+            want = (self.capacity, *f.shape)
+            if col.shape != want or col.dtype != np.dtype(f.dtype):
+                raise ValueError(
+                    f"arena {self.info.name}: adopt_columns {name} "
+                    f"{col.shape}/{col.dtype} != {want}/{f.dtype}")
+            new_state[name] = (jax.device_put(col, self.sharding)
+                               if self.sharding is not None
+                               else jax.device_put(col))
+        dev = np.ascontiguousarray(np.asarray(last_use_dev, np.int32))
+        if dev.shape != (self.capacity,):
+            raise ValueError(
+                f"arena {self.info.name}: adopt_columns last_use_dev "
+                f"{dev.shape} != ({self.capacity},)")
+        self.last_use_dev = (jax.device_put(dev, self.sharding)
+                             if self.sharding is not None
+                             else jax.device_put(dev))
+        self.state = new_state
+        self._dirty = True
 
     def adopt_delta(self, meta: Dict[str, Any], rows: np.ndarray,
                     keys: np.ndarray, live_keys: np.ndarray,

@@ -577,6 +577,15 @@ class _ActiveSnapshot:
         self.timers: Any = None
 
 
+class FencedError(RuntimeError):
+    """The snapshot store's manifest carries a newer promotion-fence
+    epoch than this plane holds: a promoted standby has claimed the
+    store (and with it, this silo's ring range).  Every commit path
+    raises this instead of acknowledging — the old primary, even if
+    merely partitioned rather than dead, can never serve a durable
+    write after its range was claimed."""
+
+
 class CheckpointPlane:
     """The engine's durable state plane (attach a SnapshotStore to
     engage).  All public entry points are host-synchronous and run
@@ -599,6 +608,22 @@ class CheckpointPlane:
         # device counts copy | None, pin tick)
         self._delta_pin: Dict[str, Tuple] = {}
         self._replaying = False
+        # emit-destination pre-activation hints per journaled site:
+        # arg leaf names whose values are emit-target KEYS of the
+        # site's own type (register_journal(..., emit_key_args=...)).
+        # Recovery resolves their union BEFORE fused replay so a fused
+        # window never misses on a cold emit destination (activation
+        # is field-inits only — state exactness is unaffected).
+        self._emit_key_args: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+        # promotion fence: the manifest's fence epoch this plane holds.
+        # A standby promotion bumps the store's epoch; every commit
+        # path re-reads it first and refuses (FencedError) when the
+        # store has moved past us — a partitioned old primary can
+        # never acknowledge a write after its range was claimed.
+        self.fence_epoch = 0
+        self._fence_owner = ""
+        self.fenced = False
+        self.on_fenced: Optional[Any] = None  # silo kill hook
         # counters (silo.collect_metrics mirrors these into ckpt.*)
         self.full_snapshots = 0
         self.delta_snapshots = 0
@@ -609,6 +634,11 @@ class CheckpointPlane:
         self.last_dirty_rows = 0
         self.pauses: List[float] = []
         self.max_pause_s = 0.0
+        # recovery observability (silo mirrors into recovery.*)
+        self.replay_fused_windows = 0
+        self.replay_fused_lanes = 0
+        self.promotions = 0
+        self.last_rto_s = 0.0
         if store is not None:
             m = store.read_manifest()
             if m is not None:
@@ -616,6 +646,8 @@ class CheckpointPlane:
                 self._seq = int(m.get("seq", 0)) + 1
                 rec = m.get("recovery") or {}
                 self._last_ckpt_tick = int(rec.get("tick", -1))
+                self.fence_epoch = int(
+                    (m.get("fence") or {}).get("epoch", 0))
 
     # -- plumbing -----------------------------------------------------------
 
@@ -630,8 +662,8 @@ class CheckpointPlane:
         return self.engine().config
 
     def attach_store(self, store: SnapshotStore) -> None:
-        """Late binding (tests / silo setup hooks): engage the plane on
-        a running engine."""
+        """Late binding (tests / silo setup hooks / standby promotion):
+        engage the plane on a running engine."""
         self.store = store
         m = store.read_manifest()
         if m is not None:
@@ -639,13 +671,24 @@ class CheckpointPlane:
             self._seq = int(m.get("seq", 0)) + 1
             self._last_ckpt_tick = int(
                 (m.get("recovery") or {}).get("tick", -1))
+            self.fence_epoch = int(
+                (m.get("fence") or {}).get("epoch", 0))
 
-    def register_journal(self, interface, method: str) -> None:
+    def register_journal(self, interface, method: str,
+                         emit_key_args: Tuple[str, ...] = ()) -> None:
+        """``emit_key_args``: names of arg leaves whose values are emit
+        DESTINATION keys of this same grain type (e.g. banking
+        transfer's ``dst``) — recovery pre-activates their union so
+        fused fold-replay windows never roll back on cold emit
+        targets."""
         eng = self.engine()
         type_name = eng._type_name(interface)
         self.journal.register(type_name, method)
         # mark the fast-path set the ingress hook checks
         eng._journal_sites.add((type_name, method))
+        if emit_key_args:
+            self._emit_key_args[(type_name, method)] = \
+                tuple(emit_key_args)
 
     def journal_ingress(self, type_name: str, method: str, batch) -> None:
         if self._replaying or not self.enabled:
@@ -663,6 +706,38 @@ class CheckpointPlane:
                                 "committed_tick": s.committed_tick}
                         for s in self.journal.sites.values()},
         }
+
+    # -- promotion fence ----------------------------------------------------
+
+    def _check_fence(self) -> None:
+        """Re-read the store's fence epoch before a commit.  A newer
+        epoch means a standby promoted over this store — refuse
+        (FencedError) rather than acknowledge a write the promoted
+        silo will never see."""
+        m = self.store.read_manifest()
+        cur = int(((m or {}).get("fence") or {}).get("epoch", 0))
+        if cur > self.fence_epoch:
+            self.fenced = True
+            raise FencedError(
+                f"snapshot store fenced at epoch {cur} (this plane "
+                f"holds {self.fence_epoch}) — a standby promoted over "
+                f"this store; refusing to commit")
+
+    def acquire_fence(self, owner: str = "") -> int:
+        """Claim the store: bump the manifest's fence epoch with one
+        commit.  From this commit on, every OTHER plane attached to the
+        store (the old primary) fails its next commit with
+        FencedError.  Returns the new epoch."""
+        m = self.store.read_manifest() or {}
+        epoch = int((m.get("fence") or {}).get("epoch", 0)) + 1
+        m["fence"] = {"epoch": epoch, "owner": owner}
+        m["seq"] = self._seq
+        self._seq += 1
+        self.store.commit_manifest(m)
+        self._manifest = m
+        self.fence_epoch = epoch
+        self._fence_owner = owner
+        return epoch
 
     # -- cadence / engine hook ----------------------------------------------
 
@@ -707,16 +782,29 @@ class CheckpointPlane:
             if (full_due or delta_due) and self._quiescent_for_pin():
                 self.begin("full" if full_due else "delta")
                 did = True
-        if self._active is not None:
-            self.run_slice(cfg.ckpt_pause_budget_s)
-            did = True
-        if cfg.journal_flush_every_ticks > 0 and \
-                eng.tick_number - self._last_journal_flush_tick \
-                >= cfg.journal_flush_every_ticks:
-            self._last_journal_flush_tick = eng.tick_number
-            if self.journal.pending_lanes():
-                self.journal.flush()
+        try:
+            if self._active is not None:
+                self.run_slice(cfg.ckpt_pause_budget_s)
                 did = True
+            if cfg.journal_flush_every_ticks > 0 and \
+                    eng.tick_number - self._last_journal_flush_tick \
+                    >= cfg.journal_flush_every_ticks:
+                self._last_journal_flush_tick = eng.tick_number
+                if self.journal.pending_lanes():
+                    self.journal.flush()
+                    did = True
+        except FencedError:
+            # a standby promoted over this store: the plane is dead
+            # from here — drop the in-flight snapshot, stop journaling
+            # (nothing further can be acknowledged) and hand control to
+            # the silo hook, which kills the silo (a fenced primary
+            # must not keep serving a range another silo now owns)
+            self._active = None
+            self.store = None
+            cb, self.on_fenced = self.on_fenced, None
+            if cb is not None:
+                cb()
+            return time.perf_counter() - t0
         if not did:
             return 0.0
         dt = time.perf_counter() - t0
@@ -838,6 +926,14 @@ class CheckpointPlane:
                 arena.last_use_dev, jnp.asarray(host_clock),
                 jnp.asarray(live), jnp.int32(cutoff))
         dirty = np.asarray(mask).copy()
+        if arena._replicas:
+            # replica groups are always dirty: the lane-hash spread
+            # lands commutative contributions on secondary rows without
+            # advancing the clocks the predicate reads, so a delta that
+            # skipped them would lose acknowledged writes at the cut.
+            # Hot grains only — a handful of rows per delta.
+            for r in arena._replicas.values():
+                dirty[r] = True
         # key churn: rows reused by a different grain since the pin (the
         # pinned map is capacity-aligned only while capacity matched)
         n = min(len(pinned_keys), len(arena._key_of_row))
@@ -882,6 +978,7 @@ class CheckpointPlane:
         return drained
 
     def _commit_snapshot(self, snap: _ActiveSnapshot) -> None:
+        self._check_fence()
         eng = self.engine()
         arenas_ref: Dict[str, Any] = {}
         for name, a in snap.arenas.items():
@@ -937,6 +1034,9 @@ class CheckpointPlane:
                         old_blobs.append(s["blob"])
                 journal[key] = {"segments": keep}
         manifest["journal"] = journal
+        if self.fence_epoch:
+            manifest["fence"] = {"epoch": self.fence_epoch,
+                                 "owner": self._fence_owner}
         self.store.commit_manifest(manifest)
         self._manifest = manifest
         for blob in old_blobs:
@@ -966,6 +1066,7 @@ class CheckpointPlane:
         manifest commit (blobs are already durable — the caller wrote
         them first; the commit order every store write in this plane
         follows)."""
+        self._check_fence()
         manifest = dict(self._manifest or {})
         journal = dict(manifest.get("journal") or {})
         for site, blob, meta in sealed:
@@ -983,6 +1084,9 @@ class CheckpointPlane:
         manifest["engine"] = {"tick_number": eng.tick_number}
         manifest.setdefault("recovery",
                             {"full": None, "deltas": [], "tick": -1})
+        if self.fence_epoch:
+            manifest["fence"] = {"epoch": self.fence_epoch,
+                                 "owner": self._fence_owner}
         self.store.commit_manifest(manifest)
         self._manifest = manifest
 
@@ -1017,10 +1121,17 @@ class CheckpointPlane:
 
     async def recover(self) -> Dict[str, Any]:
         """Crash recovery: rebuild every arena from the latest committed
-        recovery point, fold-replay the journal tail (one engine tick
-        per journaled tick), then re-anchor with a fresh full snapshot.
-        Idempotent when the store holds no manifest (fresh deployment).
-        """
+        recovery point (host-assembled full columns adopted in one
+        transfer each, deltas as one batched scatter per column),
+        fold-replay the journal tail (fused windows of consecutive
+        journaled ticks where the signature allows; per-tick engine
+        calls otherwise), then re-anchor.  Re-anchoring follows
+        ``config.recover_reanchor``: "sync" writes a fresh full inside
+        recover (the old behavior — restore time then includes a full
+        snapshot drain); "defer" leaves the old recovery point and lets
+        the periodic cadence re-anchor — a second crash replays the
+        same journal tail idempotently from the old cut.  Idempotent
+        when the store holds no manifest (fresh deployment)."""
         if not self.enabled:
             return {"recovered": False, "reason": "no snapshot store"}
         manifest = self.store.read_manifest()
@@ -1030,6 +1141,8 @@ class CheckpointPlane:
         t0 = time.perf_counter()
         self._manifest = manifest
         self._seq = int(manifest.get("seq", 0)) + 1
+        self.fence_epoch = int(
+            (manifest.get("fence") or {}).get("epoch", 0))
         rec = manifest.get("recovery") or {}
         restored_rows = 0
         recovery_tick = int(rec.get("tick", -1))
@@ -1059,12 +1172,63 @@ class CheckpointPlane:
         for arena in eng.arenas.values():
             if arena.n_shards != eng.n_shards:
                 arena.reshard(eng.n_shards, eng.sharding)
-        # journal tail: every committed entry at/after the cut, in the
-        # original global order, grouped by original tick
+        replay = self._load_replay_tail(manifest, recovery_tick)
+        self._replaying = True
+        try:
+            if recovery_tick >= 0:
+                eng.tick_number = max(eng.tick_number, recovery_tick)
+            replayed, fused_windows, fused_lanes = \
+                self._fold_replay(replay)
+            await eng.flush()
+        finally:
+            self._replaying = False
+        self.journal.replayed_lanes += replayed
+        mt = (manifest.get("engine") or {}).get("tick_number")
+        if mt is not None:
+            eng.tick_number = max(eng.tick_number, int(mt))
+        if str(getattr(eng.config, "recover_reanchor", "sync")) \
+                == "defer":
+            # no terminal full here: the OLD recovery point stays the
+            # anchor and the next cadence full re-anchors outside the
+            # outage window.  The tick bump keeps the global
+            # (tick, order) replay sort unambiguous across restarts:
+            # per-process journal order counters restart at 0, so new
+            # appends must land at a strictly later tick than anything
+            # replayed above.
+            eng.tick_number += 1
+            anchor = None
+        else:
+            # re-anchor synchronously: a second crash recovers from
+            # HERE, and the replayed segments are pruned so replay is
+            # never applied twice
+            anchor = self.checkpoint_full()
+        self.restored_rows += restored_rows
+        self.last_restore_s = time.perf_counter() - t0
+        return {"recovered": True,
+                "recovery_tick": recovery_tick,
+                "restored_rows": restored_rows,
+                "replayed_lanes": replayed,
+                "replayed_ticks": len({e['tick'] for e in replay}),
+                "fused_windows": fused_windows,
+                "fused_lanes": fused_lanes,
+                "re_anchor": anchor,
+                "seconds": round(self.last_restore_s, 6)}
+
+    def _load_replay_tail(self, manifest: Dict[str, Any],
+                          recovery_tick: int,
+                          cache: Optional[Dict[str, Any]] = None
+                          ) -> List[Dict[str, Any]]:
+        """Decode every committed journal entry at/after the cut into
+        the global (tick, order) replay order, rebuilding each site's
+        seq/committed counters so new segments continue the chain.
+        ``cache`` maps blob name → (arrays, meta) for segments already
+        staged host-side (the warm-standby tailer)."""
+        eng = self.engine()
         replay: List[Dict[str, Any]] = []
         for key, j in (manifest.get("journal") or {}).items():
             for seg in j["segments"]:
-                got = self.store.get_blob(seg["blob"])
+                got = (cache or {}).get(seg["blob"]) \
+                    or self.store.get_blob(seg["blob"])
                 if got is None:
                     raise RuntimeError(
                         f"manifest references missing journal blob "
@@ -1090,45 +1254,302 @@ class CheckpointPlane:
                                           seg["tick_max"])
                 eng._journal_sites.add((type_name, method))
         replay.sort(key=lambda e: (e["tick"], e["order"]))
+        return replay
+
+    def _fold_replay(self, replay: List[Dict[str, Any]]
+                     ) -> Tuple[int, int, int]:
+        """Replay the sorted journal tail.  Runs of consecutive ticks
+        with a fusable per-site signature execute as ONE stacked-rows
+        fused window (``FusedTickProgram.replay``) instead of a
+        per-tick engine call each — preserving original stamps and the
+        acknowledged-prefix contract bit-exactly (a window that misses
+        rolls back and replays per-tick, the autofuse discipline).
+        Returns (replayed_lanes, fused_windows, fused_lanes).  The
+        caller holds ``_replaying``."""
+        eng = self.engine()
+        # group entries by original tick, in order
+        ticks: List[Tuple[int, List[Dict[str, Any]]]] = []
+        for e in replay:
+            if ticks and ticks[-1][0] == e["tick"]:
+                ticks[-1][1].append(e)
+            else:
+                ticks.append((e["tick"], [e]))
+        cap = int(getattr(eng.config, "recover_fused_window", 0) or 0)
+        can_fuse = (cap > 1 and eng.router is None
+                    and not getattr(eng, "_stream_routes", {})
+                    and eng.timers.armed_total == 0)
+        if can_fuse:
+            # emit-destination pre-activation (register_journal's
+            # emit_key_args hints): activate the union of hinted key
+            # leaves up front so fused windows never roll back on cold
+            # emit targets.  Activation is field-inits only — state
+            # exactness is unaffected.  Gated on can_fuse so the pure
+            # per-tick path keeps its byte-identical row-identity
+            # behavior.
+            buckets: Dict[str, List[np.ndarray]] = {}
+            for e in replay:
+                names = self._emit_key_args.get((e["type"], e["method"]))
+                if not names or not isinstance(e["args"], dict):
+                    continue
+                for nm in names:
+                    leaf = e["args"].get(nm)
+                    if leaf is not None:
+                        buckets.setdefault(e["type"], []).append(
+                            np.asarray(leaf).reshape(-1))
+            for type_name, chunks in buckets.items():
+                keys = np.unique(np.concatenate(chunks)
+                                 .astype(np.int64))
+                eng.arena_for(type_name).resolve_rows(keys)
         replayed = 0
-        self._replaying = True
-        try:
-            if recovery_tick >= 0:
-                eng.tick_number = max(eng.tick_number, recovery_tick)
-            i = 0
-            while i < len(replay):
-                tick = replay[i]["tick"]
-                eng.tick_number = tick  # stamps match the original run
-                while i < len(replay) and replay[i]["tick"] == tick:
-                    e = replay[i]
-                    eng.enqueue_local_batch(e["type"], e["method"],
-                                            e["keys"], e["args"])
-                    replayed += len(e["keys"])
-                    i += 1
-                eng.run_tick()
-            await eng.flush()
-        finally:
-            self._replaying = False
-        self.journal.replayed_lanes += replayed
-        mt = (manifest.get("engine") or {}).get("tick_number")
-        if mt is not None:
-            eng.tick_number = max(eng.tick_number, int(mt))
-        # re-anchor: a second crash recovers from HERE, and the replayed
-        # segments are pruned so replay is never applied twice
-        anchor = self.checkpoint_full()
-        self.restored_rows += restored_rows
-        self.last_restore_s = time.perf_counter() - t0
-        return {"recovered": True,
-                "recovery_tick": recovery_tick,
-                "restored_rows": restored_rows,
-                "replayed_lanes": replayed,
-                "replayed_ticks": len({e['tick'] for e in replay}),
-                "re_anchor": anchor,
-                "seconds": round(self.last_restore_s, 6)}
+        fused_windows = 0
+        fused_lanes = 0
+        # compiled-window reuse across the tail: windows with the same
+        # (T, site order, lane widths, args skeleton) re-run ONE
+        # program with swapped injections instead of re-tracing — on a
+        # long tail the trace/compile cost is paid once, not per
+        # window (rows/masks ride as runtime inputs, so the trace
+        # never baked the keys; arena growth still re-traces via the
+        # generation discipline in prepare())
+        prog_cache: Dict[Tuple, Any] = {}
+        i = 0
+        while i < len(ticks):
+            j = self._fused_run_end(ticks, i, cap) if can_fuse else i
+            if j - i > 1:
+                lanes, was_fused = self._replay_window(ticks[i:j],
+                                                       prog_cache)
+                replayed += lanes
+                if was_fused:
+                    fused_windows += 1
+                    fused_lanes += lanes
+                i = j
+                continue
+            tick, entries = ticks[i]
+            eng.tick_number = tick  # stamps match the original run
+            for e in entries:
+                eng.enqueue_local_batch(e["type"], e["method"],
+                                        e["keys"], e["args"])
+                replayed += len(e["keys"])
+            eng.run_tick()
+            i += 1
+        self.replay_fused_windows += fused_windows
+        self.replay_fused_lanes += fused_lanes
+        return replayed, fused_windows, fused_lanes
+
+    @staticmethod
+    def _entry_sig(e: Dict[str, Any]) -> Tuple:
+        leaves, treedef = jax.tree_util.tree_flatten(e["args"])
+        return (len(e["keys"]), treedef,
+                tuple((np.shape(lf), np.asarray(lf).dtype.str)
+                      for lf in leaves))
+
+    def _fused_run_end(self, ticks, i: int, cap: int) -> int:
+        """Longest run [i, j) of CONSECUTIVE ticks a single stacked
+        window can replay: per-site lane width and args skeleton stay
+        constant wherever the site appears, at most one entry per
+        (site, tick), intra-tick site order embeds into one canonical
+        order, and no touched source arena holds replica groups (their
+        lane-hash spread is per-batch — per-tick replay keeps it
+        exact)."""
+        eng = self.engine()
+        sigs: Dict[Tuple[str, str], Tuple] = {}
+        canonical: List[Tuple[str, str]] = []
+        j = i
+        while j < len(ticks) and j - i < cap:
+            tick, entries = ticks[j]
+            if j > i and tick != ticks[j - 1][0] + 1:
+                break
+            seen = set()
+            pos = -1
+            ok = True
+            for e in entries:
+                site = (e["type"], e["method"])
+                if site in seen:
+                    ok = False
+                    break
+                seen.add(site)
+                sig = self._entry_sig(e)
+                if sigs.setdefault(site, sig) != sig:
+                    ok = False
+                    break
+                if site in canonical:
+                    p = canonical.index(site)
+                    if p <= pos:
+                        ok = False
+                        break
+                    pos = p
+                else:
+                    try:
+                        arena = eng.arena_for(e["type"])
+                    except Exception:
+                        ok = False
+                        break
+                    if arena._replicas:
+                        ok = False
+                        break
+                    canonical.insert(pos + 1, site)
+                    pos += 1
+            if not ok:
+                break
+            j += 1
+        return max(j, i)
+
+    def _replay_window(self, group,
+                       prog_cache: "Optional[Dict[Tuple, Any]]" = None
+                       ) -> Tuple[int, bool]:
+        """One stacked-rows fused window over consecutive journaled
+        ticks.  Exactness contract: snapshot (plain references —
+        undonated) after prepare, run, verify; a nonzero miss count
+        rolls everything back (state, counters, ledger, attribution)
+        and replays the window per-tick unfused.  ``prog_cache`` maps
+        window signatures to built programs so same-shaped windows
+        later in the tail skip the trace/compile.  Returns
+        (replayed_lanes, ran_fused)."""
+        from orleans_tpu.tensor.fused import FusedTickProgram
+        eng = self.engine()
+        first_tick = group[0][0]
+        T = len(group)
+        by_site: Dict[Tuple[str, str], Dict[int, Dict]] = {}
+        order: List[Tuple[str, str]] = []
+        lanes_total = 0
+        for t, (tick, entries) in enumerate(group):
+            pos = -1
+            for e in entries:
+                site = (e["type"], e["method"])
+                if site not in by_site:
+                    by_site[site] = {}
+                    order.insert(pos + 1, site)
+                    pos += 1
+                else:
+                    pos = order.index(site)
+                by_site[site][t] = e
+                lanes_total += len(e["keys"])
+        if all(len(entries) <= 1 for _, entries in group):
+            # no tick sequences two sites, so the order list carries no
+            # intra-tick constraint — sort it canonically so windows
+            # that merely ENCOUNTER sites in a different order share a
+            # cache signature (and a compiled program)
+            order.sort()
+        sites = []
+        stackeds = []
+        for site in order:
+            per_tick = by_site[site]
+            example = next(iter(per_tick.values()))
+            m = len(example["keys"])
+            keys2d = np.full((T, m), -1, dtype=np.int64)
+            mask2d = np.zeros((T, m), dtype=bool)
+            for t, e in per_tick.items():
+                keys2d[t] = np.asarray(e["keys"], np.int64)
+                mask2d[t] = True
+            ex_leaves, treedef = jax.tree_util.tree_flatten(
+                example["args"])
+            stacked_leaves = []
+            for li, ex in enumerate(ex_leaves):
+                ex = np.asarray(ex)
+                buf = np.zeros((T, *ex.shape), dtype=ex.dtype)
+                for t, e in per_tick.items():
+                    buf[t] = np.asarray(
+                        jax.tree_util.tree_leaves(e["args"])[li])
+                stacked_leaves.append(buf)
+            args_stacked = jax.tree_util.tree_unflatten(
+                treedef, stacked_leaves)
+            if not isinstance(args_stacked, dict):
+                # reserved leaves ride a dict — non-dict arg trees fall
+                # back to per-tick replay
+                return self._replay_group_per_tick(group), False
+            sites.append((site[0], site[1], keys2d, mask2d))
+            stackeds.append(dict(args_stacked))
+        sig = (T, tuple(
+            (tn, m, k2.shape[1],
+             tuple(sorted((name, np.shape(lf), np.asarray(lf).dtype.str)
+                          for name, lf in st.items())))
+            for (tn, m, k2, _mk), st in zip(sites, stackeds)))
+        prog = prog_cache.get(sig) if prog_cache is not None else None
+        if prog is not None:
+            # same window shape as an earlier one: swap the injections
+            # into the cached program's sources and re-resolve — rows
+            # and masks are runtime inputs, so the compiled trace is
+            # reusable as-is (prepare() still re-traces if the resolve
+            # grew an arena, the generation discipline)
+            for src, (_tn, _m, k2, mk) in zip(prog.sources, sites):
+                src.keys2d = np.asarray(k2, dtype=np.int64)
+                src.mask2d = np.asarray(mk, dtype=bool)
+                src.keys = (np.unique(src.keys2d[src.mask2d])
+                            if src.mask2d.any()
+                            else np.empty(0, dtype=np.int64))
+                src.refresh_rows()
+        else:
+            try:
+                prog = FusedTickProgram.replay(eng, sites)
+            except KeyError:
+                return self._replay_group_per_tick(group), False
+            # undonated: rollback snapshots stay plain references
+            prog.donate = False
+            if prog_cache is not None:
+                prog_cache[sig] = prog
+        statics = [{} for _ in sites]
+        for si, s in enumerate(prog.sources):
+            stackeds[si]["__rows__"] = jnp.asarray(s.rows2d)
+            stackeds[si]["__mask__"] = jnp.asarray(s.mask2d)
+        multi = len(sites) > 1
+        stacked_arg = stackeds if multi else stackeds[0]
+        static_arg = statics if multi else statics[0]
+        # prepare BEFORE the snapshot: source resolution/discovery can
+        # activate keys and GROW an arena — a post-snapshot grow would
+        # make the snapshot unrestorable (the autofuse discipline)
+        prog.prepare(stacked_arg, static_arg)
+        for si, s in enumerate(prog.sources):
+            stackeds[si]["__rows__"] = jnp.asarray(s.rows2d)
+            stackeds[si]["__mask__"] = jnp.asarray(s.mask2d)
+        snapshot = {n: dict(eng.arena_for(n).state)
+                    for n in prog._touched}
+        counters = (eng.tick_number, eng.ticks_run,
+                    eng.messages_processed)
+        ledger_state = eng.ledger.snapshot_state()
+        attr_state = eng.attribution.snapshot_state()
+        eng.tick_number = first_tick  # stamps match the original run
+        prog.run(stacked_arg, static_arg)
+        if prog.verify() == 0:
+            return lanes_total, True
+        # non-exact window (cold emit destination the hints didn't
+        # cover, fan-out overflow): roll back and replay per-tick —
+        # the slow path that keeps transparency exact
+        for n, cols in snapshot.items():
+            eng.arena_for(n).adopt_state(cols)
+        (eng.tick_number, eng.ticks_run,
+         eng.messages_processed) = counters
+        if ledger_state is not None:
+            eng.ledger.restore_state(ledger_state)
+        if attr_state is not None:
+            eng.attribution.restore_state(attr_state)
+        return self._replay_group_per_tick(group), False
+
+    def _replay_group_per_tick(self, group) -> int:
+        eng = self.engine()
+        lanes = 0
+        for tick, entries in group:
+            eng.tick_number = tick
+            for e in entries:
+                eng.enqueue_local_batch(e["type"], e["method"],
+                                        e["keys"], e["args"])
+                lanes += len(e["keys"])
+            eng.run_tick()
+        return lanes
 
     def _restore_arena_part(self, name: str, ref: Dict[str, Any],
-                            base: bool) -> int:
-        got = self.store.get_blob(ref["meta"])
+                            base: bool, store: Optional[Any] = None,
+                            replace: bool = False) -> int:
+        """Land one manifest entry's arena part.  FULL entries take the
+        fast device path: every state column is assembled at full
+        capacity in vectorized numpy (field init + one fancy-index
+        placement per part) and adopted with ONE ``device_put`` per
+        column (``arena.adopt_columns``) — no per-chunk scatters, no
+        wasted init allocation (``adopt_layout(init_columns=False)``).
+        DELTA entries concatenate all parts and land as ONE batched
+        scatter per column.  ``store`` overrides the plane's own store
+        (warm-standby tailing); ``replace`` permits full adoption over
+        a non-empty arena (standby re-base onto a newer full)."""
+        store = store if store is not None else self.store
+        got = store.get_blob(ref["meta"])
         if got is None:
             raise RuntimeError(
                 f"manifest references missing snapshot blob "
@@ -1138,20 +1559,39 @@ class CheckpointPlane:
         arena = eng.arena_for(name)
         parts = []
         for blob in ref["parts"]:
-            got = self.store.get_blob(blob)
+            got = store.get_blob(blob)
             if got is None:
                 raise RuntimeError(
                     f"manifest references missing snapshot blob {blob}")
             parts.append(got[0])
+        restored = 0
         if base or ref.get("kind") == "full":
             arena.adopt_layout(meta, meta_arrays["key_of_row"],
                                meta_arrays["last_use_tick"],
-                               meta_arrays["shard_next"])
+                               meta_arrays["shard_next"],
+                               init_columns=False, replace=replace)
+            capacity = arena.capacity
+            part_rows = [np.asarray(p["__rows"], np.int64)
+                         for p in parts]
+            restored = sum(len(r) for r in part_rows)
+            columns: Dict[str, np.ndarray] = {}
+            for fname, f in arena.info.state_fields.items():
+                col = np.full((capacity, *f.shape), f.init,
+                              dtype=f.dtype)
+                for p, rows in zip(parts, part_rows):
+                    col[rows] = np.asarray(p[fname], dtype=f.dtype)
+                columns[fname] = col
+            last_dev = np.zeros(capacity, dtype=np.int32)
+            for p, rows in zip(parts, part_rows):
+                last_dev[rows] = np.asarray(p["__last_use_dev"],
+                                            np.int32)
+            arena.adopt_columns(columns, last_dev)
         else:
             # deltas within one generation: rows never moved, so the
             # recorded row ids land EXACTLY (evict + slot-reuse between
             # base and delta included) — free dead keys, re-home moved
-            # ones, place the dirty set at its recorded rows
+            # ones, place the dirty set at its recorded rows, then ONE
+            # batched scatter per column over the concatenated parts
             all_rows = np.concatenate(
                 [p["__rows"] for p in parts]) if parts \
                 else np.empty(0, np.int64)
@@ -1162,13 +1602,15 @@ class CheckpointPlane:
                               meta_arrays["live_keys"],
                               meta_arrays["shard_next"],
                               meta_arrays["last_use_tick"])
-        restored = 0
-        for arrays in parts:
-            rows = arrays.pop("__rows")
-            arrays.pop("__keys")
-            last_dev = arrays.pop("__last_use_dev")
-            arena.scatter_restore(rows, arrays, last_dev)
-            restored += len(rows)
+            if parts:
+                columns = {
+                    fname: np.concatenate(
+                        [np.asarray(p[fname]) for p in parts])
+                    for fname in arena.info.state_fields}
+                last_dev = np.concatenate(
+                    [np.asarray(p["__last_use_dev"]) for p in parts])
+                arena.scatter_restore(all_rows, columns, last_dev)
+                restored = len(all_rows)
         return restored
 
     # -- observability ------------------------------------------------------
@@ -1200,5 +1642,212 @@ class CheckpointPlane:
             "max_pause_s": round(self.max_pause_s, 6),
             "in_progress": self._active.kind
             if self._active is not None else None,
+            "replay_fused_windows": self.replay_fused_windows,
+            "replay_fused_lanes": self.replay_fused_lanes,
+            "promotions": self.promotions,
+            "last_rto_s": round(self.last_rto_s, 6),
             "journal": self.journal.snapshot(),
         }
+
+
+class StandbyTailer:
+    """Warm-standby log shipping over the primary's ``SnapshotStore``.
+
+    A standby engine tails the primary's committed recovery entries
+    (fulls + deltas, adopted straight into its arenas) and sealed
+    journal segments (staged host-side only — a delta records absolute
+    values at its cut, so applying journaled ticks the next delta
+    already covers would double-count).  The standby therefore holds
+    an adopted-but-not-serving arena within one seal of the durable
+    horizon, and ``promote()`` only has to fence the store and replay
+    the staged tail — no full restore inside the outage window.
+
+    Contract with the primary: everything flows through the existing
+    blobs-first / manifest-last commit order, so every blob a manifest
+    names is readable by the time the tailer sees the manifest.  The
+    only race is PRUNING (the primary deletes superseded blobs after
+    committing a new full); a missing blob mid-poll just resets the
+    tailer, and the next poll re-bases onto the newer full.
+    """
+
+    def __init__(self, engine, store: SnapshotStore) -> None:
+        self._engine = weakref.ref(engine)
+        self.store = store
+        self._manifest: Optional[Dict[str, Any]] = None
+        self._adopted_seqs: set = set()
+        self._adopted_tick = -1
+        self._full_seq = -1
+        # blob name -> (arrays, meta): sealed journal segments staged
+        # host-side, handed to _load_replay_tail as its cache at
+        # promotion time
+        self._staged: Dict[str, Any] = {}
+        self._staged_tick = -1
+        self._staged_timers: List[Tuple[Any, Any]] = []
+        self.polls = 0
+        self.adopted_rows = 0
+        self.adopted_entries = 0
+        self.resets = 0
+        self.promoted = False
+        self.last_promote_s = 0.0
+
+    def _reset(self) -> None:
+        self._adopted_seqs.clear()
+        self._staged_timers = []
+        self._adopted_tick = -1
+        self._full_seq = -1
+
+    def poll(self) -> Dict[str, Any]:
+        """One tailing step: adopt any recovery entries newer than what
+        this standby holds, stage any newly sealed journal segments.
+        Cheap no-op when nothing changed."""
+        self.polls += 1
+        manifest = self.store.read_manifest()
+        if manifest is None:
+            return {"adopted_entries": 0, "staged_segments": 0}
+        self._manifest = manifest
+        plane = self._engine().checkpointer
+        rec = manifest.get("recovery") or {}
+        entries = [rec["full"]] if rec.get("full") else []
+        entries += list(rec.get("deltas") or [])
+        adopted = 0
+        try:
+            if entries and int(entries[0]["seq"]) != self._full_seq:
+                # a newer full supersedes everything adopted so far:
+                # re-base (replace=True full adoption over the live
+                # arena) and re-stage its timers chain from scratch
+                self._reset()
+                self._full_seq = int(entries[0]["seq"])
+            for entry in entries:
+                seq = int(entry["seq"])
+                if seq in self._adopted_seqs:
+                    continue
+                is_base = entry is entries[0]
+                for name, ref in entry["arenas"].items():
+                    self.adopted_rows += plane._restore_arena_part(
+                        name, ref, base=is_base, store=self.store,
+                        replace=is_base)
+                if entry.get("timers"):
+                    got = self.store.get_blob(entry["timers"])
+                    if got is None:
+                        raise RuntimeError(
+                            f"standby: timers blob {entry['timers']} "
+                            f"pruned mid-poll")
+                    self._staged_timers.append(got)
+                self._adopted_seqs.add(seq)
+                self._adopted_tick = max(self._adopted_tick,
+                                         int(entry["tick"]))
+                self.adopted_entries += 1
+                adopted += 1
+        except RuntimeError:
+            # prune race: the primary committed a new full and deleted
+            # the blobs under us — drop everything, next poll re-bases
+            self._reset()
+            self.resets += 1
+            return {"adopted_entries": 0, "staged_segments": 0,
+                    "reset": True}
+        staged = 0
+        live_blobs = set()
+        for key, j in (manifest.get("journal") or {}).items():
+            for seg in j["segments"]:
+                live_blobs.add(seg["blob"])
+                if seg["blob"] in self._staged:
+                    continue
+                got = self.store.get_blob(seg["blob"])
+                if got is None:
+                    continue  # pruned already; harmless, skip
+                self._staged[seg["blob"]] = got
+                self._staged_tick = max(self._staged_tick,
+                                        int(seg["tick_max"]))
+                staged += 1
+        # drop staged segments a new full made dead
+        for blob in list(self._staged):
+            if blob not in live_blobs:
+                del self._staged[blob]
+        return {"adopted_entries": adopted, "staged_segments": staged}
+
+    def lag_ticks(self) -> int:
+        """How far this standby trails the durable horizon, in ticks:
+        (latest committed recovery/segment tick) - (latest tick this
+        standby has adopted or staged).  ``-1`` until the first
+        manifest is seen (no primary to trail yet)."""
+        if self._manifest is None:
+            return -1
+        rec = self._manifest.get("recovery") or {}
+        durable = int(rec.get("tick", -1))
+        for key, j in (self._manifest.get("journal") or {}).items():
+            for seg in j["segments"]:
+                durable = max(durable, int(seg["tick_max"]))
+        held = max(self._adopted_tick, self._staged_tick)
+        if durable < 0:
+            return 0
+        return max(0, durable - held)
+
+    async def promote(self, owner: str = "") -> Dict[str, Any]:
+        """Take over the primary's range: fence the store (the old
+        primary's next commit fails with FencedError), catch up the
+        last committed entries, restore staged timers, fold-replay only
+        the un-adopted journal tail, and leave the engine serving at
+        the durable horizon.  Deliberately does NOT write a terminal
+        full — the periodic cadence re-anchors outside the outage
+        window, which is what keeps RTO at tail-replay cost."""
+        eng = self._engine()
+        plane = eng.checkpointer
+        t0 = time.perf_counter()
+        plane.attach_store(self.store)
+        epoch = plane.acquire_fence(owner or "standby")
+        # final catch-up under the fence: anything the old primary
+        # committed before the fence landed is adopted/staged here;
+        # anything after it could never commit
+        self.poll()
+        manifest = plane._manifest
+        if self._staged_timers:
+            for arrays, tmeta in self._staged_timers:
+                eng.timers.restore_entry(arrays, tmeta)
+            eng.timers.finish_restore(self._adopted_tick)
+        for arena in eng.arenas.values():
+            if arena.n_shards != eng.n_shards:
+                arena.reshard(eng.n_shards, eng.sharding)
+        replay = plane._load_replay_tail(
+            manifest, self._adopted_tick, cache=self._staged)
+        plane._replaying = True
+        try:
+            if self._adopted_tick >= 0:
+                eng.tick_number = max(eng.tick_number,
+                                      self._adopted_tick)
+            replayed, fused_windows, fused_lanes = \
+                plane._fold_replay(replay)
+            await eng.flush()
+        finally:
+            plane._replaying = False
+        plane.journal.replayed_lanes += replayed
+        mt = (manifest.get("engine") or {}).get("tick_number")
+        if mt is not None:
+            eng.tick_number = max(eng.tick_number, int(mt))
+        # same defer-re-anchor tick bump as recover(): per-process
+        # journal order counters restart at 0, so post-promotion
+        # appends must land strictly after everything replayed
+        eng.tick_number += 1
+        plane.restored_rows += self.adopted_rows
+        plane.promotions += 1
+        self.promoted = True
+        self.last_promote_s = time.perf_counter() - t0
+        plane.last_rto_s = self.last_promote_s
+        return {"promoted": True,
+                "fence_epoch": epoch,
+                "adopted_tick": self._adopted_tick,
+                "adopted_rows": self.adopted_rows,
+                "replayed_lanes": replayed,
+                "fused_windows": fused_windows,
+                "fused_lanes": fused_lanes,
+                "seconds": round(self.last_promote_s, 6)}
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"polls": self.polls,
+                "adopted_entries": self.adopted_entries,
+                "adopted_rows": self.adopted_rows,
+                "adopted_tick": self._adopted_tick,
+                "staged_segments": len(self._staged),
+                "lag_ticks": self.lag_ticks(),
+                "resets": self.resets,
+                "promoted": self.promoted,
+                "last_promote_s": round(self.last_promote_s, 6)}

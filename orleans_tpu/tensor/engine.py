@@ -1144,14 +1144,20 @@ class TensorEngine:
         self._wake_up()
         return future
 
-    def register_journal(self, interface, method: str) -> None:
+    def register_journal(self, interface, method: str,
+                         emit_key_args: Tuple[str, ...] = ()) -> None:
         """Mark (interface, method) as a JOURNALED ingress site: every
         batch entering through send_batch/enqueue/injectors appends to
         the device journal ring, seals into durable segments, and
         fold-replays after a crash (tensor/checkpoint.py).  The device
         tier of event_sourcing.py's JournaledGrain — per-tick batched
-        appends instead of per-event storage commits."""
-        self.checkpointer.register_journal(interface, method)
+        appends instead of per-event storage commits.
+        ``emit_key_args`` names arg leaves holding emit-destination
+        keys of this same grain type (e.g. a transfer's ``dst``) so
+        fused fold-replay can pre-activate them (see
+        CheckpointPlane.register_journal)."""
+        self.checkpointer.register_journal(
+            interface, method, emit_key_args=emit_key_args)
 
     def register_fanout(self, src_interface, src_method: str, fanout,
                         dst_interface, dst_method: str) -> None:

@@ -76,7 +76,16 @@ def _normalize(out):
 
 class _Source:
     """One injection pattern of a fused window (a multi-pattern window
-    applies several per tick, in a canonical order)."""
+    applies several per tick, in a canonical order).
+
+    Two modes.  STATIC (the steady-state autofuse mode): one key set,
+    identical every tick — rows resolve once and ride the trace as
+    constants.  STACKED (``_Source.stacked``, the journal fold-replay
+    mode): a per-tick [T, m] key matrix with a [T, m] presence mask —
+    rows resolve host-side into a [T, m] matrix that rides the scan xs
+    as ``__rows__``/``__mask__`` leaves; absent lanes carry row -1 and
+    mask False, which every handler/exchange path already treats as an
+    exact no-op (the same contract as emit-resolution misses)."""
 
     def __init__(self, engine, type_name: str, method: str,
                  keys: np.ndarray) -> None:
@@ -85,9 +94,45 @@ class _Source:
         self.type_name = type_name
         self.method = method
         self.arena = engine.arena_for(type_name)
+        self.stacked_rows = False
         self.keys = np.asarray(keys, dtype=np.int64)
-        self.rows = jnp.asarray(self.arena.spread_rows_host(
-            self.arena.resolve_rows(self.keys)))
+        self.refresh_rows()
+
+    @classmethod
+    def stacked(cls, engine, type_name: str, method: str,
+                keys2d: np.ndarray, mask2d: np.ndarray) -> "_Source":
+        if vector_type(type_name) is None:
+            raise KeyError(f"{type_name!r} is not a @vector_grain type")
+        self = cls.__new__(cls)
+        self.type_name = type_name
+        self.method = method
+        self.arena = engine.arena_for(type_name)
+        self.stacked_rows = True
+        self.keys2d = np.asarray(keys2d, dtype=np.int64)
+        self.mask2d = np.asarray(mask2d, dtype=bool)
+        self.lanes = int(self.keys2d.shape[1])
+        # the flat unique key set (activation + re-resolution domain)
+        self.keys = (np.unique(self.keys2d[self.mask2d])
+                     if self.mask2d.any()
+                     else np.empty(0, dtype=np.int64))
+        self.refresh_rows()
+        return self
+
+    def refresh_rows(self) -> None:
+        """(Re-)resolve keys → rows against the arena's CURRENT layout
+        (activates missing keys — may grow the arena, so rollback
+        snapshots must come after; the prepare() contract)."""
+        if not self.stacked_rows:
+            self.rows = jnp.asarray(self.arena.spread_rows_host(
+                self.arena.resolve_rows(self.keys)))
+            return
+        if len(self.keys):
+            self.arena.resolve_rows(self.keys)
+        flat = self.keys2d.reshape(-1).copy()
+        flat[~self.mask2d.reshape(-1)] = -1
+        rows, found = self.arena.lookup_rows(flat)
+        rows = np.where(found, rows.astype(np.int64), np.int64(-1))
+        self.rows2d = rows.reshape(self.keys2d.shape)
 
 
 class FusedTickProgram:
@@ -119,8 +164,26 @@ class FusedTickProgram:
         self._finish_init()
         return self
 
+    @classmethod
+    def replay(cls, engine,
+               sites: "List[Tuple[str, str, np.ndarray, np.ndarray]]"
+               ) -> "FusedTickProgram":
+        """Stacked-rows window for journal fold-replay: each site is
+        (type_name, method, keys2d [T, m], mask2d [T, m]) — a run of T
+        consecutive journaled ticks with per-tick key sets, applied in
+        site order each tick.  Absent (site, tick) pairs ride with
+        mask False / row -1 and are exact no-ops."""
+        self = cls.__new__(cls)
+        self.engine = engine
+        self.sources = [_Source.stacked(engine, t, m, k2, mk)
+                        for t, m, k2, mk in sites]
+        self._finish_init()
+        return self
+
     def _finish_init(self) -> None:
-        self.n_msgs = sum(len(s.keys) for s in self.sources)
+        self.n_msgs = sum(
+            s.lanes if s.stacked_rows else len(s.keys)
+            for s in self.sources)
         self._generations: Dict[str, int] = {}
         # eviction epochs of touched arenas at trace time: the window
         # bakes each arena's directory mirror in as trace constants, so
@@ -511,11 +574,14 @@ class FusedTickProgram:
                 and self.engine.config.exchange_align_sources:
             for i, s in enumerate(self.sources):
                 arena = self.engine.arena_for(s.type_name)
-                if arena.sharding is None \
+                if s.stacked_rows \
+                        or arena.sharding is None \
                         or (s.type_name, s.method) \
                         in self.engine._stream_routes \
                         or not exchangeable_args(examples[i],
                                                  len(s.keys)):
+                    # stacked sources change lanes per tick — there is
+                    # no one host packing to bake
                     continue
                 plan = self.engine.exchange.align_plan(
                     np.asarray(s.rows), int(arena.shard_capacity))
@@ -532,10 +598,12 @@ class FusedTickProgram:
                     "rows": jnp.asarray(plan["rows"]),
                     "mask": jnp.asarray(plan["take"] >= 0),
                 }
-        src_rows = [al["rows"] if al is not None else s.rows
+        src_rows = [None if s.stacked_rows
+                    else (al["rows"] if al is not None else s.rows)
                     for al, s in zip(self._align, self.sources)]
-        masks = [al["mask"] if al is not None
-                 else ones_mask(len(s.keys))
+        masks = [None if s.stacked_rows
+                 else (al["mask"] if al is not None
+                       else ones_mask(len(s.keys)))
                  for al, s in zip(self._align, self.sources)]
         # the discovery/trace examples must match the lane layout the
         # window's gather produces
@@ -562,10 +630,21 @@ class FusedTickProgram:
             miss_tot = jnp.int32(0)
             del_tot = jnp.int32(0)
             for i, src in enumerate(self.sources):
+                args_i = per_source_args[i]
+                if src.stacked_rows:
+                    # stacked mode: this tick's rows/mask ride the scan
+                    # xs as reserved leaves (per-tick key sets); pop
+                    # them so the handler sees only its own args
+                    args_i = dict(args_i)
+                    rows_i = args_i.pop("__rows__")
+                    mask_i = args_i.pop("__mask__")
+                    hk = None
+                else:
+                    rows_i, mask_i, hk = src_rows[i], masks[i], src.keys
                 states, miss, dd, hist, attr, xneed = self._apply_group(
-                    states, src.type_name, src.method, src_rows[i],
-                    per_source_args[i], masks[i], depth=1, hist=hist,
-                    attr=attr, xneed=xneed, host_keys=src.keys,
+                    states, src.type_name, src.method, rows_i,
+                    args_i, mask_i, depth=1, hist=hist,
+                    attr=attr, xneed=xneed, host_keys=hk,
                     aligned=self._align[i] is not None)
                 miss_tot = miss_tot + miss
                 del_tot = del_tot + dd
@@ -820,8 +899,7 @@ class FusedTickProgram:
             self._fold_xneed()
             self._donate = donate_target
             for s in self.sources:
-                s.rows = jnp.asarray(s.arena.spread_rows_host(
-                    s.arena.resolve_rows(s.keys)))
+                s.refresh_rows()
             examples = [
                 {**statics[i], **jax.tree_util.tree_map(lambda a: a[0],
                                                         stackeds[i])}

@@ -157,7 +157,11 @@ def register_banking_journal(engine) -> None:
     journaled — it is reachable only as a transfer's emit, and replay
     reconstructs it by re-executing the transfer."""
     engine.register_journal("AccountGrain", "deposit")
-    engine.register_journal("AccountGrain", "transfer")
+    # transfer's ``dst`` leaf holds emit-destination keys of the same
+    # type — naming it lets fused fold-replay pre-activate the union
+    # instead of rolling back on cold credit targets
+    engine.register_journal("AccountGrain", "transfer",
+                            emit_key_args=("dst",))
 
 
 async def run_banking_load(engine, events: List[Dict],

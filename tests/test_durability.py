@@ -19,18 +19,26 @@ The contract under test, end to end:
 import asyncio
 import os
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
 import samples.banking as banking
 import samples.presence  # noqa: F401 — registers the presence grains
 from orleans_tpu.config import TensorEngineConfig
+from orleans_tpu.core.grain import batched_method, commutative
 from orleans_tpu.tensor import (
+    Batch,
     FileSnapshotStore,
     MemorySnapshotStore,
     MemoryVectorStore,
     TensorEngine,
+    VectorGrain,
+    field,
+    seg_sum,
+    vector_grain,
 )
+from orleans_tpu.tensor.vector_grain import scatter_add_rows, vector_type
 
 pytestmark = pytest.mark.durability
 
@@ -685,3 +693,267 @@ def test_perfgate_durability_family(tmp_path):
         data = json.load(f)
     assert "durability_metrics" in data, \
         "PERF_BASELINE.json must seed the durability family"
+
+# ---------------------------------------------------------------------------
+# fused fold-replay, composed recovery, warm standby (PR 18)
+# ---------------------------------------------------------------------------
+
+
+def _define_composed_grains():
+    if vector_type("DuraCounter") is not None:
+        return
+
+    @vector_grain
+    class DuraCounter(VectorGrain):
+        # commutative so the grain is replicable mid-interval
+        total = field(jnp.int32, 0)
+
+        @batched_method
+        @staticmethod
+        @commutative
+        def bump(state, batch: Batch, n_rows: int):
+            return {**state,
+                    "total": state["total"]
+                    + seg_sum(batch.args["amount"], batch.rows,
+                              n_rows)}, None, ()
+
+    @vector_grain
+    class DuraTimerProbe(VectorGrain):
+        fires = field(jnp.int32, 0)
+
+        @batched_method
+        @staticmethod
+        def receive_reminder(state, batch: Batch, n_rows: int):
+            ones = jnp.where(batch.mask, 1, 0).astype(jnp.int32)
+            return {"fires": scatter_add_rows(state["fires"],
+                                              batch.rows, ones)}
+
+        @batched_method
+        @staticmethod
+        def poke(state, batch: Batch, n_rows: int):
+            return state
+
+
+_define_composed_grains()
+
+
+def _touched_keys(events):
+    return np.unique(np.concatenate(
+        [np.concatenate([e["keys"],
+                         e.get("dst", np.empty(0, np.int64))])
+         for e in events])).astype(np.int64)
+
+
+def test_fused_fold_replay_matches_per_tick_and_oracle(run):
+    """Fused fold-replay (stacked [T, m] windows through
+    FusedTickProgram.replay — ONE compiled program per window of
+    consecutive journaled ticks) is bit-exact vs BOTH the per-tick
+    replay path and the uninterrupted oracle, including the transfer
+    emit leg, and the fusion actually engages."""
+
+    async def main():
+        backing = MemorySnapshotStore.shared_backing()
+        n_accounts = 300
+        events = banking.make_events(n_accounts, 30, lanes=64, seed=29)
+        eng = _engine(backing, journal_flush_every_ticks=4)
+        banking.register_banking_journal(eng)
+        eng.checkpointer.checkpoint_full()
+        for ev in events:
+            args = {"amount": ev["amount"]}
+            if ev["method"] == "transfer":
+                args["dst"] = ev["dst"]
+            eng.send_batch("AccountGrain", ev["method"], ev["keys"],
+                           args)
+            eng.run_tick()
+        sites = eng.checkpointer.journal.sites
+        acked = (sites[("AccountGrain", "deposit")].committed_lanes
+                 + sites[("AccountGrain", "transfer")].committed_lanes
+                 ) // 64
+        assert 0 < acked < len(events)
+        oracle = banking.BankOracle(n_accounts)
+        for ev in events[:acked]:
+            oracle.apply(ev)
+        # HARD KILL → fused recovery (default window).  The restarted
+        # process re-runs its app wiring first — registration carries
+        # the emit_key_args hints the fused pre-activation needs.
+        eng2 = _engine(backing, journal_flush_every_ticks=4)
+        banking.register_banking_journal(eng2)
+        stats2 = await eng2.checkpointer.recover()
+        assert stats2["recovered"]
+        assert stats2["replayed_lanes"] == acked * 64
+        assert stats2["fused_windows"] > 0, \
+            "fusion never engaged (every window fell back per-tick)"
+        assert stats2["fused_lanes"] > 0
+        # per-tick recovery over the SAME manifest: defer-re-anchor
+        # left the recovery point untouched, so a second recovery
+        # replays the identical tail
+        eng3 = _engine(backing, journal_flush_every_ticks=4,
+                       recover_fused_window=1)
+        banking.register_banking_journal(eng3)
+        stats3 = await eng3.checkpointer.recover()
+        assert stats3["fused_windows"] == 0
+        assert stats3["replayed_lanes"] == acked * 64
+        touched = _touched_keys(events[:acked])
+        want = oracle.expect(touched)
+        got2 = banking.read_accounts(eng2, touched)
+        got3 = banking.read_accounts(eng3, touched)
+        for name in ("balance", "credits", "debits"):
+            assert np.array_equal(got2[name], want[name]), name
+            assert np.array_equal(got3[name], got2[name]), name
+
+    run(main())
+
+
+def test_composed_recovery_replication_pins_timers(run):
+    """Restore identity under composition — a kill/recover spanning a
+    promoted replication interval, migrated pins AND armed timers in
+    ONE scenario: exact state vs the acknowledged-prefix oracle
+    (replica folds exact), pins survive, timers fire exactly once."""
+
+    async def main():
+        backing = MemorySnapshotStore.shared_backing()
+        cfg = dict(ckpt_full_every_ticks=10, ckpt_delta_every_ticks=5,
+                   ckpt_pause_budget_s=0.002, journal_flush_every_ticks=3)
+        eng = _engine(backing, **cfg)
+        eng.n_shards = 4
+        eng.register_journal("DuraCounter", "bump")
+        rng = np.random.default_rng(23)
+        keys = np.arange(96, dtype=np.int64)
+        hot = 7
+        # arm one-shots due AFTER the whole drive: they must survive
+        # the kill ARMED and fire exactly once post-recovery
+        tkeys = np.arange(32, dtype=np.int64)
+        inj = eng.make_injector("DuraTimerProbe", "poke", tkeys)
+        inj.inject({})
+        eng.run_tick()
+        due = eng.tick_number + 60
+        eng.timers.arm_batch("DuraTimerProbe", tkeys,
+                             np.full(32, due, np.int64), 0, "close")
+        amounts_by_tick = []
+        for t in range(25):
+            amounts = rng.integers(1, 100, 96).astype(np.int32)
+            amounts_by_tick.append(amounts)
+            eng.send_batch("DuraCounter", "bump", keys,
+                           {"amount": amounts})
+            eng.run_tick()
+            if t == 5:
+                assert eng.replicate_key("DuraCounter", hot, 3) == 3
+            if t == 9:
+                movers = rng.choice(keys, 24, replace=False)
+                eng.migrate_keys("DuraCounter", movers,
+                                 rng.integers(0, 4, 24))
+        await eng.flush()
+        arena = eng.arenas["DuraCounter"]
+        pins = dict(arena._shard_override)
+        assert pins and arena._replicas, "scenario degenerate"
+        site = eng.checkpointer.journal.sites[("DuraCounter", "bump")]
+        acked = site.committed_lanes // 96
+        assert 0 < acked < 25, "kill must land mid-cadence"
+        oracle = np.zeros(96, dtype=np.int64)
+        for amounts in amounts_by_tick[:acked]:
+            oracle += amounts
+        # HARD KILL → fresh engine over the same backing
+        eng2 = _engine(backing, **cfg)
+        eng2.n_shards = 4
+        stats = await eng2.checkpointer.recover()
+        assert stats["recovered"]
+        # timers armed at the cut force the per-tick replay path
+        assert stats["fused_windows"] == 0
+        a2 = eng2.arenas["DuraCounter"]
+        # replica folds exact: read through the fold-aware accessor
+        got = np.array([int(a2.read_row(int(k))["total"])
+                        for k in keys], dtype=np.int64)
+        assert np.array_equal(got, oracle)
+        # migration pins survive recovery
+        assert a2._shard_override == pins
+        # the armed set survived the kill; fires exactly once, on time
+        assert eng2.timers.armed_total == 32
+        while eng2.tick_number < due:
+            eng2.run_tick()
+        await eng2.flush()
+        ta = eng2.arena_for("DuraTimerProbe")
+        rows, found = ta.lookup_rows(tkeys)
+        assert found.all()
+        fires = np.asarray(ta.state["fires"])[rows]
+        assert (fires == 1).all(), fires
+        for _ in range(8):
+            eng2.run_tick()
+        await eng2.flush()
+        fires = np.asarray(ta.state["fires"])[ta.lookup_rows(tkeys)[0]]
+        assert (fires == 1).all(), "timer fired twice"
+
+    run(main())
+
+
+def test_standby_tails_promotes_and_fences(run):
+    """Warm standby end to end: the tailer adopts the primary's
+    committed fulls/deltas and stages sealed journal segments while
+    traffic runs; promotion fences the store, replays ONLY the
+    un-adopted tail, lands bit-exact at the acknowledged prefix; the
+    old (merely partitioned) primary can never commit again, and the
+    promoted standby serves and commits from there on."""
+
+    async def main():
+        from orleans_tpu.tensor.checkpoint import (
+            FencedError,
+            StandbyTailer,
+        )
+        backing = MemorySnapshotStore.shared_backing()
+        n_accounts = 200
+        events = banking.make_events(n_accounts, 24, lanes=64, seed=13)
+        primary = _engine(backing, ckpt_full_every_ticks=8,
+                          ckpt_delta_every_ticks=4,
+                          ckpt_pause_budget_s=0.002,
+                          journal_flush_every_ticks=3)
+        banking.register_banking_journal(primary)
+        standby_eng = TensorEngine(config=TensorEngineConfig(
+            tick_interval=0.0, auto_fusion_ticks=0))
+        banking.register_banking_journal(standby_eng)
+        tailer = StandbyTailer(standby_eng,
+                               MemorySnapshotStore(backing))
+        for i, ev in enumerate(events):
+            args = {"amount": ev["amount"]}
+            if ev["method"] == "transfer":
+                args["dst"] = ev["dst"]
+            primary.send_batch("AccountGrain", ev["method"],
+                               ev["keys"], args)
+            primary.run_tick()
+            if i % 4 == 3:
+                tailer.poll()
+        await primary.flush()
+        assert tailer.lag_ticks() >= 0
+        assert tailer.adopted_rows > 0, "standby never adopted a cut"
+        sites = primary.checkpointer.journal.sites
+        acked = (sites[("AccountGrain", "deposit")].committed_lanes
+                 + sites[("AccountGrain", "transfer")].committed_lanes
+                 ) // 64
+        assert 0 < acked <= len(events)
+        oracle = banking.BankOracle(n_accounts)
+        for ev in events[:acked]:
+            oracle.apply(ev)
+        # HARD KILL the primary (the OBJECT stays alive to model a
+        # partitioned zombie).  Promote the standby.
+        res = await tailer.promote(owner="standby-1")
+        assert res["promoted"] and tailer.promoted
+        assert res["fence_epoch"] >= 1
+        assert standby_eng.checkpointer.promotions == 1
+        touched = _touched_keys(events[:acked])
+        got = banking.read_accounts(standby_eng, touched)
+        want = oracle.expect(touched)
+        for name in ("balance", "credits", "debits"):
+            assert np.array_equal(got[name], want[name]), name
+        # zero acknowledged-write loss AND the old primary is fenced:
+        # its next commit over the claimed store must refuse
+        with pytest.raises(FencedError):
+            primary.checkpointer.checkpoint_full()
+        assert primary.checkpointer.fenced
+        # the promoted standby serves and commits (it owns the fence)
+        standby_eng.send_batch("AccountGrain", "deposit",
+                               np.arange(8, dtype=np.int64),
+                               {"amount": np.ones(8, np.int32)})
+        standby_eng.run_tick()
+        await standby_eng.flush()
+        anchor = standby_eng.checkpointer.checkpoint_full()
+        assert anchor["rows"] > 0
+
+    run(main())

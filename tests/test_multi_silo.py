@@ -233,3 +233,176 @@ def test_adaptive_cache_maintainer_refreshes_and_invalidates(run):
             await cluster.stop()
 
     run(main())
+
+def test_fast_suspect_converges_under_probe_interval(run):
+    """Fast-suspect fan-out (membership satellite): a single non-quorum
+    suspect vote gossips notify_suspected; recipients probe the victim
+    OUT-OF-BAND and vote through the table themselves, reaching quorum
+    within ~probe_timeout instead of waiting out another probe round.
+    Regression pins the latency bound: probe loops and table refresh
+    are parked far beyond the assertion window, so ONLY the fast path
+    can produce the death declaration."""
+
+    async def main():
+        from orleans_tpu.config import SiloConfig
+
+        def cfg(name):
+            c = SiloConfig(name=name)
+            # park the periodic paths OUTSIDE the assertion window —
+            # convergence below can only come from the suspicion gossip
+            c.liveness.probe_period = 30.0
+            c.liveness.probe_timeout = 0.2
+            c.liveness.num_missed_probes_limit = 2
+            c.liveness.table_refresh_timeout = 0.5
+            c.liveness.iam_alive_table_publish = 30.0
+            return c
+
+        cluster = await TestingCluster(n_silos=4,
+                                       config_factory=cfg).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            victim = cluster.silos[3]
+            cluster.kill_silo(victim)
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            # one survivor's probe loop notices first and casts ONE
+            # suspect vote — quorum needs 2, and every OTHER probe loop
+            # is parked for 30s: without the fast-suspect fan-out the
+            # victim would stay active for a full probe round
+            await cluster.silos[0].membership_oracle.try_suspect_or_kill(
+                victim.address)
+            deadline = t0 + 10.0
+            while any(victim.address in s.active_silos()
+                      for s in cluster.silos):
+                assert loop.time() < deadline, \
+                    "fast-suspect never converged"
+                await asyncio.sleep(0.02)
+            elapsed = loop.time() - t0
+            bound = cfg("x").liveness.probe_period
+            assert elapsed < bound, \
+                f"detection took {elapsed:.2f}s — not faster than a " \
+                f"probe round ({bound}s): fast-suspect path inert"
+            assert elapsed < 3.0, f"detection took {elapsed:.2f}s"
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+def test_warm_standby_promotes_on_primary_death(run):
+    """Cluster-level failover: a standby silo tails the primary's
+    snapshot store (log shipping over the durable plane), membership
+    declares the killed primary DEAD, and the standby promotes —
+    exact state at the acknowledged prefix, promotion recorded with
+    the measured RTO, standby-lag metrics wired through."""
+
+    async def main():
+        import numpy as np
+
+        import samples.banking as banking
+        from orleans_tpu.dashboard import view_from_snapshots
+        from orleans_tpu.tensor import MemorySnapshotStore
+
+        backing = MemorySnapshotStore.shared_backing()
+
+        def cfg(name):
+            c = TestingCluster._default_config(name)
+            c.standby_poll_period = 0.01
+            return c
+
+        def setup(silo):
+            banking.register_banking_journal(silo.tensor_engine)
+            if silo.name == "silo1":
+                silo.tensor_engine.checkpointer.attach_store(
+                    MemorySnapshotStore(backing))
+                silo.tensor_engine.config.ckpt_full_every_ticks = 0
+                silo.tensor_engine.config.journal_flush_every_ticks = 3
+            else:
+                silo.arm_standby(MemorySnapshotStore(backing),
+                                 primary="silo1")
+
+        # TWO silos: the standby survivor inherits the whole ring on
+        # the primary's death, so the adopted range is not immediately
+        # re-partitioned from under the promotion
+        cluster = await TestingCluster(n_silos=2, config_factory=cfg,
+                                       silo_setup=setup).start()
+        try:
+            await cluster.wait_for_liveness_convergence()
+            primary, standby = cluster.silos[0], cluster.silos[1]
+            eng = primary.tensor_engine
+            # drive ONLY keys the primary's ring range owns (deposits,
+            # no emits): the standby tails ONE primary's store, and
+            # its failover contract covers that primary's range
+            owned = np.array([k for k in range(240)
+                              if eng.router.owns_key("AccountGrain",
+                                                     k)],
+                             dtype=np.int64)
+            assert len(owned) >= 40, "degenerate ring split"
+            rng = np.random.default_rng(11)
+            drive = []
+            for _ in range(14):
+                keys = rng.choice(owned, 24, replace=False)
+                amounts = rng.integers(1, 100, 24).astype(np.int32)
+                drive.append((keys, amounts))
+            for i, (keys, amounts) in enumerate(drive):
+                eng.send_batch("AccountGrain", "deposit", keys,
+                               {"amount": amounts})
+                eng.run_tick()
+                if i == 5:
+                    # mid-drive anchor: promotion must fold-replay the
+                    # sealed tail beyond this cut, not just adopt it
+                    eng.checkpointer.checkpoint_full()
+            # the poll loop tails the committed cut
+            deadline = asyncio.get_running_loop().time() + 5
+            while standby.standby.adopted_rows == 0:
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "standby never adopted the primary's checkpoint"
+                await asyncio.sleep(0.02)
+            # lag gauge discipline: standby >= 0, non-standby -1, and
+            # the cluster row lets real lag dominate the sentinel
+            snaps = [primary.collect_metrics(),
+                     standby.collect_metrics()]
+            # gauges[name][labelkey] = {source: value}
+            lag = [next(iter(next(iter(
+                s["gauges"]["ckpt.standby_lag_ticks"].values()))
+                .values())) for s in snaps]
+            assert lag[0] == -1.0
+            assert lag[1] >= 0.0
+            du = view_from_snapshots(snaps)["cluster"]["durability"]
+            assert du["standby_lag_ticks"] >= 0.0
+            # acked horizon + hard kill in ONE synchronous step: the
+            # primary's background tick loop seals segments on its
+            # cadence, so any await between the read and the kill
+            # could move the horizon under us
+            site = eng.checkpointer.journal.sites[("AccountGrain",
+                                                   "deposit")]
+            acked = site.committed_lanes // 24
+            cluster.kill_silo(primary)
+            assert 0 < acked <= len(drive)
+            oracle = {}
+            for keys, amounts in drive[:acked]:
+                for k, a in zip(keys.tolist(), amounts.tolist()):
+                    oracle[k] = oracle.get(k, 0) + a
+            # membership declares the primary DEAD and on_silo_dead
+            # promotes the armed standby
+            deadline = asyncio.get_running_loop().time() + 10
+            while standby.last_promotion is None:
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "standby never promoted"
+                await asyncio.sleep(0.02)
+            prom = standby.last_promotion
+            assert prom["promoted"]
+            assert prom["fence_epoch"] >= 1
+            assert "silo1" in prom["for"]
+            # zero acknowledged-write loss: every acked deposit is in
+            # the promoted standby, bit-exact
+            touched = np.array(sorted(oracle), dtype=np.int64)
+            got = banking.read_accounts(standby.tensor_engine, touched)
+            want = np.array([oracle[int(k)] for k in touched],
+                            dtype=np.int64)
+            assert np.array_equal(got["balance"].astype(np.int64),
+                                  want)
+        finally:
+            await cluster.stop()
+
+    run(main())
