@@ -41,6 +41,7 @@ class ChaosCluster(TestingCluster):
         self.interposer = Interposer(self.plan, self.trace)
         # populated by check_invariants on the first violation
         self.last_flight_dump: Optional[Dict[str, Any]] = None
+        self.last_incident_bundles: Optional[Dict[str, Any]] = None
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -198,6 +199,12 @@ class ChaosCluster(TestingCluster):
         except AssertionError:  # InvariantViolation is an AssertionError
             self.last_flight_dump = self.flight_recorder_dump(
                 "invariant violation")
+            # the unified incident shape (flight tail + compile ring +
+            # dead letters + timeline tail) — same bundle a fence trip
+            # or watchdog trip dumps, so chaos evidence reads the same
+            self.last_incident_bundles = {
+                s.name: s.incident_bundle("chaos invariant violation")
+                for s in self.silos}
             raise
 
     def flight_recorder_dump(self, reason: str = "") -> Dict[str, Any]:

@@ -68,8 +68,9 @@ class GrainClient:
         self.client_id = GrainId.client(uuid.uuid4())
         # batched RPC fastpath over TCP gateways (runtime/rpc.py): one
         # coalesced calls-frame per event-loop iteration per
-        # (type, method); sampled traces / ambient request contexts /
-        # non-int-keyed grains keep the per-message frames
+        # (type, method); ambient request contexts / non-int-keyed
+        # grains keep the per-message frames (sampled traces ride the
+        # frame's per-lane trace column)
         self.rpc_fastpath = rpc_fastpath
         self._pending_trace = None
         self.response_timeout = response_timeout
@@ -202,9 +203,15 @@ class GrainClient:
         # of one Message frame each (runtime/rpc.py; the gateway feeds
         # them to the silo coalescer as key/args columns)
         if self._rpc_eligible(gateway, target_grain, method):
-            return gateway.submit_rpc(
+            trace, self._pending_trace = self._pending_trace, None
+            future = gateway.submit_rpc(
                 iface, method, target_grain.n1,
-                tuple(codec.deep_copy(a) for a in args), timeout)
+                tuple(codec.deep_copy(a) for a in args), timeout,
+                trace=trace)
+            if trace is not None and trace.get("sampled"):
+                self._trace_rpc_reply(future, trace, method.name,
+                                      target_grain)
+            return future
         # trace ingress: ambient (a test/driver that set one), a
         # decision stashed by the eligibility probe, or freshly minted +
         # head-sampled; the send span's id rides the exported context
@@ -251,8 +258,10 @@ class GrainClient:
         """Admission check for the client-side batched fastpath: the
         gateway handle must speak rpc frames (TCP), the method must be
         a plain host call, the key must fit the int64 column, and the
-        call must carry no ambient context and no sampled trace (those
-        keep the full per-message fidelity)."""
+        call must carry no ambient context (that keeps the full
+        per-message fidelity).  A SAMPLED trace rides the fastpath too
+        — as a trace column on the calls frame — so tracing never
+        perturbs the very path it measures."""
         if not self.rpc_fastpath or method.batched:
             return False
         if not hasattr(gateway, "submit_rpc"):
@@ -266,11 +275,40 @@ class GrainClient:
         if rec.enabled:
             trace = rec.ingress()
             if trace is not None and trace.get("sampled"):
-                # reuse the minted head-sampling decision on the
-                # per-message path (a second draw would square the rate)
+                # stash the minted head-sampling decision for the rpc
+                # branch (a second draw would square the rate)
                 self._pending_trace = trace
-                return False
         return True
+
+    def _trace_rpc_reply(self, future: Optional[asyncio.Future],
+                         trace: Dict[str, Any], method: str,
+                         target_grain: GrainId) -> None:
+        """Client-side hop record for a sampled fastpath call: ONE
+        closed-interval event stamped when the window's results frame
+        (or the batch watchdog) resolves the future — no open Span
+        object held per pending lane."""
+        rec = self.spans
+        t0 = time.monotonic()
+        if future is None:  # one-way: the frame write IS the hop
+            rec.event(f"rpc {method}", "client.rpc", trace,
+                      start=t0, one_way=True, target=str(target_grain))
+            return
+
+        def _done(fut: asyncio.Future) -> None:
+            status = _spans.STATUS_OK
+            if fut.cancelled():
+                status = _spans.STATUS_ERROR
+            else:
+                exc = fut.exception()
+                if isinstance(exc, RequestTimeoutError):
+                    status = _spans.STATUS_TIMEOUT
+                elif exc is not None:
+                    status = _spans.STATUS_ERROR
+            rec.event(f"rpc {method}", "client.rpc", trace,
+                      start=t0, duration=time.monotonic() - t0,
+                      status=status, target=str(target_grain))
+
+        future.add_done_callback(_done)
 
     def _on_timeout(self, message_id: int) -> None:
         cb = self.callbacks.pop(message_id, None)
@@ -644,13 +682,15 @@ class TcpGatewayHandle:
     # -- batched RPC fastpath ----------------------------------------------
 
     def submit_rpc(self, iface: InterfaceInfo, minfo: MethodInfo,
-                   key: int, args: tuple,
-                   timeout: float) -> Optional[asyncio.Future]:
+                   key: int, args: tuple, timeout: float,
+                   trace: Optional[dict] = None
+                   ) -> Optional[asyncio.Future]:
         """Queue one call onto this socket's pending window; everything
         submitted in the same event-loop iteration flushes as ONE
         calls-frame per (type, method) — asyncio.gather bursts coalesce
         whole.  First sight of a (type, method) announces its
-        dictionary id ({"op": "rpc_bind"}) on the same ordered stream."""
+        dictionary id ({"op": "rpc_bind"}) on the same ordered stream.
+        A sampled ``trace`` rides the frame's per-lane trace column."""
         if not self.alive:
             raise ConnectionError(f"gateway {self.host}:{self.port} is down")
         dict_key = (iface.name, minfo.name)
@@ -666,7 +706,7 @@ class TcpGatewayHandle:
         if not minfo.one_way:
             future = asyncio.get_running_loop().create_future()
         self._rpc_pending.setdefault(rpc_id, []).append(
-            (key, args, future, time.monotonic() + timeout))
+            (key, args, future, time.monotonic() + timeout, trace))
         if not self._rpc_flush_scheduled:
             self._rpc_flush_scheduled = True
             asyncio.get_running_loop().call_soon(self._flush_rpc)
@@ -683,7 +723,8 @@ class TcpGatewayHandle:
             exc = ConnectionError(
                 f"gateway {self.host}:{self.port} is down")
             for entries in pending.values():
-                for _, _, fut, _ in entries:
+                for e in entries:
+                    fut = e[2]
                     if fut is not None and not fut.done():
                         fut.set_exception(exc)
             return
@@ -697,6 +738,15 @@ class TcpGatewayHandle:
             # caller-expired call still dead-letters at the silo
             ttls = np.fromiter((e[3] - now for e in entries),
                                dtype=np.float64, count=n)
+            # trace columns only when some lane is SAMPLED — the
+            # unsampled window pays zero wire bytes for tracing
+            trace_ids = span_ids = None
+            if any(e[4] is not None and e[4].get("sampled")
+                   for e in entries):
+                trace_ids = np.fromiter(
+                    (codec_mod.pack_rpc_trace(e[4]) for e in entries),
+                    dtype=np.uint64, count=n)
+                span_ids = np.zeros(n, dtype=np.uint64)
             args_list: Optional[list] = [e[1] for e in entries]
             common = _rpc_common_args(entries)
             if common is not None:
@@ -712,7 +762,8 @@ class TcpGatewayHandle:
             try:
                 segments = codec_mod.encode_rpc_calls(
                     codec, rpc_id, batch_id, keys, ttls, args_list,
-                    common_args=common, one_way=one_way)
+                    common_args=common, one_way=one_way,
+                    trace_ids=trace_ids, span_ids=span_ids)
                 write_gateway_rpc_frame(self._writer, segments)
             except Exception as exc:  # noqa: BLE001 — an unencodable
                 # window must fail ITS callers, not hang their futures
@@ -724,7 +775,8 @@ class TcpGatewayHandle:
     def _fail_rpc_state(self, exc: Exception) -> None:
         pending, self._rpc_pending = self._rpc_pending, {}
         for entries in pending.values():
-            for _, _, fut, _ in entries:
+            for e in entries:
+                fut = e[2]
                 if fut is not None and not fut.done():
                     fut.set_exception(exc)
         batches, self._rpc_batches = self._rpc_batches, {}

@@ -835,6 +835,40 @@ _RPC_GENERAL = 8      # length-prefixed general-codec bytes (fallback)
 _RPC_FLAG_COMMON = 1  # one args/value blob shared by every call
 _RPC_FLAG_TTL = 2     # per-call remaining-TTL f64 column present
 _RPC_FLAG_ONE_WAY = 4
+_RPC_FLAG_TRACE = 8   # per-call trace columns present (calls frames):
+#                       trace_ids uint64 (bit 63 = sampled, low 63 bits
+#                       = Dapper trace id, 0 = untraced lane) + span_ids
+#                       uint64 (parent span, 0 = none).  Absent when no
+#                       call in the window is sampled — the unsampled
+#                       hot path pays zero wire bytes for tracing.
+
+#: bit 63 of the trace_ids column carries the head-sampling decision
+#: (ids are 63-bit — spans.new_id — so the top bit is free)
+RPC_TRACE_SAMPLED_BIT = 1 << 63
+_RPC_TRACE_ID_MASK = RPC_TRACE_SAMPLED_BIT - 1
+
+
+def pack_rpc_trace(trace: Optional[dict]) -> int:
+    """One trace context → its trace_ids-column word (0 = untraced)."""
+    if not trace:
+        return 0
+    tid = trace.get("trace_id") or 0
+    if not isinstance(tid, int) or tid <= 0:
+        return 0
+    word = tid & _RPC_TRACE_ID_MASK
+    if trace.get("sampled"):
+        word |= RPC_TRACE_SAMPLED_BIT
+    return word
+
+
+def unpack_rpc_trace(trace_word: int, span_word: int) -> Optional[dict]:
+    """One lane's column words → the trace context dict the runtime's
+    RequestContext carries (None for an untraced lane)."""
+    if not trace_word:
+        return None
+    return {"trace_id": trace_word & _RPC_TRACE_ID_MASK,
+            "span_id": span_word or "",
+            "sampled": bool(trace_word & RPC_TRACE_SAMPLED_BIT)}
 
 
 def _rpc_write_value(manager: SerializationManager, w: Writer,
@@ -948,7 +982,9 @@ def encode_rpc_calls(manager: SerializationManager, rpc_id: int,
                      ttls: Optional[np.ndarray],
                      args_list: Optional[list],
                      common_args: Optional[Tuple[Any, ...]] = None,
-                     one_way: bool = False) -> list:
+                     one_way: bool = False,
+                     trace_ids: Optional[np.ndarray] = None,
+                     span_ids: Optional[np.ndarray] = None) -> list:
     """Encode one calls frame as bytes-like segments.
 
     ``keys`` is the uint64 grain-key column; ``ttls`` (optional) the
@@ -956,7 +992,11 @@ def encode_rpc_calls(manager: SerializationManager, rpc_id: int,
     own clock — per call, never per frame); args are either one
     ``common_args`` tuple every call shares or an ``args_list`` of
     per-call tuples.  ``batch_id`` 0 means no results frame is wanted
-    (one-way window)."""
+    (one-way window).  ``trace_ids``/``span_ids`` (optional, together)
+    are the per-call trace columns (see ``pack_rpc_trace``) — present
+    only when some call in the window is sampled, so a sampled call
+    rides the SAME batched frame as its window-mates instead of
+    falling back to a per-message send."""
     keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
     n = int(keys.shape[0])
     flags = 0
@@ -966,6 +1006,8 @@ def encode_rpc_calls(manager: SerializationManager, rpc_id: int,
         flags |= _RPC_FLAG_TTL
     if one_way:
         flags |= _RPC_FLAG_ONE_WAY
+    if trace_ids is not None:
+        flags |= _RPC_FLAG_TRACE
     w = Writer()
     w.varint(RPC_WIRE_VERSION)
     w.u8(RPC_KIND_CALLS)
@@ -980,6 +1022,18 @@ def encode_rpc_calls(manager: SerializationManager, rpc_id: int,
             raise SerializationError("rpc calls frame: ttl column length "
                                      f"{ttl_col.shape[0]} != {n} calls")
         arrays.append(ttl_col)
+    if trace_ids is not None:
+        if span_ids is None:
+            raise SerializationError(
+                "rpc calls frame: trace_ids without span_ids")
+        tcol = np.ascontiguousarray(np.asarray(trace_ids, dtype=np.uint64))
+        scol = np.ascontiguousarray(np.asarray(span_ids, dtype=np.uint64))
+        if tcol.shape[0] != n or scol.shape[0] != n:
+            raise SerializationError(
+                "rpc calls frame: trace columns length "
+                f"({tcol.shape[0]}, {scol.shape[0]}) != {n} calls")
+        arrays.append(tcol)
+        arrays.append(scol)
     if common_args is not None:
         _rpc_write_values(manager, w, arrays, common_args)
     else:
@@ -1023,7 +1077,8 @@ class RpcFrame:
     """Decoded rpc fast-path frame (calls or results)."""
 
     __slots__ = ("kind", "rpc_id", "batch_id", "n", "one_way",
-                 "keys", "ttls", "common_args", "args_list",
+                 "keys", "ttls", "trace_ids", "span_ids",
+                 "common_args", "args_list",
                  "statuses", "common_value", "values")
 
     def __init__(self) -> None:
@@ -1034,6 +1089,8 @@ class RpcFrame:
         self.one_way = False
         self.keys = None
         self.ttls = None
+        self.trace_ids = None
+        self.span_ids = None
         self.common_args = None
         self.args_list = None
         self.statuses = None
@@ -1066,6 +1123,7 @@ def decode_rpc_frame(manager: SerializationManager,
         out.one_way = bool(flags & _RPC_FLAG_ONE_WAY)
         common = bool(flags & _RPC_FLAG_COMMON)
         has_ttl = bool(flags & _RPC_FLAG_TTL)
+        has_trace = bool(flags & _RPC_FLAG_TRACE)
         # the value region references arrays by INDEX and the manifest
         # trails it — values parse to _RpcArrayRef placeholders first,
         # resolved below once the raw segment views are mapped
@@ -1131,6 +1189,16 @@ def decode_rpc_frame(manager: SerializationManager,
                         or out.ttls.shape != (out.n,):
                     raise SerializationError(
                         "rpc calls frame: bad ttl column")
+            if has_trace:
+                out.trace_ids = arrays[idx]
+                out.span_ids = arrays[idx + 1]
+                idx += 2
+                if out.trace_ids.dtype != np.uint64 \
+                        or out.trace_ids.shape != (out.n,) \
+                        or out.span_ids.dtype != np.uint64 \
+                        or out.span_ids.shape != (out.n,):
+                    raise SerializationError(
+                        "rpc calls frame: bad trace columns")
         else:
             out.statuses = arrays[idx]
             idx += 1

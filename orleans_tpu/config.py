@@ -139,6 +139,12 @@ class TracingConfig:
     flight_recorder_capacity: int = 256
     # recent circuit-breaker transitions retained for the dump
     breaker_transition_capacity: int = 64
+    # cluster timeline plane (orleans_tpu/timeline.py): per-silo bounded
+    # log of completed spans + lifecycle events + interval metric
+    # deltas, merged onto a common clock and exported as TIMELINE.json
+    # + a Perfetto (Chrome trace-event) file
+    timeline_enabled: bool = True
+    timeline_capacity: int = 4096
 
 
 @dataclass
@@ -683,6 +689,8 @@ class ClientConfig:
     # batched RPC fastpath over TCP gateways: eligible calls coalesce
     # into one calls-frame per event-loop iteration (negotiated
     # (type, method) dictionary + zero-copy codec); ineligible calls
-    # (string/uuid keys, sampled traces, one-off control ops) ride the
-    # per-message frames unchanged
+    # (string/uuid keys, ambient contexts, one-off control ops) ride
+    # the per-message frames unchanged.  Sampled traces RIDE the
+    # fastpath via the frame's per-lane trace column — sampling never
+    # changes the executed path
     rpc_fastpath: bool = True

@@ -198,6 +198,32 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                             .get("memory.headroom", {}).items():
         for src, v in by_src.items():
             memory.setdefault(src, {})["headroom"] = round(v, 4)
+    # cluster timeline / tracing plane (spans.py + timeline.py): span
+    # volume is cluster-summed; backlog and clock skew are per-silo
+    # properties, so the WORST silo reports — and the -1 "never probed"
+    # sentinel DOMINATES the clock-offset row (an unprobed silo means
+    # the merged timeline cannot be trusted, which must never render
+    # as 0 = perfectly synced)
+    offsets = [v for by_src in gauges.get("trace.worst_clock_offset_s",
+                                          {}).values()
+               for v in by_src.values()]
+    tracing = {
+        "spans_started": int(
+            _counter_total(merged, "trace.spans_started")),
+        "spans_committed": int(
+            _counter_total(merged, "trace.spans_committed")),
+        "sampled_traces": int(
+            _counter_total(merged, "trace.sampled_traces")),
+        "drop_spans": int(_counter_total(merged, "trace.drop_spans")),
+        "timeline_backlog": int(max(
+            (v for by_src in gauges.get("trace.timeline_backlog",
+                                        {}).values()
+             for v in by_src.values()), default=0.0)),
+        "timeline_dropped": int(
+            _counter_total(merged, "trace.timeline_dropped")),
+        "worst_clock_offset_s": (lambda vs: -1.0 if not vs
+                                 or min(vs) < 0 else max(vs))(offsets),
+    }
     view = {
         "cluster": {
             "throughput": {
@@ -390,6 +416,7 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
             "hot_grains": hot_grains,
             "skew": skew,
             "slo": slo,
+            "tracing": tracing,
             "dead_letters": dead,
             "overload": {
                 "shed_count": int(
@@ -570,6 +597,18 @@ def render_text(view: Dict[str, Any]) -> str:
             f"({s['latency_over_budget']}/{s['latency_window_msgs']} "
             f"over budget) drop_burn={s['drop_burn_rate']} "
             f"({s['dropped_msgs']}/{s['attempted_msgs']} dropped){who}")
+    tr = c.get("tracing", {})
+    if tr.get("spans_committed") or tr.get("sampled_traces") \
+            or tr.get("timeline_backlog"):
+        off = tr.get("worst_clock_offset_s", -1.0)
+        lines.append(
+            f"tracing: {tr['spans_committed']} spans committed "
+            f"({tr['sampled_traces']} sampled traces, "
+            f"{tr.get('drop_spans', 0)} drop spans), timeline "
+            f"backlog={tr.get('timeline_backlog', 0)} "
+            f"dropped={tr.get('timeline_dropped', 0)}, clock offset "
+            + ("NO DATA (unprobed silo)" if off < 0
+               else f"{off:.6f}s worst"))
     if c["dead_letters"]:
         lines.append("dead letters: " + ", ".join(
             f"{k}={v}" for k, v in sorted(c["dead_letters"].items())))

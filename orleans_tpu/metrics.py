@@ -150,14 +150,40 @@ declare("rpc.fastpath_hits", KIND_COUNTER, "calls",
         "(no Message object, no per-call task, no per-field codec)")
 declare("rpc.fastpath_fallbacks", KIND_COUNTER, "calls",
         "coalesced calls handed back to the per-message pipeline "
-        "(cold/busy/remote activation, chaos injection, shed pressure, "
-        "sampled trace) — the general path stays the correctness net")
+        "(cold/busy/remote activation, chaos injection, shed pressure) "
+        "— the general path stays the correctness net; sampling never "
+        "causes a fallback (sampled traces ride the trace column)")
 declare("rpc.windows", KIND_COUNTER, "windows",
         "coalesced (type, method) windows executed")
 declare("rpc.expired", KIND_COUNTER, "calls",
         "coalesced calls whose per-call TTL lapsed before execution "
         "(dead-lettered with reason expired, EXPIRED rejection to the "
         "caller — never silently dropped)")
+
+# -- tracing + cluster timeline plane (spans.py) -----------------------------
+declare("trace.spans_started", KIND_COUNTER, "spans",
+        "hop/tick/plane spans opened by the span recorder")
+declare("trace.spans_committed", KIND_COUNTER, "spans",
+        "spans committed to the sinks (flight ring + timeline + "
+        "telemetry); unsampled-OK spans vanish before this counter")
+declare("trace.sampled_traces", KIND_COUNTER, "traces",
+        "head-sampling YES decisions minted at ingress (client, "
+        "gateway, or fastpath trace mint)")
+declare("trace.drop_spans", KIND_COUNTER, "spans",
+        "always-on dead-letter drop spans (recorded regardless of "
+        "sampling — failures never vanish)")
+declare("trace.timeline_backlog", KIND_GAUGE, "events",
+        "events currently retained in the per-silo timeline ring "
+        "(spans + lifecycle + metric deltas awaiting collection)")
+declare("trace.timeline_dropped", KIND_COUNTER, "events",
+        "timeline events evicted by the ring bound before collection "
+        "(non-zero = raise tracing.timeline_capacity or collect "
+        "more often)")
+declare("trace.worst_clock_offset_s", KIND_GAUGE, "seconds",
+        "largest absolute peer clock-offset estimate from the "
+        "probe-piggybacked handshake; -1 = no peer probed yet (the "
+        "no-data sentinel — an empty estimate table must never read "
+        "as perfectly synced)")
 
 # -- device-resident cross-shard routing (tensor/exchange.py) ----------------
 declare("route.cross_shard_msgs", KIND_COUNTER, "messages",

@@ -103,6 +103,7 @@ FAMILIES: Dict[str, Tuple[str, str, Optional[str]]] = {
     "rebalance": ("REBALANCE", "rebalance_metrics",
                   "REBALANCE_BENCH.json"),
     "timers": ("TIMERS", "timers_metrics", "TIMERS_BENCH.json"),
+    "timeline": ("TIMELINE", "timeline_metrics", "TIMELINE_BENCH.json"),
 }
 
 

@@ -316,17 +316,23 @@ class Dispatcher:
         metrics = silo.metrics
         loop = asyncio.get_running_loop()
         # tracing: ONE batched span per window (the engine's tick-span
-        # discipline — never a span per call on the fast path; sampled
-        # per-call traces fall back before reaching the coalescer)
+        # discipline — never a span per call on the fast path).  A
+        # member call carrying its own SAMPLED trace forces the window
+        # span open so the journey always shows the window turn; the
+        # members link to it below (rpc.window.link), tick-span style.
         rec = silo.spans
         span = None
+        traced: list = []
         if rec.enabled:
-            trace = rec.begin_trace()
+            traced = [c for c in calls if c.trace is not None
+                      and c.trace.get("sampled")]
+            trace = rec.begin_trace(force_sample=bool(traced))
             if trace is not None and trace.get("sampled"):
                 span = rec.start(f"rpc window {window.method.name}",
                                  "rpc.window", trace,
                                  method=window.method.name,
-                                 calls=len(calls))
+                                 calls=len(calls),
+                                 traced=len(traced))
         watchdog = _WindowWatchdog(loop, calls, self._expire_call)
         rt_token = bind_runtime(self.runtime_client)
         valid = ActivationState.VALID
@@ -463,6 +469,21 @@ class Dispatcher:
                         n_sync)
             if span is not None:
                 rec.finish(span, hits=hits)
+            if traced:
+                # link each sampled member to the window span: the
+                # event's interval runs enqueue → window end, and
+                # coalesce_wait_s isolates the ring wait — the per-hop
+                # wall-time decomposition the timeline reconstructs
+                t_end = time.monotonic()
+                wsid = span.span_id if span is not None else ""
+                for call in traced:
+                    enq = call.trace.get("enq", t_start)
+                    rec.event(f"window turn {method_name}",
+                              "rpc.window.link", call.trace,
+                              start=enq, duration=t_end - enq,
+                              window_span_id=wsid,
+                              coalesce_wait_s=round(t_start - enq, 6),
+                              calls=len(calls))
 
     async def _finish_window_turn(self, coro, yielded, act, call) -> None:
         """Drive a promoted (suspended-mid-turn) window call to
@@ -554,6 +575,15 @@ class Dispatcher:
             is_always_interleave=method.always_interleave,
             expiration=call.deadline,
         )
+        tr = call.trace
+        if tr is not None:
+            # a sampled coalesced call keeps its identity through the
+            # per-message net: the carried trace parents the receiving
+            # hop (and any cross-silo forward) under the SAME trace id
+            msg.request_context = {_TRACE_KEY: {
+                "trace_id": tr["trace_id"],
+                "span_id": tr.get("span_id", ""),
+                "sampled": bool(tr.get("sampled"))}}
         if local:
             msg.target_silo = self.silo.address
         if call.future is None:

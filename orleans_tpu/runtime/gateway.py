@@ -439,6 +439,8 @@ class GatewayAcceptor:
         share_ok = common_args is None or _rpc_args_shared_safe(common_args)
         minfo, iface_id = binding.minfo, binding.iface.interface_id
         gid = binding.gid
+        tids, sids = frame.trace_ids, frame.span_ids
+        rec = self.silo.spans if tids is not None else None
         futures: list = []
         calls: list = []
         for i in range(frame.n):
@@ -451,8 +453,17 @@ class GatewayAcceptor:
             fut = loop.create_future() if want else None
             if fut is not None:
                 futures.append(fut)
+            trace = None
+            if tids is not None:
+                trace = codec_mod.unpack_rpc_trace(int(tids[i]),
+                                                   int(sids[i]))
+                if trace is not None and rec is not None:
+                    # the gateway-frame hop of a sampled lane's journey
+                    rec.event(f"gateway frame {minfo.name}",
+                              "gateway.rpc", trace, start=now,
+                              client=str(client_id), lanes=frame.n)
             calls.append(_Call(gid(int(keys[i])), minfo, iface_id, args,
-                               fut, deadline, client_id))
+                               fut, deadline, client_id, trace))
         gateway.submit_calls(calls)
         if want:
             task = loop.create_task(

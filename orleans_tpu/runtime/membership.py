@@ -351,11 +351,34 @@ class MembershipOracle:
             if not alive:
                 raise RuntimeError(f"{target} answered not-active")
             self._missed_probes[target] = 0
+            await self._clock_probe(target)
         except Exception:
             missed = self._missed_probes.get(target, 0) + 1
             self._missed_probes[target] = missed
             if missed >= self.config.num_missed_probes_limit:
                 await self.try_suspect_or_kill(target)
+
+    async def _clock_probe(self, target: SiloAddress) -> None:
+        """Piggyback a monotonic-clock handshake on the probe cycle: ask
+        the peer for its ``time.monotonic()`` and estimate the offset via
+        the NTP midpoint (offset = t_remote - (t0+t1)/2).  The estimate
+        feeds the timeline plane so per-silo span logs can be merged onto
+        one clock; lowest-RTT sample wins inside the recorder."""
+        timeline = getattr(self.silo.spans, "timeline", None)
+        if timeline is None or not timeline.enabled:
+            return
+        try:
+            t0 = time.monotonic()
+            peer_name, t_remote = await self.silo.system_rpc(
+                target, "membership", "clock_probe", (),
+                timeout=self.config.probe_timeout)
+            t1 = time.monotonic()
+        except Exception:
+            return  # clock sync is best-effort; never votes on liveness
+        offset = float(t_remote) - (t0 + t1) / 2.0
+        # keyed by silo NAME: timeline exports are per-name lanes, and
+        # the merge's offset graph composes along these edges
+        timeline.note_clock_offset(str(peer_name), offset, t1 - t0)
 
     async def try_suspect_or_kill(self, victim: SiloAddress) -> None:
         """(reference: MembershipOracle.TryToSuspectOrKill :915)"""
@@ -508,6 +531,12 @@ class _MembershipTarget:
 
     async def notify_table_changed(self) -> None:
         await self.oracle.refresh_view()
+
+    async def clock_probe(self):
+        """Return (name, monotonic clock) so peers can estimate the
+        pairwise offset (timeline merge onto a common clock) keyed by
+        the timeline-lane name, not the wire address."""
+        return (self.oracle.silo.name, time.monotonic())
 
     async def notify_suspected(self, victim: SiloAddress) -> None:
         """(fast-suspect hint: probe the victim now, vote if it fails)"""

@@ -406,6 +406,16 @@ class RebalanceController:
                 "share": round(mv.share, 4),
                 "trigger": round(mv.trigger, 4),
                 "pause_s": round(pause, 6), "reason": mv.reason})
+            rec = self.engine._span_recorder()
+            if rec is not None:
+                # one timeline episode per rebalance decision, carrying
+                # the planner's own evidence (share vs trigger)
+                rec.plane_span("rebalance", f"move {mv.arena}",
+                               duration=pause, grains=moved,
+                               src_shard=mv.src_shard,
+                               share=round(mv.share, 4),
+                               trigger=round(mv.trigger, 4),
+                               reason=mv.reason)
         moved_total += self._apply_replications(reps)
         self._maybe_demote(signals)
         if self.cfg.cross_silo and self.silo is not None:

@@ -21,8 +21,11 @@ batched:
   device plane's generation/eviction-epoch discipline);
 * per-call reply futures resolve from the one batched completion; the
   per-message pipeline stays as the correctness net (cold/busy/remote
-  activations, sampled traces, chaos injection, shed pressure all fall
-  back per call and are counted as ``rpc.fastpath_fallbacks``).
+  activations, chaos injection, shed pressure all fall back per call
+  and are counted as ``rpc.fastpath_fallbacks``).  Sampled traces RIDE
+  the fastpath — the calls frame carries an optional per-lane trace
+  column and the window links member traces to its batched span — so
+  tracing never perturbs the path it measures.
 
 TTL semantics are preserved per call: every coalesced call carries its
 own absolute deadline (gateway frames rebase per-call remaining TTLs on
@@ -56,12 +59,13 @@ class _Call:
     actually needs — no Message object, no header dictionary."""
 
     __slots__ = ("grain_id", "method", "iface_id", "args", "future",
-                 "deadline", "sender")
+                 "deadline", "sender", "trace")
 
     def __init__(self, grain_id: GrainId, method: MethodInfo,
                  iface_id: int, args: Tuple[Any, ...],
                  future: Optional[asyncio.Future],
-                 deadline: Optional[float], sender: Any) -> None:
+                 deadline: Optional[float], sender: Any,
+                 trace: Optional[Dict[str, Any]] = None) -> None:
         self.grain_id = grain_id
         self.method = method
         self.iface_id = iface_id
@@ -69,6 +73,7 @@ class _Call:
         self.future = future          # None = one-way
         self.deadline = deadline      # absolute time.monotonic() or None
         self.sender = sender          # FIFO key (client GrainId)
+        self.trace = trace            # sampled trace context or None
 
     # gate compatibility: while a fast turn runs, the call sits in
     # ActivationData.running — may_interleave reads these flags off
@@ -290,6 +295,11 @@ class RpcCoalescer:
             # wait accounting rides the batch head (the longest waiter),
             # not a clock read per call
             self._ring_t0 = time.perf_counter()
+        if call.trace is not None:
+            # sampled lanes stamp their own enqueue instant so the
+            # window span can attribute THIS call's coalesce wait (the
+            # unsampled majority still pays no clock read)
+            call.trace["enq"] = time.monotonic()
         ring.append(call)
         if self._drain_task is None or self._drain_task.done():
             self._drain_task = asyncio.get_running_loop().create_task(
@@ -448,6 +458,8 @@ def _serve_main(args) -> int:
         cfg.liveness.table_refresh_timeout = 0.3
         cfg.liveness.iam_alive_table_publish = 0.5
         cfg.rpc.fastpath_enabled = not args.no_fastpath
+        cfg.tracing.enabled = not args.no_tracing
+        cfg.tracing.sample_rate = args.trace_sample_rate
         from orleans_tpu.runtime.transport import TcpFabric
 
         # gateway silos need a real TCP endpoint (the acceptor only
@@ -500,6 +512,15 @@ def _serve_main(args) -> int:
         try:
             await closed
         finally:
+            if args.timeline_dir:
+                # file-handoff timeline collection: drop this silo's
+                # export for `python -m orleans_tpu.timeline` to merge
+                import os
+                os.makedirs(args.timeline_dir, exist_ok=True)
+                path = os.path.join(args.timeline_dir,
+                                    f"timeline_{silo.name}.json")
+                with open(path, "w") as f:
+                    json.dump(silo.spans.timeline.export(), f)
             await silo.stop(graceful=False)
             if table_service is not None:
                 table_service.close()
@@ -518,7 +539,7 @@ def _drive_main(args) -> int:
 
     async def main() -> Dict[str, Any]:
         cfg = ClientConfig(rpc_fastpath=not args.no_fastpath,
-                           trace_sample_rate=0.0)
+                           trace_sample_rate=args.trace_sample_rate)
         client = GrainClient.from_config(cfg)
         endpoints = []
         for ep in args.gateways.split(","):
@@ -578,6 +599,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     serve.add_argument("--table-service", default=None,
                        help="host:port of an existing table service to "
                             "join (subsequent silos of a cluster)")
+    serve.add_argument("--no-tracing", action="store_true",
+                       help="disable the span/timeline plane entirely "
+                            "(overhead A/B control arm)")
+    serve.add_argument("--trace-sample-rate", type=float, default=0.01,
+                       help="head-sampling rate for traces minted on "
+                            "this silo (default 0.01)")
+    serve.add_argument("--timeline-dir", default="",
+                       help="write timeline_<name>.json here at "
+                            "shutdown (merge with python -m "
+                            "orleans_tpu.timeline)")
     drive = sub.add_parser("drive", help="run one client driver process")
     drive.add_argument("--gateways", required=True,
                        help="comma-separated host:port gateway endpoints")
@@ -585,6 +616,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     drive.add_argument("--rounds", type=int, default=5)
     drive.add_argument("--key-base", type=int, default=41000)
     drive.add_argument("--no-fastpath", action="store_true")
+    drive.add_argument("--trace-sample-rate", type=float, default=0.0,
+                       help="client-side head-sampling rate (sampled "
+                            "calls ride the rpc trace column)")
     args = parser.parse_args(argv)
     if args.cmd == "serve":
         return _serve_main(args)

@@ -115,8 +115,8 @@ class InsideRuntimeClient:
         self.backoff = BackoffPolicy(
             base=r.backoff_base, cap=r.backoff_cap,
             seed=zlib.crc32(silo.name.encode()))
-        # a head-sampling decision minted by the rpc-fastpath probe and
-        # handed to the per-message path (one draw per call, never two)
+        # a head-sampling decision handed to the per-message path when a
+        # probe declines after minting (one draw per call, never two)
         self._pending_trace = None
 
     # wired lazily by Silo
@@ -176,10 +176,11 @@ class InsideRuntimeClient:
         # batched RPC fastpath (runtime/rpc.py): hosted-CLIENT calls
         # coalesce into invoke-table windows instead of becoming
         # per-call Messages.  Grain-to-grain calls (call chains,
-        # deadlock detection), sampled traces (full per-hop spans),
-        # chaos injection, live shed pressure, and exotic targets all
-        # keep the per-message pipeline — the fastpath only takes the
-        # steady-state front-door traffic it can serve bit-identically.
+        # deadlock detection), chaos injection, live shed pressure, and
+        # exotic targets all keep the per-message pipeline — the
+        # fastpath only takes the steady-state front-door traffic it
+        # can serve bit-identically.  Sampled traces ride the fastpath
+        # on the _Call itself (the window links them to its span).
         if sender is None:
             fut = self._try_rpc_fastpath(target_grain, iface, method,
                                          args, timeout)
@@ -273,16 +274,17 @@ class InsideRuntimeClient:
             # an ambient RequestContext must flow to the turn; only the
             # per-message envelope carries it
             return _FASTPATH_DECLINED
+        trace = None
         rec = silo.spans
         if rec.enabled and rec.sample_rate > 0.0 \
                 and rec._rng.random() < rec.sample_rate:
-            # head-sampled: this call pays the full per-hop span path;
-            # the minted decision is REUSED by send_request (a second
-            # draw would square the sample rate).  The unsampled
-            # majority allocates no trace dict at all.
-            self._pending_trace = {"trace_id": _spans._getrandbits(63),
-                                   "span_id": "", "sampled": True}
-            return _FASTPATH_DECLINED
+            # head-sampled: the call still RIDES the fastpath — the
+            # trace travels on the _Call itself and the window links it
+            # (tracing must not perturb the path it measures).  The
+            # unsampled majority allocates no trace dict at all.
+            rec.sampled_traces += 1
+            trace = {"trace_id": _spans._getrandbits(63),
+                     "span_id": "", "sampled": True}
         # requests_sent / retry-budget deposits batch per drained window
         # (RpcCoalescer._drain) — identical totals, no per-call RMW here
         future = None
@@ -296,7 +298,7 @@ class InsideRuntimeClient:
                 break
         coal.submit(_Call(
             target_grain, method, iface.interface_id, args, future,
-            time.monotonic() + timeout, silo.client_grain_id))
+            time.monotonic() + timeout, silo.client_grain_id, trace))
         return future
 
     def _on_timeout(self, message_id: int) -> None:

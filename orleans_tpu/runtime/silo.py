@@ -133,12 +133,27 @@ class Silo:
         # batched engine-tick spans + the crash flight recorder.  Built
         # FIRST — the resilience plane's dead-letter hook and every
         # runtime component record through it.
-        from orleans_tpu.spans import SpanRecorder
+        from orleans_tpu.spans import SpanRecorder, TimelineRecorder
         tr = self.config.tracing
         self.spans = SpanRecorder(
             self.name, enabled=tr.enabled, sample_rate=tr.sample_rate,
             flight_capacity=tr.flight_recorder_capacity,
             breaker_capacity=tr.breaker_transition_capacity)
+        # cluster timeline plane (orleans_tpu/timeline.py): every
+        # committed span + lifecycle event + interval metric delta
+        # appends to this bounded per-silo log; a collector merges the
+        # logs onto a common clock and exports TIMELINE.json + Perfetto
+        self.spans.timeline = TimelineRecorder(
+            self.name, capacity=tr.timeline_capacity,
+            enabled=tr.enabled and tr.timeline_enabled)
+        # last-published counter totals for the timeline's interval
+        # metric deltas (collect_metrics cadence)
+        self._timeline_totals: Dict[str, float] = {}
+        # unified incident evidence: the newest bundles dumped by any
+        # trip (fence, watchdog, SLO burn, chaos invariant)
+        from collections import deque as _deque
+        self.incidents: Any = _deque(maxlen=8)
+        self._slo_was_healthy = True  # SLO breach edge-trigger state
 
         # overload containment & failure isolation plane (PR: resilience)
         # — built BEFORE the components that consult it
@@ -326,7 +341,7 @@ class Silo:
             # silo must never acknowledge another write (it would be
             # lost to the promoted range owner).  Fast-kill, exactly
             # like the crash the standby already covers.
-            self.tensor_engine.checkpointer.on_fenced = self.kill
+            self.tensor_engine.checkpointer.on_fenced = self._fenced_kill
         # closed-loop rebalance (runtime/rebalancer.py): consumes the
         # attribution plane's HotSet/skew/slo.* signals and ACTS via
         # batched live migration.  Always constructed with an engine so
@@ -417,11 +432,15 @@ class Silo:
             self.watchdog.register(self.tensor_engine)
             self.watchdog.start()
         self.status = SiloStatus.ACTIVE
+        self.spans.timeline.lifecycle("join", address=str(self.address),
+                                      gateway_port=self.gateway_port)
         self.logger.info(f"silo {self.address} active")
 
     async def stop(self, graceful: bool = True) -> None:
         """(reference: Silo.Terminate :642-770 graceful / FastKill :776)"""
         self.status = SiloStatus.SHUTTING_DOWN if graceful else SiloStatus.STOPPING
+        self.spans.timeline.lifecycle("drain" if graceful else "stop",
+                                      address=str(self.address))
         if self.watchdog is not None:
             self.watchdog.stop()
         if self.load_publisher is not None:
@@ -516,6 +535,7 @@ class Silo:
         """Hard kill for tests: no deactivations, no handoff
         (reference: Silo.FastKill :776; TestingSiloHost.KillSilo)."""
         self.status = SiloStatus.DEAD
+        self.spans.timeline.lifecycle("kill", address=str(self.address))
         if self.watchdog is not None:
             self.watchdog.stop()
         if self.load_publisher is not None:
@@ -581,6 +601,10 @@ class Silo:
             return
         self.last_promotion = await standby.promote(owner=self.name)
         self.last_promotion["for"] = str(dead)
+        self.spans.timeline.lifecycle(
+            "promote", over=str(dead),
+            seconds=self.last_promotion["seconds"],
+            fence_epoch=self.last_promotion["fence_epoch"])
         self.logger.info(
             f"standby promoted over {dead} in "
             f"{self.last_promotion['seconds']}s "
@@ -653,6 +677,9 @@ class Silo:
             enabled=tr.enabled, sample_rate=tr.sample_rate,
             flight_capacity=tr.flight_recorder_capacity,
             breaker_capacity=tr.breaker_transition_capacity)
+        if self.spans.timeline is not None:
+            self.spans.timeline.enabled = \
+                tr.enabled and tr.timeline_enabled
         mc = self.config.metrics
         if self.tensor_engine is not None:
             self.tensor_engine.metrics_config = mc
@@ -797,6 +824,46 @@ class Silo:
             collection_slices=slices,
             profile_captures=captures)
 
+    def incident_bundle(self, reason: str) -> Dict[str, Any]:
+        """The unified incident evidence bundle: the flight-recorder
+        tail (spans correlated with dead letters + breaker
+        transitions), the recent compile-event ring, the dead-letter
+        tail, and the timeline tail around the trip.  Every trigger —
+        a chaos invariant violation, a ``FencedError`` kill, a
+        watchdog stall or failed health check, an SLO burn breach —
+        dumps through here so the evidence always has one shape.  The
+        newest bundles are retained on ``self.incidents`` (bounded);
+        the trip itself lands on the timeline as a lifecycle mark so
+        the merged cluster view shows WHEN each silo tripped."""
+        import time as _time
+        eng = self.tensor_engine
+        tl = self.spans.timeline
+        bundle = {
+            "reason": reason,
+            "silo": self.name,
+            "at": round(_time.monotonic(), 6),
+            "flight_recorder": self.flight_dump(reason),
+            "compile_events": (list(eng.compile_tracker.events)[-16:]
+                               if eng is not None else []),
+            "dead_letters": list(self.dead_letters.entries)[-32:],
+            "timeline_tail": tl.tail() if tl is not None else [],
+        }
+        self.incidents.append(bundle)
+        if tl is not None:
+            tl.lifecycle("incident", reason=reason)
+        self.logger.warn(f"incident bundle dumped: {reason}", code=3003)
+        return bundle
+
+    def _fenced_kill(self) -> None:
+        """Promotion-fence trip: dump the incident evidence (the fence
+        epoch race IS the incident), then fast-kill — this silo must
+        never acknowledge another write."""
+        try:
+            self.incident_bundle(
+                "fenced: a promoted standby owns this silo's store")
+        finally:
+            self.kill()
+
     def capture_profile(self, ticks: int = 8,
                         reason: str = "management") -> Dict[str, Any]:
         """Explicit deep-capture entry point (the management surface —
@@ -865,6 +932,22 @@ class Silo:
                              ri["ingress_batch_size"], {"silo": self.name})
             mgr.track_metric("rpc.coalesce_wait_s",
                              ri["coalesce_wait_s"], {"silo": self.name})
+        # tracing/timeline plane: span commit volume, sampled traces,
+        # the timeline backlog, and the worst estimated peer clock
+        # offset.  The offset gauge keeps the -1 no-data sentinel from
+        # worst_clock_offset_s(): an unprobed silo must read "no
+        # estimate", never "perfectly synced".
+        sp = self.spans.snapshot()
+        emit({"spans_started": sp["started"],
+              "spans_committed": sp["recorded"],
+              "sampled_traces": sp["sampled_traces"],
+              "drop_spans": sp["drop_spans"]}, None, "trace.")
+        tls = sp["timeline"]
+        if tls is not None:
+            reg.gauge("trace.timeline_backlog").set(float(tls["backlog"]))
+            reg.counter("trace.timeline_dropped").set_total(tls["dropped"])
+            reg.gauge("trace.worst_clock_offset_s").set(
+                tls["worst_clock_offset_s"])
         # host turn latency: mirror the SiloMetrics ns-bucket histogram
         # into the registry's log2 layout (same octave scheme, base 1ns)
         tl = self.metrics.turn_latency
@@ -1127,6 +1210,25 @@ class Silo:
                         reg.drop_gauges(name)
                     self._hot_set_cache = []
                 self._publish_slo(reg, eng)
+        # timeline load context: one interval's counter deltas appended
+        # to the per-silo timeline log — the lane's "what was the silo
+        # doing" strip between spans
+        tl_rec = self.spans.timeline
+        if tl_rec is not None and tl_rec.enabled:
+            totals = {
+                "turns_executed": float(self.metrics.turns_executed),
+                "requests_sent": float(self.metrics.requests_sent),
+                "rpc_fastpath_hits": float(rs["fastpath_hits"]),
+                "dead_letters": float(dl["total"]),
+                "spans_committed": float(self.spans.recorded),
+            }
+            if eng is not None:
+                totals["engine_ticks"] = float(eng.ticks_run)
+                totals["engine_messages"] = float(eng.messages_processed)
+            last, self._timeline_totals = self._timeline_totals, totals
+            tl_rec.metrics_delta(
+                {k: v - last.get(k, 0.0) for k, v in totals.items()
+                 if v != last.get(k, 0.0)})
         return reg.snapshot()
 
     #: every attribution gauge family whose label VALUES churn between
@@ -1208,8 +1310,16 @@ class Silo:
             if attempted and mc.slo_drop_error_budget > 0 else 0.0
         reg.gauge("slo.drop_burn_rate").set(drop_burn)
         reg.gauge("slo.drop_error_budget").set(mc.slo_drop_error_budget)
-        reg.gauge("slo.healthy").set(
-            1.0 if lat_burn <= 1.0 and drop_burn <= 1.0 else 0.0)
+        healthy = lat_burn <= 1.0 and drop_burn <= 1.0
+        reg.gauge("slo.healthy").set(1.0 if healthy else 0.0)
+        # edge-triggered incident dump: the FIRST publish that finds a
+        # burn rate over budget captures the evidence around the breach
+        # (re-dumping every interval would flood the bounded rings)
+        if not healthy and self._slo_was_healthy:
+            self.incident_bundle(
+                f"slo burn breach: latency_burn={lat_burn:.3f} "
+                f"drop_burn={drop_burn:.3f}")
+        self._slo_was_healthy = healthy
 
     def hot_set(self, refresh: bool = False) -> List[Dict[str, Any]]:
         """The silo's HotSet — hot grains with estimated message share
@@ -1297,6 +1407,8 @@ class Silo:
     def _on_ring_changed(self) -> None:
         if self.status != SiloStatus.ACTIVE:
             return
+        self.spans.timeline.lifecycle(
+            "ring-change", live=len(self.active_silos()))
         # drop transport sender queues for dead endpoints (queued requests
         # bounce as transient rejections; reference: SiloDeadOracle)
         prune = getattr(self._bound_transport, "prune_dead", None)

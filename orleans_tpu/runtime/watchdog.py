@@ -36,6 +36,7 @@ class Watchdog:
         self.last_check_time: Optional[float] = None
         self._task: Optional[asyncio.Task] = None
         self._running = False
+        self._was_failing = False  # health-trip edge-trigger state
         self.logger = silo.logger.child("watchdog")
 
     def register(self, participant: Any) -> None:
@@ -72,6 +73,10 @@ class Watchdog:
                     controller = getattr(self.silo, "shed_controller", None)
                     if controller is not None:
                         controller.note_stall(drift)
+                    # a stall IS an incident: whatever wedged the loop
+                    # is in the flight recorder / timeline tail NOW
+                    self.silo.incident_bundle(
+                        f"watchdog: event loop stalled {drift:.3f}s")
                 self.check_participants()
         except asyncio.CancelledError:
             pass
@@ -91,5 +96,12 @@ class Watchdog:
                 self.failed_checks += 1
                 self.logger.warn(
                     f"health check failed: {type(p).__name__}", code=3002)
+        # edge-triggered incident dump: the FIRST round with a failing
+        # participant captures the evidence; a participant that stays
+        # unhealthy must not re-dump every period
+        if failures and not self._was_failing:
+            self.silo.incident_bundle(
+                f"watchdog: {failures} health check(s) failed")
+        self._was_failing = bool(failures)
         self.last_check_time = now
         return failures

@@ -234,6 +234,128 @@ class FlightRecorder:
         }
 
 
+# ---- the timeline log -----------------------------------------------------
+
+class TimelineRecorder:
+    """Bounded per-silo timeline: completed spans + interval metric
+    deltas + lifecycle events, appended in arrival order on the silo's
+    OWN monotonic clock.  A collector (testing/cluster.py in-process,
+    orleans_tpu/timeline.py file-handoff for the multiprocess runner)
+    merges the per-silo exports onto one reference clock using the
+    gossip-piggybacked offset estimates recorded here, and renders
+    ``TIMELINE.json`` plus a Chrome trace-event (Perfetto) export.
+
+    Everything is host bookkeeping on one deque; with ``enabled=False``
+    every entry point returns before allocating (the timeline A/B in
+    bench.py proves the envelope alongside the span plane's)."""
+
+    def __init__(self, silo: str, capacity: int = 4096,
+                 enabled: bool = True) -> None:
+        self.silo = silo
+        self.enabled = enabled
+        self.capacity = capacity
+        self.events: deque = deque(maxlen=capacity)
+        self.appended = 0
+        self.dropped = 0          # events evicted by the ring bound
+        # peer → best (lowest-RTT) offset estimate: REMOTE monotonic
+        # minus LOCAL monotonic, half-RTT corrected
+        self.clock_offsets: Dict[str, Dict[str, float]] = {}
+
+    def _append(self, record: Dict[str, Any]) -> None:
+        if len(self.events) == self.events.maxlen:
+            self.dropped += 1
+        self.appended += 1
+        self.events.append(record)
+
+    def resize(self, capacity: int) -> None:
+        if capacity == self.capacity:
+            return
+        self.capacity = capacity
+        self.events = deque(self.events, maxlen=capacity)
+
+    # -- appenders ----------------------------------------------------------
+
+    def record_span(self, span: "Span") -> None:
+        if self.enabled:
+            self._append({"kind": "span", **span.to_dict()})
+
+    def lifecycle(self, event: str, **attrs: Any) -> None:
+        """join/drain/kill/promote/ring-change — the cluster's phase
+        boundaries; always cheap enough to record unconditionally."""
+        if self.enabled:
+            self._append({"kind": "lifecycle", "event": event,
+                          "silo": self.silo,
+                          "start": round(time.monotonic(), 6),
+                          "attrs": {k: (v if isinstance(
+                              v, (int, float, bool, str, type(None)))
+                              else str(v)) for k, v in attrs.items()}})
+
+    def metrics_delta(self, delta: Dict[str, float]) -> None:
+        """One interval's counter deltas (collect_metrics cadence) —
+        the timeline's load context between spans."""
+        if self.enabled and delta:
+            self._append({"kind": "metrics",
+                          "start": round(time.monotonic(), 6),
+                          "delta": {k: round(float(v), 6)
+                                    for k, v in delta.items()}})
+
+    # -- clock merge --------------------------------------------------------
+
+    def note_clock_offset(self, peer: str, offset_s: float,
+                          rtt_s: float) -> None:
+        """One probe's offset sample (remote monotonic − local, half-RTT
+        corrected).  The LOWEST-RTT sample wins (NTP's discipline: RTT
+        bounds the estimate's error), with a slow decay so a genuinely
+        drifted clock eventually re-measures."""
+        cur = self.clock_offsets.get(peer)
+        if cur is None or rtt_s <= cur["rtt_s"] * 1.5:
+            self.clock_offsets[peer] = {
+                "offset_s": round(offset_s, 6),
+                "rtt_s": round(rtt_s, 6),
+                "at": round(time.monotonic(), 6)}
+
+    def worst_clock_offset_s(self) -> float:
+        """Largest absolute peer-offset estimate; ``-1.0`` when no peer
+        has been probed yet (the dashboard's no-data sentinel — an
+        empty estimate table must never read as 'perfectly synced')."""
+        if not self.clock_offsets:
+            return -1.0
+        return max(abs(o["offset_s"]) for o in self.clock_offsets.values())
+
+    # -- export -------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "capacity": self.capacity,
+            "backlog": len(self.events),
+            "appended": self.appended,
+            "dropped": self.dropped,
+            "peers_probed": len(self.clock_offsets),
+            "worst_clock_offset_s": self.worst_clock_offset_s(),
+        }
+
+    def export(self) -> Dict[str, Any]:
+        """The per-silo handoff payload the collector merges (JSON-safe;
+        see orleans_tpu/timeline.py merge_timelines)."""
+        return {
+            "silo": self.silo,
+            "exported_at": round(time.monotonic(), 6),
+            "appended": self.appended,
+            "dropped": self.dropped,
+            "clock_offsets": {p: dict(o)
+                              for p, o in self.clock_offsets.items()},
+            "events": list(self.events),
+        }
+
+    def tail(self, n: int = 64) -> List[Dict[str, Any]]:
+        """The newest ``n`` events — the incident bundle's timeline
+        context around a trip."""
+        if n <= 0:
+            return []
+        return list(self.events)[-n:]
+
+
 # ---- the recorder ---------------------------------------------------------
 
 class SpanRecorder:
@@ -262,6 +384,13 @@ class SpanRecorder:
         self.recorded = 0             # spans committed to the sinks
         self.discarded_unsampled = 0  # OK spans of unsampled traces
         self.drop_spans = 0           # always-on dead-letter spans
+        self.sampled_traces = 0       # head-sampling YES decisions minted
+        # the cluster timeline sink (None until the owner attaches one;
+        # every committed span also lands on the timeline when set)
+        self.timeline: Optional[TimelineRecorder] = None
+        # per-plane monotonic sequence numbers: (silo, plane, seq) is the
+        # STABLE id of a plane-span episode across exports
+        self._plane_seq: Dict[str, int] = {}
 
     def configure(self, enabled: Optional[bool] = None,
                   sample_rate: Optional[float] = None,
@@ -287,9 +416,12 @@ class SpanRecorder:
         baked in.  ``span_id`` starts empty (no parent span yet)."""
         if not self.enabled:
             return None
+        sampled = bool(force_sample
+                       or self._rng.random() < self.sample_rate)
+        if sampled:
+            self.sampled_traces += 1
         return {"trace_id": _getrandbits(63), "span_id": "",
-                "sampled": bool(force_sample
-                                or self._rng.random() < self.sample_rate)}
+                "sampled": sampled}
 
     def ingress(self) -> Optional[Dict[str, Any]]:
         """The ambient trace if one flows with the caller, else a fresh
@@ -302,8 +434,11 @@ class SpanRecorder:
             t = rc.get(TRACE_KEY)
             if t is not None:
                 return t
+        sampled = self._rng.random() < self.sample_rate
+        if sampled:
+            self.sampled_traces += 1
         return {"trace_id": _getrandbits(63), "span_id": "",
-                "sampled": self._rng.random() < self.sample_rate}
+                "sampled": sampled}
 
     @staticmethod
     def child_context(trace: Dict[str, Any], span: Optional[Span]
@@ -480,6 +615,39 @@ class SpanRecorder:
         self._commit(span)
         return span
 
+    # -- device-plane interval spans -----------------------------------------
+
+    def plane_span(self, plane: str, name: str,
+                   start: Optional[float] = None, duration: float = 0.0,
+                   status: str = STATUS_OK, **attrs: Any
+                   ) -> Optional[Span]:
+        """ONE interval span for one device-plane episode — an exchange
+        re-trace, a grant growth step, a stream fan-out tick, a timer
+        harvest, a checkpoint pin/drain/seal, a journal segment seal, a
+        migration wave, a standby tail/promote, a rebalance decision —
+        annotated with the plane's own counters (rows moved, lanes
+        sealed, harvest width).  Batched like tick spans: one span per
+        EPISODE, never per row.  Always recorded (``trace_id == ""``,
+        sampled) so the timeline has every plane's track at sample_rate
+        0; the stable identity of an episode across exports is
+        ``(silo, plane, seq)`` — seq is a per-plane monotonic counter,
+        not a random id."""
+        if not self.enabled:
+            return None
+        seq = self._plane_seq.get(plane, 0) + 1
+        self._plane_seq[plane] = seq
+        self.started += 1
+        span = Span(
+            trace_id="", span_id=new_id(), parent_id=None,
+            name=name, kind=f"plane.{plane}", silo=self.name,
+            sampled=True,
+            start=(time.monotonic() - duration) if start is None
+            else start,
+            duration=duration, status=status,
+            attrs={"plane": plane, "seq": seq, **attrs})
+        self._commit(span)
+        return span
+
     # -- breaker evidence ----------------------------------------------------
 
     def note_breaker(self, target: Any, old: str, new: str,
@@ -497,6 +665,9 @@ class SpanRecorder:
             return
         self.recorded += 1
         self.flight.add(span)
+        tl = self.timeline
+        if tl is not None:
+            tl.record_span(span)
         from orleans_tpu import telemetry
         mgr = telemetry.default_manager
         if mgr.consumers:
@@ -510,7 +681,10 @@ class SpanRecorder:
             "recorded": self.recorded,
             "discarded_unsampled": self.discarded_unsampled,
             "drop_spans": self.drop_spans,
+            "sampled_traces": self.sampled_traces,
             "flight_capacity": self.flight.capacity,
             "flight_retained": len(self.flight.spans),
             "flight_dropped": self.flight.dropped,
+            "timeline": (self.timeline.snapshot()
+                         if self.timeline is not None else None),
         }

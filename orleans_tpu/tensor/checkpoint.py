@@ -661,6 +661,12 @@ class CheckpointPlane:
     def config(self):
         return self.engine().config
 
+    def _rec(self):
+        """The owning silo's SpanRecorder (timeline plane spans) or
+        None — same single-check gate every engine hook uses."""
+        eng = self.engine()
+        return None if eng is None else eng._span_recorder()
+
     def attach_store(self, store: SnapshotStore) -> None:
         """Late binding (tests / silo setup hooks / standby promotion):
         engage the plane on a running engine."""
@@ -823,6 +829,7 @@ class CheckpointPlane:
         happens in later slices."""
         if self._active is not None:
             raise RuntimeError("snapshot already in progress")
+        t_pin0 = time.perf_counter()
         eng = self.engine()
         fuser = getattr(eng, "autofuser", None)
         if fuser is not None and fuser._unverified:
@@ -896,6 +903,13 @@ class CheckpointPlane:
         # arm/cancel op log since the previous cut
         snap.timers = eng.timers.export_cut(snap.kind)
         self._active = snap
+        rec = self._rec()
+        if rec is not None:
+            rec.plane_span("checkpoint", f"pin {snap.kind}",
+                           duration=time.perf_counter() - t_pin0,
+                           tick=pin_tick, seq=snap.seq,
+                           arenas=len(snap.arenas),
+                           dirty_rows=self.last_dirty_rows)
 
     def _dirty_rows(self, arena, pin, live_rows: np.ndarray) -> np.ndarray:
         """Attribution-driven delta predicate: rows whose traffic count
@@ -973,6 +987,13 @@ class CheckpointPlane:
             drained += 1
             if budget_s > 0 and time.perf_counter() - t0 >= budget_s:
                 break
+        if drained:
+            rec = self._rec()
+            if rec is not None:
+                rec.plane_span("checkpoint", "drain slice",
+                               duration=time.perf_counter() - t0,
+                               chunks=drained, seq=snap.seq,
+                               remaining=len(snap.queue))
         if not snap.queue:
             self._commit_snapshot(snap)
         return drained
@@ -1059,6 +1080,11 @@ class CheckpointPlane:
         self.rows_written += snap.rows
         self.bytes_written += snap.bytes
         self._active = None
+        rec = self._rec()
+        if rec is not None:
+            rec.plane_span("checkpoint", f"seal {snap.kind}",
+                           tick=snap.tick, seq=snap.seq,
+                           rows=snap.rows, bytes=snap.bytes)
 
     def _journal_commit(self, sealed: List[Tuple[Any, str,
                                                  Dict[str, Any]]]) -> None:
@@ -1089,6 +1115,12 @@ class CheckpointPlane:
                                  "owner": self._fence_owner}
         self.store.commit_manifest(manifest)
         self._manifest = manifest
+        rec = self._rec()
+        if rec is not None:
+            rec.plane_span("journal", "segment seal",
+                           segments=len(sealed),
+                           lanes=sum(int(m["lanes"])
+                                     for _, _, m in sealed))
 
     # -- explicit sync entry points -----------------------------------------
 
@@ -1763,6 +1795,14 @@ class StandbyTailer:
         for blob in list(self._staged):
             if blob not in live_blobs:
                 del self._staged[blob]
+        if adopted or staged:
+            eng = self._engine()
+            rec = None if eng is None else eng._span_recorder()
+            if rec is not None:
+                rec.plane_span("standby", "tail poll",
+                               adopted_entries=adopted,
+                               staged_segments=staged,
+                               lag_ticks=self.lag_ticks())
         return {"adopted_entries": adopted, "staged_segments": staged}
 
     def lag_ticks(self) -> int:
@@ -1832,6 +1872,13 @@ class StandbyTailer:
         self.promoted = True
         self.last_promote_s = time.perf_counter() - t0
         plane.last_rto_s = self.last_promote_s
+        rec = eng._span_recorder()
+        if rec is not None:
+            rec.plane_span("standby", "promote",
+                           duration=self.last_promote_s,
+                           fence_epoch=epoch,
+                           adopted_rows=self.adopted_rows,
+                           replayed_lanes=replayed)
         return {"promoted": True,
                 "fence_epoch": epoch,
                 "adopted_tick": self._adopted_tick,

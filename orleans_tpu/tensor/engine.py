@@ -945,10 +945,20 @@ class TensorEngine:
         if self._pending_checks or self._exchange_checks \
                 or self._fanout_checks:
             self._drain_checks()
+        t_mv0 = time.perf_counter()
         moved = arena.migrate_keys(keys, dst_shards, pin=pin)
         if moved:
             self.migrations += 1
             self.grains_migrated += moved
+            rec = self._span_recorder()
+            if rec is not None:
+                # migration-wave episode: plan→move→adopt collapses
+                # into one device gather/scatter here; rows moved is
+                # the plane counter the timeline annotates
+                rec.plane_span("migration", f"wave {type_name}",
+                               duration=time.perf_counter() - t_mv0,
+                               rows_moved=moved, tick=self.tick_number,
+                               type=type_name)
         return moved
 
     def replicate_key(self, type_name: str, key: int, k: int) -> int:
@@ -973,6 +983,11 @@ class TensorEngine:
         got = arena.promote_replicas(key, k)
         self.replications += 1
         self.grains_replicated += 1
+        rec = self._span_recorder()
+        if rec is not None:
+            rec.plane_span("migration", f"replicate {type_name}",
+                           key=int(key), replicas=got,
+                           tick=self.tick_number)
         return got
 
     def demote_key(self, type_name: str, key: int) -> int:
@@ -1702,6 +1717,17 @@ class TensorEngine:
             # after a live re-enable and blind its overrun detector
             self.pipeline.take_tick_overlap()
         compile_events = self.compile_tracker.drain_tick_events()
+        if rec is not None and stages.get("fanout"):
+            # stream-plane episode: this tick's subscription fan-out /
+            # routing work, one interval on the streams track
+            rec.plane_span("streams", "fan-out tick",
+                           duration=stages["fanout"],
+                           tick=self.tick_number, rounds=rounds)
+        if rec is not None and stages.get("timers"):
+            rec.plane_span("timers", "advance",
+                           duration=stages["timers"],
+                           tick=self.tick_number,
+                           armed=self.timers.armed_total)
         if rec is not None:
             # ONE batched span per tick (batch size, per-type counts,
             # compile events) + link events into the sampled traces it

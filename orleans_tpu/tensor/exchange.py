@@ -432,6 +432,17 @@ class ShardExchange:
         if changed or (changed_pd and getattr(
                 cfg, "exchange_per_dest", "auto") != "never"):
             self.cap_version += 1
+            rec = self.engine._span_recorder()
+            if rec is not None:
+                # a grant move is the exchange's re-trace trigger
+                # (fused plans re-bake on cap_version): one timeline
+                # episode per rung move, annotated with the new caps
+                rec.plane_span(
+                    "exchange", f"grant growth {site}",
+                    site=str(site), cap_version=self.cap_version,
+                    grant=int(est.grant or 0),
+                    recv_grant=int(est.recv_grant or 0),
+                    peak_need=int(np.asarray(need).max(initial=0)))
 
     def grant_for(self, site: Optional[Site]) -> Optional[int]:
         if site is None or not self.engine.config.exchange_occupancy_sizing:

@@ -908,12 +908,21 @@ class FusedTickProgram:
             self._compiled = self._build(
                 examples if self._is_multi() else examples[0])
             self._reshard_count = engine.reshard_count
+            t_built = time.perf_counter() - t_build
             engine.compile_tracker.record(
                 cause,
                 key="fused:" + "+".join(f"{s.type_name}.{s.method}"
                                         for s in self.sources),
-                seconds=time.perf_counter() - t_build,
+                seconds=t_built,
                 tick=engine.tick_number)
+            rec = engine._span_recorder()
+            if rec is not None:
+                # re-trace episodes on the exchange track: the
+                # timeline shows WHEN a window re-baked and why
+                rec.plane_span("exchange", f"re-trace {cause}",
+                               duration=t_built, cause=cause,
+                               tick=engine.tick_number,
+                               sources=len(self.sources))
 
     def run(self, stacked_args: Any, static_args: Any = None) -> None:
         """Execute T fused ticks.

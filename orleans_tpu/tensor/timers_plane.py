@@ -575,6 +575,14 @@ class TimersPlane:
         late = int((t - dues).max()) if len(dues) else 0
         if late > self.worst_lateness_ticks:
             self.worst_lateness_ticks = late
+        rec = eng._span_recorder()
+        if rec is not None:
+            # one timeline episode per non-empty harvest, annotated
+            # with the plane's own counters (ISSUE: harvest width)
+            rec.plane_span("timers", f"harvest {type_name}",
+                           width=len(slots),
+                           rearmed=int(len(rearm_slots)),
+                           tick=t, late_ticks=late)
 
     def _rebuild(self, tt: _TypeTimers, t: int
                  ) -> Tuple[np.ndarray, np.ndarray]:

@@ -220,6 +220,22 @@ class TestingCluster:
                     f"liveness did not converge: {views} != {expected}")
             await asyncio.sleep(0.05)
 
+    def collect_timeline(self, reference: str = "",
+                         out_dir: Optional[str] = None):
+        """In-process timeline collection: merge every live silo's
+        per-silo span/lifecycle/metrics log onto one clock
+        (orleans_tpu/timeline.py).  In-process silos share one
+        ``time.monotonic()``, so the merge is exact even before any
+        clock probe has run.  ``out_dir`` additionally writes
+        ``TIMELINE.json`` + the Perfetto export there."""
+        from orleans_tpu.timeline import merge_timelines, write_artifacts
+        exports = [s.spans.timeline.export() for s in self.silos
+                   if s.spans.timeline is not None]
+        merged = merge_timelines(exports, reference=reference)
+        if out_dir is not None:
+            write_artifacts(merged, out_dir)
+        return merged
+
     def total_activations(self) -> int:
         return sum(len(s.catalog.directory) for s in self.silos)
 

@@ -1,0 +1,337 @@
+"""Cluster timeline collector: merge per-silo span logs onto one clock.
+
+Each silo appends completed spans, lifecycle events, and interval metric
+deltas to its bounded :class:`~orleans_tpu.spans.TimelineRecorder`, all
+stamped with the silo's OWN ``time.monotonic()``.  Monotonic clocks are
+per-process — two silos' timestamps are not comparable until the
+pairwise offsets are known.  The membership probe loop piggybacks an
+NTP-midpoint handshake (``clock_probe``) on its existing ping cycle and
+records ``offset = remote − (t0+t1)/2`` per peer (lowest RTT wins,
+membership.py).  This module is the other half:
+
+* :func:`merge_timelines` — take the per-silo ``export()`` payloads,
+  resolve every silo's offset to ONE reference clock (direct estimate
+  when a silo probed the reference; otherwise the offsets compose along
+  a BFS path through the probe graph), rebase every event, and return
+  one time-sorted stream;
+* :func:`to_chrome_trace` — render the merged stream as a Chrome
+  trace-event JSON (the format Perfetto / ``chrome://tracing`` load):
+  one *process* lane per silo, one *thread* track per plane (rpc,
+  gateway, engine, checkpoint, exchange, …), spans as complete ``X``
+  events, lifecycle marks as instants, metric deltas as counter series;
+* :func:`write_artifacts` — emit ``TIMELINE.json`` (the merged stream +
+  clock table, the machine-readable artifact) and
+  ``TIMELINE.perfetto.json`` next to it;
+* a CLI (``python -m orleans_tpu.timeline <dir>``) that merges the
+  ``timeline_<silo>.json`` files the multiprocess runner's serve
+  processes drop at shutdown (runtime/rpc.py ``--timeline-dir``).
+
+Everything here is offline post-processing: plain dicts, no runtime
+imports, safe to run against artifacts from a dead cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "merge_timelines",
+    "to_chrome_trace",
+    "write_artifacts",
+    "load_exports",
+]
+
+
+# ---- clock-offset resolution ----------------------------------------------
+
+def _resolve_offsets(exports: List[Dict[str, Any]], reference: str
+                     ) -> Dict[str, Optional[Dict[str, float]]]:
+    """Per-silo offset TO the reference clock (``t_ref = t_silo +
+    offset``), composed along the probe graph.
+
+    Silo S's recorded estimate against peer P is ``P_clock − S_clock``,
+    so the edge S→P carries ``+offset`` and the reverse edge carries
+    ``−offset`` — a BFS from the reference reaches every silo the probe
+    graph connects, summing edge offsets (and RTTs, the composed error
+    bound).  A silo outside the connected component resolves to ``None``
+    and its events are kept on its own clock, flagged ``unsynced`` —
+    never silently pretended onto the common clock."""
+    # adjacency: silo → {peer: (offset_peer_minus_silo, rtt)}
+    adj: Dict[str, Dict[str, Tuple[float, float]]] = {}
+    for ex in exports:
+        me = ex["silo"]
+        adj.setdefault(me, {})
+        for peer, est in (ex.get("clock_offsets") or {}).items():
+            off, rtt = float(est["offset_s"]), float(est["rtt_s"])
+            # forward edge: me → peer
+            cur = adj[me].get(peer)
+            if cur is None or rtt < cur[1]:
+                adj[me][peer] = (off, rtt)
+            # reverse edge: peer → me (negated) — a one-sided probe
+            # still connects both silos to the graph
+            rev = adj.setdefault(peer, {}).get(me)
+            if rev is None or rtt < rev[1]:
+                adj[peer][me] = (-off, rtt)
+    # BFS from the reference; offset accumulates along the path from
+    # each silo TOWARD the reference: t_ref = t_silo + acc
+    out: Dict[str, Optional[Dict[str, float]]] = {
+        s["silo"]: None for s in exports}
+    out[reference] = {"offset_s": 0.0, "rtt_s": 0.0, "hops": 0}
+    seen = {reference}
+    q: deque = deque([(reference, 0.0, 0.0, 0)])
+    while q:
+        node, acc, err, hops = q.popleft()
+        for peer, (off, rtt) in adj.get(node, {}).items():
+            if peer in seen:
+                continue
+            seen.add(peer)
+            # edge node→peer says peer_clock − node_clock = off, so
+            # t_node = t_peer − off; composed: t_ref = t_peer + (acc−off)
+            res = {"offset_s": round(acc - off, 6),
+                   "rtt_s": round(err + rtt, 6), "hops": hops + 1}
+            if peer in out:
+                out[peer] = res
+            q.append((peer, acc - off, err + rtt, hops + 1))
+    return out
+
+
+# ---- merge ----------------------------------------------------------------
+
+def merge_timelines(exports: List[Dict[str, Any]],
+                    reference: str = "") -> Dict[str, Any]:
+    """Merge per-silo ``TimelineRecorder.export()`` payloads onto the
+    reference silo's monotonic clock.  ``reference`` defaults to the
+    first export's silo.  Every event gains ``silo`` and ``ts`` (seconds
+    on the reference clock, rebased so the merged stream starts near 0);
+    events from a silo with no resolvable offset keep their own clock
+    and carry ``"unsynced": True``."""
+    if not exports:
+        return {"reference": "", "silos": {}, "events": []}
+    names = [ex["silo"] for ex in exports]
+    if not reference or reference not in names:
+        reference = names[0]
+    offsets = _resolve_offsets(exports, reference)
+    events: List[Dict[str, Any]] = []
+    silos: Dict[str, Any] = {}
+    for ex in exports:
+        name = ex["silo"]
+        est = offsets.get(name)
+        silos[name] = {
+            "offset_to_reference_s": None if est is None
+            else est["offset_s"],
+            "offset_error_bound_s": None if est is None else est["rtt_s"],
+            "offset_hops": None if est is None else est["hops"],
+            "appended": ex.get("appended", 0),
+            "dropped": ex.get("dropped", 0),
+            "events": len(ex.get("events") or []),
+        }
+        off = 0.0 if est is None else est["offset_s"]
+        for ev in ex.get("events") or []:
+            rec = dict(ev)
+            rec["silo"] = name
+            rec["ts"] = round(float(ev.get("start", 0.0)) + off, 6)
+            if est is None:
+                rec["unsynced"] = True
+            events.append(rec)
+    events.sort(key=lambda e: e["ts"])
+    t0 = events[0]["ts"] if events else 0.0
+    for ev in events:
+        ev["ts"] = round(ev["ts"] - t0, 6)
+    return {
+        "reference": reference,
+        "t0_reference_monotonic": round(t0, 6),
+        "silos": silos,
+        "unsynced_silos": sorted(
+            n for n, e in offsets.items() if e is None),
+        "events": events,
+    }
+
+
+# ---- Chrome trace-event (Perfetto) export ---------------------------------
+
+def _track_of(kind: str) -> str:
+    """The thread-track a span renders on inside its silo lane: device
+    planes get their own track (``plane.checkpoint`` → ``checkpoint``);
+    hop spans group by kind family (``rpc.window.link`` → ``rpc``)."""
+    if kind.startswith("plane."):
+        return kind.split(".", 1)[1]
+    return kind.split(".", 1)[0] or "spans"
+
+
+def to_chrome_trace(merged: Dict[str, Any]) -> Dict[str, Any]:
+    """Render a :func:`merge_timelines` result as Chrome trace-event
+    JSON: one process (pid) per silo lane, one thread (tid) per plane
+    track, ``X`` complete events for spans, ``i`` instants for
+    lifecycle marks, ``C`` counter series for interval metric deltas.
+    Loadable directly in Perfetto (ui.perfetto.dev) or
+    ``chrome://tracing``."""
+    trace_events: List[Dict[str, Any]] = []
+    pids: Dict[str, int] = {}
+    tids: Dict[Tuple[str, str], int] = {}
+
+    def pid_of(silo: str) -> int:
+        pid = pids.get(silo)
+        if pid is None:
+            pid = pids[silo] = len(pids) + 1
+            trace_events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"silo {silo}"}})
+        return pid
+
+    def tid_of(silo: str, track: str) -> int:
+        key = (silo, track)
+        tid = tids.get(key)
+        if tid is None:
+            tid = tids[key] = \
+                sum(1 for s, _ in tids if s == silo) + 1
+            trace_events.append({
+                "name": "thread_name", "ph": "M",
+                "pid": pid_of(silo), "tid": tid,
+                "args": {"name": track}})
+        return tid
+
+    for ev in merged.get("events", []):
+        silo = ev.get("silo", "?")
+        ts_us = float(ev.get("ts", 0.0)) * 1e6
+        kind = ev.get("kind")
+        if kind == "lifecycle":
+            trace_events.append({
+                "name": ev.get("event", "lifecycle"), "ph": "i",
+                "s": "p", "ts": ts_us, "pid": pid_of(silo),
+                "tid": tid_of(silo, "lifecycle"),
+                "args": dict(ev.get("attrs") or {})})
+        elif kind == "metrics":
+            delta = ev.get("delta") or {}
+            if delta:
+                trace_events.append({
+                    "name": "interval_delta", "ph": "C", "ts": ts_us,
+                    "pid": pid_of(silo),
+                    "tid": tid_of(silo, "metrics"),
+                    "args": {k: float(v) for k, v in delta.items()}})
+        else:
+            # span record: TimelineRecorder.record_span flattens
+            # Span.to_dict(), so ``kind`` IS the span's kind
+            # (``rpc.window.link``, ``plane.checkpoint``, …)
+            span_kind = str(kind or "span")
+            args = {"status": ev.get("status", "ok"),
+                    **(ev.get("attrs") or {})}
+            if ev.get("trace_id"):
+                args["trace_id"] = ev["trace_id"]
+                args["span_id"] = ev.get("span_id")
+                if ev.get("parent_id"):
+                    args["parent_id"] = ev["parent_id"]
+            dur_us = max(float(ev.get("duration_s", 0.0)) * 1e6, 1.0)
+            trace_events.append({
+                "name": ev.get("name", "span"), "ph": "X",
+                "ts": ts_us, "dur": dur_us, "pid": pid_of(silo),
+                "tid": tid_of(silo, _track_of(span_kind)),
+                "cat": span_kind, "args": args})
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms",
+            "otherData": {"reference": merged.get("reference", ""),
+                          "unsynced_silos":
+                          merged.get("unsynced_silos", [])}}
+
+
+# ---- artifacts ------------------------------------------------------------
+
+def write_artifacts(merged: Dict[str, Any], out_dir: str,
+                    prefix: str = "TIMELINE") -> Dict[str, str]:
+    """Write ``<prefix>.json`` (merged stream + clock table) and
+    ``<prefix>.perfetto.json`` (Chrome trace-event export) into
+    ``out_dir``; returns both paths."""
+    os.makedirs(out_dir, exist_ok=True)
+    timeline_path = os.path.join(out_dir, f"{prefix}.json")
+    perfetto_path = os.path.join(out_dir, f"{prefix}.perfetto.json")
+    with open(timeline_path, "w") as f:
+        json.dump(merged, f, indent=1)
+        f.write("\n")
+    with open(perfetto_path, "w") as f:
+        json.dump(to_chrome_trace(merged), f)
+        f.write("\n")
+    return {"timeline": timeline_path, "perfetto": perfetto_path}
+
+
+def load_exports(paths_or_dir: Any) -> List[Dict[str, Any]]:
+    """Load per-silo export payloads: a directory (every
+    ``timeline_*.json`` inside), or an explicit list of file paths."""
+    if isinstance(paths_or_dir, str):
+        if os.path.isdir(paths_or_dir):
+            paths = sorted(
+                os.path.join(paths_or_dir, n)
+                for n in os.listdir(paths_or_dir)
+                if n.startswith("timeline_") and n.endswith(".json"))
+        else:
+            paths = [paths_or_dir]
+    else:
+        paths = list(paths_or_dir)
+    exports = []
+    for p in paths:
+        with open(p) as f:
+            exports.append(json.load(f))
+    return exports
+
+
+# ---- trace journey reconstruction -----------------------------------------
+
+def trace_journey(merged: Dict[str, Any], trace_id: Any
+                  ) -> List[Dict[str, Any]]:
+    """Every merged span belonging to ``trace_id``, time-ordered on the
+    common clock — the hop-by-hop journey of one sampled call (client
+    rpc → gateway frame → window turn with its coalesce wait →
+    cross-silo forward → remote turn).  Per-hop wall time is each hop's
+    own ``duration_s``; inter-hop gaps read directly off ``ts``."""
+    hops = [ev for ev in merged.get("events", [])
+            if ev.get("trace_id") == trace_id]
+    hops.sort(key=lambda e: e["ts"])
+    return hops
+
+
+# ---- CLI ------------------------------------------------------------------
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m orleans_tpu.timeline",
+        description="Merge per-silo timeline exports into TIMELINE.json "
+                    "+ a Perfetto-loadable Chrome trace.")
+    ap.add_argument("inputs", nargs="+",
+                    help="timeline_<silo>.json files, or one directory "
+                         "containing them")
+    ap.add_argument("--out", default=".",
+                    help="output directory (default: cwd)")
+    ap.add_argument("--reference", default="",
+                    help="silo whose clock anchors the merge "
+                         "(default: first export)")
+    ap.add_argument("--trace", default="",
+                    help="print the hop journey of one trace id")
+    args = ap.parse_args(argv)
+    if len(args.inputs) == 1:
+        exports = load_exports(args.inputs[0])
+    else:
+        exports = load_exports(args.inputs)
+    if not exports:
+        print("no timeline exports found")
+        return 1
+    merged = merge_timelines(exports, reference=args.reference)
+    paths = write_artifacts(merged, args.out)
+    print(f"merged {len(exports)} silo timelines "
+          f"({len(merged['events'])} events, reference "
+          f"{merged['reference']!r}) -> {paths['timeline']}, "
+          f"{paths['perfetto']}")
+    if merged.get("unsynced_silos"):
+        print(f"WARNING: no clock estimate for "
+              f"{merged['unsynced_silos']} (kept on own clock)")
+    if args.trace:
+        tid = int(args.trace) if args.trace.isdigit() else args.trace
+        for hop in trace_journey(merged, tid):
+            print(f"  {hop['ts']:>10.6f}s  {hop['silo']:<12} "
+                  f"{hop.get('name', '?'):<32} "
+                  f"{hop.get('duration_s', 0.0):.6f}s")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(_main())

@@ -1,0 +1,583 @@
+"""Cluster timeline plane (orleans_tpu/timeline.py + spans.TimelineRecorder
++ the rpc trace column): trace continuity through the batched fastpath,
+clock-offset merge onto one reference, the Perfetto export, incident
+bundles, and the no-data sentinel discipline.
+
+Covers the PR's claims: a sampled call RIDES the coalesced fastpath (no
+Heisenberg fallback — ``rpc.fastpath_fallbacks`` is unmoved by sampling
+and replies stay bit-exact), one trace id survives client → TCP gateway
+frame → silo window → cross-silo forward → reply, per-silo timelines
+merge onto a common clock via the probe-piggybacked offset estimates,
+and an empty/unprobed lane reads as NO DATA, never as healthy.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import orleans_tpu.codec as codec_mod
+from orleans_tpu.client import GrainClient
+from orleans_tpu.codec import default_manager as codec
+from orleans_tpu.config import SiloConfig
+from orleans_tpu.core.reference import bind_runtime
+from orleans_tpu.spans import SpanRecorder, TimelineRecorder
+from orleans_tpu.testing.cluster import TestingCluster
+from orleans_tpu.timeline import (
+    load_exports,
+    merge_timelines,
+    to_chrome_trace,
+    trace_journey,
+    write_artifacts,
+)
+
+from samples.helloworld import IHello
+
+pytestmark = pytest.mark.tracing
+
+
+# ===========================================================================
+# rpc trace column: codec round-trip
+# ===========================================================================
+
+def test_trace_column_roundtrip():
+    """The per-lane trace column round-trips through the calls frame:
+    63-bit id + sampled bit, 0 = untraced lane; columns absent when the
+    encoder is given none (zero wire cost for the unsampled majority)."""
+    t = {"trace_id": (1 << 62) + 12345, "span_id": "", "sampled": True}
+    word = codec_mod.pack_rpc_trace(t)
+    assert word & codec_mod.RPC_TRACE_SAMPLED_BIT
+    back = codec_mod.unpack_rpc_trace(word, 0)
+    assert back == {"trace_id": t["trace_id"], "span_id": "",
+                    "sampled": True}
+    # unsampled context still carries its id (failure reconstruction)
+    word = codec_mod.pack_rpc_trace({"trace_id": 77, "sampled": False})
+    assert not (word & codec_mod.RPC_TRACE_SAMPLED_BIT)
+    assert codec_mod.unpack_rpc_trace(word, 0)["sampled"] is False
+    # untraced lane
+    assert codec_mod.pack_rpc_trace(None) == 0
+    assert codec_mod.unpack_rpc_trace(0, 0) is None
+
+    keys = np.array([5, 6, 7], dtype=np.uint64)
+    trace_ids = np.array(
+        [codec_mod.pack_rpc_trace(t), 0,
+         codec_mod.pack_rpc_trace({"trace_id": 9, "sampled": True})],
+        dtype=np.uint64)
+    span_ids = np.zeros(3, dtype=np.uint64)
+    segments = codec_mod.encode_rpc_calls(
+        codec, rpc_id=1, batch_id=2, keys=keys, ttls=None,
+        args_list=None, common_args=("x",),
+        trace_ids=trace_ids, span_ids=span_ids)
+    frame = codec_mod.decode_rpc_frame(
+        codec, b"".join(bytes(memoryview(s).cast("B")) for s in segments))
+    assert np.array_equal(frame.trace_ids, trace_ids)
+    assert np.array_equal(frame.span_ids, span_ids)
+    lane0 = codec_mod.unpack_rpc_trace(int(frame.trace_ids[0]),
+                                       int(frame.span_ids[0]))
+    assert lane0["trace_id"] == t["trace_id"] and lane0["sampled"]
+    assert codec_mod.unpack_rpc_trace(int(frame.trace_ids[1]), 0) is None
+
+    # no trace columns given → none on the wire, decode yields None
+    segments = codec_mod.encode_rpc_calls(
+        codec, rpc_id=1, batch_id=3, keys=keys, ttls=None,
+        args_list=None, common_args=("x",))
+    frame = codec_mod.decode_rpc_frame(
+        codec, b"".join(bytes(memoryview(s).cast("B")) for s in segments))
+    assert frame.trace_ids is None and frame.span_ids is None
+
+
+# ===========================================================================
+# TimelineRecorder: ring bound, appenders, clock-offset discipline
+# ===========================================================================
+
+def test_timeline_recorder_ring_and_appenders():
+    tl = TimelineRecorder("s1", capacity=4)
+    rec = SpanRecorder("s1", sample_rate=1.0, seed=3)
+    rec.timeline = tl
+    for i in range(6):
+        rec.finish(rec.start(f"hop{i}", "client.send", rec.begin_trace()))
+    assert len(tl.events) == 4 and tl.dropped == 2 and tl.appended == 6
+    tl.lifecycle("join", address="a:1")
+    tl.metrics_delta({"turns": 3.0})
+    tl.metrics_delta({})  # empty delta appends nothing
+    kinds = [e["kind"] for e in tl.events]
+    assert kinds[-2:] == ["lifecycle", "metrics"]
+    assert tl.tail(2)[0]["event"] == "join"
+    ex = tl.export()
+    assert ex["silo"] == "s1" and len(ex["events"]) == 4
+    assert json.loads(json.dumps(ex))  # JSON-safe handoff payload
+
+    off = TimelineRecorder("s2", enabled=False)
+    off.lifecycle("join")
+    rec2 = SpanRecorder("s2", sample_rate=1.0, seed=3)
+    rec2.timeline = off
+    rec2.finish(rec2.start("h", "client.send", rec2.begin_trace()))
+    assert len(off.events) == 0  # disabled appends nothing
+    assert rec2.recorded == 1    # ...but the flight ring still records
+
+
+def test_clock_offset_lowest_rtt_wins_and_sentinel():
+    tl = TimelineRecorder("s1")
+    # SENTINEL: unprobed reads -1, never 0 ("perfectly synced")
+    assert tl.worst_clock_offset_s() == -1.0
+    tl.note_clock_offset("peer", 1.25, rtt_s=0.010)
+    assert tl.worst_clock_offset_s() == 1.25
+    # a much-worse-RTT sample must NOT displace the tight estimate
+    tl.note_clock_offset("peer", 5.0, rtt_s=1.0)
+    assert tl.clock_offsets["peer"]["offset_s"] == 1.25
+    # a comparable-RTT sample refreshes (slow decay: <= 1.5x)
+    tl.note_clock_offset("peer", 1.30, rtt_s=0.012)
+    assert tl.clock_offsets["peer"]["offset_s"] == 1.30
+    assert tl.snapshot()["peers_probed"] == 1
+
+
+# ===========================================================================
+# merge: offset composition along the probe graph
+# ===========================================================================
+
+def _export(silo, events, clock_offsets=None):
+    return {"silo": silo, "exported_at": 0.0, "appended": len(events),
+            "dropped": 0, "clock_offsets": clock_offsets or {},
+            "events": events}
+
+
+def _span(name, start, duration=0.01, kind="client.rpc", trace_id=0):
+    return {"kind": kind, "trace_id": trace_id or "", "span_id": 1,
+            "parent_id": None, "name": name, "silo": "", "sampled": True,
+            "start": start, "duration_s": duration, "status": "ok",
+            "attrs": {}}
+
+
+def test_merge_composes_offsets_across_probe_graph():
+    """Three silos with chained probe estimates: B probed A, C probed
+    B — C's offset to A composes along the path.  One simultaneous
+    real-world instant (A=100, B=105, C=108 on their own clocks) must
+    land at ONE merged ts; a silo outside the probe graph stays on its
+    own clock, flagged unsynced."""
+    a = _export("A", [_span("ea", 100.0)])
+    # B's monotonic runs 5s ahead of A's: offset(A rel B) = A−B = −5
+    b = _export("B", [_span("eb", 105.0)],
+                {"A": {"offset_s": -5.0, "rtt_s": 0.001, "at": 0.0}})
+    # C runs 3s ahead of B: offset(B rel C) = B−C = −3
+    c = _export("C", [_span("ec", 108.0)],
+                {"B": {"offset_s": -3.0, "rtt_s": 0.002, "at": 0.0}})
+    d = _export("D", [_span("ed", 42.0)])  # never probed, no edges
+    merged = merge_timelines([a, b, c, d], reference="A")
+    assert merged["reference"] == "A"
+    assert merged["silos"]["B"]["offset_to_reference_s"] == -5.0
+    assert merged["silos"]["C"]["offset_to_reference_s"] == -8.0
+    assert merged["silos"]["C"]["offset_hops"] == 2
+    assert merged["unsynced_silos"] == ["D"]
+    ts = {e["silo"]: e["ts"] for e in merged["events"]}
+    # the three synced events collapse onto one instant
+    assert ts["A"] == ts["B"] == ts["C"]
+    unsynced = [e for e in merged["events"] if e["silo"] == "D"]
+    assert unsynced and unsynced[0].get("unsynced") is True
+
+
+def test_chrome_trace_export_lanes_and_tracks():
+    """Perfetto export: one process per silo lane, one thread per plane
+    track, X events for spans, instants for lifecycle, counters for
+    metric deltas."""
+    ev = [
+        _span("pin full", 10.0, kind="plane.checkpoint"),
+        _span("window turn say_hello", 10.1, kind="rpc.window.link",
+              trace_id=777),
+        {"kind": "lifecycle", "event": "join", "silo": "A",
+         "start": 9.0, "attrs": {"address": "a:1"}},
+        {"kind": "metrics", "start": 10.5, "delta": {"turns": 4.0}},
+    ]
+    merged = merge_timelines([_export("A", ev)])
+    chrome = to_chrome_trace(merged)
+    evs = chrome["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"process_name", "thread_name", "join",
+            "interval_delta"} <= names
+    lanes = {e["args"]["name"] for e in evs if e["name"] == "process_name"}
+    assert lanes == {"silo A"}
+    tracks = {e["args"]["name"] for e in evs if e["name"] == "thread_name"}
+    # the checkpoint PLANE gets its own track; hop spans group by family
+    assert {"checkpoint", "rpc", "lifecycle", "metrics"} <= tracks
+    x = [e for e in evs if e["ph"] == "X" and e["name"].startswith(
+        "window turn")]
+    assert x and x[0]["args"]["trace_id"] == 777
+    assert x[0]["dur"] >= 1.0  # µs, floored so Perfetto renders it
+
+
+def test_timeline_cli_merges_files(tmp_path):
+    from orleans_tpu.timeline import _main
+    for name, start in (("s1", 50.0), ("s2", 53.0)):
+        ex = _export(name, [_span("e", start)],
+                     {"s1": {"offset_s": -3.0, "rtt_s": 0.001, "at": 0.0}}
+                     if name == "s2" else None)
+        (tmp_path / f"timeline_{name}.json").write_text(json.dumps(ex))
+    out = tmp_path / "out"
+    assert _main([str(tmp_path), "--out", str(out),
+                  "--reference", "s1"]) == 0
+    merged = json.loads((out / "TIMELINE.json").read_text())
+    assert merged["reference"] == "s1"
+    assert merged["silos"]["s2"]["offset_to_reference_s"] == -3.0
+    chrome = json.loads((out / "TIMELINE.perfetto.json").read_text())
+    assert chrome["traceEvents"]
+
+
+# ===========================================================================
+# fastpath × sampling: the Heisenberg regression
+# ===========================================================================
+
+def test_sampling_does_not_cause_fastpath_fallbacks(run):
+    """REGRESSION: a sampled call must RIDE the batched fastpath (trace
+    column), not fall back to the per-message pipeline — tracing that
+    changes the code path under observation is a Heisenberg.  With
+    sampling at 100%: zero new fallbacks, every call a fastpath hit,
+    and replies bit-exact with an unsampled client."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=1, transport="tcp").start()
+        try:
+            silo = cluster.silos[0]
+            gw = (silo.address.host, silo.gateway_port)
+            traced = await GrainClient(trace_sample_rate=1.0).connect(gw)
+            plain = await GrainClient(trace_sample_rate=0.0).connect(gw)
+            try:
+                refs_t = [traced.get_grain(IHello, 61000 + i)
+                          for i in range(8)]
+                refs_p = [plain.get_grain(IHello, 61000 + i)
+                          for i in range(8)]
+                # reference calls route through the AMBIENT runtime
+                # (core/reference.py current_runtime) and connect() binds
+                # last-one-wins — pin the right client around each round
+                # warm: activations + invoke tables + rpc dictionary
+                bind_runtime(traced)
+                await asyncio.gather(*(r.say_hello("w") for r in refs_t))
+                bind_runtime(plain)
+                await asyncio.gather(*(r.say_hello("w") for r in refs_p))
+                before = silo.rpc.snapshot()
+                bind_runtime(traced)
+                got_t = await asyncio.gather(
+                    *(r.say_hello(f"m{i % 3}")
+                      for i, r in enumerate(refs_t)))
+                bind_runtime(plain)
+                got_p = await asyncio.gather(
+                    *(r.say_hello(f"m{i % 3}")
+                      for i, r in enumerate(refs_p)))
+                after = silo.rpc.snapshot()
+                # bit-exact A/B: tracing on vs off
+                assert got_t == got_p
+                # sampling caused ZERO fallbacks and all 16 rode the path
+                assert after["fastpath_fallbacks"] \
+                    == before["fastpath_fallbacks"]
+                assert after["fastpath_hits"] \
+                    >= before["fastpath_hits"] + 16
+                # ...and the sampled calls left their window-link spans
+                kinds = {s.kind for s in silo.spans.flight.spans}
+                assert "rpc.window.link" in kinds
+                assert "gateway.rpc" in kinds
+            finally:
+                await traced.close()
+                await plain.close()
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+# ===========================================================================
+# cross-silo continuity + in-process timeline collection
+# ===========================================================================
+
+async def _key_on_other_silo(cluster, client, start: int) -> int:
+    """A key whose grain activates on silos[1] while the client talks to
+    silos[0]'s gateway — the cross-silo forward path."""
+    for key in range(start, start + 64):
+        ref = client.get_grain(IHello, key)
+        await ref.say_hello("probe")
+        if cluster.find_silo_hosting(ref.grain_id) is cluster.silos[1]:
+            return key
+    raise AssertionError("no key hashed to silos[1] in 64 tries")
+
+
+def test_cross_silo_trace_journey_in_merged_timeline(run, tmp_path):
+    """One sampled call: client → TCP gateway frame on silo0 → coalesced
+    window → cross-silo forward → turn on silo1.  ONE trace id appears
+    in BOTH silos' timeline lanes, the merged journey is hop-ordered on
+    the common clock, and the artifacts write out Perfetto-loadable."""
+
+    async def main():
+        def cfg(name):
+            c = SiloConfig(name=name)
+            c.tracing.sample_rate = 1.0
+            return c
+
+        cluster = await TestingCluster(n_silos=2, transport="tcp",
+                                       config_factory=cfg).start()
+        client = None
+        try:
+            silo0 = cluster.silos[0]
+            client = await GrainClient(trace_sample_rate=1.0).connect(
+                (silo0.address.host, silo0.gateway_port))
+            key = await _key_on_other_silo(cluster, client, 62000)
+            got = await client.get_grain(IHello, key).say_hello("xyz")
+            assert got == "You said: 'xyz', I say: Hello!"
+
+            merged = cluster.collect_timeline(out_dir=str(tmp_path))
+            # a trace id present in BOTH lanes (the forwarded call)
+            by_trace = {}
+            for ev in merged["events"]:
+                if ev.get("trace_id"):
+                    by_trace.setdefault(ev["trace_id"],
+                                        set()).add(ev["silo"])
+            crossed = [t for t, silos in by_trace.items()
+                       if len(silos) == 2]
+            assert crossed, "no trace spanned both silos"
+            journey = trace_journey(merged, crossed[0])
+            assert len(journey) >= 2
+            kinds = {h["kind"] for h in journey}
+            # the sending silo's batched hops + the remote turn
+            assert kinds & {"gateway.rpc", "rpc.window.link"}
+            assert "activation.turn" in kinds
+            assert journey == sorted(journey, key=lambda h: h["ts"])
+            # every silo joined the timeline (lifecycle lane)
+            joins = {e["silo"] for e in merged["events"]
+                     if e.get("kind") == "lifecycle"
+                     and e.get("event") == "join"}
+            assert joins == {s.name for s in cluster.silos}
+            # artifacts on disk, Perfetto-parseable
+            timeline = json.loads(
+                (tmp_path / "TIMELINE.json").read_text())
+            assert timeline["events"]
+            chrome = json.loads(
+                (tmp_path / "TIMELINE.perfetto.json").read_text())
+            assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+        finally:
+            if client is not None:
+                await client.close()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_clock_probe_feeds_offsets(run):
+    """The membership probe loop piggybacks the clock handshake: after a
+    few probe periods every silo holds an offset estimate for its peer
+    (≈0 in-process — one monotonic clock) and the sentinel clears."""
+
+    async def main():
+        cluster = await TestingCluster(n_silos=2).start()
+        try:
+            for _ in range(100):
+                if all(s.spans.timeline.clock_offsets
+                       for s in cluster.silos):
+                    break
+                await asyncio.sleep(0.05)
+            for s in cluster.silos:
+                tl = s.spans.timeline
+                assert tl.clock_offsets, f"{s.name}: no clock estimate"
+                worst = tl.worst_clock_offset_s()
+                assert worst != -1.0
+                assert worst < 0.5  # shared clock: offset ≈ 0
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+# ===========================================================================
+# multi-process proof: per-process timeline files → one merged artifact
+# ===========================================================================
+
+def _spawn(args, **kw):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-m", "orleans_tpu.runtime.rpc", *args],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, env=env, cwd=repo, **kw)
+
+
+def _read_banner(server, what: str):
+    import selectors
+    sel = selectors.DefaultSelector()
+    sel.register(server.stdout, selectors.EVENT_READ)
+    ready = sel.select(timeout=120)
+    sel.close()
+    if not ready:
+        server.kill()
+        raise AssertionError(f"{what} produced no banner in 120s")
+    line = server.stdout.readline()
+    if not line:
+        err = server.stderr.read().decode(errors="replace")[-2000:]
+        if server.poll() is not None:
+            pytest.skip(f"{what} process could not start "
+                        f"(sandboxed environment?): {err}")
+        raise AssertionError(f"no {what} banner: {err}")
+    return json.loads(line)
+
+
+def test_multiprocess_merged_timeline(tmp_path):
+    """The PR's acceptance artifact: two REAL silo processes (clustered
+    over a TCP table-service, separate monotonic clocks), a driver
+    process at 100% sampling, each server dropping its timeline export
+    on shutdown — then ONE merge puts both lanes on silo A's clock via
+    the probe-piggybacked offsets and writes the Perfetto-loadable
+    trace with a cross-process trace journey in it."""
+    if not os.path.exists(sys.executable):
+        pytest.skip("no python executable for subprocess workers")
+    tl_dir = str(tmp_path / "timelines")
+    servers = []
+    try:
+        a = _spawn(["serve", "--name", "tl-a", "--host-table-service",
+                    "--trace-sample-rate", "1.0",
+                    "--timeline-dir", tl_dir])
+        servers.append(a)
+        banner_a = _read_banner(a, "silo tl-a")
+        assert banner_a.get("ok") and banner_a["table_service_port"] > 0
+        b = _spawn(["serve", "--name", "tl-b", "--table-service",
+                    f"127.0.0.1:{banner_a['table_service_port']}",
+                    "--trace-sample-rate", "1.0",
+                    "--timeline-dir", tl_dir])
+        servers.append(b)
+        banner_b = _read_banner(b, "silo tl-b")
+        assert banner_b.get("ok")
+
+        driver = _spawn(["drive", "--gateways",
+                         f"127.0.0.1:{banner_a['gateway_port']}",
+                         "--grains", "48", "--rounds", "2",
+                         "--key-base", "63000",
+                         "--trace-sample-rate", "1.0"])
+        try:
+            out, err = driver.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            driver.kill()
+            raise
+        assert driver.returncode == 0, err.decode(errors="replace")[-2000:]
+        result = json.loads(out.splitlines()[-1])
+        assert result["ok"] and result["exact"]
+    finally:
+        for server in servers:
+            if server.poll() is None:
+                server.stdin.close()  # EOF → export timeline + shut down
+        for server in servers:
+            if server.poll() is None:
+                try:
+                    server.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    server.kill()
+
+    exports = load_exports(tl_dir)
+    assert {e["silo"] for e in exports} == {"tl-a", "tl-b"}
+    merged = merge_timelines(exports, reference="tl-a")
+    # the probe-piggybacked clock handshake synced BOTH process clocks
+    assert merged["unsynced_silos"] == []
+    assert merged["silos"]["tl-b"]["offset_hops"] >= 1
+    # a sampled call forwarded A→B left the SAME trace id in both lanes
+    by_trace = {}
+    for ev in merged["events"]:
+        if ev.get("trace_id"):
+            by_trace.setdefault(ev["trace_id"], set()).add(ev["silo"])
+    crossed = [t for t, silos in by_trace.items() if len(silos) == 2]
+    assert crossed, "no trace crossed the process boundary"
+    journey = trace_journey(merged, crossed[0])
+    assert len(journey) >= 2
+    assert journey == sorted(journey, key=lambda h: h["ts"])
+    assert {h["silo"] for h in journey} == {"tl-a", "tl-b"}
+    # one Perfetto-loadable artifact for the whole run
+    write_artifacts(merged, str(tmp_path))
+    chrome = json.loads((tmp_path / "TIMELINE.perfetto.json").read_text())
+    lanes = {e["args"]["name"] for e in chrome["traceEvents"]
+             if e["name"] == "process_name"}
+    assert lanes == {"silo tl-a", "silo tl-b"}
+    assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+
+
+# ===========================================================================
+# incident bundles
+# ===========================================================================
+
+def test_incident_bundle_shape_and_watchdog_edge_trigger(run):
+    async def main():
+        cluster = await TestingCluster(n_silos=1).start()
+        try:
+            silo = cluster.silos[0]
+            bundle = silo.incident_bundle("test trip")
+            assert set(bundle) >= {"reason", "silo", "at",
+                                   "flight_recorder", "compile_events",
+                                   "dead_letters", "timeline_tail"}
+            assert bundle["reason"] == "test trip"
+            assert bundle["flight_recorder"]["reason"] == "test trip"
+            assert list(silo.incidents)[-1] is bundle
+            # the trip lands on the timeline as a lifecycle mark
+            marks = [e for e in silo.spans.timeline.events
+                     if e.get("kind") == "lifecycle"
+                     and e.get("event") == "incident"]
+            assert marks and marks[-1]["attrs"]["reason"] == "test trip"
+
+            # watchdog health trip: edge-triggered — first failing round
+            # dumps ONE bundle, a participant that STAYS unhealthy must
+            # not flood the ring every period
+            from orleans_tpu.runtime.watchdog import Watchdog
+
+            class Bad:
+                def check_health(self):
+                    return False
+
+            wd = Watchdog(silo, period=60.0)
+            wd.register(Bad())
+            n0 = len(silo.incidents)
+            assert wd.check_participants() == 1
+            assert len(silo.incidents) == n0 + 1
+            assert wd.check_participants() == 1
+            assert len(silo.incidents) == n0 + 1  # no re-dump
+            assert "watchdog" in list(silo.incidents)[-1]["reason"]
+        finally:
+            await cluster.stop()
+
+    run(main())
+
+
+# ===========================================================================
+# sentinel tripwire: an empty lane never reads healthy
+# ===========================================================================
+
+def test_empty_timeline_lane_never_reads_healthy(run):
+    """SENTINEL AUDIT (satellite): the dashboard's tracing row reads
+    these exact values — a fresh silo that has probed nobody must gauge
+    ``trace.worst_clock_offset_s`` at -1 (no data), not 0 (perfect
+    sync); a timeline-disabled silo must read enabled=False with an
+    empty backlog, not a healthy zero-backlog lane."""
+
+    async def main():
+        def cfg(name):
+            c = SiloConfig(name=name)
+            c.liveness.probe_period = 3600.0  # nobody probes: no data
+            return c
+
+        cluster = await TestingCluster(n_silos=1,
+                                       config_factory=cfg).start()
+        try:
+            silo = cluster.silos[0]
+            snap = silo.collect_metrics()
+            # gauge values are keyed label → source; every leaf must
+            # read the -1 NO-DATA sentinel, never a healthy-looking 0
+            leaves = [v
+                      for src in snap["gauges"][
+                          "trace.worst_clock_offset_s"].values()
+                      for v in src.values()]
+            assert leaves == [-1.0]
+            # live-disable the timeline: the snapshot must SAY disabled
+            silo.update_config({"tracing": {"timeline_enabled": False}})
+            tls = silo.spans.snapshot()["timeline"]
+            assert tls["enabled"] is False
+            silo.spans.timeline.lifecycle("ghost")  # disabled: no append
+            assert not any(e.get("event") == "ghost"
+                           for e in silo.spans.timeline.events)
+        finally:
+            await cluster.stop()
+
+    run(main())

@@ -316,8 +316,11 @@ def test_request_context_survives_client_gateway_cross_silo_resend(run):
 @pytest.mark.tracing
 def test_cross_silo_trace_spans_reach_both_silos(run):
     """A sampled request through the cluster leaves spans on both the
-    sending and executing silo under ONE trace id, including the turn
-    and queue-wait hops."""
+    sending and executing silo under ONE trace id.  The sampled call
+    RIDES the batched fastpath (it no longer falls back): the sending
+    silo records its window-link hop, and the remote-activation
+    fallback carries the same trace across silos to the turn and
+    queue-wait hops."""
 
     async def main():
         def cfg(name):
@@ -338,7 +341,7 @@ def test_cross_silo_trace_spans_reach_both_silos(run):
                       if s.trace_id == tid}
             kinds1 = {s.kind for s in cluster.silos[1].spans.flight.spans
                       if s.trace_id == tid}
-            assert "client.send" in kinds0
+            assert "rpc.window.link" in kinds0
             assert "activation.turn" in kinds1
             assert "dispatch.queue" in kinds1
         finally:
