@@ -3519,21 +3519,24 @@ async def _rpc_proc(args: list, stdin_pipe: bool = False):
         env=env, cwd=here)
 
 
-async def _rpc_multiprocess(smoke: bool) -> dict:
-    """The real multi-process proof: silo SERVER processes (clustered
-    through a TCP table-service — no shared memory, no shared disk) and
-    external client DRIVER processes dialing the gateways over TCP.
-    Exactness is asserted inside every driver (same oracle as the
-    in-process tiers: the reply string is a pure function of the
-    greeting).  No jax.distributed anywhere — the control plane is
-    plain sockets."""
+async def _rpc_multiprocess_arm(smoke: bool, grains: int, rounds: int,
+                                extra_serve: list,
+                                latency_probes: int,
+                                inflight: int = 1) -> dict:
+    """One full bring-up → drive → teardown of the multi-process
+    topology: silo SERVER processes clustered through a TCP
+    table-service (no shared memory, no shared disk), external client
+    DRIVER processes dialing the gateways over TCP.  In the 2-silo
+    shape each driver pins to ONE gateway while its grains hash across
+    BOTH silos, so ~half of every driver's calls are forwarded
+    silo→silo — the segment the fabric coalesces."""
     import json as _json
 
-    grains, rounds = (64, 3) if smoke else (300, 6)
     servers = []
     try:
         first = await _rpc_proc(
-            ["serve", "--name", "mp1", "--host-table-service"],
+            ["serve", "--name", "mp1", "--host-table-service",
+             *extra_serve],
             stdin_pipe=True)
         servers.append(first)
         banner_line = await asyncio.wait_for(first.stdout.readline(),
@@ -3548,7 +3551,8 @@ async def _rpc_multiprocess(smoke: bool) -> dict:
         if not smoke:
             second = await _rpc_proc(
                 ["serve", "--name", "mp2", "--table-service",
-                 f"127.0.0.1:{banner1['table_service_port']}"],
+                 f"127.0.0.1:{banner1['table_service_port']}",
+                 *extra_serve],
                 stdin_pipe=True)
             servers.append(second)
             banner2 = _json.loads(await asyncio.wait_for(
@@ -3560,7 +3564,9 @@ async def _rpc_multiprocess(smoke: bool) -> dict:
             proc = await _rpc_proc(
                 ["drive", "--gateways", gw, "--grains", str(grains),
                  "--rounds", str(rounds),
-                 "--key-base", str(60_000 + 10_000 * i)])
+                 "--key-base", str(60_000 + 10_000 * i),
+                 "--latency-probes", str(latency_probes),
+                 "--inflight", str(inflight)])
             out, err = await asyncio.wait_for(proc.communicate(),
                                               timeout=300)
             if proc.returncode != 0:
@@ -3571,23 +3577,37 @@ async def _rpc_multiprocess(smoke: bool) -> dict:
 
         results = await asyncio.gather(
             *(drive(i, gw) for i, gw in enumerate(gateways)))
+        # graceful teardown WITH stats harvest: stdin EOF makes each
+        # server print one final JSON line (fabric frame counters +
+        # forward counts) before exiting
+        finals = []
+        for proc in servers:
+            proc.stdin.close()
+            try:
+                line = await asyncio.wait_for(proc.stdout.readline(),
+                                              timeout=15)
+                if line:
+                    finals.append(_json.loads(line))
+            except (asyncio.TimeoutError, ValueError):
+                pass
+        p50s = [r["single_call_p50_s"] for r in results
+                if r.get("single_call_p50_s")]
         return {
             "silo_processes": n_silos,
             "client_processes": len(results),
-            "table_service": "TCP (no shared memory/disk between "
-                             "processes)" if not smoke
-                             else "single-silo smoke (one server, one "
-                                  "driver process)",
             "exact": bool(all(r["exact"] for r in results)),
             "calls": sum(r["calls"] for r in results),
             "aggregate_rpc_per_sec": round(
                 sum(r["rpc_per_sec"] for r in results), 1),
             "per_driver_rpc_per_sec": [round(r["rpc_per_sec"], 1)
                                        for r in results],
+            # worst driver's p50 — the latency gate compares worst-case
+            "single_call_p50_s": (round(max(p50s), 7) if p50s else None),
+            "silo_stats": finals,
         }
     finally:
         for proc in servers:
-            if proc.returncode is None:
+            if proc.returncode is None and not proc.stdin.is_closing():
                 proc.stdin.close()  # EOF → graceful server exit
         for proc in servers:
             if proc.returncode is None:
@@ -3595,6 +3615,64 @@ async def _rpc_multiprocess(smoke: bool) -> dict:
                     await asyncio.wait_for(proc.wait(), timeout=15)
                 except asyncio.TimeoutError:
                     proc.kill()
+
+
+async def _rpc_multiprocess(smoke: bool) -> dict:
+    """The real multi-process proof, run as a fabric A/B: the batched
+    silo→silo fabric (default) against ``--no-fabric`` servers (one
+    Message frame per forwarded call — the pre-fabric wire) on the SAME
+    forwarding-heavy topology.  Exactness is asserted inside every
+    driver of BOTH arms (the reply string is a pure function of the
+    greeting).  No jax.distributed anywhere — plain sockets."""
+    grains, rounds = (64, 3) if smoke else (300, 20)
+    probes = 100 if smoke else 400
+    fabric = await _rpc_multiprocess_arm(smoke, grains, rounds, [],
+                                         probes)
+    # the per-message control arm re-proves the fallback wire end to
+    # end at a fraction of the rounds (it is the slow arm)
+    per_msg = await _rpc_multiprocess_arm(
+        smoke, grains, max(2, rounds // 4), ["--no-fabric"], probes)
+    agg = fabric["aggregate_rpc_per_sec"]
+    agg_pm = per_msg["aggregate_rpc_per_sec"]
+    p50 = fabric["single_call_p50_s"]
+    p50_pm = per_msg["single_call_p50_s"]
+    fab_stats = [s.get("fabric", {}) for s in fabric["silo_stats"]]
+    return {
+        "silo_processes": fabric["silo_processes"],
+        "client_processes": fabric["client_processes"],
+        "table_service": "TCP (no shared memory/disk between "
+                         "processes)" if not smoke
+                         else "single-silo smoke (one server, one "
+                              "driver process)",
+        "exact": bool(fabric["exact"] and per_msg["exact"]),
+        "calls": fabric["calls"],
+        "aggregate_rpc_per_sec": agg,
+        "per_driver_rpc_per_sec": fabric["per_driver_rpc_per_sec"],
+        "per_message_rpc_per_sec": agg_pm,
+        "speedup_vs_per_message": (round(agg / agg_pm, 2)
+                                   if agg_pm else None),
+        "single_call_p50_s": p50,
+        "per_message_single_call_p50_s": p50_pm,
+        # the latency regression gate: a lone call through the fabric
+        # (ring → idle flush → one-call frame) must stay within 2x of
+        # the direct per-message send
+        "single_call_p50_within_2x": (
+            bool(p50 <= 2.0 * p50_pm) if p50 and p50_pm else None),
+        "fabric_frames_sent": sum(s.get("frames_sent", 0)
+                                  for s in fab_stats),
+        "fabric_calls_sent": sum(s.get("calls_sent", 0)
+                                 for s in fab_stats),
+        "fabric_results_sent": sum(s.get("results_sent", 0)
+                                   for s in fab_stats),
+        "fabric_fallbacks": sum(s.get("fallbacks", 0)
+                                for s in fab_stats),
+        "forwarded": sum(s.get("forwarded", 0)
+                         for s in fabric["silo_stats"]),
+        "silo_stats": fabric["silo_stats"],
+        "ab": "same topology, servers restarted with --no-fabric for "
+              "the control arm; both arms assert reply exactness "
+              "per driver",
+    }
 
 
 async def _rpc_tier(smoke: bool) -> dict:
@@ -3628,8 +3706,9 @@ async def _rpc_tier(smoke: bool) -> dict:
         "engine": "batched host path: ingress ring → coalesced "
                   "(type, method) invoke windows → pre-resolved invoke "
                   "tables; per-call futures resolved from one batched "
-                  "completion; per-message pipeline kept as the "
-                  "correctness net",
+                  "completion; silo→silo hops ride the same frames via "
+                  "per-destination egress rings (the fabric); "
+                  "per-message pipeline kept as the correctness net",
     }
     # the embedded perfgate verdict (--family rpc): compares THIS run
     # against the checked-in rpc_metrics bands

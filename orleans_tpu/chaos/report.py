@@ -4,7 +4,7 @@
 ``bench.py --chaos-smoke``) runs the canonical short scenario on a
 3-silo ChaosCluster — storage flakes + injected CAS conflicts + one
 NaN-poisoned slab under live traffic, then partition → heal → hard-kill
-— checks all eight invariants (including the durable-state-plane
+— checks all nine invariants (including the durable-state-plane
 kill-mid-traffic recovery scenario), and emits a JSON report alongside the
 BENCH_*.json artifacts.  The report carries the (seed, plan) pair and
 the deterministic trace signature, so a failing run is replayable
@@ -34,6 +34,7 @@ class IChaosKv:
     async def put(self, v) -> None: ...
     async def save(self) -> None: ...
     async def get(self): ...
+    async def slow_echo(self, v): ...
 
 
 @grain_class(storage_provider="Default",
@@ -49,6 +50,12 @@ class ChaosKvGrain(StatefulGrain, IChaosKv):
 
     async def get(self):
         return self.state["v"]
+
+    async def slow_echo(self, v):
+        # holds the executing silo long enough that a batched fabric
+        # result is still outstanding when the chaos plan kills it
+        await asyncio.sleep(0.25)
+        return v
 
 
 @grain_interface
@@ -531,6 +538,113 @@ async def migration_storm_scenario(seed: int,
     }
 
 
+async def fabric_midflush_scenario(seed: int,
+                                   settle_bound_s: float = 10.0
+                                   ) -> Dict[str, Any]:
+    """Batched-fabric death smoke: the destination silo is HARD-KILLED
+    mid-flush — with requests still parked in the sender's egress ring
+    AND shipped direct calls whose batched results are still
+    outstanding — and every frame member fails over NOW.  Ringed
+    requests and stranded direct calls re-enter the per-message resend
+    net as TRANSIENT, re-address onto the survivor, and settle well
+    inside ``settle_bound_s`` (the anti-property: nobody waits out the
+    response timeout on a dead silo's unanswered frame).  The
+    kill→detection hop is the main plan's membership territory; here
+    the oracle's ``on_silo_dead`` hook fires directly so the mid-flush
+    timing is deterministic."""
+    from orleans_tpu.chaos.invariants import InvariantViolation
+    from orleans_tpu.runtime.messaging import Category, Direction, Message
+    from orleans_tpu.runtime.runtime_client import CallbackData
+    from orleans_tpu.testing.cluster import TestingCluster
+
+    cluster = await TestingCluster(n_silos=2).start()
+    try:
+        s0, s1 = cluster.silos
+        factory = s0.attach_client()
+        # grains the hash placement hosts on the victim silo
+        victims = []
+        key = 77000
+        while len(victims) < 8 and key < 77256:
+            ref = factory.get_grain(IChaosKv, key)
+            await ref.put(key)
+            if cluster.find_silo_hosting(ref.grain_id) is s1:
+                victims.append(ref)
+            key += 1
+        if len(victims) < 8:
+            raise InvariantViolation(
+                "fabric midflush: placement never landed 8 grains on "
+                "the victim silo")
+        before = s0.rpc_fabric.snapshot()
+        await asyncio.gather(*(r.get() for r in victims))
+        engaged = s0.rpc_fabric.snapshot()
+        if engaged["calls_sent"] <= before["calls_sent"]:
+            raise InvariantViolation(
+                "fabric midflush: cross-silo calls never rode the "
+                "fabric (scenario degenerate)")
+
+        loop = asyncio.get_running_loop()
+        rc = s0.runtime_client
+        t0 = time.monotonic()
+        # leg 1 — SHIPPED direct calls: slow_echo holds the victim long
+        # enough that every batched result is still outstanding
+        inflight = [asyncio.ensure_future(r.slow_echo(i))
+                    for i, r in enumerate(victims)]
+        for _ in range(8):
+            await asyncio.sleep(0)  # let the invoke windows ship
+        # leg 2 — RINGED requests: parked synchronously, with NO yield
+        # between here and the kill (death arrives mid-flush)
+        ringed = []
+        for r in victims:
+            msg = Message(category=Category.APPLICATION,
+                          direction=Direction.REQUEST,
+                          sending_silo=s0.address,
+                          sending_grain=s0.client_grain_id,
+                          target_silo=s1.address,
+                          target_grain=r.grain_id,
+                          method_name="get", args=())
+            fut = loop.create_future()
+            rc.callbacks[msg.id] = CallbackData(future=fut, message=msg)
+            s0.message_center.send_message(msg)
+            ringed.append(fut)
+        parked = s0.rpc_fabric.pending()
+        stranded = len(s0.rpc_fabric._direct)
+        if parked == 0 or stranded == 0:
+            raise InvariantViolation(
+                f"fabric midflush: nothing mid-flush at the kill "
+                f"(parked={parked} stranded={stranded})")
+        cluster.kill_silo(s1)
+        s0.on_silo_dead(s1.address)
+        if s0.rpc_fabric.pending() != 0 or s0.rpc_fabric._direct:
+            raise InvariantViolation(
+                "fabric midflush: members survived fail_destination")
+        done = await asyncio.wait_for(
+            asyncio.gather(*inflight, *ringed, return_exceptions=True),
+            settle_bound_s)
+        settle_s = time.monotonic() - t0
+        failures = [r for r in done if isinstance(r, BaseException)]
+        if failures:
+            raise InvariantViolation(
+                f"fabric midflush: {len(failures)} members failed "
+                f"instead of re-addressing ({failures[0]!r})")
+        # re-addressed slow_echo calls land on the survivor and echo
+        echoed = list(done[:len(inflight)])
+        if echoed != list(range(len(inflight))):
+            raise InvariantViolation(
+                f"fabric midflush: re-addressed replies wrong: {echoed}")
+        after = s0.rpc_fabric.snapshot()
+        return {
+            "ok": True,
+            "parked_in_ring": parked,
+            "stranded_direct": stranded,
+            "bounced": after["bounced"] - before["bounced"],
+            "settle_s": round(settle_s, 4),
+            "settle_bound_s": settle_bound_s,
+            "requests_resent": int(s0.metrics.requests_resent),
+        }
+    finally:
+        await cluster.stop()
+
+
 def smoke_plan(seed: int):
     """The canonical smoke scenario: finite pinned fault rules (fully
     deterministic trace signature), then partition → heal → hard-kill."""
@@ -557,7 +671,7 @@ def smoke_plan(seed: int):
 
 
 async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
-    """One full smoke run; returns the report dict (``ok`` = all eight
+    """One full smoke run; returns the report dict (``ok`` = all nine
     invariants held).  Invariant violations are reported, not raised —
     the caller (CLI / bench step) decides the exit code."""
     import numpy as np
@@ -639,7 +753,7 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
         live_engine.send_batch("ChaosCounter", "poke", keys,
                                {"v": np.zeros(64, np.float32)})
 
-        # -- the eight invariants ---------------------------------------
+        # -- the nine invariants ----------------------------------------
         def _run(name, result):
             invariants[name] = result
 
@@ -696,6 +810,16 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
                  await standby_failover_scenario(seed))
         except (InvariantViolation, AssertionError) as exc:
             _run("standby_failover", {"ok": False, "error": str(exc)})
+        # the batched silo→silo fabric's death contract (seeded, its
+        # own 2-silo cluster): a destination killed MID-FLUSH fails
+        # every frame member immediately — ringed and shipped alike —
+        # and the members re-address instead of stranding
+        try:
+            _run("fabric_midflush_failfast",
+                 await fabric_midflush_scenario(seed))
+        except (InvariantViolation, AssertionError) as exc:
+            _run("fabric_midflush_failfast",
+                 {"ok": False, "error": str(exc)})
 
         # flight-recorder evidence: every silo's ring (dead silos too —
         # their in-memory spans ARE the crash evidence), correlated by
@@ -708,7 +832,7 @@ async def run_smoke(seed: int = 1234) -> Dict[str, Any]:
         await cluster.stop()
 
     ok = all(v.get("ok") for v in invariants.values()) \
-        and len(invariants) == 8
+        and len(invariants) == 9
     return {
         "metric": "chaos_smoke",
         "ok": ok,

@@ -1244,6 +1244,331 @@ def _rpc_resolve_refs(values: Tuple[Any, ...],
     return tuple(_rpc_resolve_one(v, arrays) for v in values)
 
 
+# ===================== silo→silo fabric frame format ========================
+#
+# The intra-cluster sibling of the gateway rpc frame above: ONE frame per
+# (source silo, destination silo) flush carries every remote call, forward
+# and response that accumulated in the egress ring.  Unlike a gateway frame
+# (one negotiated (type, method) per frame) a fabric frame is SECTIONED —
+# each calls section is one (type_code, method) window, results collapse
+# into flat sections — because the ring mixes traffic for many methods and
+# the flush must not reorder a sender's calls across methods.  Per-call
+# msg-id / TTL / forward-count / sender / trace columns ride as raw
+# little-endian columns exactly like the gateway frame's key column; TTLs
+# are REMAINING time at encode and rebased per call on the receiver's
+# clock (never frame-level).  Reply-to identities (silo, grain) dedupe
+# into one general-codec table per frame so the per-call cost is a u32.
+
+FABRIC_WIRE_VERSION = 1
+FABRIC_SECTION_CALLS = 0
+FABRIC_SECTION_RESULTS = 1
+
+#: per-result statuses in a fabric results section
+FABRIC_RESULT_OK = 0
+FABRIC_RESULT_ERROR = 1
+FABRIC_RESULT_REJECTION = 2
+
+#: ttl-column sentinel for "no deadline" (remaining TTLs are >= 0)
+FABRIC_NO_TTL = -1.0
+
+
+class FabricCallsSection:
+    """One (type_code, method) window of calls inside a fabric frame."""
+
+    __slots__ = ("type_code", "method_name", "one_way", "n",
+                 "keys", "msg_ids", "ttls", "forward_counts", "senders",
+                 "trace_ids", "span_ids", "common_args", "args_list")
+
+    def __init__(self, type_code: int, method_name: str, one_way: bool,
+                 keys=None, msg_ids=None, ttls=None, forward_counts=None,
+                 senders=None, trace_ids=None, span_ids=None,
+                 common_args=None, args_list=None) -> None:
+        self.type_code = type_code
+        self.method_name = method_name
+        self.one_way = one_way
+        self.keys = keys
+        self.msg_ids = msg_ids
+        self.ttls = ttls
+        self.forward_counts = forward_counts
+        self.senders = senders
+        self.trace_ids = trace_ids
+        self.span_ids = span_ids
+        self.common_args = common_args
+        self.args_list = args_list
+        self.n = 0 if keys is None else int(np.asarray(keys).shape[0])
+
+
+class FabricResultsSection:
+    """A flat run of responses inside a fabric frame (correlated at the
+    destination through its own callback table by msg id)."""
+
+    __slots__ = ("n", "msg_ids", "statuses", "rejections", "targets",
+                 "trace_ids", "span_ids", "values")
+
+    def __init__(self, msg_ids=None, statuses=None, rejections=None,
+                 targets=None, trace_ids=None, span_ids=None,
+                 values=None) -> None:
+        self.msg_ids = msg_ids
+        self.statuses = statuses
+        self.rejections = rejections
+        self.targets = targets
+        self.trace_ids = trace_ids
+        self.span_ids = span_ids
+        self.values = values
+        self.n = 0 if msg_ids is None else int(np.asarray(msg_ids).shape[0])
+
+
+class FabricFrame:
+    """Decoded silo→silo fabric frame."""
+
+    __slots__ = ("origin", "idents", "sections")
+
+    def __init__(self, origin=None, idents=None, sections=None) -> None:
+        self.origin = origin
+        self.idents = idents if idents is not None else []
+        self.sections = sections if sections is not None else []
+
+
+def _fabric_col(values, dtype) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(values, dtype=dtype))
+
+
+def encode_fabric_frame(manager: SerializationManager, origin: Any,
+                        idents: list, sections: list) -> list:
+    """Encode one fabric frame as bytes-like segments.
+
+    ``origin`` is the sending silo's address (the receiver credits its
+    breaker and stamps synthesized responses with it); ``idents`` the
+    deduped reply-to/target identity table (general-codec, once per
+    frame); ``sections`` a mix of :class:`FabricCallsSection` /
+    :class:`FabricResultsSection` in ring order."""
+    w = Writer()
+    w.varint(FABRIC_WIRE_VERSION)
+    w.raw(manager.serialize(origin))
+    w.raw(manager.serialize(list(idents)))
+    w.varint(len(sections))
+    arrays: list = []
+    for sec in sections:
+        if isinstance(sec, FabricCallsSection):
+            n = sec.n
+            w.u8(FABRIC_SECTION_CALLS)
+            w.varint(sec.type_code)
+            w.string(sec.method_name)
+            w.varint(n)
+            flags = 0
+            if sec.common_args is not None:
+                flags |= _RPC_FLAG_COMMON
+            if sec.one_way:
+                flags |= _RPC_FLAG_ONE_WAY
+            if sec.trace_ids is not None:
+                flags |= _RPC_FLAG_TRACE
+            w.u8(flags)
+            # the section's implicit columns start here — written
+            # explicitly so args-embedded ndarrays never shift them
+            w.varint(len(arrays))
+            arrays.append(_fabric_col(sec.keys, np.uint64))
+            arrays.append(_fabric_col(sec.msg_ids, np.uint64))
+            arrays.append(_fabric_col(sec.ttls, np.float64))
+            arrays.append(_fabric_col(sec.forward_counts, np.uint32))
+            arrays.append(_fabric_col(sec.senders, np.uint32))
+            if sec.trace_ids is not None:
+                arrays.append(_fabric_col(sec.trace_ids, np.uint64))
+                arrays.append(_fabric_col(sec.span_ids, np.uint64))
+            if sec.common_args is not None:
+                _rpc_write_values(manager, w, arrays, sec.common_args)
+            else:
+                if sec.args_list is None or len(sec.args_list) != n:
+                    raise SerializationError(
+                        "fabric calls section: args_list must carry one "
+                        "tuple per call")
+                for args in sec.args_list:
+                    _rpc_write_values(manager, w, arrays, args)
+        elif isinstance(sec, FabricResultsSection):
+            n = sec.n
+            w.u8(FABRIC_SECTION_RESULTS)
+            w.varint(n)
+            flags = _RPC_FLAG_TRACE if sec.trace_ids is not None else 0
+            w.u8(flags)
+            w.varint(len(arrays))
+            arrays.append(_fabric_col(sec.msg_ids, np.uint64))
+            arrays.append(_fabric_col(sec.statuses, np.uint8))
+            arrays.append(_fabric_col(sec.rejections, np.uint8))
+            arrays.append(_fabric_col(sec.targets, np.uint32))
+            if sec.trace_ids is not None:
+                arrays.append(_fabric_col(sec.trace_ids, np.uint64))
+                arrays.append(_fabric_col(sec.span_ids, np.uint64))
+            if sec.values is None or len(sec.values) != n:
+                raise SerializationError(
+                    "fabric results section: values must carry one entry "
+                    "per result")
+            for v in sec.values:
+                _rpc_write_value(manager, w, arrays, v)
+        else:
+            raise SerializationError(
+                f"unknown fabric section type {type(sec).__name__}")
+    return _rpc_manifest_and_segments(w, arrays)
+
+
+def _fabric_check_col(a: np.ndarray, dtype, n: int, what: str) -> np.ndarray:
+    if a.dtype != dtype or a.shape != (n,):
+        raise SerializationError(f"fabric frame: bad {what} column "
+                                 f"({a.dtype}, {a.shape})")
+    return a
+
+
+def decode_fabric_frame(manager: SerializationManager,
+                        payload: bytes) -> FabricFrame:
+    """Decode one fabric frame body.  Columns come back as read-only
+    ``np.frombuffer`` views over ``payload``; malformation raises
+    :class:`SerializationError` (the transport drops the frame whole —
+    member failure handling is the sender's bounce path)."""
+    try:
+        r = Reader(payload)
+        version = r.varint()
+        if version != FABRIC_WIRE_VERSION:
+            raise SerializationError(
+                f"unsupported fabric wire version {version}")
+        origin = manager.deserialize(bytes(r.raw()))
+        idents = manager.deserialize(bytes(r.raw()))
+        n_sections = r.varint()
+        if n_sections < 0:
+            raise SerializationError(
+                f"negative fabric section count {n_sections}")
+        # first pass: parse section headers + value streams (array refs
+        # stay placeholders until the trailing manifest maps segments)
+        raw_sections: list = []
+        for _ in range(n_sections):
+            skind = r.u8()
+            if skind == FABRIC_SECTION_CALLS:
+                type_code = r.varint()
+                method_name = r.string()
+                n = r.varint()
+                if n < 0:
+                    raise SerializationError(
+                        f"negative fabric call count {n}")
+                flags = r.u8()
+                col_base = r.varint()
+                common_args = None
+                args_list = None
+                if flags & _RPC_FLAG_COMMON:
+                    common_args = _rpc_read_values(manager, r)
+                else:
+                    args_list = [_rpc_read_values(manager, r)
+                                 for _ in range(n)]
+                raw_sections.append((skind, type_code, method_name, n,
+                                     flags, col_base, common_args,
+                                     args_list))
+            elif skind == FABRIC_SECTION_RESULTS:
+                n = r.varint()
+                if n < 0:
+                    raise SerializationError(
+                        f"negative fabric result count {n}")
+                flags = r.u8()
+                col_base = r.varint()
+                values = [_rpc_read_value(manager, r) for _ in range(n)]
+                raw_sections.append((skind, None, None, n, flags,
+                                     col_base, None, values))
+            else:
+                raise SerializationError(
+                    f"unknown fabric section kind {skind}")
+        # manifest + raw segment views (same layout as the rpc frame)
+        n_arrays = r.varint()
+        if n_arrays < 0:
+            raise SerializationError(
+                f"negative fabric array count {n_arrays}")
+        specs = []
+        for _ in range(n_arrays):
+            dtype = np.dtype(r.string())
+            if dtype.hasobject:
+                raise SerializationError(
+                    f"refusing object ndarray dtype {dtype!r}")
+            ndim = r.varint()
+            if not 0 <= ndim <= _SLAB_MAX_NDIM:
+                raise SerializationError(f"bad fabric array ndim {ndim}")
+            shape = tuple(r.varint() for _ in range(ndim))
+            if any(d < 0 for d in shape):
+                raise SerializationError(f"negative fabric dim in {shape}")
+            specs.append((dtype, shape))
+        buf = memoryview(payload)
+        offset = r.pos
+        arrays: list = []
+        for dtype, shape in specs:
+            count = int(np.prod(shape, dtype=np.int64))
+            nbytes = count * dtype.itemsize
+            if offset + nbytes > len(buf):
+                raise SerializationError(
+                    "fabric frame truncated: manifest wants "
+                    f"{nbytes} bytes at offset {offset}, frame has "
+                    f"{len(buf)}")
+            arrays.append(np.frombuffer(buf[offset:offset + nbytes],
+                                        dtype=dtype).reshape(shape))
+            offset += nbytes
+        if offset != len(buf):
+            raise SerializationError(
+                f"fabric frame has {len(buf) - offset} trailing bytes")
+        # second pass: bind columns + resolve value refs
+        sections: list = []
+        for (skind, type_code, method_name, n, flags, col_base,
+             common_args, payload_values) in raw_sections:
+            has_trace = bool(flags & _RPC_FLAG_TRACE)
+            n_cols = (7 if has_trace else 5) if skind == FABRIC_SECTION_CALLS \
+                else (6 if has_trace else 4)
+            if col_base < 0 or col_base + n_cols > len(arrays):
+                raise SerializationError(
+                    f"fabric section column base {col_base} out of range")
+            cols = arrays[col_base:col_base + n_cols]
+            if skind == FABRIC_SECTION_CALLS:
+                sec = FabricCallsSection(
+                    type_code, method_name,
+                    bool(flags & _RPC_FLAG_ONE_WAY),
+                    keys=_fabric_check_col(cols[0], np.uint64, n, "key"),
+                    msg_ids=_fabric_check_col(cols[1], np.uint64, n,
+                                              "msg-id"),
+                    ttls=_fabric_check_col(cols[2], np.float64, n, "ttl"),
+                    forward_counts=_fabric_check_col(cols[3], np.uint32,
+                                                     n, "forward-count"),
+                    senders=_fabric_check_col(cols[4], np.uint32, n,
+                                              "sender"))
+                if has_trace:
+                    sec.trace_ids = _fabric_check_col(cols[5], np.uint64,
+                                                      n, "trace-id")
+                    sec.span_ids = _fabric_check_col(cols[6], np.uint64,
+                                                     n, "span-id")
+                if common_args is not None:
+                    sec.common_args = _rpc_resolve_refs(common_args,
+                                                        arrays)
+                else:
+                    sec.args_list = [_rpc_resolve_refs(a, arrays)
+                                     for a in payload_values]
+                sec.n = n
+                sections.append(sec)
+            else:
+                sec = FabricResultsSection(
+                    msg_ids=_fabric_check_col(cols[0], np.uint64, n,
+                                              "msg-id"),
+                    statuses=_fabric_check_col(cols[1], np.uint8, n,
+                                               "status"),
+                    rejections=_fabric_check_col(cols[2], np.uint8, n,
+                                                 "rejection"),
+                    targets=_fabric_check_col(cols[3], np.uint32, n,
+                                              "target"))
+                if has_trace:
+                    sec.trace_ids = _fabric_check_col(cols[4], np.uint64,
+                                                      n, "trace-id")
+                    sec.span_ids = _fabric_check_col(cols[5], np.uint64,
+                                                     n, "span-id")
+                sec.values = [_rpc_resolve_one(v, arrays)
+                              for v in payload_values]
+                sec.n = n
+                sections.append(sec)
+        return FabricFrame(origin, idents, sections)
+    except SerializationError:
+        raise
+    except Exception as exc:  # noqa: BLE001 — corrupt bytes surface as one
+        # typed rejection, never a partial decode
+        raise SerializationError(f"malformed fabric frame: {exc!r}") from exc
+
+
 def serializable(cls: Type) -> Type:
     """Class decorator: register a dataclass with the default manager
     (replaces the reference's Roslyn-generated per-type serializers,

@@ -83,6 +83,25 @@ class RpcConfig:
     # is the real backpressure surface)
     max_pending: int = 131072
 
+    # -- silo→silo fabric (runtime/rpc.py RpcFabric) --------------------
+    # eligible remote application sends coalesce into per-destination
+    # egress rings and ship as ONE sectioned rpc frame per flush; OFF is
+    # the batched-vs-per-message A/B arm the rpc bench measures against.
+    # Ineligible traffic (string/uuid keys, grain-to-grain call chains,
+    # piggybacked invalidations) always stays per-message — counted as
+    # rpc.fabric_fallbacks, never silent.  Live-reloadable.
+    fabric_enabled: bool = True
+    # a destination ring reaching this depth flushes inline instead of
+    # waiting for the loop-idle drain (bulk-forwarding amortization cap)
+    fabric_flush_lanes: int = 512
+    # >0: the drain task holds small batches up to this long before
+    # flushing (µs); 0 = flush at the next loop-idle point — single-call
+    # p50 stays within the bench-gated bound of the per-message path
+    fabric_flush_us: int = 0
+    # per-destination ring bound: past this, sends fall back to the
+    # per-message path (the transport's queue limits then apply)
+    fabric_max_pending: int = 65536
+
 
 @dataclass
 class ResilienceConfig:

@@ -261,6 +261,38 @@ def view_from_snapshots(snapshots: Iterable[Dict[str, Any]],
                         "rpc.coalesce_wait_s", {}).values()
                      for v in by_src.values()), default=0.0), 6),
             },
+            # batched silo→silo fabric (runtime/rpc.py RpcFabric) plus
+            # the per-message forwarding it coexists with: frames vs
+            # members shows the coalescing ratio, fallbacks/bounced are
+            # the counted escape hatches
+            "fabric": {
+                "frames_sent": int(
+                    _counter_total(merged, "rpc.fabric_frames_sent")),
+                "calls_sent": int(
+                    _counter_total(merged, "rpc.fabric_calls_sent")),
+                "results_sent": int(
+                    _counter_total(merged, "rpc.fabric_results_sent")),
+                "frames_rejected": int(
+                    _counter_total(merged, "rpc.fabric_frames_rejected")),
+                "fallbacks": int(
+                    _counter_total(merged, "rpc.fabric_fallbacks")),
+                "bounced": int(
+                    _counter_total(merged, "rpc.fabric_bounced")),
+                "vector_batches": int(
+                    _counter_total(merged, "rpc.fabric_vector_batches")),
+                # worst (smallest) nonzero per-silo frame depth — same
+                # no-signal convention as ingress_batch_size above
+                "egress_batch": round(min(
+                    (v for by_src in gauges.get(
+                        "rpc.fabric_egress_batch", {}).values()
+                     for v in by_src.values() if v > 0), default=0.0), 1),
+                "forwarded": int(
+                    _counter_total(merged, "dispatch.forwarded")),
+                "forward_depth": int(max(
+                    (v for by_src in gauges.get(
+                        "dispatch.forward_depth", {}).values()
+                     for v in by_src.values()), default=0.0)),
+            },
             # device-resident cross-shard routing (tensor/exchange.py):
             # traffic that crossed mesh shards WITHOUT leaving the device
             "cross_shard": {
@@ -485,6 +517,18 @@ def render_text(view: Dict[str, Any]) -> str:
             f"(batch {rpc.get('ingress_batch_size', 0.0)}, "
             f"wait {rpc.get('coalesce_wait_s', 0.0)}s, "
             f"{rpc.get('expired', 0)} expired)")
+    fb = c.get("fabric", {})
+    if fb.get("frames_sent") or fb.get("fallbacks") or fb.get("forwarded"):
+        lines.append(
+            f"fabric (silo→silo frames): {fb.get('frames_sent', 0)} frames "
+            f"carrying {fb.get('calls_sent', 0)} calls + "
+            f"{fb.get('results_sent', 0)} results "
+            f"(batch {fb.get('egress_batch', 0.0)}, "
+            f"{fb.get('fallbacks', 0)} per-message fallbacks, "
+            f"{fb.get('bounced', 0)} bounced, "
+            f"{fb.get('vector_batches', 0)} vector batches); "
+            f"forwarded: {fb.get('forwarded', 0)} "
+            f"(depth {fb.get('forward_depth', 0)})")
     xs = c.get("cross_shard", {})
     if xs.get("exchanges"):
         lines.append(

@@ -160,6 +160,51 @@ declare("rpc.expired", KIND_COUNTER, "calls",
         "(dead-lettered with reason expired, EXPIRED rejection to the "
         "caller — never silently dropped)")
 
+# -- batched silo→silo fabric (runtime/rpc.py RpcFabric) ---------------------
+declare("rpc.fabric_frames_sent", KIND_COUNTER, "frames",
+        "coalesced silo→silo frames shipped (one transport send per "
+        "per-destination egress-ring flush)")
+declare("rpc.fabric_frames_received", KIND_COUNTER, "frames",
+        "coalesced silo→silo frames decoded on ingress")
+declare("rpc.fabric_frames_rejected", KIND_COUNTER, "frames",
+        "inbound fabric frames that failed to decode (dropped whole; "
+        "senders recover via the per-message resend machinery)")
+declare("rpc.fabric_calls_sent", KIND_COUNTER, "calls",
+        "request/one-way members shipped inside fabric frames")
+declare("rpc.fabric_calls_received", KIND_COUNTER, "calls",
+        "request/one-way members ingested from fabric frames (TTL "
+        "rebased per call on this silo's clock)")
+declare("rpc.fabric_results_sent", KIND_COUNTER, "results",
+        "response members shipped inside fabric frames")
+declare("rpc.fabric_results_received", KIND_COUNTER, "results",
+        "response members ingested from fabric frames and correlated "
+        "through the callback table")
+declare("rpc.fabric_fallbacks", KIND_COUNTER, "messages",
+        "remote application messages ineligible for frame coalescing "
+        "(rich context, ring full, encode failure) sent per-message — "
+        "the counted correctness fallback, never silent")
+declare("rpc.fabric_bounced", KIND_COUNTER, "messages",
+        "frame members failed individually after a carrier bounce "
+        "(dead peer / closed link): requests re-enter the resend "
+        "machinery as TRANSIENT rejections, one-ways/responses "
+        "dead-letter as undeliverable — no stranded callers")
+declare("rpc.fabric_vector_batches", KIND_COUNTER, "batches",
+        "forwarded call sections whose keys are vector-arena grains "
+        "injected as ONE batched engine send instead of per-call turns")
+declare("rpc.fabric_egress_batch", KIND_GAUGE, "messages",
+        "mean members per shipped fabric frame over the last collection "
+        "interval (1.0 = the fabric is degenerating to per-message)")
+
+# -- per-message forwarding (runtime/dispatcher.py try_forward) --------------
+declare("dispatch.forwarded", KIND_COUNTER, "messages",
+        "messages re-routed after a stale/moved target "
+        "(Dispatcher.try_forward; each hop increments forward_count "
+        "until max_forward_count rejects UNRECOVERABLE)")
+declare("dispatch.forward_depth", KIND_GAUGE, "hops",
+        "deepest forward chain observed in the last collection "
+        "interval (sustained values near max_forward_count mean the "
+        "directory is chasing migrations)")
+
 # -- tracing + cluster timeline plane (spans.py) -----------------------------
 declare("trace.spans_started", KIND_COUNTER, "spans",
         "hop/tick/plane spans opened by the span recorder")

@@ -63,6 +63,9 @@ class Dispatcher:
         self.rejection_injection_rate = 0.0
         self._inject_rng = None
         self.metrics = silo.metrics
+        # deepest forward chain observed since the last metrics interval
+        # (dispatch.forward_depth gauge; reset by silo.collect_metrics)
+        self.forward_depth_max = 0
         # batched host RPC plane: pre-resolved (type, method) → turn
         # entrypoint tables (runtime/rpc.py; invalidated on the
         # catalog's deactivation epoch)
@@ -349,14 +352,17 @@ class Dispatcher:
         method_name = window.method.name
         deep_copy = codec.deep_copy
         get_activation = self.catalog.get_activation
+        fabric_route = silo.rpc_fabric.route_call
         # per-call contextvar discipline: one SET per call (the next
         # call's set overwrites it), one reset for the whole window —
         # the drain task owns this context, nothing else reads it
         # between calls
         act_var = gctx._current_activation
         chain_var = gctx._call_chain
+        rc_var = gctx._request_context
         act_token = act_var.set(None)
         chain_token = chain_var.set(())
+        rc_token = rc_var.set(None)
         t_start = time.monotonic()
         try:
             for call in calls:
@@ -375,6 +381,12 @@ class Dispatcher:
                 if cached is None or cached[0].state is not valid:
                     act = get_activation(call.grain_id)
                     if act is None or act.state is not valid:
+                        # not here: a warm directory hit ships the call
+                        # DIRECTLY over the silo→silo fabric (no Message,
+                        # no callback-table entry); cold placement and
+                        # everything the fabric declines stay per-message
+                        if fabric_route(call):
+                            continue
                         self._window_fallback(call, loop)
                         continue
                     cached = (act, getattr(act.grain_instance,
@@ -400,6 +412,12 @@ class Dispatcher:
                 act.running[id(call)] = call
                 act_var.set(act)
                 chain_var.set((call.grain_id,))
+                # the carried trace is grain-visible exactly as it is on
+                # the per-message path (RequestContext.get(TRACE_KEY));
+                # setting per call also isolates turns from a
+                # window-mate's RequestContext.set
+                tr = call.trace
+                rc_var.set({_TRACE_KEY: tr} if tr is not None else None)
                 hits += 1
                 coro = bound(*call.args)
                 try:
@@ -450,6 +468,7 @@ class Dispatcher:
         finally:
             act_var.reset(act_token)
             chain_var.reset(chain_token)
+            rc_var.reset(rc_token)
             _current_runtime.reset(rt_token)
             watchdog.cancel()
             coal.fastpath_hits += hits
@@ -536,7 +555,7 @@ class Dispatcher:
             sending_silo=self.silo.address, sending_grain=call.sender,
             target_grain=call.grain_id, interface_id=call.iface_id,
             method_id=call.method.method_id, method_name=call.method.name,
-            expiration=call.deadline)
+            expiration=call.deadline, forward_count=call.forward_count)
         self.silo.dead_letters.record(
             record, REASON_EXPIRED, "expired in rpc ingress")
         if call.future is not None and not call.future.done():
@@ -574,6 +593,10 @@ class Dispatcher:
             is_read_only=method.read_only,
             is_always_interleave=method.always_interleave,
             expiration=call.deadline,
+            # a call that arrived over the fabric already spent hops —
+            # its budget carries into the per-message net so forwarding
+            # loops stay bounded by max_forward_count end to end
+            forward_count=call.forward_count,
         )
         tr = call.trace
         if tr is not None:
@@ -605,6 +628,19 @@ class Dispatcher:
         if msg.target_silo is not None:
             self.silo.message_center.send_message(msg)
             return
+        # sync addressing fast path: a warm directory hit (local
+        # partition or cache) resolves without spawning a task, so every
+        # remote call of one ingress window reaches the fabric's egress
+        # ring inside the SAME loop iteration — one frame per flush
+        # instead of one per addressing-task wakeup
+        if msg.target_grain is not None:
+            addr = self.silo.grain_directory.try_local_lookup(
+                msg.target_grain)
+            if addr is not None:
+                msg.target_silo = addr.silo
+                msg.target_activation = addr.activation
+                self.silo.message_center.send_message(msg)
+                return
         asyncio.get_running_loop().create_task(self._address_and_send(msg))
 
     async def _address_and_send(self, msg: Message) -> None:
@@ -659,6 +695,8 @@ class Dispatcher:
                 f"exceeded max forward count ({reason})"))
             return
         self.metrics.messages_forwarded += 1
+        if msg.forward_count > self.forward_depth_max:
+            self.forward_depth_max = msg.forward_count
         from orleans_tpu import spans as _spans
         self.silo.spans.event(f"forward {msg.method_name}", "forward",
                               _spans.trace_of(msg), reason=reason,

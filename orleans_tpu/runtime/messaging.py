@@ -204,6 +204,21 @@ def is_slab_message(msg: Message) -> bool:
             and len(msg.args) >= 4)
 
 
+#: the silo→silo fabric's carrier method name (runtime/rpc.py RpcFabric) —
+#: carriers ship pre-encoded frame segments, never the token-stream codec
+FABRIC_METHOD = "rpc_fabric_frame"
+
+
+def is_fabric_message(msg: Message) -> bool:
+    """True for a fabric frame carrier: one silo→silo envelope holding a
+    whole flush of coalesced calls/responses as pre-encoded segments.
+    Transports ship the segments verbatim (codec.encode_fabric_frame
+    wire format) and bounce the carrier back through
+    ``RpcFabric.on_frame_bounce`` so every member fails individually."""
+    return (msg.method_name == FABRIC_METHOD
+            and getattr(msg, "_fabric_segments", None) is not None)
+
+
 class MessageCenter:
     """Per-silo message hub (reference: MessageCenter.cs:33).
 
@@ -230,6 +245,10 @@ class MessageCenter:
         # records every breaker fast-fail
         self.breakers = None
         self.dead_letters = None
+        # batched silo→silo fabric (wired by Silo; runtime/rpc.py
+        # RpcFabric) — eligible remote application traffic coalesces into
+        # per-destination frames instead of per-message transport sends
+        self.rpc_fabric = None
 
     def send_message(self, msg: Message) -> None:
         if msg.sending_silo is None:
@@ -266,6 +285,14 @@ class MessageCenter:
                 self.deliver_local(msg.create_rejection(
                     RejectionType.TRANSIENT,
                     f"circuit breaker open to {msg.target_silo}"))
+            return
+        # batched silo→silo fabric: eligible remote application traffic
+        # (already breaker-gated above, per message) joins a per-
+        # destination egress ring and ships inside ONE coalesced frame;
+        # everything else stays on the per-message path — counted by the
+        # fabric, never silent
+        fabric = self.rpc_fabric
+        if fabric is not None and fabric.route(msg):
             return
         self.transport.send(msg)
 

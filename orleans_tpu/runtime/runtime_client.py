@@ -270,21 +270,29 @@ class InsideRuntimeClient:
             # it.  (Shed pressure is consulted per WINDOW at execution,
             # where the level actually applies — invoke_window.)
             return _FASTPATH_DECLINED
-        if ctx._request_context.get() is not None:
-            # an ambient RequestContext must flow to the turn; only the
-            # per-message envelope carries it
-            return _FASTPATH_DECLINED
         trace = None
-        rec = silo.spans
-        if rec.enabled and rec.sample_rate > 0.0 \
-                and rec._rng.random() < rec.sample_rate:
-            # head-sampled: the call still RIDES the fastpath — the
-            # trace travels on the _Call itself and the window links it
-            # (tracing must not perturb the path it measures).  The
-            # unsampled majority allocates no trace dict at all.
-            rec.sampled_traces += 1
-            trace = {"trace_id": _spans._getrandbits(63),
-                     "span_id": "", "sampled": True}
+        rc_now = ctx._request_context.get()
+        if rc_now is not None:
+            # a trace-ONLY ambient context rides the _Call (the window
+            # turn re-imports it, so the grain sees the same TRACE_KEY
+            # as on the per-message path); anything richer must flow on
+            # the per-message envelope
+            carried = (rc_now.get(_spans.TRACE_KEY)
+                       if len(rc_now) == 1 else None)
+            if not isinstance(carried, dict):
+                return _FASTPATH_DECLINED
+            trace = dict(carried)
+        else:
+            rec = silo.spans
+            if rec.enabled and rec.sample_rate > 0.0 \
+                    and rec._rng.random() < rec.sample_rate:
+                # head-sampled: the call still RIDES the fastpath — the
+                # trace travels on the _Call itself and the window links
+                # it (tracing must not perturb the path it measures).
+                # The unsampled majority allocates no trace dict at all.
+                rec.sampled_traces += 1
+                trace = {"trace_id": _spans._getrandbits(63),
+                         "span_id": "", "sampled": True}
         # requests_sent / retry-budget deposits batch per drained window
         # (RpcCoalescer._drain) — identical totals, no per-call RMW here
         future = None

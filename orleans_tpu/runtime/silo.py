@@ -211,8 +211,11 @@ class Silo:
             self.config.messaging.deadlock_detection
         # batched host RPC plane (runtime/rpc.py): ingress ring +
         # coalesced invoke windows for hosted-client/gateway calls
-        from orleans_tpu.runtime.rpc import RpcCoalescer
+        from orleans_tpu.runtime.rpc import RpcCoalescer, RpcFabric
         self.rpc = RpcCoalescer(self)
+        # batched silo→silo fabric: per-destination egress rings drained
+        # into sectioned rpc frames (the coalescer's intra-cluster twin)
+        self.rpc_fabric = RpcFabric(self)
         self.placement_manager = PlacementDirectorsManager(self)
         self.factory = GrainFactory()
         self.max_forward_count = self.config.messaging.max_forward_count
@@ -220,6 +223,7 @@ class Silo:
         self.message_center.dispatcher = self.dispatcher
         self.message_center.breakers = self.breakers
         self.message_center.dead_letters = self.dead_letters
+        self.message_center.rpc_fabric = self.rpc_fabric
 
         # providers (reference: StorageProviderManager; Silo.cs:478-484)
         self.storage_providers: Dict[str, StorageProvider] = \
@@ -523,11 +527,17 @@ class Silo:
             self.gateway_acceptor.close()
         if self._bound_transport is not None:
             if graceful:
-                # flush outbound sender queues so in-flight responses
-                # reach their callers before the sockets die
+                # flush the fabric's egress rings, then the outbound
+                # sender queues, so in-flight responses reach their
+                # callers before the sockets die
+                try:
+                    await self.rpc_fabric.wait_idle()
+                except Exception:  # noqa: BLE001 — a wedged flush must
+                    pass           # not block shutdown
                 drain = getattr(self._bound_transport, "drain", None)
                 if drain is not None:
                     await drain()
+            self.rpc_fabric.close_nowait()
             self._bound_transport.close()
         self.status = SiloStatus.DEAD
 
@@ -559,6 +569,7 @@ class Silo:
             self.membership_oracle.kill()
         if self.gateway_acceptor is not None:
             self.gateway_acceptor.close()
+        self.rpc_fabric.close_nowait()
         if self._bound_transport is not None:
             self._bound_transport.close()
 
@@ -932,6 +943,36 @@ class Silo:
                              ri["ingress_batch_size"], {"silo": self.name})
             mgr.track_metric("rpc.coalesce_wait_s",
                              ri["coalesce_wait_s"], {"silo": self.name})
+        # batched silo→silo fabric: frame/member counters plus the
+        # interval-mean frame shape gauge
+        fs = self.rpc_fabric.snapshot()
+        fi = self.rpc_fabric.collect_interval()
+        emit({"fabric_frames_sent": fs["frames_sent"],
+              "fabric_frames_received": fs["frames_received"],
+              "fabric_frames_rejected": fs["frames_rejected"],
+              "fabric_calls_sent": fs["calls_sent"],
+              "fabric_calls_received": fs["calls_received"],
+              "fabric_results_sent": fs["results_sent"],
+              "fabric_results_received": fs["results_received"],
+              "fabric_fallbacks": fs["fallbacks"],
+              "fabric_bounced": fs["bounced"],
+              "fabric_vector_batches": fs["vector_batches"]},
+             None, "rpc.")
+        reg.gauge("rpc.fabric_egress_batch").set(fi["egress_batch"])
+        if fan:
+            mgr.track_metric("rpc.fabric_egress_batch",
+                             fi["egress_batch"], {"silo": self.name})
+        # per-message forwarding: total hops plus the deepest chain seen
+        # this interval (the gauge resets here — this collector owns it)
+        emit({"forwarded": self.metrics.messages_forwarded},
+             None, "dispatch.")
+        reg.gauge("dispatch.forward_depth").set(
+            float(self.dispatcher.forward_depth_max))
+        if fan:
+            mgr.track_metric("dispatch.forward_depth",
+                             float(self.dispatcher.forward_depth_max),
+                             {"silo": self.name})
+        self.dispatcher.forward_depth_max = 0
         # tracing/timeline plane: span commit volume, sampled traces,
         # the timeline backlog, and the worst estimated peer clock
         # offset.  The offset gauge keeps the -1 no-data sentinel from
@@ -1399,6 +1440,10 @@ class Silo:
             # (the ring removal above already re-homed it onto us)
             asyncio.ensure_future(self._promote_standby(addr))
         self.grain_directory.on_silo_dead(addr)
+        # fail the fabric's still-ringed sends to the corpse FIRST —
+        # their requests become TRANSIENT rejections that re-address via
+        # the (just-healed) ring, no caller waits out its deadline
+        self.rpc_fabric.fail_destination(addr, "silo declared dead")
         self.runtime_client.break_outstanding_messages_to_dead_silo(addr)
         # a dead silo's breaker is moot (its traffic re-addresses; a
         # replacement incarnation is a different SiloAddress)
@@ -1414,6 +1459,7 @@ class Silo:
         prune = getattr(self._bound_transport, "prune_dead", None)
         if prune is not None:
             prune(self.active_silos())
+        self.rpc_fabric.prune_dead(set(self.active_silos()))
         if self.load_publisher is not None:
             live = set(self.active_silos())
             for s in list(self.load_publisher.periodic_stats):

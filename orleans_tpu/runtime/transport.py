@@ -64,6 +64,27 @@ class InProcTransport:
             return
         deliver = self._inboxes.get(msg.target_silo)
         sender_silo = self._silos.get(sender)
+        from orleans_tpu.runtime.messaging import is_fabric_message
+        if is_fabric_message(msg):
+            # batched silo→silo fabric carrier: wire fidelity means the
+            # REAL frame encode/decode (the same bytes TCP would ship),
+            # delivered straight into the peer's fabric ingress
+            sender_fabric = getattr(sender_silo, "rpc_fabric", None)
+            target_fabric = getattr(self._silos.get(msg.target_silo),
+                                    "rpc_fabric", None)
+            if deliver is None or target_fabric is None:
+                breakers = getattr(sender_silo, "breakers", None)
+                if breakers is not None:
+                    breakers.record_failure(msg.target_silo, "unreachable")
+                if sender_fabric is not None:
+                    sender_fabric.on_frame_bounce(
+                        msg, f"target silo {msg.target_silo} unreachable")
+                return
+            self.messages_carried += 1
+            payload = b"".join(bytes(s) for s in msg._fabric_segments)
+            asyncio.get_running_loop().call_soon(
+                target_fabric.on_frame_payload, payload)
+            return
         if deliver is None:
             # closed-socket analog: the connection refuses immediately, so
             # requests bounce back as transient rejections — the caller's
@@ -169,8 +190,9 @@ class TcpTransport:
     remaining-TTL and rebased against the receiver's clock.
     """
 
-    MAGIC = 0x4F54       # "OT" — token-stream codec frame
-    MAGIC_SLAB = 0x4F53  # "OS" — zero-copy slab frame (header + raw buffers)
+    MAGIC = 0x4F54        # "OT" — token-stream codec frame
+    MAGIC_SLAB = 0x4F53   # "OS" — zero-copy slab frame (header + raw buffers)
+    MAGIC_FABRIC = 0x4F46  # "OF" — batched silo→silo rpc fabric frame
     MAX_QUEUED_PER_DEST = 10_000  # (reference: queue-length overload limits)
     # byte-aware backpressure: the count limit alone is unbounded memory
     # when the queue holds multi-MB slabs — bound the bytes in flight per
@@ -230,6 +252,13 @@ class TcpTransport:
                     payload = await reader.readexactly(length)
                     self.silo.message_center.deliver_local(
                         self._decode_slab_message(payload))
+                    continue
+                if magic == self.MAGIC_FABRIC:
+                    # batched silo→silo fabric frame: the whole flush
+                    # enters the rpc ingress in one decode (per-call
+                    # TTLs rebase on OUR clock inside the fabric)
+                    payload = await reader.readexactly(length)
+                    self.silo.rpc_fabric.on_frame_payload(payload)
                     continue
                 if magic != self.MAGIC:
                     raise TransportError(f"bad frame magic {magic:#x}")
@@ -307,8 +336,13 @@ class TcpTransport:
     @staticmethod
     def _wire_cost(msg: Message) -> int:
         """Deterministic queue-accounting estimate of a message's wire
-        size — exact (buffer bytes) for slabs, nominal for control
-        frames.  Must return the same value at enqueue and dequeue."""
+        size — exact (buffer bytes) for slabs and fabric frames, nominal
+        for control frames.  Must return the same value at enqueue and
+        dequeue."""
+        from orleans_tpu.runtime.messaging import is_fabric_message
+        if is_fabric_message(msg):
+            return 8 + sum(s.nbytes if isinstance(s, memoryview)
+                           else len(s) for s in msg._fabric_segments)
         if not is_slab_message(msg):
             return TcpTransport.CONTROL_MSG_COST
         import jax
@@ -379,9 +413,23 @@ class TcpTransport:
         Undeliverable RESPONSES are logged (the remote caller's own
         timeout/dead-silo break covers it — reference behavior), never
         dropped without a trace."""
-        from orleans_tpu.runtime.messaging import Direction, RejectionType
+        from orleans_tpu.runtime.messaging import (
+            Direction,
+            RejectionType,
+            is_fabric_message,
+        )
         if self._closing:
             return  # own silo dying: nothing meaningful to bounce into
+        if is_fabric_message(msg):
+            # a bounced frame fails every member individually: requests
+            # become TRANSIENT rejections NOW (resend machinery
+            # re-addresses under its hop/retry budget — no caller waits
+            # out its deadline), one-ways/responses dead-letter
+            fabric = getattr(self.silo, "rpc_fabric", None)
+            if fabric is not None:
+                self._link(msg.target_silo)["msgs_bounced"] += 1
+                fabric.on_frame_bounce(msg, reason)
+            return
         router = getattr(self.silo, "vector_router", None)
         if (is_slab_message(msg) and router is not None
                 and hasattr(router, "reinject_bounced")):
@@ -445,6 +493,16 @@ class TcpTransport:
         included), or None if it was degraded/bounced locally."""
         import dataclasses
         import time
+
+        from orleans_tpu.runtime.messaging import is_fabric_message
+        if is_fabric_message(msg):
+            # pre-encoded by RpcFabric (per-call TTLs already remaining-
+            # time at encode); ship the segments verbatim — zero copy
+            segs = msg._fabric_segments
+            total = sum(s.nbytes if isinstance(s, memoryview) else len(s)
+                        for s in segs)
+            return [struct.pack("<II", self.MAGIC_FABRIC, total)] \
+                + list(segs)
         if is_slab_message(msg):
             try:
                 parts = self._encode_slab_segments(msg)

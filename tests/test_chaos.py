@@ -392,7 +392,7 @@ def test_chaos_storage_flake_surfaces_and_recovers(run):
 def test_chaos_smoke_plan_reproducible_end_to_end(run):
     """The acceptance scenario: the canonical seeded smoke plan
     (partition → heal → hard-kill) on a 3-silo ChaosCluster passes all
-    eight invariant checkers TWICE with identical fault traces."""
+    nine invariant checkers TWICE with identical fault traces."""
 
     async def main():
         from orleans_tpu.chaos.report import run_smoke
@@ -405,7 +405,8 @@ def test_chaos_smoke_plan_reproducible_end_to_end(run):
                 "membership_convergence", "single_activation",
                 "arena_conservation", "stream_at_least_once",
                 "dead_letter_accounting", "durability_accounting",
-                "migration_storm", "standby_failover"}
+                "migration_storm", "standby_failover",
+                "fabric_midflush_failfast"}
         assert first["trace_signature"] == second["trace_signature"]
         assert len(first["trace_signature"]) >= 5
 

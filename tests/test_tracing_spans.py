@@ -317,10 +317,11 @@ def test_request_context_survives_client_gateway_cross_silo_resend(run):
 def test_cross_silo_trace_spans_reach_both_silos(run):
     """A sampled request through the cluster leaves spans on both the
     sending and executing silo under ONE trace id.  The sampled call
-    RIDES the batched fastpath (it no longer falls back): the sending
-    silo records its window-link hop, and the remote-activation
-    fallback carries the same trace across silos to the turn and
-    queue-wait hops."""
+    RIDES the batched planes end to end (it no longer falls back): the
+    trace crosses the silo→silo fabric as a frame column and BOTH silos
+    record their window-link hops.  A request carrying a rich ambient
+    context keeps the per-message pipeline and still reaches the
+    executing silo's turn and queue-wait hops under its trace."""
 
     async def main():
         def cfg(name):
@@ -342,6 +343,21 @@ def test_cross_silo_trace_spans_reach_both_silos(run):
             kinds1 = {s.kind for s in cluster.silos[1].spans.flight.spans
                       if s.trace_id == tid}
             assert "rpc.window.link" in kinds0
+            assert "rpc.window.link" in kinds1
+
+            # a rich ambient context pins the per-message pipeline: the
+            # same trace id reaches the executing silo's turn and
+            # queue-wait hops through the envelope
+            RequestContext.set("k", "v")
+            RequestContext.set(TRACE_KEY, {"trace_id": "pm-tid",
+                                           "span_id": "", "sampled": True})
+            try:
+                got = await f0.get_grain(ICtxEcho, key).who()
+            finally:
+                RequestContext.clear()
+            assert got["trace_id"] == "pm-tid" and got["k"] == "v"
+            kinds1 = {s.kind for s in cluster.silos[1].spans.flight.spans
+                      if s.trace_id == "pm-tid"}
             assert "activation.turn" in kinds1
             assert "dispatch.queue" in kinds1
         finally:
